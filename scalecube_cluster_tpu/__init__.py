@@ -1,0 +1,29 @@
+"""scalecube_cluster_tpu — a TPU-native SWIM membership framework.
+
+A from-scratch reimplementation of the capabilities of ScaleCube Cluster
+(reference: /root/reference, Java/Reactor/Netty) as a batched simulation
+engine on TPU: per-node protocol state lives in sharded ``[N, ...]`` JAX
+arrays, message delivery is a dense inbox-tensor exchange, and the whole
+SWIM tick (random-probe failure detection, infection-style gossip,
+suspicion timeouts with incarnation refutation, SYNC anti-entropy) runs
+as one ``jax.lax.scan`` over protocol rounds under pjit/shard_map.
+
+Layout (mirrors SURVEY.md §7):
+  - ``records``    core record/merge semantics (MembershipRecord.isOverrides)
+  - ``swim_math``  the analytic SWIM/gossip model (ClusterMath port)
+  - ``config``     ClusterConfig with LAN/WAN/LOCAL presets
+  - ``oracle``     event-driven small-N simulator (behavioral oracle,
+                   stands in for the reference's in-JVM multi-node harness)
+  - ``models``     the TPU tick functions (fd-only, gossip-only, full SWIM)
+  - ``ops``        dense delivery / merge kernels (MXU matmul delivery)
+  - ``parallel``   mesh + sharding layer (row-sharded N over devices)
+  - ``utils``      PRNG, metrics, checkpointing
+"""
+
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.records import MemberStatus
+from scalecube_cluster_tpu import swim_math
+
+__version__ = "0.1.0"
+
+__all__ = ["ClusterConfig", "MemberStatus", "swim_math", "__version__"]

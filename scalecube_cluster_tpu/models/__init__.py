@@ -1,0 +1,1 @@
+"""models subpackage of scalecube_cluster_tpu."""

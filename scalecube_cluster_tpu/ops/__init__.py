@@ -1,0 +1,1 @@
+"""ops subpackage of scalecube_cluster_tpu."""

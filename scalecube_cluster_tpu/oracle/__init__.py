@@ -1,0 +1,1 @@
+"""oracle subpackage of scalecube_cluster_tpu."""

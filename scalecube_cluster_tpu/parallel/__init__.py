@@ -1,0 +1,1 @@
+"""parallel subpackage of scalecube_cluster_tpu."""

@@ -1,0 +1,207 @@
+"""Core membership-record semantics: status codes and the merge rule.
+
+This pins the SWIM merge semantics of the reference's
+``MembershipRecord.isOverrides`` (reference:
+cluster/src/main/java/io/scalecube/cluster/membership/MembershipRecord.java:66-84)
+as pure functions, in two forms:
+
+  - scalar Python (used by the event-driven oracle in ``oracle/``),
+  - vectorized JAX/numpy (used inside the TPU tick in ``models/``).
+
+The truth table of the reference's ``MembershipRecordTest`` is ported
+verbatim in ``tests/test_records.py`` and must hold for both forms.
+
+Status encoding
+---------------
+The reference stores records in a ``Map<id, MembershipRecord>`` where a
+missing key means "unknown member" and DEAD records are *removed* from the
+table on acceptance (MembershipProtocolImpl.java:512-513).  The dense
+``[N, N]`` table therefore needs a fourth code for "no record":
+
+  ALIVE=0, SUSPECT=1, DEAD=2 match the reference enum order
+  (membership/MemberStatus.java:3-16); ABSENT=3 encodes the null record.
+
+A *table* only ever holds ALIVE/SUSPECT/ABSENT; DEAD exists transiently in
+messages (and maps to ABSENT on acceptance).  ``is_overrides`` handles all
+four codes so the same function gates both message merges and SYNC row
+merges.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+class MemberStatus(enum.IntEnum):
+    """Member liveness status (reference: membership/MemberStatus.java:3-16).
+
+    ABSENT is this implementation's encoding of "no record in the table"
+    (the reference's ``null``); it never appears on the wire.
+    """
+
+    ALIVE = 0
+    SUSPECT = 1
+    DEAD = 2
+    ABSENT = 3
+
+
+ALIVE = int(MemberStatus.ALIVE)
+SUSPECT = int(MemberStatus.SUSPECT)
+DEAD = int(MemberStatus.DEAD)
+ABSENT = int(MemberStatus.ABSENT)
+
+
+def is_overrides(new_status: int, new_inc: int, old_status: int, old_inc: int) -> bool:
+    """Does record (new_status, new_inc) override table entry (old_status, old_inc)?
+
+    Exact port of MembershipRecord.isOverrides (MembershipRecord.java:66-84):
+
+      1. no existing record (ABSENT) -> accept only ALIVE;
+      2. existing DEAD              -> nothing overrides;
+      3. new DEAD                   -> always overrides;
+      4. equal incarnation          -> only SUSPECT beats ALIVE;
+      5. otherwise                  -> higher incarnation wins.
+    """
+    if old_status == ABSENT:
+        return new_status == ALIVE
+    if old_status == DEAD:
+        return False
+    if new_status == DEAD:
+        return True
+    if new_status == ABSENT:
+        return False
+    if new_inc == old_inc:
+        return new_status != old_status and new_status == SUSPECT
+    return new_inc > old_inc
+
+
+def is_overrides_array(new_status, new_inc, old_status, old_inc):
+    """Vectorized ``is_overrides`` over arrays of status/incarnation codes.
+
+    Branch-free formulation of MembershipRecord.java:66-84 — all five rules
+    composed with ``where``-style selects so it lowers to elementwise VPU ops
+    under jit.  Works on any broadcastable shapes.
+    """
+    new_status = jnp.asarray(new_status)
+    old_status = jnp.asarray(old_status)
+    new_inc = jnp.asarray(new_inc)
+    old_inc = jnp.asarray(old_inc)
+
+    # Rule 4/5: live-vs-live comparison.
+    equal_inc = new_inc == old_inc
+    suspect_beats_alive = (new_status != old_status) & (new_status == SUSPECT)
+    live_wins = jnp.where(equal_inc, suspect_beats_alive, new_inc > old_inc)
+
+    result = live_wins
+    # Rule 3: new DEAD always overrides a live record.
+    result = jnp.where(new_status == DEAD, True, result)
+    # New ABSENT is not a record; it never overrides.
+    result = jnp.where(new_status == ABSENT, False, result)
+    # Rule 2: existing DEAD is terminal.
+    result = jnp.where(old_status == DEAD, False, result)
+    # Rule 1: no existing record -> accept only ALIVE.
+    result = jnp.where(old_status == ABSENT, new_status == ALIVE, result)
+    return result
+
+
+def merge_key(status, inc):
+    """Total-order key for folding many inbound records about one subject.
+
+    Within one simulation round a node can receive several records about the
+    same subject (FD verdict, gossip, SYNC).  The reference serializes them
+    through one scheduler thread in arrival order
+    (MembershipProtocolImpl.java:475-541); arrival order is arbitrary, so any
+    deterministic serialization is a faithful schedule.  We pick the one
+    induced by this key: the fold keeps the record with the largest
+
+        key = (is_dead << 30) | (min(incarnation, 2^29 - 1) << 1) | is_suspect
+
+    i.e. DEAD absorbs everything (rule 3), then higher incarnation wins
+    (rule 5), then SUSPECT beats ALIVE at equal incarnation (rule 4).  This
+    max is associative/commutative, so a segment/matmul reduce over inbound
+    records is schedule-deterministic.  ABSENT maps to key -1 (never wins).
+
+    The incarnation field saturates at 2^29 - 1 so the DEAD flag can never
+    be overtaken in int32 (incarnations only grow by refutation bumps, so
+    half a billion is unreachable in any realistic run; saturation degrades
+    the order among such records instead of silently corrupting rule 3).
+    """
+    status = jnp.asarray(status)
+    inc = jnp.asarray(inc, dtype=jnp.int32)
+    is_dead = (status == DEAD).astype(jnp.int32)
+    is_suspect = (status == SUSPECT).astype(jnp.int32)
+    # int32 layout: bit 30 = dead flag, bits 1..29 = incarnation, bit 0 = suspect.
+    inc_sat = jnp.minimum(inc, jnp.int32(2**29 - 1))
+    key = (is_dead << 30) | (inc_sat << 1) | is_suspect
+    return jnp.where(status == ABSENT, jnp.int32(-1), key)
+
+
+def apply_record(old_status, old_inc, new_status, new_inc):
+    """Merge one inbound record into a table entry; returns (status, inc).
+
+    The acceptance gate is ``is_overrides_array``; on acceptance a DEAD
+    record *removes* the entry (becomes ABSENT), matching
+    MembershipProtocolImpl.java:512-516 where accepted DEAD records are
+    deleted from the membership table rather than stored.
+    """
+    accept = is_overrides_array(new_status, new_inc, old_status, old_inc)
+    stored_status = jnp.where(new_status == DEAD, ABSENT, new_status)
+    status = jnp.where(accept, stored_status, old_status)
+    inc = jnp.where(accept, new_inc, old_inc)
+    return status.astype(jnp.int8), inc.astype(jnp.int32)
+
+
+def fold_records(statuses, incs, axis: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Reduce many records about the same subject to the schedule winner.
+
+    ``statuses``/``incs`` have a fold axis of candidate records; returns the
+    (status, inc) with the maximal ``merge_key`` along that axis.  Use with
+    ABSENT padding for "no message".
+    """
+    keys = merge_key(statuses, incs)
+    idx = jnp.argmax(keys, axis=axis)
+    win_status = jnp.take_along_axis(
+        jnp.asarray(statuses), jnp.expand_dims(idx, axis), axis=axis
+    ).squeeze(axis)
+    win_inc = jnp.take_along_axis(
+        jnp.asarray(incs), jnp.expand_dims(idx, axis), axis=axis
+    ).squeeze(axis)
+    return win_status, win_inc
+
+
+def merge_inbound(entry_status, entry_inc, statuses, incs, axis: int):
+    """Merge a round's worth of inbound records into a table entry.
+
+    Equivalent to *one valid arrival-order serialization* of the reference's
+    per-message ``updateMembership`` loop (MembershipProtocolImpl.java:475-541)
+    — specifically: for an ABSENT entry, the best ALIVE record is applied
+    first (only ALIVE opens the null gate, MembershipRecord.java:67-69), then
+    the remaining records in ascending ``merge_key`` order, ending with the
+    global winner.  Because post-gate application is monotone in the key,
+    that whole suffix collapses to applying just the winner.
+
+    Returns the merged (status int8, inc int32), reduced over ``axis``.
+    """
+    entry_status = jnp.asarray(entry_status)
+    entry_inc = jnp.asarray(entry_inc)
+    statuses = jnp.asarray(statuses)
+    incs = jnp.asarray(incs)
+
+    win_status, win_inc = fold_records(statuses, incs, axis)
+
+    # Best ALIVE record (for opening the null gate on ABSENT entries).
+    alive_keys = jnp.where(statuses == ALIVE, merge_key(statuses, incs), jnp.int32(-1))
+    alive_idx = jnp.argmax(alive_keys, axis=axis)
+    any_alive = jnp.max(alive_keys, axis=axis) >= 0
+    best_alive_inc = jnp.take_along_axis(
+        incs, jnp.expand_dims(alive_idx, axis), axis=axis
+    ).squeeze(axis)
+
+    open_gate = (entry_status == ABSENT) & any_alive
+    gate_status = jnp.where(open_gate, ALIVE, entry_status)
+    gate_inc = jnp.where(open_gate, best_alive_inc, entry_inc)
+
+    return apply_record(gate_status, gate_inc, win_status, win_inc)

@@ -1,0 +1,88 @@
+"""Closed-form SWIM/gossip analytic model.
+
+Functional port of the reference's ``ClusterMath``
+(cluster/src/main/java/io/scalecube/cluster/ClusterMath.java:8-136) — the
+"published" performance model of the reference, used there both by the
+runtime (suspicion timeout, gossip spread/sweep periods) and by tests as
+the measurement oracle.  This repo uses it the same two ways: the TPU tick
+derives its round budgets from it, and the validation suite checks measured
+dissemination/convergence curves against it (BASELINE.md targets: within 5%).
+
+All functions are pure Python on ints/floats; ``ceil_log2_jnp`` is the
+traceable variant for use inside jitted code.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ceil_log2(num: int) -> int:
+    """``32 - numberOfLeadingZeros(num)`` == ``ceil(log2(num + 1))``.
+
+    Reference: ClusterMath.java:133-135.  Examples: 0->0, 1->1, 2->2, 3->2,
+    4->3, 50->6, 1000->10.
+    """
+    if num < 0:
+        raise ValueError("num must be non-negative")
+    return int(num).bit_length()
+
+
+def ceil_log2_jnp(num):
+    """Traceable ``ceil_log2`` for int32 arrays (uses count-leading-zeros)."""
+    return 32 - jax.lax.clz(jnp.asarray(num, dtype=jnp.int32))
+
+
+def gossip_convergence_probability(
+    fanout: int, repeat_mult: int, cluster_size: int, loss: float
+) -> float:
+    """P(gossip reaches everyone) — ClusterMath.java:38-43.
+
+    ``loss`` is a probability in [0, 1].
+    """
+    fanout_with_loss = (1.0 - loss) * fanout
+    spread_size = cluster_size - cluster_size ** -(fanout_with_loss * repeat_mult - 2)
+    return spread_size / cluster_size
+
+
+def gossip_convergence_percent(
+    fanout: int, repeat_mult: int, cluster_size: int, loss_percent: float
+) -> float:
+    """Convergence probability in percent — ClusterMath.java:23-28."""
+    return gossip_convergence_probability(fanout, repeat_mult, cluster_size, loss_percent / 100.0) * 100.0
+
+
+def max_messages_per_gossip_per_node(fanout: int, repeat_mult: int, cluster_size: int) -> int:
+    """``fanout * repeatMult * ceilLog2(n)`` — ClusterMath.java:65-67."""
+    return fanout * repeat_mult * ceil_log2(cluster_size)
+
+
+def max_messages_per_gossip_total(fanout: int, repeat_mult: int, cluster_size: int) -> int:
+    """``n * perNode`` — ClusterMath.java:53-55."""
+    return cluster_size * max_messages_per_gossip_per_node(fanout, repeat_mult, cluster_size)
+
+
+def gossip_periods_to_spread(repeat_mult: int, cluster_size: int) -> int:
+    """How many gossip periods a node retransmits a gossip — ClusterMath.java:111-113."""
+    return repeat_mult * ceil_log2(cluster_size)
+
+
+def gossip_periods_to_sweep(repeat_mult: int, cluster_size: int) -> int:
+    """Periods after which a gossip is garbage-collected — ClusterMath.java:99-103."""
+    return 2 * (gossip_periods_to_spread(repeat_mult, cluster_size) + 1)
+
+
+def gossip_dissemination_time(repeat_mult: int, cluster_size: int, gossip_interval_ms: int) -> int:
+    """Spread periods x interval, in ms — ClusterMath.java:77-79."""
+    return gossip_periods_to_spread(repeat_mult, cluster_size) * gossip_interval_ms
+
+
+def gossip_timeout_to_sweep(repeat_mult: int, cluster_size: int, gossip_interval_ms: int) -> int:
+    """Sweep periods x interval, in ms — ClusterMath.java:86-90."""
+    return gossip_periods_to_sweep(repeat_mult, cluster_size) * gossip_interval_ms
+
+
+def suspicion_timeout(suspicion_mult: int, cluster_size: int, ping_interval_ms: int) -> int:
+    """``suspicionMult * ceilLog2(n) * pingInterval`` — ClusterMath.java:123-125."""
+    return suspicion_mult * ceil_log2(cluster_size) * ping_interval_ms

@@ -1,0 +1,1 @@
+"""utils subpackage of scalecube_cluster_tpu."""

@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax import.
+
+Mirrors the reference's test trick of simulating "multi-node" inside one
+process (SURVEY.md §4): there, n in-JVM transports on loopback; here, a
+virtual 8-device CPU mesh so sharding/collective code paths run without TPU
+hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,65 @@
+"""Analytic-model checks against hand-computed values from the reference
+(ClusterMath.java; defaults from ClusterConfig.java:26-57)."""
+
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu import swim_math
+
+
+@pytest.mark.parametrize(
+    "n,expected",
+    [(0, 0), (1, 1), (2, 2), (3, 2), (4, 3), (7, 3), (8, 4), (50, 6), (1000, 10), (10**6, 20)],
+)
+def test_ceil_log2(n, expected):
+    assert swim_math.ceil_log2(n) == expected
+    assert int(swim_math.ceil_log2_jnp(n)) == expected
+
+
+def test_gossip_periods_and_time_lan_defaults():
+    # n=50, repeatMult=3, interval=200ms -> 18 periods, 3.6s (SURVEY.md §6).
+    assert swim_math.gossip_periods_to_spread(3, 50) == 18
+    assert swim_math.gossip_dissemination_time(3, 50, 200) == 3600
+    assert swim_math.gossip_periods_to_sweep(3, 50) == 38
+    assert swim_math.gossip_timeout_to_sweep(3, 50, 200) == 7600
+
+
+def test_max_messages():
+    # n=50 LAN defaults: 3*3*6 = 54 per node (SURVEY.md §6).
+    assert swim_math.max_messages_per_gossip_per_node(3, 3, 50) == 54
+    assert swim_math.max_messages_per_gossip_total(3, 3, 50) == 50 * 54
+
+
+def test_suspicion_timeout():
+    # n=1000 LAN defaults: 5*10*1000 = 50s (SURVEY.md §6).
+    assert swim_math.suspicion_timeout(5, 1000, 1000) == 50_000
+
+
+def test_convergence_probability_formula():
+    # Direct formula check: n - n^-(F(1-loss)R - 2), normalized.
+    n, fanout, repeat, loss = 50, 3, 3, 0.25
+    expected = (n - n ** -((1 - loss) * fanout * repeat - 2)) / n
+    assert swim_math.gossip_convergence_probability(fanout, repeat, n, loss) == pytest.approx(expected)
+    assert swim_math.gossip_convergence_percent(fanout, repeat, n, 25.0) == pytest.approx(expected * 100)
+    # Lossless LAN defaults converge with overwhelming probability.
+    assert swim_math.gossip_convergence_probability(3, 3, 50, 0.0) > 0.999999
+
+
+def test_config_presets_and_quantization():
+    from scalecube_cluster_tpu.config import ClusterConfig
+
+    lan = ClusterConfig.default()
+    assert (lan.ping_interval, lan.ping_timeout, lan.gossip_fanout) == (1000, 500, 3)
+    wan = ClusterConfig.default_wan()
+    assert (wan.suspicion_mult, wan.sync_interval, wan.gossip_fanout) == (6, 60_000, 4)
+    local = ClusterConfig.default_local()
+    assert (local.gossip_interval, local.ping_req_members, local.gossip_repeat_mult) == (100, 1, 2)
+
+    with pytest.raises(ValueError):
+        ClusterConfig(ping_timeout=1000, ping_interval=1000)
+
+    sim = lan.to_sim(cluster_size=50)
+    assert sim.ping_every == 5          # 1000ms / 200ms
+    assert sim.sync_every == 150        # 30s / 200ms
+    assert sim.periods_to_spread == 18
+    assert sim.suspicion_rounds == 150  # 5*6*1000ms / 200ms

@@ -1,0 +1,159 @@
+"""Merge-rule truth table, ported from the reference's MembershipRecordTest
+(cluster/src/test/java/io/scalecube/cluster/membership/MembershipRecordTest.java:34-108).
+
+This table pins the SWIM merge semantics; both the scalar (oracle) and the
+vectorized (TPU) forms must satisfy it bit-exactly.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu import records
+from scalecube_cluster_tpu.records import ABSENT, ALIVE, DEAD, SUSPECT
+
+
+def both(new_s, new_i, old_s, old_i):
+    """Evaluate scalar and vectorized is_overrides; assert they agree."""
+    scalar = records.is_overrides(new_s, new_i, old_s, old_i)
+    vec = bool(records.is_overrides_array(new_s, new_i, old_s, old_i))
+    assert scalar == vec, (
+        f"scalar/vector divergence for new=({new_s},{new_i}) old=({old_s},{old_i}): "
+        f"{scalar} vs {vec}"
+    )
+    return scalar
+
+
+class TestDeadOverride:
+    """MembershipRecordTest.testDeadOverride:47-65."""
+
+    def test_dead_vs_null(self):
+        assert not both(DEAD, 1, ABSENT, 0)
+
+    @pytest.mark.parametrize("old_inc", [0, 1, 2])
+    def test_dead_vs_alive(self, old_inc):
+        assert both(DEAD, 1, ALIVE, old_inc)
+
+    @pytest.mark.parametrize("old_inc", [0, 1, 2])
+    def test_dead_vs_suspect(self, old_inc):
+        assert both(DEAD, 1, SUSPECT, old_inc)
+
+    @pytest.mark.parametrize("old_inc", [0, 1, 2])
+    def test_dead_vs_dead(self, old_inc):
+        assert not both(DEAD, 1, DEAD, old_inc)
+
+
+class TestAliveOverride:
+    """MembershipRecordTest.testAliveOverride:67-86."""
+
+    def test_alive_vs_null(self):
+        assert both(ALIVE, 1, ABSENT, 0)
+
+    @pytest.mark.parametrize("old_inc,expected", [(0, True), (1, False), (2, False)])
+    def test_alive_vs_alive(self, old_inc, expected):
+        assert both(ALIVE, 1, ALIVE, old_inc) == expected
+
+    @pytest.mark.parametrize("old_inc,expected", [(0, True), (1, False), (2, False)])
+    def test_alive_vs_suspect(self, old_inc, expected):
+        assert both(ALIVE, 1, SUSPECT, old_inc) == expected
+
+    @pytest.mark.parametrize("old_inc", [0, 1, 2])
+    def test_alive_vs_dead(self, old_inc):
+        assert not both(ALIVE, 1, DEAD, old_inc)
+
+
+class TestSuspectOverride:
+    """MembershipRecordTest.testSuspectOverride:88-107."""
+
+    def test_suspect_vs_null(self):
+        assert not both(SUSPECT, 1, ABSENT, 0)
+
+    @pytest.mark.parametrize("old_inc,expected", [(0, True), (1, True), (2, False)])
+    def test_suspect_vs_alive(self, old_inc, expected):
+        assert both(SUSPECT, 1, ALIVE, old_inc) == expected
+
+    @pytest.mark.parametrize("old_inc,expected", [(0, True), (1, False), (2, False)])
+    def test_suspect_vs_suspect(self, old_inc, expected):
+        assert both(SUSPECT, 1, SUSPECT, old_inc) == expected
+
+    @pytest.mark.parametrize("old_inc", [0, 1, 2])
+    def test_suspect_vs_dead(self, old_inc):
+        assert not both(SUSPECT, 1, DEAD, old_inc)
+
+
+def test_equal_record_not_overriding():
+    """MembershipRecordTest.testEqualRecordNotOverriding:104-108."""
+    for status in (ALIVE, SUSPECT, DEAD):
+        assert not both(status, 1, status, 1)
+
+
+def test_vectorized_matches_scalar_exhaustively():
+    """Full cross product: statuses x incarnations 0..3, batched evaluation."""
+    statuses = [ALIVE, SUSPECT, DEAD, ABSENT]
+    incs = [0, 1, 2, 3]
+    cases = list(itertools.product(statuses, incs, statuses, incs))
+    new_s = np.array([c[0] for c in cases])
+    new_i = np.array([c[1] for c in cases])
+    old_s = np.array([c[2] for c in cases])
+    old_i = np.array([c[3] for c in cases])
+    vec = np.asarray(records.is_overrides_array(new_s, new_i, old_s, old_i))
+    scalar = np.array([records.is_overrides(*c[:2], *c[2:]) for c in cases])
+    np.testing.assert_array_equal(vec, scalar)
+
+
+def test_apply_record_dead_removes_entry():
+    """Accepted DEAD deletes the table entry (MembershipProtocolImpl.java:512-516)."""
+    s, i = records.apply_record(ALIVE, 3, DEAD, 1)
+    assert int(s) == ABSENT
+    s, i = records.apply_record(SUSPECT, 0, DEAD, 0)
+    assert int(s) == ABSENT
+    # ...and a later ALIVE at any incarnation is accepted again (rejoin).
+    s2, i2 = records.apply_record(s, i, ALIVE, 0)
+    assert int(s2) == ALIVE and int(i2) == 0
+
+
+def test_merge_inbound_is_a_valid_serialization():
+    """``merge_inbound`` must equal sequential ``updateMembership`` application
+    under SOME arrival order — the reference delivers same-round messages in
+    arbitrary order, so any permutation's outcome is a faithful schedule
+    (SURVEY.md §7 'incarnation races').  Exhaustive over permutations."""
+    import itertools as it
+
+    rng = np.random.RandomState(42)
+    for trial in range(300):
+        k = rng.randint(1, 5)
+        statuses = rng.choice([ALIVE, SUSPECT, DEAD, ABSENT], size=k)
+        incs = rng.randint(0, 4, size=k)
+        entry_s = int(rng.choice([ALIVE, SUSPECT, ABSENT]))
+        entry_i = int(rng.randint(0, 4))
+        got_s, got_i = records.merge_inbound(entry_s, entry_i, statuses, incs, axis=0)
+        got = (int(got_s), int(got_i))
+
+        def apply_scalar(s0, i0, s1, i1):
+            if not records.is_overrides(s1, i1, s0, i0):
+                return s0, i0
+            return (ABSENT, i1) if s1 == DEAD else (s1, i1)
+
+        outcomes = set()
+        for perm in it.permutations(range(k)):
+            seq_s, seq_i = entry_s, entry_i
+            for j in perm:
+                if statuses[j] == ABSENT:
+                    continue  # ABSENT is padding, not a record
+                seq_s, seq_i = apply_scalar(seq_s, seq_i, int(statuses[j]), int(incs[j]))
+            outcomes.add((seq_s, seq_i))
+        assert got in outcomes, (
+            f"trial {trial}: merge_inbound={got} not among valid serializations "
+            f"{outcomes} for entry=({entry_s},{entry_i}) records="
+            f"{list(zip(statuses.tolist(), incs.tolist()))}"
+        )
+
+
+def test_merge_key_ordering():
+    """DEAD absorbs; then incarnation; then SUSPECT > ALIVE; ABSENT never wins."""
+    key = lambda s, i: int(records.merge_key(s, i))
+    assert key(DEAD, 0) > key(SUSPECT, 100)
+    assert key(SUSPECT, 2) > key(ALIVE, 1) > key(SUSPECT, 0) > key(ALIVE, 0)
+    assert key(SUSPECT, 1) > key(ALIVE, 1)
+    assert key(ABSENT, 100) < key(ALIVE, 0)
