@@ -1,1 +1,58 @@
-"""oracle subpackage of scalecube_cluster_tpu."""
+"""Event-driven small-N oracle simulator.
+
+The behavioral reference implementation of the framework: faithful per-node
+protocol objects (transport, failure detector, gossip, membership, metadata,
+cluster facade) driven by a seeded discrete-event loop with virtual time.
+It stands in for the reference's in-JVM multi-node test harness
+(SURVEY.md §4) and is the cross-check target for the dense TPU tick in
+``models/`` (SURVEY.md §7 step 2).
+"""
+
+from scalecube_cluster_tpu.oracle.core import (
+    Address,
+    CorrelationIdGenerator,
+    Member,
+    SimFuture,
+    Simulator,
+    TimeoutError_,
+)
+from scalecube_cluster_tpu.oracle.transport import (
+    Message,
+    NetworkEmulator,
+    NetworkLinkSettings,
+    Transport,
+)
+from scalecube_cluster_tpu.oracle.fdetector import FailureDetector, FailureDetectorEvent
+from scalecube_cluster_tpu.oracle.gossip import GossipProtocol
+from scalecube_cluster_tpu.oracle.membership import (
+    MembershipEvent,
+    MembershipProtocol,
+    MembershipRecord,
+    SyncData,
+)
+from scalecube_cluster_tpu.oracle.metadata import MetadataStore
+from scalecube_cluster_tpu.oracle.cluster import SYSTEM_GOSSIPS, SYSTEM_MESSAGES, Cluster
+
+__all__ = [
+    "Address",
+    "Cluster",
+    "CorrelationIdGenerator",
+    "FailureDetector",
+    "FailureDetectorEvent",
+    "GossipProtocol",
+    "Member",
+    "MembershipEvent",
+    "MembershipProtocol",
+    "MembershipRecord",
+    "Message",
+    "MetadataStore",
+    "NetworkEmulator",
+    "NetworkLinkSettings",
+    "Simulator",
+    "SimFuture",
+    "SyncData",
+    "SYSTEM_GOSSIPS",
+    "SYSTEM_MESSAGES",
+    "TimeoutError_",
+    "Transport",
+]

@@ -1,0 +1,232 @@
+"""The Cluster facade: join/leave, messaging, gossip, metadata, events.
+
+Mirror of the reference's public API surface
+(cluster/src/main/java/io/scalecube/cluster/Cluster.java:16-271 and
+ClusterImpl.java:85-155 ``join0`` wiring): one call constructs and wires
+transport + failure detector + gossip + metadata + membership, starts them
+in the reference's order, and exposes the user-facing operations with
+system messages filtered out of ``listen``/``listen_gossips``
+(ClusterImpl.java:44-58, 202-216).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.oracle.core import (
+    Address,
+    CorrelationIdGenerator,
+    Member,
+    SimFuture,
+    Simulator,
+    generate_member_id,
+)
+from scalecube_cluster_tpu.oracle import fdetector as fd_mod
+from scalecube_cluster_tpu.oracle import gossip as gossip_mod
+from scalecube_cluster_tpu.oracle import membership as mem_mod
+from scalecube_cluster_tpu.oracle import metadata as meta_mod
+from scalecube_cluster_tpu.oracle.fdetector import FailureDetector
+from scalecube_cluster_tpu.oracle.gossip import GossipProtocol
+from scalecube_cluster_tpu.oracle.membership import MembershipEvent, MembershipProtocol
+from scalecube_cluster_tpu.oracle.metadata import MetadataStore
+from scalecube_cluster_tpu.oracle.transport import Message, NetworkEmulator, Transport
+
+# System qualifiers hidden from user listen() (ClusterImpl.java:44-58).
+SYSTEM_MESSAGES = frozenset(
+    {
+        fd_mod.PING,
+        fd_mod.PING_REQ,
+        fd_mod.PING_ACK,
+        mem_mod.SYNC,
+        mem_mod.SYNC_ACK,
+        gossip_mod.GOSSIP_REQ,
+        meta_mod.GET_METADATA_REQ,
+        meta_mod.GET_METADATA_RESP,
+    }
+)
+SYSTEM_GOSSIPS = frozenset({mem_mod.MEMBERSHIP_GOSSIP})
+
+
+class Cluster:
+    """One simulated cluster member with the full protocol stack.
+
+    Usage mirrors the reference facade::
+
+        sim = Simulator(seed=1)
+        alice = Cluster.join(sim)                     # seedless bootstrap
+        bob = Cluster.join(sim, seeds=[alice.address])
+        sim.run_for(2_000)                            # virtual ms
+        assert bob.other_members() == [alice.member()]
+    """
+
+    def __init__(self, sim: Simulator, config: ClusterConfig, alias: Optional[str] = None):
+        self.sim = sim
+        self.config = config
+        self.transport = Transport(
+            sim,
+            address=None if config.port == 0 else Address("localhost", config.port),
+        )
+        member_id = generate_member_id(sim.rng) if alias is None else alias
+        self.local_member = Member(member_id, self.transport.address)
+        cid_generator = CorrelationIdGenerator(member_id)
+
+        # Component construction + wiring (ClusterImpl.join0, :85-155).
+        self.failure_detector = FailureDetector(
+            self.local_member, self.transport, config, sim, cid_generator
+        )
+        self.gossip = GossipProtocol(self.local_member, self.transport, config, sim)
+        self.metadata_store = MetadataStore(
+            self.local_member, self.transport, config.metadata_dict(), config, sim, cid_generator
+        )
+        self.membership = MembershipProtocol(
+            self.local_member,
+            self.transport,
+            self.failure_detector,
+            self.gossip,
+            self.metadata_store,
+            config,
+            sim,
+            cid_generator,
+        )
+        # Membership events feed FD's and gossip's peer lists
+        # (ClusterImpl.java:103-118).
+        self.membership.listen(self.failure_detector.on_member_event)
+        self.membership.listen(self.gossip.on_member_event)
+
+        self._shutdown = False
+        self.on_joined: SimFuture = SimFuture()
+
+    # -- join --------------------------------------------------------------
+
+    @staticmethod
+    def join(
+        sim: Simulator,
+        seeds: Optional[List[Address]] = None,
+        config: Optional[ClusterConfig] = None,
+        metadata: Optional[Dict[str, str]] = None,
+        alias: Optional[str] = None,
+    ) -> "Cluster":
+        """Construct, wire, and start a member (Cluster.java:19-87 factories)."""
+        config = config or ClusterConfig.default_local()
+        if seeds is not None:
+            config = config.replace(seed_members=tuple(str(a) for a in seeds))
+        if metadata is not None:
+            config = config.replace(metadata=tuple(metadata.items()))
+        cluster = Cluster(sim, config, alias=alias)
+        cluster._start()
+        return cluster
+
+    def _start(self) -> None:
+        # Start order mirrors join0: FD, gossip, metadata serve, membership
+        # initial sync (ClusterImpl.java:139-155).
+        self.failure_detector.start()
+        self.gossip.start()
+        self.metadata_store.start()
+        self.membership.start().subscribe(self.on_joined.resolve, self.on_joined.reject)
+
+    # -- identity / views --------------------------------------------------
+
+    @property
+    def address(self) -> Address:
+        return self.transport.address
+
+    def member(self) -> Member:
+        return self.local_member
+
+    def members(self) -> List[Member]:
+        return self.membership.member_list()
+
+    def other_members(self) -> List[Member]:
+        return self.membership.other_members()
+
+    def member_by_id(self, member_id: str) -> Optional[Member]:
+        return self.membership.member_by_id(member_id)
+
+    def member_by_address(self, address: Address) -> Optional[Member]:
+        return self.membership.member_by_address(address)
+
+    # -- messaging (ClusterImpl.java:180-216) ------------------------------
+
+    def send(self, target, message: Message) -> SimFuture:
+        address = target.address if isinstance(target, Member) else target
+        return self.transport.send(address, message)
+
+    def request_response(self, target, request: Message, timeout_ms: float = 3_000) -> SimFuture:
+        address = target.address if isinstance(target, Member) else target
+        return self.transport.request_response(request, address, timeout_ms)
+
+    def listen(self, handler: Callable[[Message], None]) -> None:
+        """User messages only — system qualifiers filtered (ClusterImpl.java:202-205)."""
+        self.transport.listen(
+            lambda msg: handler(msg) if msg.qualifier not in SYSTEM_MESSAGES else None
+        )
+
+    # -- gossip (ClusterImpl.java:207-216) ---------------------------------
+
+    def spread_gossip(self, message: Message) -> SimFuture:
+        return self.gossip.spread(message)
+
+    def listen_gossips(self, handler: Callable[[Message], None]) -> None:
+        self.gossip.listen(
+            lambda msg: handler(msg) if msg.qualifier not in SYSTEM_GOSSIPS else None
+        )
+
+    # -- metadata (ClusterImpl.java:228-280) -------------------------------
+
+    def metadata(self, member: Optional[Member] = None) -> Optional[Dict[str, str]]:
+        return self.metadata_store.metadata(member)
+
+    def update_metadata(self, metadata: Dict[str, str]) -> SimFuture:
+        """Replace local metadata and bump incarnation so peers re-fetch."""
+        self.metadata_store.update_metadata(metadata)
+        return self.membership.update_incarnation()
+
+    def update_metadata_property(self, key: str, value: str) -> SimFuture:
+        metadata = dict(self.metadata_store.metadata() or {})
+        metadata[key] = value
+        return self.update_metadata(metadata)
+
+    def remove_metadata_property(self, key: str) -> SimFuture:
+        metadata = dict(self.metadata_store.metadata() or {})
+        metadata.pop(key, None)
+        return self.update_metadata(metadata)
+
+    # -- membership events (ClusterImpl.java:283-293) ----------------------
+
+    def listen_membership(self, handler: Callable[[MembershipEvent], None]) -> None:
+        """Prepends synthetic ADDED for already-known members, then live events."""
+        for member in self.other_members():
+            handler(MembershipEvent.added(member, self.metadata(member)))
+        self.membership.listen(handler)
+
+    # -- shutdown (ClusterImpl.java:297-347) -------------------------------
+
+    def shutdown(self) -> SimFuture:
+        """Graceful leave: spread DEAD gossip, wait for its sweep, then stop."""
+        done = SimFuture()
+        if self._shutdown:
+            done.resolve(None)
+            return done
+        self._shutdown = True
+
+        def dispose(_=None):
+            self.metadata_store.stop()
+            self.membership.stop()
+            self.gossip.stop()
+            self.failure_detector.stop()
+            self.transport.stop()
+            done.resolve(None)
+
+        self.membership.leave_cluster().subscribe(dispose, lambda _err: dispose())
+        return done
+
+    @property
+    def is_shutdown(self) -> bool:
+        return self._shutdown
+
+    # -- fault injection (ClusterImpl.java:360-363) ------------------------
+
+    @property
+    def network_emulator(self) -> NetworkEmulator:
+        return self.transport.network_emulator

@@ -1,0 +1,222 @@
+"""Discrete-event simulation core: virtual clock, futures, addresses, members.
+
+The reference runs each node's whole protocol stack on one dedicated Reactor
+scheduler thread with wall-clock timers (ClusterImpl.java:93,
+``Schedulers.newSingle``), which makes tests slow and unseeded-flaky
+(SURVEY.md §4 weaknesses).  The oracle inverts both choices deliberately:
+**virtual time** (a heapq event loop, so simulated minutes cost milliseconds)
+and **one seeded PRNG** (bit-reproducible runs).  Everything else mirrors the
+reference's single-threaded-per-node execution model: callbacks run one at a
+time in deterministic (time, seq) order, so protocol logic needs no locks,
+exactly like the reference's L3 (SURVEY.md §1 concurrency model).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import heapq
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Address:
+    """host:port endpoint identity (reference: transport/Address.java:10-142)."""
+
+    host: str
+    port: int
+
+    @staticmethod
+    def from_string(s: str) -> "Address":
+        host, sep, port = s.rpartition(":")
+        if not sep or not host:
+            raise ValueError(f"can't parse address from string: {s!r}")
+        return Address(host, int(port))
+
+    def __str__(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Member:
+    """Cluster member identity: random id + address (reference: cluster/Member.java)."""
+
+    id: str
+    address: Address
+
+    def __str__(self) -> str:
+        return f"{self.id}@{self.address}"
+
+
+def generate_member_id(rng: random.Random) -> str:
+    """10 random bytes -> MD5 -> hex (reference: membership/IdGenerator.java:21-54)."""
+    raw = bytes(rng.getrandbits(8) for _ in range(10))
+    return hashlib.md5(raw).hexdigest()
+
+
+class CorrelationIdGenerator:
+    """``memberId-counter`` correlation ids (reference: CorrelationIdGenerator.java:6-17)."""
+
+    def __init__(self, prefix: str):
+        self._prefix = prefix
+        self._counter = 0
+
+    def next_cid(self) -> str:
+        self._counter += 1
+        return f"{self._prefix}-{self._counter}"
+
+
+class Timer:
+    """Cancellable scheduled task handle (the oracle's reactor ``Disposable``)."""
+
+    __slots__ = ("cancelled", "fn")
+
+    def __init__(self, fn: Callable[[], None]):
+        self.fn = fn
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+    # reactor-style alias used by protocol code ported from Disposable.dispose()
+    dispose = cancel
+
+    @property
+    def is_disposed(self) -> bool:
+        return self.cancelled
+
+
+class SimFuture:
+    """Single-value async result with success/error callbacks and sim-time timeout.
+
+    Stands in for the reference's ``Mono`` in request-response and spread()
+    plumbing.  Callbacks fire synchronously inside the event loop tick.
+    """
+
+    __slots__ = ("_done", "_value", "_error", "_callbacks")
+
+    def __init__(self):
+        self._done = False
+        self._value = None
+        self._error: Optional[Exception] = None
+        self._callbacks: List[Tuple[Callable, Optional[Callable]]] = []
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    @property
+    def value(self):
+        if not self._done:
+            raise RuntimeError("future not resolved")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    @property
+    def error(self) -> Optional[Exception]:
+        return self._error if self._done else None
+
+    def resolve(self, value=None) -> None:
+        if self._done:
+            return
+        self._done, self._value = True, value
+        for on_ok, _ in self._callbacks:
+            if on_ok is not None:
+                on_ok(value)
+        self._callbacks.clear()
+
+    def reject(self, error: Exception) -> None:
+        if self._done:
+            return
+        self._done, self._error = True, error
+        for _, on_err in self._callbacks:
+            if on_err is not None:
+                on_err(error)
+        self._callbacks.clear()
+
+    def subscribe(self, on_ok: Optional[Callable] = None, on_err: Optional[Callable] = None) -> None:
+        if self._done:
+            if self._error is None:
+                if on_ok is not None:
+                    on_ok(self._value)
+            elif on_err is not None:
+                on_err(self._error)
+            return
+        self._callbacks.append((on_ok, on_err))
+
+
+class TimeoutError_(Exception):
+    """Virtual-time timeout (the oracle's ``java.util.concurrent.TimeoutException``)."""
+
+
+class Simulator:
+    """The event loop: virtual clock + seeded PRNG + transport registry.
+
+    One Simulator hosts many in-process nodes — the oracle analog of the
+    reference's "multi-node is multi-instance in-JVM" test harness
+    (SURVEY.md §4), with the wall clock replaced by ``now`` and every random
+    draw routed through ``rng``.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.now: float = 0.0
+        self.rng = random.Random(seed)
+        self._queue: List[Tuple[float, int, Timer]] = []
+        self._seq = 0
+        # address -> bound transport (set by transport.bind/stop)
+        self.transports: Dict[Address, Any] = {}
+        self._next_ephemeral_port = 40000
+
+    # -- ports -------------------------------------------------------------
+
+    def allocate_port(self) -> int:
+        """Ephemeral port allocation (reference binds port 0, TransportConfig.java:5)."""
+        port = self._next_ephemeral_port
+        self._next_ephemeral_port += 1
+        return port
+
+    # -- scheduling --------------------------------------------------------
+
+    def schedule(self, delay_ms: float, fn: Callable[[], None]) -> Timer:
+        """One-shot task after ``delay_ms`` of virtual time."""
+        timer = Timer(fn)
+        self._seq += 1
+        heapq.heappush(self._queue, (self.now + max(0.0, delay_ms), self._seq, timer))
+        return timer
+
+    def schedule_periodic(self, interval_ms: float, fn: Callable[[], None]) -> Timer:
+        """Fixed-rate periodic task, first run after one interval
+        (matches ``scheduler.schedulePeriodically(fn, interval, interval)``
+        call sites, e.g. FailureDetectorImpl.java:102-107)."""
+        handle = Timer(lambda: None)
+
+        def tick():
+            if handle.cancelled:
+                return
+            fn()
+            if not handle.cancelled:
+                self.schedule(interval_ms, tick)
+
+        self.schedule(interval_ms, tick)
+        return handle
+
+    def timeout_future(self, future: SimFuture, timeout_ms: float) -> SimFuture:
+        """Reject ``future`` with TimeoutError_ after ``timeout_ms`` unless done."""
+        self.schedule(timeout_ms, lambda: future.reject(TimeoutError_(f"timeout {timeout_ms}ms")))
+        return future
+
+    # -- running -----------------------------------------------------------
+
+    def run_until(self, t_ms: float) -> None:
+        """Process events with time <= t_ms; advance the clock to t_ms."""
+        while self._queue and self._queue[0][0] <= t_ms:
+            when, _, timer = heapq.heappop(self._queue)
+            self.now = max(self.now, when)
+            if not timer.cancelled:
+                timer.fn()
+        self.now = max(self.now, t_ms)
+
+    def run_for(self, dt_ms: float) -> None:
+        self.run_until(self.now + dt_ms)

@@ -1,0 +1,225 @@
+"""SWIM random-probe failure detector (oracle form).
+
+Behavior-for-behavior port of the reference
+(cluster/src/main/java/io/scalecube/cluster/fdetector/FailureDetectorImpl.java:28-389):
+periodic direct PING with timeout, k-proxy PING_REQ rescue with the
+remaining-time budget, transit ping/ack relaying, per-period ALIVE/SUSPECT
+verdict events.  All timers and random draws go through the simulator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+from scalecube_cluster_tpu.oracle.core import (
+    CorrelationIdGenerator,
+    Member,
+    SimFuture,
+    Simulator,
+)
+from scalecube_cluster_tpu.oracle.transport import Message, Transport
+from scalecube_cluster_tpu.records import MemberStatus
+
+# Qualifiers (FailureDetectorImpl.java:34-36).
+PING = "sc/fdetector/ping"
+PING_REQ = "sc/fdetector/pingReq"
+PING_ACK = "sc/fdetector/pingAck"
+
+
+@dataclasses.dataclass(frozen=True)
+class PingData:
+    """Ping payload: issuer, target, optional original issuer for transit pings
+    (reference: fdetector/PingData.java:1-50)."""
+
+    from_: Member
+    to: Member
+    original_issuer: Optional[Member] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class FailureDetectorEvent:
+    """Per-period verdict (reference: fdetector/FailureDetectorEvent.java:1-29)."""
+
+    member: Member
+    status: MemberStatus
+
+
+class FailureDetector:
+    """One node's failure detector component."""
+
+    def __init__(
+        self,
+        local_member: Member,
+        transport: Transport,
+        config,  # FailureDetectorConfig view of ClusterConfig
+        sim: Simulator,
+        cid_generator: CorrelationIdGenerator,
+    ):
+        self.local_member = local_member
+        self.transport = transport
+        self.config = config
+        self.sim = sim
+        self.cid_generator = cid_generator
+
+        self.current_period = 0
+        # Shuffled round-robin probe list (FailureDetectorImpl.java:48-49).
+        self.ping_members: List[Member] = []
+        self.ping_member_index = 0
+
+        self._listeners: List[Callable[[FailureDetectorEvent], None]] = []
+        self._stopped = False
+        self._periodic = None
+        self._unsubscribe = transport.listen(self._on_message)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Begin periodic probing (FailureDetectorImpl.java:101-108)."""
+        self._periodic = self.sim.schedule_periodic(self.config.ping_interval, self._do_ping)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._periodic is not None:
+            self._periodic.cancel()
+        self._unsubscribe()
+        self._listeners.clear()
+
+    def listen(self, handler: Callable[[FailureDetectorEvent], None]) -> None:
+        self._listeners.append(handler)
+
+    # -- membership feed (FailureDetectorImpl.java:321-332) ----------------
+
+    def on_member_event(self, event) -> None:
+        member = event.member
+        if event.is_removed():
+            if member in self.ping_members:
+                self.ping_members.remove(member)
+        if event.is_added():
+            # Insert at a random position to decorrelate probe orders.
+            size = len(self.ping_members)
+            index = self.sim.rng.randrange(size) if size > 0 else 0
+            self.ping_members.insert(index, member)
+
+    # -- probe tick (FailureDetectorImpl.java:128-213) ---------------------
+
+    def _do_ping(self) -> None:
+        if self._stopped:
+            return
+        period = self.current_period
+        self.current_period += 1
+
+        ping_member = self._select_ping_member()
+        if ping_member is None:
+            return
+
+        cid = self.cid_generator.next_cid()
+        ping_msg = Message(
+            qualifier=PING,
+            correlation_id=cid,
+            data=PingData(self.local_member, ping_member),
+        )
+        self.transport.request_response(
+            ping_msg, ping_member.address, timeout_ms=self.config.ping_timeout
+        ).subscribe(
+            lambda _msg: self._publish(period, ping_member, MemberStatus.ALIVE),
+            lambda _err: self._on_ping_timeout(period, ping_member, cid),
+        )
+
+    def _on_ping_timeout(self, period: int, ping_member: Member, cid: str) -> None:
+        if self._stopped:
+            return
+        time_left = self.config.ping_interval - self.config.ping_timeout
+        ping_req_members = self._select_ping_req_members(ping_member)
+        if time_left <= 0 or not ping_req_members:
+            self._publish(period, ping_member, MemberStatus.SUSPECT)
+            return
+        # PING_REQ to each proxy; each proxy result publishes independently,
+        # exactly like the reference's per-proxy subscriptions
+        # (FailureDetectorImpl.java:178-213) — membership dedups repeats.
+        ping_req_msg = Message(
+            qualifier=PING_REQ,
+            correlation_id=cid,
+            data=PingData(self.local_member, ping_member),
+        )
+        for proxy in ping_req_members:
+            self.transport.request_response(
+                ping_req_msg, proxy.address, timeout_ms=time_left
+            ).subscribe(
+                lambda _msg, m=ping_member: self._publish(period, m, MemberStatus.ALIVE),
+                lambda _err, m=ping_member: self._publish(period, m, MemberStatus.SUSPECT),
+            )
+
+    # -- message handlers (FailureDetectorImpl.java:217-315) ---------------
+
+    def _on_message(self, message: Message) -> None:
+        if self._stopped:
+            return
+        if message.qualifier == PING:
+            self._on_ping(message)
+        elif message.qualifier == PING_REQ:
+            self._on_ping_req(message)
+        elif message.qualifier == PING_ACK and message.data.original_issuer is not None:
+            self._on_transit_ping_ack(message)
+
+    def _on_ping(self, message: Message) -> None:
+        """Answer PING with PING_ACK — drops pings addressed to a previous
+        incarnation of this endpoint (FailureDetectorImpl.java:230-255)."""
+        data: PingData = message.data
+        if data.to.id != self.local_member.id:
+            return
+        ack = Message(qualifier=PING_ACK, correlation_id=message.correlation_id, data=data)
+        self.transport.send(data.from_.address, ack)
+
+    def _on_ping_req(self, message: Message) -> None:
+        """Relay a transit PING on behalf of the original issuer
+        (FailureDetectorImpl.java:258-284)."""
+        data: PingData = message.data
+        transit = Message(
+            qualifier=PING,
+            correlation_id=message.correlation_id,
+            data=PingData(self.local_member, data.to, original_issuer=data.from_),
+        )
+        self.transport.send(data.to.address, transit)
+
+    def _on_transit_ping_ack(self, message: Message) -> None:
+        """Convert a transit ack back to a plain ack for the original issuer
+        (FailureDetectorImpl.java:290-315)."""
+        data: PingData = message.data
+        issuer = data.original_issuer
+        ack = Message(
+            qualifier=PING_ACK,
+            correlation_id=message.correlation_id,
+            data=PingData(issuer, data.to),
+        )
+        self.transport.send(issuer.address, ack)
+
+    # -- selection (FailureDetectorImpl.java:338-361) ----------------------
+
+    def _select_ping_member(self) -> Optional[Member]:
+        if not self.ping_members:
+            return None
+        if self.ping_member_index >= len(self.ping_members):
+            self.ping_member_index = 0
+            self.sim.rng.shuffle(self.ping_members)
+        member = self.ping_members[self.ping_member_index]
+        self.ping_member_index += 1
+        return member
+
+    def _select_ping_req_members(self, ping_member: Member) -> List[Member]:
+        if self.config.ping_req_members <= 0:
+            return []
+        candidates = [m for m in self.ping_members if m != ping_member]
+        if not candidates:
+            return []
+        self.sim.rng.shuffle(candidates)
+        return candidates[: self.config.ping_req_members]
+
+    # -- events ------------------------------------------------------------
+
+    def _publish(self, period: int, member: Member, status: MemberStatus) -> None:
+        if self._stopped:
+            return
+        event = FailureDetectorEvent(member, status)
+        for handler in list(self._listeners):
+            handler(event)

@@ -1,0 +1,203 @@
+"""Infection-style gossip dissemination (oracle form).
+
+Behavior-for-behavior port of the reference
+(cluster/src/main/java/io/scalecube/cluster/gossip/GossipProtocolImpl.java:31-327):
+spread() enqueues with id ``memberId-counter`` and resolves when the gossip
+is swept; each period the node picks a fanout-sized window over a shuffled
+member list, sends each live gossip (one GOSSIP_REQ message per gossip) to
+targets not already known infected, and sweeps gossips older than
+``2*(periodsToSpread+1)`` periods.  Delivery dedups by gossip id.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Set
+
+from scalecube_cluster_tpu import swim_math
+from scalecube_cluster_tpu.oracle.core import Member, SimFuture, Simulator
+from scalecube_cluster_tpu.oracle.transport import Message, Transport
+
+# Qualifier (GossipProtocolImpl.java:37).
+GOSSIP_REQ = "sc/gossip/req"
+
+
+@dataclasses.dataclass(frozen=True)
+class Gossip:
+    """gossip id + payload message (reference: gossip/Gossip.java:1-49)."""
+
+    gossip_id: str
+    message: Message
+
+
+@dataclasses.dataclass(frozen=True)
+class GossipRequest:
+    """One gossip + sender member id (reference: gossip/GossipRequest.java:1-37)."""
+
+    gossips: tuple  # tuple[Gossip, ...]
+    from_id: str
+
+
+class GossipState:
+    """Local per-gossip state (reference: gossip/GossipState.java:8-38)."""
+
+    __slots__ = ("gossip", "infection_period", "infected")
+
+    def __init__(self, gossip: Gossip, infection_period: int):
+        self.gossip = gossip
+        self.infection_period = infection_period
+        # member ids this gossip was received from (so we skip resending to them)
+        self.infected: Set[str] = set()
+
+
+class GossipProtocol:
+    """One node's gossip component."""
+
+    def __init__(
+        self,
+        local_member: Member,
+        transport: Transport,
+        config,  # GossipConfig view of ClusterConfig
+        sim: Simulator,
+    ):
+        self.local_member = local_member
+        self.transport = transport
+        self.config = config
+        self.sim = sim
+
+        self.current_period = 0
+        self.gossip_counter = 0
+        self.gossips: Dict[str, GossipState] = {}
+        self.futures: Dict[str, SimFuture] = {}
+        # Shuffled-window target selection state (GossipProtocolImpl.java:52-53).
+        self.remote_members: List[Member] = []
+        self.remote_members_index = -1
+
+        self._listeners: List[Callable[[Message], None]] = []
+        self._stopped = False
+        self._periodic = None
+        self._unsubscribe = transport.listen(self._on_message)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Begin periodic spreading (GossipProtocolImpl.java:105-112)."""
+        self._periodic = self.sim.schedule_periodic(
+            self.config.gossip_interval, self._do_spread_gossip
+        )
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._periodic is not None:
+            self._periodic.cancel()
+        self._unsubscribe()
+        self._listeners.clear()
+
+    def listen(self, handler: Callable[[Message], None]) -> None:
+        """Subscribe to first-delivery of remote gossips (deduped by id)."""
+        self._listeners.append(handler)
+
+    # -- membership feed (GossipProtocolImpl.java:185-193) -----------------
+
+    def on_member_event(self, event) -> None:
+        member = event.member
+        if event.is_removed() and member in self.remote_members:
+            self.remote_members.remove(member)
+        if event.is_added():
+            self.remote_members.append(member)
+
+    # -- API ---------------------------------------------------------------
+
+    def spread(self, message: Message) -> SimFuture:
+        """Enqueue a gossip; future resolves with the gossip id on sweep
+        (GossipProtocolImpl.java:124-128,163-169)."""
+        gossip = Gossip(self._generate_gossip_id(), message)
+        self.gossips[gossip.gossip_id] = GossipState(gossip, self.current_period)
+        future = SimFuture()
+        self.futures[gossip.gossip_id] = future
+        return future
+
+    # -- periodic tick (GossipProtocolImpl.java:139-157) -------------------
+
+    def _do_spread_gossip(self) -> None:
+        if self._stopped:
+            return
+        period = self.current_period
+        self.current_period += 1
+        if not self.gossips:
+            return
+        for member in self._select_gossip_members():
+            self._spread_gossips_to(period, member)
+        self._sweep_gossips(period)
+
+    # -- handlers (GossipProtocolImpl.java:171-183) ------------------------
+
+    def _on_message(self, message: Message) -> None:
+        if self._stopped or message.qualifier != GOSSIP_REQ:
+            return
+        period = self.current_period
+        request: GossipRequest = message.data
+        for gossip in request.gossips:
+            state = self.gossips.get(gossip.gossip_id)
+            if state is None:  # new gossip: store + first-delivery emit
+                state = GossipState(gossip, period)
+                self.gossips[gossip.gossip_id] = state
+                for handler in list(self._listeners):
+                    handler(gossip.message)
+            state.infected.add(request.from_id)
+
+    # -- helpers (GossipProtocolImpl.java:239-308) -------------------------
+
+    def _generate_gossip_id(self) -> str:
+        gid = f"{self.local_member.id}-{self.gossip_counter}"
+        self.gossip_counter += 1
+        return gid
+
+    def _select_gossips_to_send(self, period: int, member: Member) -> List[Gossip]:
+        periods_to_spread = swim_math.gossip_periods_to_spread(
+            self.config.gossip_repeat_mult, len(self.remote_members) + 1
+        )
+        return [
+            state.gossip
+            for state in self.gossips.values()
+            if state.infection_period + periods_to_spread >= period
+            and member.id not in state.infected
+        ]
+
+    def _select_gossip_members(self) -> List[Member]:
+        fanout = self.config.gossip_fanout
+        if len(self.remote_members) < fanout:
+            return list(self.remote_members)
+        # Shuffled sliding window, reshuffle at wrap (GossipProtocolImpl.java:252-273).
+        if self.remote_members_index < 0 or self.remote_members_index + fanout > len(
+            self.remote_members
+        ):
+            self.sim.rng.shuffle(self.remote_members)
+            self.remote_members_index = 0
+        selected = self.remote_members[self.remote_members_index : self.remote_members_index + fanout]
+        self.remote_members_index += fanout
+        return selected
+
+    def _spread_gossips_to(self, period: int, member: Member) -> None:
+        # One GOSSIP_REQ message per gossip (GossipProtocolImpl.java:211-237).
+        for gossip in self._select_gossips_to_send(period, member):
+            msg = Message(
+                qualifier=GOSSIP_REQ,
+                data=GossipRequest((gossip,), self.local_member.id),
+            )
+            self.transport.send(member.address, msg)
+
+    def _sweep_gossips(self, period: int) -> None:
+        periods_to_sweep = swim_math.gossip_periods_to_sweep(
+            self.config.gossip_repeat_mult, len(self.remote_members) + 1
+        )
+        to_remove = [
+            state
+            for state in self.gossips.values()
+            if period > state.infection_period + periods_to_sweep
+        ]
+        for state in to_remove:
+            del self.gossips[state.gossip.gossip_id]
+            future = self.futures.pop(state.gossip.gossip_id, None)
+            if future is not None:
+                future.resolve(state.gossip.gossip_id)

@@ -1,0 +1,445 @@
+"""SWIM membership protocol with SYNC anti-entropy (oracle form).
+
+Behavior-for-behavior port of the reference
+(cluster/src/main/java/io/scalecube/cluster/membership/MembershipProtocolImpl.java:50-750):
+the membership table, the five-source merge funnel gated by
+``is_overrides``, incarnation self-refutation, suspicion timeouts,
+periodic + initial SYNC, leave, and ADDED/REMOVED/UPDATED event emission
+with metadata fetch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+from scalecube_cluster_tpu import swim_math
+from scalecube_cluster_tpu.oracle.core import (
+    CorrelationIdGenerator,
+    Member,
+    SimFuture,
+    Simulator,
+    TimeoutError_,
+    Timer,
+)
+from scalecube_cluster_tpu.oracle.fdetector import FailureDetector, FailureDetectorEvent
+from scalecube_cluster_tpu.oracle.gossip import GossipProtocol
+from scalecube_cluster_tpu.oracle.transport import Address, Message, Transport
+from scalecube_cluster_tpu.records import MemberStatus, is_overrides
+
+# Qualifiers (MembershipProtocolImpl.java:64-66).
+SYNC = "sc/membership/sync"
+SYNC_ACK = "sc/membership/syncAck"
+MEMBERSHIP_GOSSIP = "sc/membership/gossip"
+
+ALIVE = MemberStatus.ALIVE
+SUSPECT = MemberStatus.SUSPECT
+DEAD = MemberStatus.DEAD
+ABSENT = MemberStatus.ABSENT
+
+
+class UpdateReason(enum.Enum):
+    """The five merge sources (MembershipProtocolImpl.java:54-60)."""
+
+    FAILURE_DETECTOR_EVENT = "fd"
+    MEMBERSHIP_GOSSIP = "gossip"
+    SYNC = "sync"
+    INITIAL_SYNC = "initial_sync"
+    SUSPICION_TIMEOUT = "suspicion_timeout"
+
+
+@dataclasses.dataclass(frozen=True)
+class MembershipRecord:
+    """member + status + incarnation (reference: membership/MembershipRecord.java:12-26)."""
+
+    member: Member
+    status: MemberStatus
+    incarnation: int
+
+    def is_overrides(self, r0: Optional["MembershipRecord"]) -> bool:
+        old_status = int(r0.status) if r0 is not None else int(ABSENT)
+        old_inc = r0.incarnation if r0 is not None else 0
+        return is_overrides(int(self.status), self.incarnation, old_status, old_inc)
+
+
+@dataclasses.dataclass(frozen=True)
+class SyncData:
+    """Full-table payload of SYNC/SYNC_ACK (reference: membership/SyncData.java)."""
+
+    membership: Tuple[MembershipRecord, ...]
+    sync_group: str
+
+
+class EventType(enum.Enum):
+    ADDED = "added"
+    REMOVED = "removed"
+    UPDATED = "updated"
+
+
+@dataclasses.dataclass(frozen=True)
+class MembershipEvent:
+    """ADDED/REMOVED/UPDATED notification (reference: membership/MembershipEvent.java:1-123)."""
+
+    type: EventType
+    member: Member
+    old_metadata: Optional[Dict[str, str]] = None
+    new_metadata: Optional[Dict[str, str]] = None
+
+    def is_added(self) -> bool:
+        return self.type == EventType.ADDED
+
+    def is_removed(self) -> bool:
+        return self.type == EventType.REMOVED
+
+    def is_updated(self) -> bool:
+        return self.type == EventType.UPDATED
+
+    @staticmethod
+    def added(member: Member, metadata) -> "MembershipEvent":
+        return MembershipEvent(EventType.ADDED, member, None, metadata)
+
+    @staticmethod
+    def removed(member: Member, metadata) -> "MembershipEvent":
+        return MembershipEvent(EventType.REMOVED, member, metadata, None)
+
+    @staticmethod
+    def updated(member: Member, old, new) -> "MembershipEvent":
+        return MembershipEvent(EventType.UPDATED, member, old, new)
+
+
+class MembershipProtocol:
+    """One node's membership component (SWIM state machine + SYNC)."""
+
+    def __init__(
+        self,
+        local_member: Member,
+        transport: Transport,
+        failure_detector: FailureDetector,
+        gossip_protocol: GossipProtocol,
+        metadata_store,
+        config,  # MembershipConfig view of ClusterConfig
+        sim: Simulator,
+        cid_generator: CorrelationIdGenerator,
+    ):
+        self.local_member = local_member
+        self.transport = transport
+        self.failure_detector = failure_detector
+        self.gossip_protocol = gossip_protocol
+        self.metadata_store = metadata_store
+        self.config = config
+        self.sim = sim
+        self.cid_generator = cid_generator
+
+        # Seeds: dedup, drop own address (MembershipProtocolImpl.java:160-167).
+        seen = []
+        for addr in config.seed_members:
+            address = Address.from_string(addr) if isinstance(addr, str) else addr
+            if address not in seen and address != local_member.address and address != transport.address:
+                seen.append(address)
+        self.seed_members: List[Address] = seen
+
+        # Membership table seeded with the local record (MembershipProtocolImpl.java:131-137).
+        self.membership_table: Dict[str, MembershipRecord] = {
+            local_member.id: MembershipRecord(local_member, ALIVE, 0)
+        }
+        self.members: Dict[str, Member] = {local_member.id: local_member}
+
+        self.suspicion_timeout_tasks: Dict[str, Timer] = {}
+        self._listeners: List[Callable[[MembershipEvent], None]] = []
+        self._stopped = False
+        self._periodic_sync: Optional[Timer] = None
+
+        self._unsubscribe = transport.listen(self._on_message)
+        failure_detector.listen(self._on_failure_detector_event)
+        gossip_protocol.listen(self._on_gossip_message)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> SimFuture:
+        """Initial SYNC to all seeds; resolves when the first acceptable
+        SYNC_ACK is merged or the sync timeout elapses
+        (MembershipProtocolImpl.java:216-251)."""
+        started = SimFuture()
+        if not self.seed_members:
+            self._schedule_periodic_sync()
+            started.resolve(None)
+            return started
+
+        def finish(_=None):
+            if not started.done:
+                self._schedule_periodic_sync()
+                started.resolve(None)
+
+        def on_reply(msg: Message):
+            if started.done or self._stopped:
+                return
+            if not self._check_sync_group(msg):
+                return
+            self._sync_membership(msg.data, on_start=True)
+            finish()
+
+        for address in self.seed_members:
+            cid = self.cid_generator.next_cid()
+            self.transport.request_response(
+                self._prepare_sync_msg(SYNC, cid), address, timeout_ms=self.config.sync_timeout
+            ).subscribe(on_reply, lambda _err: None)
+        # Global timeout: resolve start() even if no seed answered.
+        self.sim.schedule(self.config.sync_timeout, finish)
+        return started
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._periodic_sync is not None:
+            self._periodic_sync.cancel()
+        for timer in self.suspicion_timeout_tasks.values():
+            timer.cancel()
+        self.suspicion_timeout_tasks.clear()
+        self._unsubscribe()
+        self._listeners.clear()
+
+    def listen(self, handler: Callable[[MembershipEvent], None]) -> None:
+        self._listeners.append(handler)
+
+    # -- views -------------------------------------------------------------
+
+    def member_list(self) -> List[Member]:
+        return list(self.members.values())
+
+    def other_members(self) -> List[Member]:
+        return [m for m in self.members.values() if m != self.local_member]
+
+    def member_by_id(self, member_id: str) -> Optional[Member]:
+        return self.members.get(member_id)
+
+    def member_by_address(self, address: Address) -> Optional[Member]:
+        for m in self.members.values():
+            if m.address == address:
+                return m
+        return None
+
+    def membership_records(self) -> List[MembershipRecord]:
+        return list(self.membership_table.values())
+
+    @property
+    def incarnation(self) -> int:
+        return self.membership_table[self.local_member.id].incarnation
+
+    # -- public protocol actions -------------------------------------------
+
+    def update_incarnation(self) -> SimFuture:
+        """Bump own incarnation and gossip it — drives metadata version bumps
+        (MembershipProtocolImpl.java:176-190, used by ClusterImpl.updateMetadata)."""
+        cur = self.membership_table[self.local_member.id]
+        new = MembershipRecord(self.local_member, ALIVE, cur.incarnation + 1)
+        self.membership_table[self.local_member.id] = new
+        return self._spread_membership_gossip(new)
+
+    def leave_cluster(self) -> SimFuture:
+        """Self-record -> DEAD at inc+1, gossiped; resolves when the leave
+        gossip is swept (MembershipProtocolImpl.java:197-206)."""
+        cur = self.membership_table[self.local_member.id]
+        new = MembershipRecord(self.local_member, DEAD, cur.incarnation + 1)
+        self.membership_table[self.local_member.id] = new
+        return self._spread_membership_gossip(new)
+
+    # -- periodic sync (MembershipProtocolImpl.java:298-314,410-421) -------
+
+    def _schedule_periodic_sync(self) -> None:
+        self._periodic_sync = self.sim.schedule_periodic(self.config.sync_interval, self._do_sync)
+
+    def _do_sync(self) -> None:
+        if self._stopped:
+            return
+        address = self._select_sync_address()
+        if address is None:
+            return
+        self.transport.send(address, self._prepare_sync_msg(SYNC, None))
+
+    def _select_sync_address(self) -> Optional[Address]:
+        addresses = list(
+            dict.fromkeys(
+                list(self.seed_members) + [m.address for m in self.other_members()]
+            )
+        )
+        if not addresses:
+            return None
+        return addresses[self.sim.rng.randrange(len(addresses))]
+
+    # -- message handlers --------------------------------------------------
+
+    def _on_message(self, message: Message) -> None:
+        if self._stopped or not self._check_sync_group(message):
+            return
+        if message.qualifier == SYNC:
+            self._on_sync(message)
+        elif message.qualifier == SYNC_ACK and message.correlation_id is None:
+            # Correlated SYNC_ACKs are consumed by the initial-sync
+            # request-response path (MembershipProtocolImpl.java:324-330).
+            self._sync_membership(message.data, on_start=False)
+
+    def _on_sync(self, message: Message) -> None:
+        """Merge then reply SYNC_ACK with our merged table
+        (MembershipProtocolImpl.java:346-367)."""
+        self._sync_membership(message.data, on_start=False)
+        reply = self._prepare_sync_msg(SYNC_ACK, message.correlation_id)
+        self.transport.send(message.sender, reply)
+
+    def _on_failure_detector_event(self, event: FailureDetectorEvent) -> None:
+        """FD verdicts (MembershipProtocolImpl.java:370-398)."""
+        if self._stopped:
+            return
+        r0 = self.membership_table.get(event.member.id)
+        if r0 is None:  # member already removed
+            return
+        if r0.status == event.status:  # no change
+            return
+        if event.status == ALIVE:
+            # ALIVE won't override SUSPECT — send SYNC to the member instead,
+            # forcing it to spread a refutation at inc+1.
+            self.transport.send(event.member.address, self._prepare_sync_msg(SYNC, None))
+        else:
+            record = MembershipRecord(r0.member, event.status, r0.incarnation)
+            self._update_membership(record, UpdateReason.FAILURE_DETECTOR_EVENT)
+
+    def _on_gossip_message(self, message: Message) -> None:
+        """Membership gossips from the gossip component
+        (MembershipProtocolImpl.java:401-408)."""
+        if self._stopped:
+            return
+        if message.qualifier == MEMBERSHIP_GOSSIP:
+            self._update_membership(message.data, UpdateReason.MEMBERSHIP_GOSSIP)
+
+    # -- sync plumbing -----------------------------------------------------
+
+    def _check_sync_group(self, message: Message) -> bool:
+        """Drop cross-cluster messages (MembershipProtocolImpl.java:431-437)."""
+        if isinstance(message.data, SyncData):
+            return message.data.sync_group == self.config.sync_group
+        return False
+
+    def _prepare_sync_msg(self, qualifier: str, cid: Optional[str]) -> Message:
+        records = tuple(self.membership_table.values())
+        return Message(
+            qualifier=qualifier,
+            correlation_id=cid,
+            data=SyncData(records, self.config.sync_group),
+        )
+
+    def _sync_membership(self, sync_data: SyncData, on_start: bool) -> None:
+        """Merge every changed record (MembershipProtocolImpl.java:456-467)."""
+        reason = UpdateReason.INITIAL_SYNC if on_start else UpdateReason.SYNC
+        for r1 in sync_data.membership:
+            if self.membership_table.get(r1.member.id) != r1:
+                self._update_membership(r1, reason)
+
+    # -- the merge funnel (MembershipProtocolImpl.java:475-541) ------------
+
+    def _update_membership(self, r1: MembershipRecord, reason: UpdateReason) -> None:
+        r0 = self.membership_table.get(r1.member.id)
+
+        if not r1.is_overrides(r0):
+            return
+
+        # Self-refutation: record about the local member that overrides ->
+        # bump incarnation, keep own status, gossip (:488-509).
+        if r1.member.id == self.local_member.id:
+            current_incarnation = max(r0.incarnation, r1.incarnation)
+            r2 = MembershipRecord(self.local_member, r0.status, current_incarnation + 1)
+            self.membership_table[self.local_member.id] = r2
+            self._spread_membership_gossip(r2)
+            return
+
+        # Update table: accepted DEAD deletes the record (:512-516).
+        if r1.status == DEAD:
+            self.membership_table.pop(r1.member.id, None)
+        else:
+            self.membership_table[r1.member.id] = r1
+
+        # Schedule/cancel suspicion timeout (:518-523).
+        if r1.status == SUSPECT:
+            self._schedule_suspicion_timeout(r1)
+        else:
+            self._cancel_suspicion_timeout(r1.member.id)
+
+        self._emit_membership_event(r0, r1)
+
+        # Re-gossip unless the update itself arrived by gossip/initial sync (:526-539).
+        if reason not in (UpdateReason.MEMBERSHIP_GOSSIP, UpdateReason.INITIAL_SYNC):
+            self._spread_membership_gossip(r1)
+
+    # -- events + metadata (MembershipProtocolImpl.java:543-588) -----------
+
+    def _emit_membership_event(self, r0: Optional[MembershipRecord], r1: MembershipRecord) -> None:
+        member = r1.member
+
+        if r1.status == DEAD:
+            self.members.pop(member.id, None)
+            metadata = self.metadata_store.remove_metadata(member)
+            self._emit(MembershipEvent.removed(member, metadata))
+            return
+
+        if r0 is None and r1.status == ALIVE:
+            self.members[member.id] = member
+            # ADDED only after the metadata fetch succeeds; a fetch timeout
+            # suppresses the event (:558-570 onErrorResume(TimeoutException)).
+            self.metadata_store.fetch_metadata(member).subscribe(
+                lambda metadata, m=member: self._on_added_metadata(m, metadata),
+                lambda _err: None,
+            )
+            return
+
+        if r0 is not None and r0.incarnation < r1.incarnation:
+            self.metadata_store.fetch_metadata(member).subscribe(
+                lambda metadata, m=member: self._on_updated_metadata(m, metadata),
+                lambda _err: None,
+            )
+
+    def _on_added_metadata(self, member: Member, metadata: Dict[str, str]) -> None:
+        if self._stopped:
+            return
+        self.metadata_store.update_metadata_for(member, metadata)
+        self._emit(MembershipEvent.added(member, metadata))
+
+    def _on_updated_metadata(self, member: Member, new_metadata: Dict[str, str]) -> None:
+        if self._stopped:
+            return
+        old_metadata = self.metadata_store.update_metadata_for(member, new_metadata)
+        self._emit(MembershipEvent.updated(member, old_metadata, new_metadata))
+
+    def _emit(self, event: MembershipEvent) -> None:
+        for handler in list(self._listeners):
+            handler(event)
+
+    # -- suspicion timeouts (MembershipProtocolImpl.java:590-618) ----------
+
+    def _schedule_suspicion_timeout(self, record: MembershipRecord) -> None:
+        member_id = record.member.id
+        if member_id in self.suspicion_timeout_tasks:
+            return  # computeIfAbsent semantics: don't reschedule
+        timeout = swim_math.suspicion_timeout(
+            self.config.suspicion_mult, len(self.membership_table), self.config.ping_interval
+        )
+        self.suspicion_timeout_tasks[member_id] = self.sim.schedule(
+            timeout, lambda: self._on_suspicion_timeout(member_id)
+        )
+
+    def _cancel_suspicion_timeout(self, member_id: str) -> None:
+        timer = self.suspicion_timeout_tasks.pop(member_id, None)
+        if timer is not None:
+            timer.cancel()
+
+    def _on_suspicion_timeout(self, member_id: str) -> None:
+        if self._stopped:
+            return
+        self.suspicion_timeout_tasks.pop(member_id, None)
+        record = self.membership_table.get(member_id)
+        if record is not None:
+            dead = MembershipRecord(record.member, DEAD, record.incarnation)
+            self._update_membership(dead, UpdateReason.SUSPICION_TIMEOUT)
+
+    # -- gossip spread (MembershipProtocolImpl.java:620-635) ---------------
+
+    def _spread_membership_gossip(self, record: MembershipRecord) -> SimFuture:
+        msg = Message(qualifier=MEMBERSHIP_GOSSIP, data=record)
+        return self.gossip_protocol.spread(msg)

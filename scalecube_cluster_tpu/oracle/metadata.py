@@ -1,0 +1,130 @@
+"""Member metadata store with remote pull (oracle form).
+
+Behavior-for-behavior port of the reference
+(cluster/src/main/java/io/scalecube/cluster/metadata/MetadataStoreImpl.java:22-242):
+per-member KV maps, local CRUD, and remote fetch via request-response
+(``sc/metadata/req``/``resp``).  Metadata is never gossiped — only the
+owner's incarnation bump is, and observers then pull directly
+(SURVEY.md §2.1 row 5).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from scalecube_cluster_tpu.oracle.core import (
+    CorrelationIdGenerator,
+    Member,
+    SimFuture,
+    Simulator,
+)
+from scalecube_cluster_tpu.oracle.transport import Message, Transport
+
+# Qualifiers (MetadataStoreImpl.java:28-29).
+GET_METADATA_REQ = "sc/metadata/req"
+GET_METADATA_RESP = "sc/metadata/resp"
+
+
+class GetMetadataRequest:
+    """Target-member payload (reference: metadata/GetMetadataRequest.java)."""
+
+    def __init__(self, member: Member):
+        self.member = member
+
+
+class GetMetadataResponse:
+    """Owner + metadata payload (reference: metadata/GetMetadataResponse.java)."""
+
+    def __init__(self, member: Member, metadata: Dict[str, str]):
+        self.member = member
+        self.metadata = metadata
+
+
+class MetadataStore:
+    """One node's metadata component."""
+
+    def __init__(
+        self,
+        local_member: Member,
+        transport: Transport,
+        metadata: Dict[str, str],
+        config,  # needs .metadata_timeout
+        sim: Simulator,
+        cid_generator: CorrelationIdGenerator,
+    ):
+        self.local_member = local_member
+        self.transport = transport
+        self.config = config
+        self.sim = sim
+        self.cid_generator = cid_generator
+        self.members_metadata: Dict[Member, Dict[str, str]] = {}
+        self._stopped = False
+        self._unsubscribe: Optional[Callable] = None
+        self.update_metadata(dict(metadata))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Serve GET_METADATA_REQ (MetadataStoreImpl.java:77-85)."""
+        self._unsubscribe = self.transport.listen(self._on_message)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+        self.members_metadata.clear()
+
+    # -- local CRUD (MetadataStoreImpl.java:96-146) ------------------------
+
+    def metadata(self, member: Optional[Member] = None) -> Optional[Dict[str, str]]:
+        return self.members_metadata.get(member or self.local_member)
+
+    def update_metadata(self, metadata: Dict[str, str]) -> Optional[Dict[str, str]]:
+        return self.update_metadata_for(self.local_member, metadata)
+
+    def update_metadata_for(self, member: Member, metadata: Dict[str, str]) -> Optional[Dict[str, str]]:
+        previous = self.members_metadata.get(member)
+        self.members_metadata[member] = dict(metadata)
+        return previous
+
+    def remove_metadata(self, member: Member) -> Optional[Dict[str, str]]:
+        if member == self.local_member:
+            raise ValueError("remove_metadata must not accept local member")
+        return self.members_metadata.pop(member, None)
+
+    # -- remote fetch (MetadataStoreImpl.java:149-186) ---------------------
+
+    def fetch_metadata(self, member: Member) -> SimFuture:
+        if member == self.local_member:
+            future = SimFuture()
+            future.resolve(dict(self.members_metadata.get(member, {})))
+            return future
+        cid = self.cid_generator.next_cid()
+        request = Message(
+            qualifier=GET_METADATA_REQ,
+            correlation_id=cid,
+            data=GetMetadataRequest(member),
+        )
+        result = SimFuture()
+        self.transport.request_response(
+            request, member.address, timeout_ms=self.config.metadata_timeout
+        ).subscribe(
+            lambda response: result.resolve(dict(response.data.metadata)),
+            result.reject,
+        )
+        return result
+
+    # -- serving (MetadataStoreImpl.java:202-241) --------------------------
+
+    def _on_message(self, message: Message) -> None:
+        if self._stopped or message.qualifier != GET_METADATA_REQ:
+            return
+        target = message.data.member
+        if target.id != self.local_member.id:
+            return  # request for a previous owner of this address
+        response = Message(
+            qualifier=GET_METADATA_RESP,
+            correlation_id=message.correlation_id,
+            data=GetMetadataResponse(self.local_member, dict(self.metadata() or {})),
+        )
+        self.transport.send(message.sender, response)

@@ -1,0 +1,245 @@
+"""In-process transport with fault injection: the oracle's wire layer.
+
+Mirrors the reference Transport SPI
+(transport/src/main/java/io/scalecube/transport/Transport.java:74-135):
+``address / send / request_response / listen / stop / network_emulator`` —
+with sockets replaced by direct delivery through the simulator's event loop.
+The NetworkEmulator (transport/NetworkEmulator.java:21-273,
+NetworkLinkSettings.java:15-80) is ported behavior-for-behavior: per-link
+loss%% / exponential mean delay, block = 100%% loss, sent/lost counters; it
+sits in the send path exactly where the reference hooks ``tryFail`` then
+``tryDelay`` (TransportImpl.java:257-269).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from scalecube_cluster_tpu.oracle.core import (
+    Address,
+    SimFuture,
+    Simulator,
+    TimeoutError_,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Message:
+    """Header map + data + sender (reference: transport/Message.java:11-248).
+
+    The reference keeps qualifier/correlationId in a headers map
+    (Message.java:17-30 ``q``/``cid``); the oracle promotes the two
+    load-bearing headers to fields.
+    """
+
+    qualifier: Optional[str] = None
+    correlation_id: Optional[str] = None
+    data: Any = None
+    sender: Optional[Address] = None
+
+    def with_sender(self, sender: Address) -> "Message":
+        return dataclasses.replace(self, sender=sender)
+
+
+class NetworkLinkSettings:
+    """Per-link loss%% and mean delay (reference: NetworkLinkSettings.java:15-80)."""
+
+    def __init__(self, loss_percent: int, mean_delay_ms: int):
+        self.loss_percent = loss_percent
+        self.mean_delay_ms = mean_delay_ms
+
+    def evaluate_loss(self, rng) -> bool:
+        """Bernoulli loss draw (NetworkLinkSettings.java:54-57)."""
+        return self.loss_percent > 0 and (
+            self.loss_percent >= 100 or rng.random() * 100 <= self.loss_percent
+        )
+
+    def evaluate_delay(self, rng) -> float:
+        """Exponential delay ``-ln(1-U) * mean`` (NetworkLinkSettings.java:64-74)."""
+        if self.mean_delay_ms <= 0:
+            return 0.0
+        u = rng.random()
+        return -math.log(1.0 - (1.0 - 1e-10) * u, math.e) * self.mean_delay_ms
+
+
+DEAD_LINK_SETTINGS = NetworkLinkSettings(100, 0)
+ALIVE_LINK_SETTINGS = NetworkLinkSettings(0, 0)
+
+
+class NetworkEmulatorError(Exception):
+    """Raised (to error callbacks) when the emulator drops a message
+    (reference: transport/NetworkEmulatorException.java)."""
+
+
+class NetworkEmulator:
+    """Outbound fault injection for one node (reference: NetworkEmulator.java:21-273)."""
+
+    def __init__(self, address: Address, enabled: bool = True):
+        self.address = address
+        self.enabled = enabled
+        self.default_link_settings = ALIVE_LINK_SETTINGS
+        self.custom_link_settings: Dict[Address, NetworkLinkSettings] = {}
+        self.total_message_sent_count = 0
+        self.total_message_lost_count = 0
+
+    def link_settings(self, destination: Address) -> NetworkLinkSettings:
+        return self.custom_link_settings.get(destination, self.default_link_settings)
+
+    def set_link_settings(self, destination: Address, loss_percent: int, mean_delay_ms: int) -> None:
+        if not self.enabled:
+            return
+        self.custom_link_settings[destination] = NetworkLinkSettings(loss_percent, mean_delay_ms)
+
+    def set_default_link_settings(self, loss_percent: int, mean_delay_ms: int) -> None:
+        if not self.enabled:
+            return
+        self.default_link_settings = NetworkLinkSettings(loss_percent, mean_delay_ms)
+
+    def block(self, *destinations: Address) -> None:
+        """100%% loss toward destinations (NetworkEmulator.java:132-160)."""
+        if not self.enabled:
+            return
+        for destination in self._flatten(destinations):
+            self.custom_link_settings[destination] = DEAD_LINK_SETTINGS
+
+    def unblock(self, *destinations: Address) -> None:
+        """Remove per-link overrides (NetworkEmulator.java:162-186)."""
+        if not self.enabled:
+            return
+        for destination in self._flatten(destinations):
+            self.custom_link_settings.pop(destination, None)
+
+    def unblock_all(self) -> None:
+        if not self.enabled:
+            return
+        self.custom_link_settings.clear()
+
+    @staticmethod
+    def _flatten(destinations) -> List[Address]:
+        out: List[Address] = []
+        for d in destinations:
+            if isinstance(d, Address):
+                out.append(d)
+            else:
+                out.extend(d)
+        return out
+
+
+class Transport:
+    """In-process point-to-point messaging bound to a simulator.
+
+    Reference parity notes (TransportImpl.java:45-385):
+      - ``send`` is fire-and-forget; delivery errors go to the returned
+        future's error callback and are otherwise dropped (:257-269);
+      - ``request_response`` = send + first inbound message with equal
+        correlationId (:205-232) — matched on the shared inbound stream, so
+        correlated replies ALSO reach ``listen`` subscribers, which
+        membership relies on for SYNC_ACK routing
+        (MembershipProtocolImpl.java:320-331);
+      - sending to an unbound address fails like a refused TCP connect;
+      - a stopped transport delivers nothing (:175-186).
+    """
+
+    def __init__(self, sim: Simulator, address: Optional[Address] = None, enabled_emulator: bool = True):
+        self.sim = sim
+        self.address = address or Address("localhost", sim.allocate_port())
+        if self.address in sim.transports:
+            raise RuntimeError(f"address already in use: {self.address}")
+        self.network_emulator = NetworkEmulator(self.address, enabled_emulator)
+        self._listeners: List[Callable[[Message], None]] = []
+        # cid -> pending request-response futures.  A list, not a single slot:
+        # the FD's PING_REQ rescue issues one request per proxy all sharing the
+        # original ping's cid (FailureDetectorImpl.java:178-213), and the
+        # reference resolves every one of them from the shared inbound stream
+        # (TransportImpl.java:205-232).
+        self._pending: Dict[str, List[SimFuture]] = {}
+        self.stopped = False
+        sim.transports[self.address] = self
+
+    # -- SPI ---------------------------------------------------------------
+
+    def listen(self, handler: Callable[[Message], None]) -> Callable[[], None]:
+        """Subscribe to all inbound messages; returns an unsubscribe fn."""
+        self._listeners.append(handler)
+        return lambda: self._listeners.remove(handler) if handler in self._listeners else None
+
+    def send(self, destination: Address, message: Message) -> SimFuture:
+        """Fire-and-forget send through the network emulator."""
+        future = SimFuture()
+        if self.stopped:
+            future.reject(RuntimeError("transport stopped"))
+            return future
+        message = message.with_sender(self.address)
+
+        # NetworkEmulator hook: tryFail then tryDelay (TransportImpl.java:257-269).
+        settings = self.network_emulator.link_settings(destination)
+        self.network_emulator.total_message_sent_count += 1
+        if settings.evaluate_loss(self.sim.rng):
+            self.network_emulator.total_message_lost_count += 1
+            future.reject(NetworkEmulatorError(f"emulator dropped {self.address}->{destination}"))
+            return future
+        delay = settings.evaluate_delay(self.sim.rng)
+
+        def deliver():
+            target = self.sim.transports.get(destination)
+            if target is None or target.stopped:
+                # Connect refused — reference evicts the cached connection and
+                # reports the error to the send future (TransportImpl.java:283-307).
+                future.reject(ConnectionError(f"no transport bound at {destination}"))
+                return
+            future.resolve(None)
+            target._on_inbound(message)
+
+        self.sim.schedule(delay, deliver)
+        return future
+
+    def request_response(self, message: Message, destination: Address, timeout_ms: float) -> SimFuture:
+        """Send + await first inbound message with the same correlation id."""
+        cid = message.correlation_id
+        if cid is None:
+            raise ValueError("request_response requires a correlation id")
+        future = SimFuture()
+        self._pending.setdefault(cid, []).append(future)
+
+        def cleanup(_ignored):
+            futures = self._pending.get(cid)
+            if futures is not None:
+                if future in futures:
+                    futures.remove(future)
+                if not futures:
+                    del self._pending[cid]
+
+        future.subscribe(cleanup, cleanup)
+        self.send(destination, message).subscribe(None, future.reject)
+        self.sim.timeout_future(future, timeout_ms)
+        return future
+
+    def stop(self) -> None:
+        """Unbind; in-flight messages to this address are dropped (like closed sockets)."""
+        if self.stopped:
+            return
+        self.stopped = True
+        self.sim.transports.pop(self.address, None)
+        self._listeners.clear()
+        for futures in list(self._pending.values()):
+            for future in list(futures):
+                future.reject(RuntimeError("transport stopped"))
+        self._pending.clear()
+
+    # -- inbound -----------------------------------------------------------
+
+    def _on_inbound(self, message: Message) -> None:
+        if self.stopped:
+            return
+        # Correlated reply resolves EVERY pending request-response future with
+        # that cid (shared-inbound-stream matching, TransportImpl.java:205-232)...
+        cid = message.correlation_id
+        if cid is not None and cid in self._pending:
+            for future in list(self._pending.get(cid, ())):
+                future.resolve(message)
+        # ...and the message still reaches every listen() subscriber (shared
+        # inbound stream, TransportImpl.java:205-232).
+        for handler in list(self._listeners):
+            handler(message)

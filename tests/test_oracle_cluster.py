@@ -1,0 +1,163 @@
+"""Full-API e2e tests, ported from the reference's ClusterTest.java (402 LoC)
+and the examples module (SURVEY.md §2.1 row 13): join semantics, user
+messaging, gossip, metadata propagation, graceful shutdown, dead seeds."""
+
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.oracle import Address, Cluster, Message, Simulator
+
+FAST = ClusterConfig.default_local().replace(
+    sync_interval=2_000, ping_interval=500, ping_timeout=200, gossip_interval=100
+)
+
+
+def ids(members):
+    return sorted(m.id for m in members)
+
+
+def test_join_await_semantics():
+    """Cluster.joinAwait-shaped: on_joined resolves after initial sync."""
+    sim = Simulator(seed=1)
+    alice = Cluster.join(sim, config=FAST, alias="alice")
+    assert alice.on_joined.done  # seedless join completes immediately
+    bob = Cluster.join(sim, seeds=[alice.address], config=FAST, alias="bob")
+    assert not bob.on_joined.done
+    sim.run_for(200)
+    assert bob.on_joined.done
+    assert ids(bob.other_members()) == ["alice"]
+
+
+def test_user_messaging_filters_system_messages():
+    """MessagingExample.java:15-48 + ClusterImpl.listen filter:202-205."""
+    sim = Simulator(seed=2)
+    alice = Cluster.join(sim, config=FAST, alias="alice")
+    bob = Cluster.join(sim, seeds=[alice.address], config=FAST, alias="bob")
+    sim.run_for(1_000)
+    inbox = []
+    alice.listen(lambda m: inbox.append(m))
+    bob.send(alice.member(), Message(qualifier="greeting", data="hi alice"))
+    sim.run_for(5_000)  # plenty of protocol chatter in between
+    assert [m.data for m in inbox] == ["hi alice"]  # no system messages leaked
+
+
+def test_request_response_between_members():
+    sim = Simulator(seed=3)
+    alice = Cluster.join(sim, config=FAST, alias="alice")
+    bob = Cluster.join(sim, seeds=[alice.address], config=FAST, alias="bob")
+    sim.run_for(1_000)
+    alice.listen(
+        lambda m: alice.send(
+            m.sender, Message(qualifier="pong", correlation_id=m.correlation_id, data=m.data + 1)
+        )
+        if m.qualifier == "ping-user"
+        else None
+    )
+    results = []
+    bob.request_response(
+        alice.member(), Message(qualifier="ping-user", correlation_id="u-1", data=41)
+    ).subscribe(results.append)
+    sim.run_for(100)
+    assert len(results) == 1 and results[0].data == 42
+
+
+def test_user_gossip_delivered_once_to_everyone():
+    """GossipExample.java:15-37."""
+    sim = Simulator(seed=4)
+    alice = Cluster.join(sim, config=FAST, alias="alice")
+    others = [
+        Cluster.join(sim, seeds=[alice.address], config=FAST, alias=f"n{i}") for i in range(5)
+    ]
+    sim.run_for(2_000)
+    received = {c.member().id: [] for c in others}
+    for c in others:
+        c.listen_gossips(lambda m, c=c: received[c.member().id].append(m))
+    alice.spread_gossip(Message(qualifier="user/news", data="breaking"))
+    sim.run_for(10_000)
+    for member_id, msgs in received.items():
+        assert [m.data for m in msgs] == ["breaking"], member_id
+
+
+def test_metadata_update_propagates_via_incarnation_bump():
+    """ClusterMetadataExample.java:21-57 + ClusterTest metadata tests:107-303."""
+    sim = Simulator(seed=5)
+    alice = Cluster.join(sim, config=FAST, alias="alice", metadata={"role": "seed"})
+    bob = Cluster.join(
+        sim, seeds=[alice.address], config=FAST, alias="bob", metadata={"role": "worker"}
+    )
+    sim.run_for(2_000)
+    assert alice.metadata(bob.member()) == {"role": "worker"}
+    assert bob.metadata(alice.member()) == {"role": "seed"}
+
+    updates = []
+    bob.membership.listen(lambda e: updates.append(e) if e.is_updated() else None)
+    alice.update_metadata({"role": "seed", "version": "2"})
+    sim.run_for(5_000)
+    assert bob.metadata(alice.member()) == {"role": "seed", "version": "2"}
+    assert updates and updates[-1].new_metadata == {"role": "seed", "version": "2"}
+    assert updates[-1].old_metadata == {"role": "seed"}
+
+
+def test_update_metadata_property():
+    sim = Simulator(seed=6)
+    alice = Cluster.join(sim, config=FAST, alias="alice", metadata={"a": "1"})
+    bob = Cluster.join(sim, seeds=[alice.address], config=FAST, alias="bob")
+    sim.run_for(2_000)
+    alice.update_metadata_property("b", "2")
+    sim.run_for(5_000)
+    assert bob.metadata(alice.member()) == {"a": "1", "b": "2"}
+    alice.remove_metadata_property("a")
+    sim.run_for(5_000)
+    assert bob.metadata(alice.member()) == {"b": "2"}
+
+
+def test_graceful_shutdown_removes_metadata():
+    """ClusterTest.testMemberMetadataRemoved:331-373."""
+    sim = Simulator(seed=7)
+    alice = Cluster.join(sim, config=FAST, alias="alice")
+    bob = Cluster.join(
+        sim, seeds=[alice.address], config=FAST, alias="bob", metadata={"k": "v"}
+    )
+    sim.run_for(2_000)
+    assert alice.metadata(bob.member()) == {"k": "v"}
+    removed = []
+    alice.membership.listen(lambda e: removed.append(e) if e.is_removed() else None)
+    bob.shutdown()
+    sim.run_for(5_000)
+    assert bob.is_shutdown
+    assert removed and removed[0].member.id == "bob"
+    assert removed[0].old_metadata == {"k": "v"}  # REMOVED carries last metadata
+    assert alice.metadata(bob.member()) is None
+
+
+def test_join_via_dead_seed_then_alive_seed():
+    """ClusterTest.testJoinDeadSeedMembers:375-388."""
+    sim = Simulator(seed=8)
+    alice = Cluster.join(sim, config=FAST, alias="alice")
+    dead = Address("localhost", 1)  # nothing bound
+    bob = Cluster.join(sim, seeds=[dead, alice.address], config=FAST, alias="bob")
+    sim.run_for(5_000)
+    assert ids(bob.other_members()) == ["alice"]
+
+
+def test_join_via_all_dead_seeds_starts_alone():
+    """Join succeeds (alone) even when every seed is dead; periodic sync
+    keeps retrying them (MembershipProtocolImpl.java:298-314)."""
+    sim = Simulator(seed=9)
+    bob = Cluster.join(sim, seeds=[Address("localhost", 1)], config=FAST, alias="bob")
+    sim.run_for(5_000)
+    assert bob.on_joined.done
+    assert bob.other_members() == []
+    # The seed comes up later; periodic sync finds it.
+    alice = Cluster.join(sim, config=FAST.replace(port=1), alias="alice")
+    sim.run_for(10_000)
+    assert ids(bob.other_members()) == ["alice"]
+
+
+def test_listen_membership_prepends_existing_members():
+    """ClusterImpl.listenMembership:283-293."""
+    sim = Simulator(seed=10)
+    alice = Cluster.join(sim, config=FAST, alias="alice")
+    bob = Cluster.join(sim, seeds=[alice.address], config=FAST, alias="bob")
+    sim.run_for(2_000)
+    events = []
+    alice.listen_membership(events.append)
+    assert [(e.type.value, e.member.id) for e in events] == [("added", "bob")]

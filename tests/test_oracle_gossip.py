@@ -1,0 +1,137 @@
+"""Gossip dissemination tests, ported from the reference's
+GossipProtocolTest.java (cluster/src/test/java/io/scalecube/cluster/gossip/):
+the {N, loss%, delay} experiment matrix asserting full dissemination before
+the sweep timeout and zero double delivery, with ClusterMath as the oracle
+— on virtual time with a seeded PRNG, so the statistical envelope is
+deterministic per seed."""
+
+import pytest
+
+from scalecube_cluster_tpu import swim_math
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.oracle import (
+    GossipProtocol,
+    Member,
+    Message,
+    Simulator,
+    Transport,
+)
+from scalecube_cluster_tpu.oracle.membership import MembershipEvent
+
+
+def make_gossip_cluster(sim, n, config, loss_percent=0, mean_delay_ms=0):
+    """n gossip protocols with stubbed membership (GossipProtocolTest.java:254-274)."""
+    transports = [Transport(sim) for _ in range(n)]
+    members = [Member(f"m{i}", t.address) for i, t in enumerate(transports)]
+    protocols = []
+    for i in range(n):
+        if loss_percent or mean_delay_ms:
+            transports[i].network_emulator.set_default_link_settings(loss_percent, mean_delay_ms)
+        g = GossipProtocol(members[i], transports[i], config, sim)
+        for j in range(n):
+            if j != i:
+                g.on_member_event(MembershipEvent.added(members[j], None))
+        protocols.append(g)
+        g.start()
+    return transports, members, protocols
+
+
+# The reference matrix (GossipProtocolTest.java:50-66), thinned to keep the
+# suite fast: N up to 50, loss up to 25%, delay up to 100ms.
+MATRIX = [
+    (2, 0, 0),
+    (5, 0, 0),
+    (10, 0, 0),
+    (50, 0, 0),
+    (10, 25, 0),
+    (50, 25, 0),
+    (10, 0, 100),
+    (50, 10, 2),
+]
+
+
+@pytest.mark.parametrize("n,loss,delay", MATRIX)
+def test_dissemination_and_no_double_delivery(n, loss, delay):
+    """GossipProtocolTest.testGossipProtocol-shaped:156-175."""
+    sim = Simulator(seed=42 + n + loss + delay)
+    config = ClusterConfig.default()  # LAN: fanout 3, repeat 3, interval 200ms
+    _, members, protocols = make_gossip_cluster(sim, n, config, loss, delay)
+
+    received = {i: [] for i in range(n)}
+    for i, g in enumerate(protocols):
+        g.listen(lambda msg, i=i: received[i].append(msg))
+
+    spread_future = protocols[0].spread(Message(qualifier="user/chat", data="juicy rumor"))
+    sweep_ms = swim_math.gossip_timeout_to_sweep(
+        config.gossip_repeat_mult, n, config.gossip_interval
+    )
+    sim.run_for(2 * sweep_ms + 1_000)
+
+    delivered = [i for i in range(1, n) if received[i]]
+    assert len(delivered) == n - 1, f"dissemination incomplete: {len(delivered)}/{n-1}"
+    # Zero double delivery (dedup by gossip id, GossipProtocolImpl.java:176-180).
+    for i in range(1, n):
+        assert len(received[i]) == 1, f"node {i} got {len(received[i])} deliveries"
+    # The spread future resolves on sweep (GossipProtocolImpl.java:283-308).
+    assert spread_future.done
+
+
+def test_dissemination_time_within_analytic_envelope():
+    """Measured rounds-to-full-dissemination tracks ClusterMath's
+    periodsToSpread prediction (GossipProtocolTest.java:178-205 logs this;
+    we assert a 2x envelope)."""
+    n = 50
+    config = ClusterConfig.default()
+    sim = Simulator(seed=7)
+    _, members, protocols = make_gossip_cluster(sim, n, config)
+
+    done_at = {}
+    for i, g in enumerate(protocols[1:], start=1):
+        g.listen(lambda msg, i=i: done_at.setdefault(i, sim.now))
+
+    protocols[0].spread(Message(qualifier="q", data="x"))
+    predicted_ms = swim_math.gossip_dissemination_time(
+        config.gossip_repeat_mult, n, config.gossip_interval
+    )
+    sim.run_for(4 * predicted_ms)
+    assert len(done_at) == n - 1
+    measured_ms = max(done_at.values())
+    assert measured_ms <= 2 * predicted_ms, (measured_ms, predicted_ms)
+
+
+def test_max_messages_per_node_bounded():
+    """Per-gossip sends per node stay within ClusterMath's bound
+    (ClusterMath.java:65-67; sweep stops retransmission)."""
+    n = 10
+    config = ClusterConfig.default()
+    sim = Simulator(seed=9)
+    transports, members, protocols = make_gossip_cluster(sim, n, config)
+    protocols[0].spread(Message(qualifier="q", data="x"))
+    sweep_ms = swim_math.gossip_timeout_to_sweep(
+        config.gossip_repeat_mult, n, config.gossip_interval
+    )
+    sim.run_for(3 * sweep_ms)
+    # Exact protocol bound: the spread window is inclusive
+    # (``infectionPeriod + periodsToSpread >= period``,
+    # GossipProtocolImpl.java:243-247), i.e. periodsToSpread+1 periods of at
+    # most ``fanout`` sends — one more period than ClusterMath's estimate
+    # (ClusterMath.java:65-67), which the reference never asserts on counters.
+    bound = config.gossip_fanout * (
+        swim_math.gossip_periods_to_spread(config.gossip_repeat_mult, n) + 1
+    )
+    for t in transports:
+        assert t.network_emulator.total_message_sent_count <= bound
+
+
+def test_gossip_stops_after_sweep():
+    """After the sweep horizon no node retransmits (GossipProtocolImpl.java:283-308)."""
+    sim = Simulator(seed=10)
+    config = ClusterConfig.default()
+    transports, members, protocols = make_gossip_cluster(sim, 5, config)
+    protocols[0].spread(Message(qualifier="q", data="x"))
+    sweep_ms = swim_math.gossip_timeout_to_sweep(config.gossip_repeat_mult, 5, config.gossip_interval)
+    sim.run_for(2 * sweep_ms)
+    counts = [t.network_emulator.total_message_sent_count for t in transports]
+    sim.run_for(5 * config.gossip_interval)
+    assert [t.network_emulator.total_message_sent_count for t in transports] == counts
+    assert all(not g.gossips for g in protocols)

@@ -1,0 +1,164 @@
+"""Membership protocol tests, ported from the reference's
+MembershipProtocolTest.java (673 LoC) — initial join, partitions with
+suspicion->death, recovery, restart, seed chains, incarnation refutation —
+on virtual time with seeded randomness (the reference's wall-clock
+``awaitSeconds`` sleeps become exact ``sim.run_for`` calls)."""
+
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.oracle import Cluster, Simulator
+from scalecube_cluster_tpu.records import MemberStatus
+
+
+# Fast test config in the spirit of MembershipProtocolTest.java:545-554
+# (sync=500ms, ping=200ms there; we keep local preset ratios).
+FAST = ClusterConfig.default_local().replace(
+    sync_interval=2_000, ping_interval=500, ping_timeout=200, gossip_interval=100
+)
+
+
+def ids(members):
+    return sorted(m.id for m in members)
+
+
+def statuses(cluster):
+    return {
+        r.member.id: r.status for r in cluster.membership.membership_records()
+    }
+
+
+def make_trio(sim, config=FAST):
+    alice = Cluster.join(sim, config=config, alias="alice")
+    bob = Cluster.join(sim, seeds=[alice.address], config=config, alias="bob")
+    carol = Cluster.join(sim, seeds=[alice.address], config=config, alias="carol")
+    sim.run_for(2_000)
+    return alice, bob, carol
+
+
+def test_initial_three_way_join():
+    """MembershipProtocolTest.testInitialPhaseOk:57-80."""
+    sim = Simulator(seed=1)
+    alice, bob, carol = make_trio(sim)
+    assert ids(alice.other_members()) == ["bob", "carol"]
+    assert ids(bob.other_members()) == ["alice", "carol"]
+    assert ids(carol.other_members()) == ["alice", "bob"]
+
+
+def test_full_partition_then_recovery():
+    """MembershipProtocolTest.testNetworkPartitionThenRecovery:82-310."""
+    sim = Simulator(seed=2)
+    alice, bob, carol = make_trio(sim)
+    # Full partition of carol.
+    for c in (alice, bob):
+        c.network_emulator.block(carol.address)
+    carol.network_emulator.block(alice.address, bob.address)
+
+    sim.run_for(2_000)
+    assert statuses(alice).get("carol") == MemberStatus.SUSPECT
+
+    sim.run_for(15_000)  # > suspicion timeout
+    assert ids(alice.other_members()) == ["bob"]
+    assert ids(bob.other_members()) == ["alice"]
+    assert ids(carol.other_members()) == []
+
+    # Heal: periodic SYNC (to seeds ∪ known members) re-merges the cluster.
+    for c in (alice, bob, carol):
+        c.network_emulator.unblock_all()
+    sim.run_for(20_000)
+    assert ids(alice.other_members()) == ["bob", "carol"]
+    assert ids(carol.other_members()) == ["alice", "bob"]
+
+
+def test_suspicion_timeout_declares_dead_and_emits_removed():
+    """MembershipProtocolTest suspicion->removal:312-366."""
+    sim = Simulator(seed=3)
+    alice, bob, carol = make_trio(sim)
+    removed = []
+    alice.membership.listen(lambda e: removed.append(e) if e.is_removed() else None)
+    carol.transport.stop()  # hard crash, no leave gossip
+    sim.run_for(20_000)
+    assert ids(alice.other_members()) == ["bob"]
+    assert [e.member.id for e in removed] == ["carol"]
+
+
+def test_restart_failed_member_same_port_new_id():
+    """MembershipProtocolTest.testRestartFailedMembers:368-430 — a crashed
+    member's address can rejoin under a fresh id and be re-accepted."""
+    sim = Simulator(seed=4)
+    alice, bob, carol = make_trio(sim)
+    carol_address = carol.address
+    carol.transport.stop()
+    sim.run_for(20_000)
+    assert ids(alice.other_members()) == ["bob"]
+
+    cfg = FAST.replace(port=carol_address.port)
+    carol2 = Cluster.join(sim, seeds=[alice.address], config=cfg, alias="carol2")
+    assert carol2.address == carol_address
+    sim.run_for(5_000)
+    assert ids(alice.other_members()) == ["bob", "carol2"]
+    assert ids(carol2.other_members()) == ["alice", "bob"]
+
+
+def test_seed_chain_join():
+    """MembershipProtocolTest.testNodeJoinClusterWithNoInbound-shaped seed
+    chains:432-462 — c only knows b, b only knows a; all converge."""
+    sim = Simulator(seed=5)
+    a = Cluster.join(sim, config=FAST, alias="a")
+    b = Cluster.join(sim, seeds=[a.address], config=FAST, alias="b")
+    sim.run_for(1_000)
+    c = Cluster.join(sim, seeds=[b.address], config=FAST, alias="c")
+    sim.run_for(3_000)
+    assert ids(a.other_members()) == ["b", "c"]
+    assert ids(c.other_members()) == ["a", "b"]
+
+
+def test_incarnation_refutation_on_false_suspicion():
+    """A lossy (not dead) member refutes its suspicion with a bumped
+    incarnation and stays in the cluster
+    (MembershipProtocolImpl.java:488-509 self-refutation)."""
+    sim = Simulator(seed=6)
+    alice, bob, carol = make_trio(sim)
+    # Carol's outbound links are 85% lossy: acks often lost => suspicion
+    # arises; suspicion gossip still reaches carol (inbound is clean) and her
+    # refutation eventually squeezes through.
+    carol.network_emulator.set_default_link_settings(85, 0)
+    saw_suspect = False
+    for _ in range(200):
+        sim.run_for(500)
+        if statuses(alice).get("carol") == MemberStatus.SUSPECT:
+            saw_suspect = True
+        if saw_suspect and carol.membership.incarnation > 0:
+            break
+    assert saw_suspect, "expected carol to be suspected at least once"
+    assert carol.membership.incarnation > 0, "expected a refutation bump"
+    # Heal the links; carol must end ALIVE everywhere (not DEAD).
+    carol.network_emulator.unblock_all()
+    carol.network_emulator.set_default_link_settings(0, 0)
+    sim.run_for(20_000)
+    assert statuses(alice).get("carol") == MemberStatus.ALIVE
+    assert "carol" in ids(alice.other_members())
+
+
+def test_sync_group_isolation():
+    """Different sync groups never merge (MembershipProtocolImpl.java:431-437;
+    ClusterJoinExamples.java:35-42 uses this as cluster isolation)."""
+    sim = Simulator(seed=7)
+    alice = Cluster.join(sim, config=FAST, alias="alice")
+    eve_cfg = FAST.replace(sync_group="other")
+    eve = Cluster.join(sim, seeds=[alice.address], config=eve_cfg, alias="eve")
+    sim.run_for(10_000)
+    assert alice.other_members() == []
+    assert eve.other_members() == []
+
+
+def test_leave_spreads_dead_at_higher_incarnation():
+    """MembershipProtocolImpl.leaveCluster:197-206 — graceful leave is
+    gossiped as DEAD at inc+1 and removes the member everywhere quickly
+    (no suspicion timeout involved)."""
+    sim = Simulator(seed=8)
+    alice, bob, carol = make_trio(sim)
+    removed = []
+    alice.membership.listen(lambda e: removed.append(e) if e.is_removed() else None)
+    bob.shutdown()
+    sim.run_for(3_000)  # well under the suspicion timeout
+    assert ids(alice.other_members()) == ["carol"]
+    assert [e.member.id for e in removed] == ["bob"]

@@ -1,0 +1,147 @@
+"""Transport + NetworkEmulator tests, ported from the reference's
+TransportTest.java / NetworkEmulatorTest.java / TransportSendOrderTest.java
+(transport/src/test/java/io/scalecube/transport/) onto virtual time."""
+
+import pytest
+
+from scalecube_cluster_tpu.oracle import (
+    Address,
+    Message,
+    NetworkLinkSettings,
+    Simulator,
+    TimeoutError_,
+    Transport,
+)
+
+
+def make_pair(seed=1):
+    sim = Simulator(seed=seed)
+    return sim, Transport(sim), Transport(sim)
+
+
+def test_ping_pong():
+    """TransportTest.testPingPongOnSingleChannel:105-127."""
+    sim, client, server = make_pair()
+    server.listen(
+        lambda msg: server.send(msg.sender, Message(qualifier="pong", data=msg.data))
+        if msg.qualifier == "ping"
+        else None
+    )
+    got = []
+    client.listen(lambda msg: got.append(msg))
+    client.send(server.address, Message(qualifier="ping", data="hello"))
+    sim.run_for(10)
+    assert len(got) == 1
+    assert got[0].qualifier == "pong" and got[0].data == "hello"
+    assert got[0].sender == server.address
+
+
+def test_request_response_matches_correlation_id():
+    """TransportTest.testRequestResponse-shaped (TransportImpl.java:205-232)."""
+    sim, client, server = make_pair()
+    server.listen(
+        lambda msg: server.send(
+            msg.sender,
+            Message(qualifier="resp", correlation_id=msg.correlation_id, data=msg.data * 2),
+        )
+    )
+    results = []
+    client.request_response(
+        Message(qualifier="req", correlation_id="cid-1", data=21), server.address, timeout_ms=100
+    ).subscribe(results.append)
+    # An unrelated message with a different cid must not resolve it.
+    sim.run_for(10)
+    assert len(results) == 1 and results[0].data == 42
+
+
+def test_request_response_timeout():
+    sim, client, server = make_pair()
+    errors = []
+    client.request_response(
+        Message(qualifier="req", correlation_id="cid-1"), server.address, timeout_ms=50
+    ).subscribe(None, errors.append)
+    sim.run_for(100)
+    assert len(errors) == 1 and isinstance(errors[0], TimeoutError_)
+
+
+def test_send_to_unbound_address_errors():
+    """TransportTest.testUnresolvedHostConnection-shaped:60-73."""
+    sim = Simulator()
+    t = Transport(sim)
+    errors = []
+    t.send(Address("localhost", 9), Message(qualifier="x")).subscribe(None, errors.append)
+    sim.run_for(10)
+    assert len(errors) == 1 and isinstance(errors[0], ConnectionError)
+
+
+def test_bind_conflict():
+    """TransportTest.testBindExceptionWithoutPortAutoIncrement-shaped:41-58."""
+    sim = Simulator()
+    t = Transport(sim, Address("localhost", 5000))
+    with pytest.raises(RuntimeError):
+        Transport(sim, Address("localhost", 5000))
+    t.stop()
+    Transport(sim, Address("localhost", 5000))  # rebind after stop works
+
+
+def test_fifty_percent_loss_honored_statistically():
+    """TransportTest.testNetworkSettings:129-153 — 50% loss ±10%."""
+    sim, sender, receiver = make_pair(seed=3)
+    sender.network_emulator.set_link_settings(receiver.address, loss_percent=50, mean_delay_ms=0)
+    got = []
+    receiver.listen(lambda m: got.append(m))
+    total = 1000
+    for i in range(total):
+        sender.send(receiver.address, Message(qualifier="q", data=i))
+    sim.run_for(10)
+    assert 0.4 * total < len(got) < 0.6 * total
+    assert sender.network_emulator.total_message_sent_count == total
+    assert sender.network_emulator.total_message_lost_count == total - len(got)
+
+
+def test_block_and_unblock():
+    """TransportTest.testBlockAndUnblockTraffic:334-355."""
+    sim, a, b = make_pair()
+    got = []
+    b.listen(lambda m: got.append(m.data))
+    a.network_emulator.block(b.address)
+    a.send(b.address, Message(qualifier="q", data="blocked"))
+    sim.run_for(10)
+    assert got == []
+    a.network_emulator.unblock(b.address)
+    a.send(b.address, Message(qualifier="q", data="open"))
+    sim.run_for(10)
+    assert got == ["open"]
+
+
+def test_exponential_delay_orders_by_draw():
+    """NetworkLinkSettings delay distribution sanity (NetworkLinkSettings.java:64-74)."""
+    sim = Simulator(seed=5)
+    settings = NetworkLinkSettings(0, 100)
+    draws = [settings.evaluate_delay(sim.rng) for _ in range(5000)]
+    mean = sum(draws) / len(draws)
+    assert 85 < mean < 115  # exponential with mean 100
+    assert all(d >= 0 for d in draws)
+
+
+def test_fifo_order_per_link_without_delay():
+    """TransportSendOrderTest.java:39-209 — FIFO preserved on clean links."""
+    sim, a, b = make_pair()
+    got = []
+    b.listen(lambda m: got.append(m.data))
+    for i in range(100):
+        a.send(b.address, Message(qualifier="q", data=i))
+    sim.run_for(10)
+    assert got == list(range(100))
+
+
+def test_stopped_transport_delivers_nothing():
+    """TransportTest stream completion on stop:257-283."""
+    sim, a, b = make_pair()
+    got = []
+    b.listen(lambda m: got.append(m))
+    b.stop()
+    errors = []
+    a.send(b.address, Message(qualifier="q")).subscribe(None, errors.append)
+    sim.run_for(10)
+    assert got == [] and len(errors) == 1

@@ -120,33 +120,39 @@ def test_merge_inbound_is_a_valid_serialization():
     (SURVEY.md §7 'incarnation races').  Exhaustive over permutations."""
     import itertools as it
 
+    trials = 300
+    kmax = 4
     rng = np.random.RandomState(42)
-    for trial in range(300):
-        k = rng.randint(1, 5)
-        statuses = rng.choice([ALIVE, SUSPECT, DEAD, ABSENT], size=k)
-        incs = rng.randint(0, 4, size=k)
-        entry_s = int(rng.choice([ALIVE, SUSPECT, ABSENT]))
-        entry_i = int(rng.randint(0, 4))
-        got_s, got_i = records.merge_inbound(entry_s, entry_i, statuses, incs, axis=0)
-        got = (int(got_s), int(got_i))
+    # ABSENT-padded record batches: one vectorized merge_inbound call for all
+    # trials (per-call dispatch overhead would dominate otherwise).
+    statuses = rng.choice([ALIVE, SUSPECT, DEAD, ABSENT], size=(trials, kmax))
+    incs = rng.randint(0, 4, size=(trials, kmax))
+    ks = rng.randint(1, kmax + 1, size=trials)
+    for t in range(trials):
+        statuses[t, ks[t] :] = ABSENT  # vary the record count via padding
+    entry_s = rng.choice([ALIVE, SUSPECT, ABSENT], size=trials)
+    entry_i = rng.randint(0, 4, size=trials)
 
-        def apply_scalar(s0, i0, s1, i1):
-            if not records.is_overrides(s1, i1, s0, i0):
-                return s0, i0
-            return (ABSENT, i1) if s1 == DEAD else (s1, i1)
+    got_s, got_i = records.merge_inbound(entry_s, entry_i, statuses, incs, axis=1)
+    got_s, got_i = np.asarray(got_s), np.asarray(got_i)
 
+    def apply_scalar(s0, i0, s1, i1):
+        if not records.is_overrides(s1, i1, s0, i0):
+            return s0, i0
+        return (ABSENT, i1) if s1 == DEAD else (s1, i1)
+
+    for t in range(trials):
+        live = [j for j in range(kmax) if statuses[t, j] != ABSENT]
         outcomes = set()
-        for perm in it.permutations(range(k)):
-            seq_s, seq_i = entry_s, entry_i
+        for perm in it.permutations(live):
+            seq_s, seq_i = int(entry_s[t]), int(entry_i[t])
             for j in perm:
-                if statuses[j] == ABSENT:
-                    continue  # ABSENT is padding, not a record
-                seq_s, seq_i = apply_scalar(seq_s, seq_i, int(statuses[j]), int(incs[j]))
+                seq_s, seq_i = apply_scalar(seq_s, seq_i, int(statuses[t, j]), int(incs[t, j]))
             outcomes.add((seq_s, seq_i))
-        assert got in outcomes, (
-            f"trial {trial}: merge_inbound={got} not among valid serializations "
-            f"{outcomes} for entry=({entry_s},{entry_i}) records="
-            f"{list(zip(statuses.tolist(), incs.tolist()))}"
+        assert (int(got_s[t]), int(got_i[t])) in outcomes, (
+            f"trial {t}: merge_inbound={(int(got_s[t]), int(got_i[t]))} not among valid "
+            f"serializations {outcomes} for entry=({entry_s[t]},{entry_i[t]}) records="
+            f"{list(zip(statuses[t].tolist(), incs[t].tolist()))}"
         )
 
 
