@@ -1,0 +1,67 @@
+"""Headline benchmark: SWIM member-rounds/sec/chip on real TPU.
+
+Runs the full SWIM tick (FD + gossip + suspicion + SYNC,
+models/swim.swim_tick) in focal mode at 1M members — the BASELINE.md
+north-star configuration (1M members on a v5e; the reference never ran
+above N=50, SURVEY.md §6, and publishes no absolute numbers) — and reports
+throughput in member-rounds/sec/chip.
+
+``vs_baseline`` is measured against the north-star requirement implied by
+BASELINE.json: simulate 1M members × 10k rounds on a v5e-8 in one hour,
+i.e. 1e6*1e4/(3600*8) ≈ 3.47e8 member-rounds/sec/chip.  vs_baseline 1.0
+means exactly that rate; higher is better.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+N_MEMBERS = 1_000_000
+N_SUBJECTS = 16
+BENCH_ROUNDS = 200
+NORTH_STAR_RATE = 1e6 * 1e4 / (3600.0 * 8)  # member-rounds/sec/chip
+
+
+def main():
+    import jax
+
+    from scalecube_cluster_tpu.config import ClusterConfig
+    from scalecube_cluster_tpu.models import swim
+
+    params = swim.SwimParams.from_config(
+        ClusterConfig.default(),
+        n_members=N_MEMBERS,
+        n_subjects=N_SUBJECTS,
+        loss_probability=0.02,
+        per_subject_metrics=True,
+    )
+    world = swim.SwimWorld.healthy(params).with_crash(3, at_round=50)
+    key = jax.random.key(0)
+
+    # Compile + warm up with the SAME static args and pytree structure as
+    # the timed call (params, n_rounds, state-provided), so the timed
+    # region hits the jit cache and measures steady state only.
+    state = swim.initial_state(params, world)
+    state, _ = swim.run(key, params, world, BENCH_ROUNDS, state=state,
+                        start_round=0)
+    jax.block_until_ready(state.status)
+
+    t0 = time.perf_counter()
+    state, metrics = swim.run(
+        key, params, world, BENCH_ROUNDS, state=state, start_round=BENCH_ROUNDS
+    )
+    jax.block_until_ready(state.status)
+    elapsed = time.perf_counter() - t0
+
+    member_rounds_per_sec = N_MEMBERS * BENCH_ROUNDS / elapsed
+    print(json.dumps({
+        "metric": "swim_member_rounds_per_sec_per_chip",
+        "value": round(member_rounds_per_sec, 1),
+        "unit": "member-rounds/sec/chip",
+        "vs_baseline": round(member_rounds_per_sec / NORTH_STAR_RATE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,180 @@
+"""Gossip-only dissemination model: the TPU analog of GossipProtocolImpl.
+
+Simulates infection-style dissemination of G tracked gossips over N members
+as a ``jax.lax.scan`` over gossip periods — the batched equivalent of the
+reference's gossip component in isolation (the setup of its statistical
+experiment matrix, GossipProtocolTest.java:50-66: {N, loss%, meanDelay}).
+
+Reference behaviors modeled (gossip/GossipProtocolImpl.java):
+  - per-period fanout selection over remote members (:252-273) ->
+    ``prng.targets_excluding_self``;
+  - a member spreads each live gossip for ``periodsToSpread =
+    repeatMult * ceilLog2(n+1)`` periods after first receiving it
+    (:239-250, ClusterMath.java:111-113) -> per-(member, gossip)
+    ``spread_until`` round;
+  - delivery dedup by gossip id (:176-180) -> the infection bit itself;
+  - NetworkEmulator per-message loss (NetworkEmulator.java:132-192) ->
+    Bernoulli ``drop`` mask per (sender, fanout-slot).
+
+Deviations, documented:
+  - the per-gossip "infected" set (don't re-send to the member you got it
+    from, GossipState.java:17-38) is not tracked: we re-send and rely on
+    delivery dedup, which the protocol tolerates (SURVEY.md §7 hard parts);
+    message *counts* therefore track the ClusterMath worst-case bound
+    (max_messages_per_gossip_per_node) rather than the slightly lower
+    typical count.
+  - mean link delay quantizes to the period grid: a delayed message still
+    lands in the next period's inbox (the reference's 2ms-100ms delays vs
+    200ms periods round the same way).
+
+State is O(N·G) bits, not O(N²), so this model scales to millions of
+members on one chip; rows shard over devices via parallel/mesh.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu import swim_math
+from scalecube_cluster_tpu.ops import delivery, prng
+
+
+@dataclasses.dataclass(frozen=True)
+class GossipSimParams:
+    """Static (compile-time) knobs of the gossip tick.
+
+    Derived from ClusterConfig gossip settings (config.GossipConfig fields;
+    reference gossip/GossipConfig.java:3-10) for a given cluster size.
+    """
+
+    n_members: int
+    n_gossips: int
+    fanout: int
+    periods_to_spread: int
+    loss_probability: float = 0.0
+
+    @staticmethod
+    def from_config(config, n_members: int, n_gossips: int = 1,
+                    loss_probability: float = 0.0) -> "GossipSimParams":
+        return GossipSimParams(
+            n_members=n_members,
+            n_gossips=n_gossips,
+            fanout=config.gossip_fanout,
+            periods_to_spread=swim_math.gossip_periods_to_spread(
+                config.gossip_repeat_mult, n_members
+            ),
+            loss_probability=loss_probability,
+        )
+
+
+@dataclasses.dataclass
+class GossipState:
+    """Scan carry: per-(member, gossip) infection state.
+
+    ``infected``     [N, G] bool — member has the gossip (delivery-dedup bit,
+                     GossipProtocolImpl.java:176-180).
+    ``spread_until`` [N, G] int32 — first period this member no longer
+                     retransmits it (GossipState.infectionPeriod analog,
+                     gossip/GossipState.java:8-38).
+    """
+
+    infected: jnp.ndarray
+    spread_until: jnp.ndarray
+
+
+jax.tree_util.register_dataclass(
+    GossipState, data_fields=["infected", "spread_until"], meta_fields=[]
+)
+
+
+def initial_state(params: GossipSimParams,
+                  origin: Optional[jnp.ndarray] = None) -> GossipState:
+    """Each gossip g starts at member ``origin[g]`` (default member g).
+
+    Mirrors ``spread()`` enqueueing at the originating member
+    (GossipProtocolImpl.java:163-169) at period 0.
+    """
+    n, g = params.n_members, params.n_gossips
+    if origin is None:
+        origin = jnp.arange(g, dtype=jnp.int32) % n
+    infected = jnp.zeros((n, g), dtype=jnp.bool_).at[origin, jnp.arange(g)].set(True)
+    spread_until = jnp.where(infected, params.periods_to_spread, 0).astype(jnp.int32)
+    return GossipState(infected=infected, spread_until=spread_until)
+
+
+def gossip_tick(state: GossipState, round_idx, base_key,
+                params: GossipSimParams) -> tuple:
+    """One gossip period (the body of doSpreadGossip, :139-157).
+
+    Returns (new_state, metrics) where metrics is a dict of per-round
+    observables (the TPU analog of the NetworkEmulator counters the
+    reference tests measure with, GossipProtocolTest.java:212-228).
+    """
+    key = prng.round_key(base_key, round_idx)
+    k_targets, k_drop = jax.random.split(key)
+
+    # selectGossipsToSend (:239-250): alive == still within spread window.
+    hot = state.infected & (round_idx < state.spread_until)
+
+    targets = prng.targets_excluding_self(
+        k_targets, params.n_members, params.n_members, params.fanout
+    )
+    drop = prng.bernoulli_mask(
+        k_drop, params.loss_probability, (params.n_members, params.fanout)
+    )
+
+    inbox = delivery.scatter_or(hot, targets, drop, params.n_members)
+
+    newly = inbox & ~state.infected
+    infected = state.infected | inbox
+    spread_until = jnp.where(
+        newly, round_idx + 1 + params.periods_to_spread, state.spread_until
+    )
+
+    # Transmissions this period, per gossip (ClusterMath bound substrate).
+    sent = jnp.sum(hot, axis=0, dtype=jnp.int32) * params.fanout
+    metrics = {
+        "infected_count": jnp.sum(infected, axis=0, dtype=jnp.int32),
+        "messages_sent": sent,
+        "newly_infected": jnp.sum(newly, axis=0, dtype=jnp.int32),
+    }
+    return GossipState(infected=infected, spread_until=spread_until), metrics
+
+
+@partial(jax.jit, static_argnames=("params", "n_rounds"))
+def run(base_key, params: GossipSimParams, n_rounds: int,
+        state: Optional[GossipState] = None):
+    """Scan the gossip tick over ``n_rounds`` periods.
+
+    Returns (final_state, metrics) with metrics arrays of leading dim
+    ``n_rounds`` — the full dissemination trace (infected-count curve =
+    the measured analog of ClusterMath.gossipConvergencePercent).
+    """
+    if state is None:
+        state = initial_state(params)
+
+    def body(carry, round_idx):
+        new_state, metrics = gossip_tick(carry, round_idx, base_key, params)
+        return new_state, metrics
+
+    final_state, metrics = jax.lax.scan(
+        body, state, jnp.arange(n_rounds, dtype=jnp.int32)
+    )
+    return final_state, metrics
+
+
+def dissemination_rounds(metrics, n_members: int):
+    """First round at which each gossip reached all N members (-1 if never).
+
+    The measured counterpart of ClusterMath.gossipDisseminationTime
+    (ClusterMath.java:77-79) in period units.
+    """
+    full = metrics["infected_count"] >= n_members
+    ever = jnp.any(full, axis=0)
+    first = jnp.argmax(full, axis=0)
+    return jnp.where(ever, first, -1)

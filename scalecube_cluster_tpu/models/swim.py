@@ -1,0 +1,598 @@
+"""The full SWIM tick: failure detection + gossip + suspicion + SYNC on TPU.
+
+This is the flagship model: the reference's three protocol components —
+FailureDetectorImpl (random probe + ping-req), GossipProtocolImpl
+(infection-style dissemination) and MembershipProtocolImpl (merge rule,
+suspicion timeouts, incarnation self-refutation, SYNC anti-entropy) — lifted
+into ONE pure state-transition function over dense arrays, scanned over
+protocol rounds with ``jax.lax.scan``.  The lift is faithful because the
+reference already runs each node's whole stack single-threaded on one
+scheduler (SURVEY.md §1): a node's behavior in a period IS a pure function
+of (state, inbound messages, RNG).
+
+State layout — the subject-view matrix
+--------------------------------------
+``[N, K]`` arrays where row i = observer node, column k = *tracked subject*
+(``subject_ids[k]`` is the subject's node index):
+
+  - **full-view mode** (K == N, subjects = everyone): exact dense SWIM,
+    every node tracks every node — the reference semantics, O(N²) state,
+    practical to ~16k members/chip.
+  - **focal mode** (K << N): only K focal subjects' records are tracked
+    through the full protocol machinery; the other N-K members are alive
+    background that probes, relays gossip and syncs.  State is O(N·K), so
+    1M members × 10k rounds fits one chip — this is what produces the
+    dissemination / first-false-positive curves at the BASELINE.md scale
+    (the reference itself never ran above N=50, SURVEY.md §6).
+
+Time quantization: the gossip period is the base round
+(config.ClusterConfig.to_sim); pings fire every ``ping_every`` rounds,
+SYNC every ``sync_every``.  Sub-round timing (pingTimeout vs pingInterval,
+exponential link delays) is resolved in closed form inside the FD phase by
+sampling per-hop delays and comparing sums against the millisecond budgets
+— the phased collapse of the 3-hop ping-req flow (SURVEY.md §7 hard parts).
+
+Documented deviations from the reference (all statistical-regime-neutral):
+  - fanout targets drawn with replacement (ops/prng.py docstring);
+  - FD probe targets drawn uniformly per period instead of round-robin over
+    a shuffled pass (FailureDetectorImpl.java:338-347); detection-time
+    distributions at large N are indistinguishable, and the SWIM paper
+    itself analyzes the uniform variant;
+  - the SYNC exchange is push-only per round (the syncAck pull is replaced
+    by the partner's own future random pushes — symmetric in distribution);
+    an FD ALIVE-verdict on a suspected member pushes the suspect record to
+    the member itself (MembershipProtocolImpl.java:379-391's SYNC), whose
+    self-refutation then travels back by gossip;
+  - gossip per-gossip "infected" sets are not tracked (models/gossip.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu import records, swim_math
+from scalecube_cluster_tpu.ops import delivery, prng
+
+INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+# --------------------------------------------------------------------------
+# Static parameters
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SwimParams:
+    """Compile-time shape/schedule knobs of the SWIM tick.
+
+    Round-quantized from ClusterConfig via :meth:`from_config`
+    (config.ClusterConfig.to_sim describes the quantization rule).
+    Millisecond knobs that resolve *within* a round (ping_timeout_ms,
+    mean_delay_ms) stay in ms and are compared against sampled hop delays.
+    """
+
+    n_members: int
+    n_subjects: int
+    fanout: int
+    periods_to_spread: int
+    ping_every: int
+    sync_every: int
+    suspicion_rounds: int
+    ping_req_members: int
+    # Sub-round timing (ms), resolved in closed form in the FD phase.
+    ping_timeout_ms: float = 500.0
+    ping_interval_ms: float = 1000.0
+    mean_delay_ms: float = 0.0
+    loss_probability: float = 0.0
+    # True: FD probes uniformly among *known* subjects (exact reference
+    # behavior, full-view mode); False: uniformly over the whole cluster
+    # (focal mode, where most members aren't tracked subjects).
+    ping_known_only: bool = True
+    # Per-subject metric columns (disable for K too large to trace).
+    per_subject_metrics: bool = True
+
+    @staticmethod
+    def from_config(config, n_members: int, n_subjects: Optional[int] = None,
+                    loss_probability: float = 0.0, mean_delay_ms: float = 0.0,
+                    **overrides) -> "SwimParams":
+        sim = config.to_sim(n_members)
+        k = n_members if n_subjects is None else n_subjects
+        kwargs = dict(
+            n_members=n_members,
+            n_subjects=k,
+            fanout=sim.gossip_fanout,
+            periods_to_spread=sim.periods_to_spread,
+            ping_every=sim.ping_every,
+            sync_every=sim.sync_every,
+            suspicion_rounds=sim.suspicion_rounds,
+            ping_req_members=sim.ping_req_members,
+            ping_timeout_ms=float(config.ping_timeout),
+            ping_interval_ms=float(config.ping_interval),
+            mean_delay_ms=mean_delay_ms,
+            loss_probability=loss_probability,
+            ping_known_only=(k == n_members),
+        )
+        kwargs.update(overrides)
+        return SwimParams(**kwargs)
+
+    @property
+    def full_view(self) -> bool:
+        return self.n_subjects == self.n_members
+
+
+# --------------------------------------------------------------------------
+# World model: ground truth + fault injection (the NetworkEmulator analog)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SwimWorld:
+    """Ground-truth node liveness + network fault schedule (dynamic arrays).
+
+    The vectorization of the reference's NetworkEmulator
+    (transport/NetworkEmulator.java:21-273) plus process-level faults the
+    reference injects by stopping transports (MembershipProtocolTest
+    partition/restart scenarios, SURVEY.md §4):
+
+      - ``down_from``/``down_until`` [N] int32: node i is crashed during
+        rounds [down_from, down_until) — it neither sends, receives, nor
+        updates state (frozen, like a stopped JVM); on revival it resumes
+        with its old identity and refutes its own death via gossip.
+      - ``partition_of`` [P, N] int8: rolling-partition schedule; at round
+        r, phase (r // partition_phase_rounds) % P is active, and messages
+        cross partition boundaries only if ids match.  A single all-zeros
+        phase means no partition (the default).
+      - ``subject_ids`` [K] int32 / ``slot_of_node`` [N] int32: the focal
+        subject mapping (slot -1 = node is not a tracked subject).
+    """
+
+    down_from: jnp.ndarray
+    down_until: jnp.ndarray
+    partition_of: jnp.ndarray
+    partition_phase_rounds: jnp.ndarray  # int32 scalar
+    subject_ids: jnp.ndarray
+    slot_of_node: jnp.ndarray
+
+    @staticmethod
+    def healthy(params: SwimParams,
+                subject_ids: Optional[jnp.ndarray] = None) -> "SwimWorld":
+        n, k = params.n_members, params.n_subjects
+        if subject_ids is None:
+            subject_ids = jnp.arange(k, dtype=jnp.int32)
+        slot_of_node = (
+            jnp.full((n,), -1, dtype=jnp.int32)
+            .at[subject_ids]
+            .set(jnp.arange(k, dtype=jnp.int32))
+        )
+        return SwimWorld(
+            down_from=jnp.full((n,), INT32_MAX, dtype=jnp.int32),
+            down_until=jnp.full((n,), INT32_MAX, dtype=jnp.int32),
+            partition_of=jnp.zeros((1, n), dtype=jnp.int8),
+            partition_phase_rounds=jnp.int32(1),
+            subject_ids=subject_ids,
+            slot_of_node=slot_of_node,
+        )
+
+    def with_crash(self, node, at_round: int, until_round: int = INT32_MAX):
+        """Crash ``node`` (scalar or array) during [at_round, until_round)."""
+        node = jnp.atleast_1d(jnp.asarray(node, dtype=jnp.int32))
+        return dataclasses.replace(
+            self,
+            down_from=self.down_from.at[node].set(at_round),
+            down_until=self.down_until.at[node].set(until_round),
+        )
+
+    def with_partition_schedule(self, partition_of, phase_rounds: int):
+        partition_of = jnp.asarray(partition_of, dtype=jnp.int8)
+        if partition_of.ndim == 1:
+            partition_of = partition_of[None, :]
+        return dataclasses.replace(
+            self,
+            partition_of=partition_of,
+            partition_phase_rounds=jnp.int32(phase_rounds),
+        )
+
+    def alive_at(self, round_idx):
+        """[N] bool ground-truth liveness at a round."""
+        return ~((self.down_from <= round_idx) & (round_idx < self.down_until))
+
+    def partition_at(self, round_idx):
+        """[N] partition id at a round (rolling schedule)."""
+        phase = (round_idx // self.partition_phase_rounds) % self.partition_of.shape[0]
+        return jax.lax.dynamic_index_in_dim(
+            self.partition_of, phase, axis=0, keepdims=False
+        )
+
+
+jax.tree_util.register_dataclass(
+    SwimWorld,
+    data_fields=[
+        "down_from", "down_until", "partition_of", "partition_phase_rounds",
+        "subject_ids", "slot_of_node",
+    ],
+    meta_fields=[],
+)
+
+
+# --------------------------------------------------------------------------
+# Scan carry
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SwimState:
+    """Scan carry: the distributed membership state, one row per observer.
+
+    ``status``/``inc`` [N, K]: observer's record of each subject — the dense
+    form of ``Map<id, MembershipRecord>`` (MembershipProtocolImpl.java:82).
+    A stored DEAD is the deleted-record tombstone that keeps spreading its
+    death notice (ops/delivery.merge_inbox docstring).
+
+    ``spread_until``    [N, K] int32: gossip retransmission window for the
+                        current record (GossipState.infectionPeriod analog).
+    ``suspect_deadline`` [N, K] int32: round at which a SUSPECT entry is
+                        declared DEAD (suspicionTimeoutTasks analog,
+                        MembershipProtocolImpl.java:96,597-606); INT32_MAX
+                        when no timer is pending.
+    ``self_inc``        [N] int32: own incarnation (bumped by refutation,
+                        MembershipProtocolImpl.java:488-509).
+    """
+
+    status: jnp.ndarray
+    inc: jnp.ndarray
+    spread_until: jnp.ndarray
+    suspect_deadline: jnp.ndarray
+    self_inc: jnp.ndarray
+
+
+jax.tree_util.register_dataclass(
+    SwimState,
+    data_fields=["status", "inc", "spread_until", "suspect_deadline", "self_inc"],
+    meta_fields=[],
+)
+
+
+def initial_state(params: SwimParams, world: SwimWorld,
+                  warm: bool = True) -> SwimState:
+    """Warm start: everyone knows every subject ALIVE at incarnation 0.
+
+    (The post-join steady state; seed-join growth is exercised separately
+    by starting rows ABSENT.)  A node's record about *itself* is pinned
+    ALIVE at its own incarnation.
+    """
+    n, k = params.n_members, params.n_subjects
+    fill = records.ALIVE if warm else records.ABSENT
+    status = jnp.full((n, k), fill, dtype=jnp.int8)
+    is_self = world.subject_ids[None, :] == jnp.arange(n, dtype=jnp.int32)[:, None]
+    status = jnp.where(is_self, records.ALIVE, status)
+    return SwimState(
+        status=status,
+        inc=jnp.zeros((n, k), dtype=jnp.int32),
+        spread_until=jnp.zeros((n, k), dtype=jnp.int32),
+        suspect_deadline=jnp.full((n, k), INT32_MAX, dtype=jnp.int32),
+        self_inc=jnp.zeros((n,), dtype=jnp.int32),
+    )
+
+
+# --------------------------------------------------------------------------
+# The tick
+# --------------------------------------------------------------------------
+
+
+def _hop_ok(key, loss_probability, mean_delay_ms, budget_ms, n_hops, shape):
+    """P2P multi-hop success: every hop delivered AND total delay <= budget.
+
+    Vectorizes NetworkLinkSettings.evaluateLoss/evaluateDelay
+    (transport/NetworkLinkSettings.java:54-74) over ``n_hops`` chained hops
+    with a shared millisecond budget (the reference's Reactor
+    ``.timeout(duration)``, FailureDetectorImpl.java:152).
+    """
+    keys = jax.random.split(key, n_hops * 2)
+    ok = jnp.ones(shape, dtype=jnp.bool_)
+    total_delay = jnp.zeros(shape, dtype=jnp.float32)
+    for h in range(n_hops):
+        ok &= ~prng.bernoulli_mask(keys[2 * h], loss_probability, shape)
+        total_delay += prng.exponential_delay(keys[2 * h + 1], mean_delay_ms, shape)
+    return ok & (total_delay <= budget_ms)
+
+
+def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
+              world: SwimWorld, offset=0, axis_name: Optional[str] = None):
+    """One protocol round.  Pure: (state, r, key) -> (state', metrics).
+
+    Phases (matching the reference's periodic loops, SURVEY.md §3.2-3.4):
+      1. FD probe (every ping_every rounds): pick target, direct ping with
+         ping_timeout, else ping-req via k proxies — collapsed in closed
+         form over the loss/delay model; SUSPECT verdicts merge locally,
+         ALIVE-on-suspected pushes the record to the subject (SYNC analog).
+      2. Gossip send: every node pushes its hot records to fanout targets.
+      3. SYNC (every sync_every rounds): push the full row to one random
+         member (anti-entropy, MembershipProtocolImpl.java:439-454).
+      4. Merge all inboxes through the is_overrides lattice; self-records
+         refute (incarnation bump); suspicion timers set/cancel/fire.
+
+    Sharding: ``state`` rows may be a contiguous slice of the global member
+    axis (``offset`` = first global row).  Senders scatter into a
+    global-height inbox contribution; under ``shard_map`` the contributions
+    combine with one ``lax.pmax`` over ``axis_name`` — the ICI collective
+    that replaces the reference's point-to-point TCP (SURVEY.md §5.8) —
+    and each device keeps its own row slice.  With ``axis_name=None`` and
+    ``offset=0`` this is the single-device path unchanged.
+    """
+    n, k = params.n_members, params.n_subjects
+    n_local = state.status.shape[0]
+    # Fold both the round and the shard offset so draws are independent
+    # across rounds AND across devices (ops/prng.py module docstring).
+    key = prng.round_key(prng.round_key(base_key, round_idx), offset)
+    (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t, k_gossip_drop,
+     k_sync_t, k_sync_drop) = jax.random.split(key, 8)
+
+    def combine_max(buf):
+        """Cross-device inbox combine + own-row slice."""
+        if axis_name is not None:
+            buf = jax.lax.pmax(buf, axis_name)
+        if n_local == n and axis_name is None:
+            return buf
+        return jax.lax.dynamic_slice_in_dim(buf, offset, n_local, axis=0)
+
+    def global_sum(x):
+        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+
+    alive = world.alive_at(round_idx)                       # [N] ground truth
+    part = world.partition_at(round_idx)                    # [N]
+    node_ids = jnp.arange(n_local, dtype=jnp.int32) + offset    # global ids
+    alive_here = alive[node_ids]                            # [n_local]
+    is_self = world.subject_ids[None, :] == node_ids[:, None]   # [n_local, K]
+
+    # Row i's record about itself is pinned (a node always believes itself
+    # ALIVE at self_inc — MembershipProtocolImpl drops self-updates and
+    # refutes instead, :488-509).
+    status = jnp.where(is_self, records.ALIVE, state.status)
+    inc = jnp.where(is_self, state.self_inc[:, None], state.inc)
+
+    def same_partition(a_ids, b_ids):
+        return part[a_ids] == part[b_ids]
+
+    # ---- Phase 1: failure detector probe --------------------------------
+    fd_round = (round_idx % params.ping_every) == 0
+
+    if params.ping_known_only:
+        # Uniform among known live-record subjects (FailureDetectorImpl
+        # pingMembers list, :48-49) — exact in full-view mode.
+        eligible = (~is_self) & (
+            (status == records.ALIVE) | (status == records.SUSPECT)
+        )
+        slot, has_target = prng.choose_eligible(k_ping_t, eligible)
+        ping_target = world.subject_ids[slot]               # [n_local] node ids
+    else:
+        # Focal mode: probe the whole cluster uniformly; only probes that
+        # land on tracked subjects affect tracked state.
+        ping_target = prng.targets_excluding_self(
+            k_ping_t, n_local, n, 1, sender_offset=offset
+        )[:, 0]
+        slot = world.slot_of_node[ping_target]              # -1 = untracked
+        has_target = slot >= 0
+        eligible_t = (
+            jnp.take_along_axis(status, jnp.maximum(slot, 0)[:, None], 1)[:, 0]
+        )
+        has_target &= (eligible_t == records.ALIVE) | (eligible_t == records.SUSPECT)
+
+    t = ping_target
+    # Direct ping: 2 hops within ping_timeout (FailureDetectorImpl.java:128-176).
+    direct_ok = (
+        _hop_ok(k_ping_net, params.loss_probability, params.mean_delay_ms,
+                params.ping_timeout_ms, 2, (n_local,))
+        & alive[t] & same_partition(node_ids, t)
+    )
+    # Ping-req through R proxies: 4 hops within (ping_interval - ping_timeout)
+    # (:178-213; transit relay :258-315).
+    r_proxies = params.ping_req_members
+    proxies = prng.targets_excluding_self(
+        k_proxy, n_local, n, r_proxies, sender_offset=offset
+    )
+    proxy_ok = (
+        _hop_ok(k_proxy_net, params.loss_probability, params.mean_delay_ms,
+                params.ping_interval_ms - params.ping_timeout_ms, 4,
+                (n_local, r_proxies))
+        & alive[proxies] & alive[t][:, None]
+        & same_partition(node_ids[:, None], proxies)
+        & same_partition(proxies, t[:, None])
+        & (proxies != t[:, None])
+    )
+    ack_ok = direct_ok | jnp.any(proxy_ok, axis=1)
+    probe_active = fd_round & has_target & alive_here       # [n_local]
+    verdict_suspect = probe_active & ~ack_ok
+    verdict_alive = probe_active & ack_ok
+
+    # SUSPECT verdict -> local record (SUSPECT, entry inc) for the target
+    # slot (onFailureDetectorEvent, MembershipProtocolImpl.java:392-397).
+    slot_safe = jnp.maximum(slot, 0)
+    fd_slot_onehot = (
+        jnp.arange(k, dtype=jnp.int32)[None, :] == slot_safe[:, None]
+    )
+    fd_suspect_key = delivery.pack_record(
+        jnp.int8(records.SUSPECT),
+        jnp.take_along_axis(inc, slot_safe[:, None], 1)[:, 0],
+    )
+    fd_inbox = jnp.where(
+        fd_slot_onehot & verdict_suspect[:, None],
+        fd_suspect_key[:, None],
+        delivery.NO_MESSAGE,
+    )
+
+    # ALIVE verdict on a suspected entry -> push the suspect record to the
+    # member itself so it can refute (the reference sends SYNC there,
+    # :379-391; the refutation travels back via gossip).
+    entry_t_status = jnp.take_along_axis(status, slot_safe[:, None], 1)[:, 0]
+    push_refute = verdict_alive & (entry_t_status == records.SUSPECT)
+
+    # ---- Phase 2 + 3: gossip and SYNC sends ------------------------------
+    # Hot records: changed within the spread window; DEAD tombstones
+    # transmit their death notice (GossipProtocolImpl.java:239-250).
+    hot = (status != records.ABSENT) & (round_idx < state.spread_until)
+    record_keys = delivery.pack_record(status, inc)          # [n_local, K]
+    gossip_keys = jnp.where(hot, record_keys, delivery.NO_MESSAGE)
+
+    gossip_targets = prng.targets_excluding_self(
+        k_gossip_t, n_local, n, params.fanout, sender_offset=offset
+    )
+    send_ok = alive_here[:, None] & alive[gossip_targets] \
+        & same_partition(node_ids[:, None], gossip_targets)
+    gossip_drop = (
+        prng.bernoulli_mask(k_gossip_drop, params.loss_probability,
+                            (n_local, params.fanout))
+        | ~send_ok
+    )
+
+    # SYNC: full-row push to one random member (doSync,
+    # MembershipProtocolImpl.java:298-314) — tombstones masked out (the
+    # reference table holds no DEAD records, so SYNC never carries them).
+    sync_round = (round_idx % params.sync_every) == 0
+    sync_keys = jnp.where(status == records.DEAD, delivery.NO_MESSAGE, record_keys)
+    sync_target = prng.targets_excluding_self(
+        k_sync_t, n_local, n, 1, sender_offset=offset
+    )
+    # FD's alive-on-suspected push reuses the sync channel, aimed at the
+    # suspected member itself.
+    sync_target = jnp.where(push_refute[:, None], t[:, None], sync_target)
+    do_sync = (sync_round & alive_here) | push_refute
+    sync_ok = (
+        alive[sync_target[:, 0]]
+        & same_partition(node_ids, sync_target[:, 0])
+        & ~prng.bernoulli_mask(k_sync_drop, params.loss_probability, (n_local,))
+    )
+    sync_drop = (~(do_sync & sync_ok))[:, None]
+
+    # Accumulate all send channels into one global-height contribution,
+    # then a single cross-device combine (one pmax per round).
+    inbox_buf = jnp.maximum(
+        delivery.scatter_max(gossip_keys, gossip_targets, gossip_drop, n),
+        delivery.scatter_max(sync_keys, sync_target, sync_drop, n),
+    )
+    alive_flags = (gossip_keys >= 0) & (status == records.ALIVE)
+    sync_alive_flags = (sync_keys >= 0) & (status == records.ALIVE)
+    alive_buf = (
+        delivery.scatter_or(alive_flags, gossip_targets, gossip_drop, n)
+        | delivery.scatter_or(sync_alive_flags, sync_target, sync_drop, n)
+    )
+    inbox = combine_max(inbox_buf)
+    inbox_alive = combine_max(alive_buf.astype(jnp.int8)).astype(jnp.bool_)
+
+    # FD local verdicts fold into the same inbox (observer-local, no comm).
+    inbox = jnp.maximum(inbox, fd_inbox)
+
+    # ---- Phase 4: merge + timers ----------------------------------------
+    new_status, new_inc, changed = delivery.merge_inbox(
+        status, inc, inbox, inbox_alive
+    )
+
+    # Self-refutation (updateMembership about-self branch, :488-509): if the
+    # inbound winner about ME overrides my ALIVE@self_inc record, bump to
+    # max(inc)+1 and gossip the refutation (spread reset via `changed`).
+    win_status, win_inc = delivery.unpack_record(inbox)
+    self_overridden = is_self & records.is_overrides_array(
+        win_status, win_inc, records.ALIVE, state.self_inc[:, None]
+    )
+    refuted = jnp.any(self_overridden, axis=1)
+    bumped_inc = jnp.maximum(
+        state.self_inc,
+        jnp.max(jnp.where(self_overridden, win_inc, 0), axis=1),
+    ) + 1
+    new_self_inc = jnp.where(refuted & alive_here, bumped_inc, state.self_inc)
+    new_status = jnp.where(is_self, records.ALIVE, new_status)
+    new_inc = jnp.where(is_self, new_self_inc[:, None], new_inc)
+    changed = jnp.where(is_self, self_overridden & alive_here[:, None], changed)
+
+    # Suspicion timers (scheduleSuspicionTimeoutTask / cancel,
+    # MembershipProtocolImpl.java:518-523,590-606).  ``computeIfAbsent``
+    # semantics: an accepted SUSPECT update does NOT reset a pending timer;
+    # any accepted non-SUSPECT update cancels it.
+    no_timer = state.suspect_deadline == INT32_MAX
+    start_timer = changed & (new_status == records.SUSPECT) & no_timer
+    cancel_timer = changed & (new_status != records.SUSPECT)
+    deadline = jnp.where(
+        start_timer,
+        round_idx + params.suspicion_rounds,
+        jnp.where(cancel_timer, INT32_MAX, state.suspect_deadline),
+    )
+    # Timer fires -> DEAD at the same incarnation (onSuspicionTimeout,
+    # :608-618); the tombstone spreads its death notice.
+    fired = (new_status == records.SUSPECT) & (round_idx >= deadline)
+    new_status = jnp.where(fired, records.DEAD, new_status)
+    deadline = jnp.where(fired, INT32_MAX, deadline)
+    changed = changed | fired
+
+    # Crashed nodes are frozen (a stopped JVM): no state updates at all.
+    frozen = ~alive_here[:, None]
+    new_status = jnp.where(frozen, status, new_status)
+    new_inc = jnp.where(frozen, inc, new_inc)
+    deadline = jnp.where(frozen, state.suspect_deadline, deadline)
+    changed = changed & ~frozen
+
+    spread_until = jnp.where(
+        changed, round_idx + 1 + params.periods_to_spread, state.spread_until
+    )
+
+    new_state = SwimState(
+        status=new_status.astype(jnp.int8),
+        inc=new_inc.astype(jnp.int32),
+        spread_until=spread_until.astype(jnp.int32),
+        suspect_deadline=deadline.astype(jnp.int32),
+        self_inc=new_self_inc.astype(jnp.int32),
+    )
+
+    # ---- Metrics (the per-round observability tensors, SURVEY.md §5.1) ---
+    observer_alive = alive_here[:, None]
+    subject_alive = alive[world.subject_ids][None, :]
+    counts = {}
+    for name, code in (("alive", records.ALIVE), ("suspect", records.SUSPECT),
+                       ("dead", records.DEAD), ("absent", records.ABSENT)):
+        mask = (new_status == code) & observer_alive & ~is_self
+        counts[name] = global_sum(
+            jnp.sum(mask, axis=0, dtype=jnp.int32)
+            if params.per_subject_metrics
+            else jnp.sum(mask, dtype=jnp.int32)
+        )
+    # False positive: a live observer holds SUSPECT/DEAD about a live subject.
+    fp_mask = (
+        ((new_status == records.SUSPECT) | (new_status == records.DEAD))
+        & observer_alive & subject_alive & ~is_self
+    )
+    metrics = dict(
+        counts,
+        false_positives=global_sum(
+            jnp.sum(fp_mask, axis=0, dtype=jnp.int32)
+            if params.per_subject_metrics
+            else jnp.sum(fp_mask, dtype=jnp.int32)
+        ),
+        messages_gossip=global_sum(jnp.sum(
+            jnp.any(hot, axis=1)[:, None] & ~gossip_drop, dtype=jnp.int32
+        )),
+        messages_ping=global_sum(jnp.sum(probe_active, dtype=jnp.int32)),
+        refutations=global_sum(jnp.sum(refuted & alive_here, dtype=jnp.int32)),
+    )
+    return new_state, metrics
+
+
+@partial(jax.jit, static_argnames=("params", "n_rounds"))
+def run(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
+        state: Optional[SwimState] = None, start_round: int = 0):
+    """Scan the SWIM tick over ``n_rounds`` rounds from ``start_round``.
+
+    Returns (final_state, metrics-dict of [n_rounds, ...] traces).
+    ``start_round``/``state`` support checkpoint-resume: re-enter the scan
+    at round r with a restored carry (SURVEY.md §5.4).
+    """
+    if state is None:
+        state = initial_state(params, world)
+
+    def body(carry, round_idx):
+        return swim_tick(carry, round_idx, base_key, params, world)
+
+    rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
+    return jax.lax.scan(body, state, rounds)

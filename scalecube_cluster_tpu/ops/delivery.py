@@ -1,0 +1,148 @@
+"""Dense message delivery: the TPU-native transport fast path.
+
+This is the ``TpuSimTransport`` seam from SURVEY.md §5.8: instead of netty
+sockets (reference: transport/TransportImpl.java:257-269, ``send0`` piping
+each message through the NetworkEmulator and a TCP connection), a round's
+worth of messages is one batched tensor exchange:
+
+  - a *record* (subject status + incarnation) packs into one int32 sort key
+    whose max implements the SWIM merge winner (records.merge_key);
+  - "send" = scatter the sender's packed row into the receivers' inbox
+    with a max combiner; duplicate targets fold associatively, so the
+    scatter IS the merge — no per-message materialization;
+  - "listen" = read your inbox row next round.
+
+Timeouts become round comparisons, correlation ids become (round, slot)
+indices, and the NetworkEmulator's per-link loss/delay becomes the ``drop``
+mask argument (SURVEY.md §7 design mapping).
+
+Sharding: receivers (rows) are sharded over devices; each device scatters
+its local senders' messages into a full-width inbox contribution and the
+cross-device combine is a single ``pmax`` (see parallel/mesh.py) — the
+ICI-collective analog of the reference's point-to-point TCP.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu import records
+
+# Inbox key for "no message": below every real record key (merge_key >= 0
+# for any non-ABSENT record; ABSENT maps to -1 and never wins).
+NO_MESSAGE = jnp.int32(-1)
+
+_INC_MASK = (1 << 29) - 1
+
+
+def pack_record(status, inc):
+    """Pack (status, incarnation) into the int32 merge key (records.merge_key).
+
+    ABSENT packs to -1 == NO_MESSAGE: absent entries are simply never
+    transmitted, matching the reference where only table-present records go
+    into SYNC/gossip payloads (MembershipProtocolImpl.java:446-454).
+    """
+    return records.merge_key(status, inc)
+
+
+def unpack_record(key):
+    """Invert :func:`pack_record`: key -> (status int8, incarnation int32).
+
+    Keys < 0 unpack to (ABSENT, 0).
+    """
+    key = jnp.asarray(key, dtype=jnp.int32)
+    is_dead = (key >> 30) & 1
+    is_suspect = key & 1
+    status = jnp.where(
+        is_dead == 1,
+        records.DEAD,
+        jnp.where(is_suspect == 1, records.SUSPECT, records.ALIVE),
+    )
+    status = jnp.where(key < 0, records.ABSENT, status).astype(jnp.int8)
+    inc = jnp.where(key < 0, 0, (key >> 1) & _INC_MASK).astype(jnp.int32)
+    return status, inc
+
+
+def scatter_max(values, targets, drop, n_rows: int):
+    """Deliver each sender's record row to its targets; inbox = per-cell max.
+
+    Args:
+      values:  ``[S, K]`` int32 packed record keys per sender (NO_MESSAGE for
+               slots the sender does not transmit).
+      targets: ``[S, F]`` int32 receiver row indices per sender (global).
+      drop:    ``[S, F]`` bool, True = message lost in flight (the
+               NetworkEmulator seam, reference NetworkEmulator.java:132-192).
+      n_rows:  global receiver count (inbox height).
+
+    Returns ``[n_rows, K]`` int32 inbox: the max packed key received per
+    (receiver, subject), NO_MESSAGE where nothing arrived.
+
+    The fanout axis is unrolled (F is 3-4, reference gossipFanout default
+    ClusterConfig.java:34-36); each step is one XLA scatter-max, which TPU
+    lowers natively; duplicate-index collisions combine associatively.
+    """
+    n_fanout = targets.shape[1]
+    inbox = jnp.full((n_rows, values.shape[1]), NO_MESSAGE, dtype=jnp.int32)
+    for f in range(n_fanout):
+        contribution = jnp.where(drop[:, f, None], NO_MESSAGE, values)
+        inbox = inbox.at[targets[:, f]].max(contribution, mode="drop")
+    return inbox
+
+
+def scatter_or(flags, targets, drop, n_rows: int):
+    """Boolean variant of :func:`scatter_max`: inbox = any sender flagged.
+
+    Used for the ALIVE-gate side channel (records.merge_inbound's null-gate:
+    an ABSENT entry opens only for an ALIVE record,
+    MembershipRecord.java:67-69), and for pure infection bits in the
+    gossip-only model.
+    """
+    n_fanout = targets.shape[1]
+    inbox = jnp.zeros((n_rows, flags.shape[1]), dtype=jnp.bool_)
+    for f in range(n_fanout):
+        contribution = flags & ~drop[:, f, None]
+        inbox = inbox.at[targets[:, f]].max(contribution, mode="drop")
+    return inbox
+
+
+def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive):
+    """Merge one round's inbox into the membership table rows.
+
+    Equivalent to one valid arrival-order serialization of the reference's
+    per-message ``updateMembership`` loop (MembershipProtocolImpl.java:475-541)
+    — see records.merge_inbound for the argument; here the fold over inbound
+    records already happened inside the scatter (max of packed keys), so only
+    the entry-gate logic remains:
+
+      - ABSENT entry: opens only if some ALIVE record arrived
+        (``inbox_any_alive``); once open, the winner always applies (its key
+        dominates the gate-opener's, and every >= -comparison in
+        MembershipRecord.java:76-83 is monotone in the packed key).
+      - live entry: standard ``is_overrides`` gate against the winner.
+
+    Stored DEAD semantics: an accepted DEAD record *removes* the entry in the
+    reference (MembershipProtocolImpl.java:512-516), so for merge gating a
+    stored DEAD behaves like ABSENT (a later ALIVE at any incarnation is
+    re-accepted — the deliberate no-tombstone design, SURVEY.md §5.3,
+    exercised by MembershipProtocolTest.testRestartFailedMembers).  We keep
+    the DEAD code + incarnation in the table anyway so death notices keep
+    spreading for their remaining gossip periods (the reference's gossip
+    component retransmits independently of the table,
+    GossipProtocolImpl.java:239-250); transmission masks decide visibility.
+
+    Returns (status int8, inc int32, changed bool).
+    """
+    win_status, win_inc = unpack_record(inbox_key)
+
+    # Stored DEAD gates like ABSENT (record was deleted in the reference).
+    gate_status = jnp.where(entry_status == records.DEAD, records.ABSENT, entry_status)
+
+    accepts = records.is_overrides_array(win_status, win_inc, gate_status, entry_inc)
+    # The ABSENT gate: only an ALIVE opener admits the winner.
+    absent = gate_status == records.ABSENT
+    accepts = jnp.where(absent, inbox_any_alive & (win_status != records.ABSENT), accepts)
+
+    new_status = jnp.where(accepts, win_status, entry_status).astype(jnp.int8)
+    new_inc = jnp.where(accepts, win_inc, entry_inc).astype(jnp.int32)
+    changed = accepts & ((new_status != entry_status) | (new_inc != entry_inc))
+    return new_status, new_inc, changed

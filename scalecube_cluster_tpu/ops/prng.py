@@ -1,0 +1,82 @@
+"""Counter-based randomness for the dense protocol tick.
+
+The reference draws randomness imperatively per node (`ThreadLocalRandom` +
+`Collections.shuffle`, e.g. fdetector/FailureDetectorImpl.java:338-361,
+gossip/GossipProtocolImpl.java:252-273) — unseeded, so failures don't
+reproduce (SURVEY.md §4 weaknesses).  The TPU tick inverts this: every draw
+is a pure function of ``(experiment key, round index)`` via ``fold_in``, so
+runs are bit-reproducible and — crucially for sharding — every device can
+regenerate any other device's draws without communication (SURVEY.md §7
+"sharded randomized peer selection without gathers").
+
+All helpers take an already-folded per-round key; callers derive it with
+:func:`round_key` once per tick.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def round_key(base_key, round_idx):
+    """Per-round PRNG key: fold the round counter into the experiment key."""
+    return jax.random.fold_in(base_key, round_idx)
+
+
+def targets_excluding_self(key, n_senders: int, n_members: int, fanout: int,
+                           sender_offset: int = 0):
+    """Uniform random message targets, self excluded: ``[n_senders, fanout]``.
+
+    Models the reference's fanout-member selection
+    (gossip/GossipProtocolImpl.java:252-273: a fanout-sized window over a
+    shuffled remote-member list).  Deviation, documented: the reference picks
+    *distinct* members per round; we draw with replacement, which at fanout F
+    collides with probability ~F²/n — negligible for the statistical regimes
+    this simulator targets and tolerated by the protocol (delivery dedups,
+    GossipProtocolImpl.java:176-180).
+
+    ``sender_offset`` is the global row index of sender 0 (for sharded
+    callers whose local rows are a slice of the global member axis).
+    """
+    draws = jax.random.randint(key, (n_senders, fanout), 0, n_members - 1)
+    sender_ids = jnp.arange(n_senders, dtype=draws.dtype)[:, None] + sender_offset
+    # Shift draws >= self up by one: uniform over the other n-1 members.
+    return jnp.where(draws >= sender_ids, draws + 1, draws)
+
+
+def bernoulli_mask(key, prob, shape):
+    """Per-message loss draw (NetworkLinkSettings.evaluateLoss analog).
+
+    Reference: transport/NetworkLinkSettings.java:54-57 (``p% Bernoulli``).
+    ``prob`` may be a scalar or broadcastable per-sender/per-edge array.
+    """
+    return jax.random.uniform(key, shape) < prob
+
+
+def exponential_delay(key, mean_ms, shape):
+    """Exponential per-hop delay draw (NetworkLinkSettings.evaluateDelay).
+
+    Reference: transport/NetworkLinkSettings.java:64-74 —
+    ``-ln(1-U) * mean`` with U uniform in [0, 1).
+    """
+    u = jax.random.uniform(key, shape)
+    return -jnp.log1p(-u) * mean_ms
+
+
+def choose_eligible(key, eligible, axis: int = -1):
+    """Uniformly choose one index among ``eligible`` entries per row.
+
+    Vectorized analog of the reference's "pick a random live member"
+    (fdetector/FailureDetectorImpl.java:338-347 selects from the current
+    peer list).  Uses the Gumbel-argmax trick so it stays one fused
+    elementwise pass + reduce on the VPU.
+
+    Returns ``(index, any_eligible)``; ``index`` is arbitrary (0) where no
+    entry is eligible — callers must gate on ``any_eligible``.
+    """
+    gumbel = jax.random.gumbel(key, eligible.shape)
+    scores = jnp.where(eligible, gumbel, -jnp.inf)
+    idx = jnp.argmax(scores, axis=axis)
+    any_eligible = jnp.any(eligible, axis=axis)
+    return idx, any_eligible

@@ -15,7 +15,6 @@ from typing import Callable, List, Optional
 from scalecube_cluster_tpu.oracle.core import (
     CorrelationIdGenerator,
     Member,
-    SimFuture,
     Simulator,
 )
 from scalecube_cluster_tpu.oracle.transport import Message, Transport

@@ -20,7 +20,6 @@ from scalecube_cluster_tpu.oracle.core import (
     Member,
     SimFuture,
     Simulator,
-    TimeoutError_,
     Timer,
 )
 from scalecube_cluster_tpu.oracle.fdetector import FailureDetector, FailureDetectorEvent

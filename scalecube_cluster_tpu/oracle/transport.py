@@ -15,13 +15,12 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional
 
 from scalecube_cluster_tpu.oracle.core import (
     Address,
     SimFuture,
     Simulator,
-    TimeoutError_,
 )
 
 

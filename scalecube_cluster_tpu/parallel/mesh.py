@@ -1,0 +1,106 @@
+"""Device-mesh sharding for the SWIM tick: rows over chips, pmax over ICI.
+
+The reference's "distributed" axis is n independent JVM nodes over TCP
+(SURVEY.md §2.5); here the analogous first-class parallelism is
+**node-sharded data parallelism**: the ``[N, K]`` per-observer state rows
+are sharded across TPU devices on a 1-D ``jax.sharding.Mesh``, the whole
+round loop runs inside one ``shard_map``-ped ``lax.scan``, and the only
+cross-device traffic is the per-round inbox combine (``lax.pmax`` of the
+packed-record contribution buffer — ops/delivery.py) riding ICI.
+
+Multi-host scale-out is the same program on a larger mesh: jax places the
+mesh over DCN-connected hosts and the identical collective lowers to
+ICI-within-slice / DCN-across-slices.  Nothing in the model code changes —
+that is the point of designing delivery as one associative reduction.
+
+Randomness under sharding: each device folds its global row offset into the
+per-round key (models/swim.swim_tick), so draws are independent across
+devices but the trace is only bit-reproducible for a fixed mesh size (the
+single-device trace is the oracle-checked one; sharded runs are validated
+statistically and for invariants — tests/test_parallel.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from scalecube_cluster_tpu.models import swim
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = NODE_AXIS) -> Mesh:
+    """1-D device mesh over the first ``n_devices`` available devices."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def state_sharding(mesh: Mesh) -> NamedSharding:
+    """Row sharding for SwimState arrays ([N, ...] split on the node axis)."""
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+@partial(jax.jit, static_argnames=("params", "n_rounds", "mesh"))
+def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
+              n_rounds: int, mesh: Mesh,
+              state: Optional[swim.SwimState] = None, start_round: int = 0):
+    """models/swim.run, row-sharded over ``mesh``.
+
+    The scan lives *inside* shard_map, so the per-round pmax is the only
+    collective XLA emits and the whole n_rounds loop compiles to one
+    per-device program.  World arrays ([N] ground truth / fault schedule)
+    are replicated — they are O(N) scalars, not O(N·K) state.
+
+    Returns (final_state, metrics) with state rows sharded over the mesh
+    and metrics replicated (already psum-combined inside the tick).
+    """
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    if params.n_members % n_dev != 0:
+        raise ValueError(
+            f"n_members ({params.n_members}) must divide the mesh size ({n_dev})"
+        )
+    n_local = params.n_members // n_dev
+
+    if state is None:
+        state = swim.initial_state(params, world)
+
+    state_specs = swim.SwimState(
+        status=P(axis), inc=P(axis), spread_until=P(axis),
+        suspect_deadline=P(axis), self_inc=P(axis),
+    )
+    world_specs = jax.tree.map(lambda _: P(), world)
+    metric_spec = P()
+
+    def sharded_body(base_key, world, state):
+        offset = jax.lax.axis_index(axis) * n_local
+
+        def body(carry, round_idx):
+            return swim.swim_tick(
+                carry, round_idx, base_key, params, world,
+                offset=offset, axis_name=axis,
+            )
+
+        rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
+        return jax.lax.scan(body, state, rounds)
+
+    out_metric_specs = {
+        name: metric_spec
+        for name in ("alive", "suspect", "dead", "absent", "false_positives",
+                     "messages_gossip", "messages_ping", "refutations")
+    }
+    return jax.shard_map(
+        sharded_body,
+        mesh=mesh,
+        in_specs=(P(), world_specs, state_specs),
+        out_specs=(state_specs, out_metric_specs),
+        check_vma=False,
+    )(base_key, world, state)

@@ -8,7 +8,15 @@ hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when a real TPU (e.g. JAX_PLATFORMS=axon) is attached:
+# unit tests exercise the virtual 8-device mesh; the real chip is for
+# bench.py only.  The axon image pins jax_platforms at jax-import time, so
+# the env var alone is not enough — override the config after import too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

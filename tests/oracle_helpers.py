@@ -1,0 +1,16 @@
+"""Shared oracle-test fixtures: the sped-up timing preset + id helper.
+
+One definition so the membership and cluster e2e suites always exercise
+identical protocol timings (the analog of the reference's shared test
+config, MembershipProtocolTest.java:545-554).
+"""
+
+from scalecube_cluster_tpu.config import ClusterConfig
+
+FAST = ClusterConfig.default_local().replace(
+    sync_interval=2_000, ping_interval=500, ping_timeout=200, gossip_interval=100
+)
+
+
+def ids(members):
+    return sorted(m.id for m in members)

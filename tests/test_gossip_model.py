@@ -1,0 +1,123 @@
+"""Tests for the gossip-only TPU model (models/gossip.py).
+
+Mirrors the reference's statistical experiment design
+(GossipProtocolTest.java:50-66: matrix over {N, loss, delay}; asserts full
+dissemination within the sweep window and no double delivery; compares
+measured curves to ClusterMath predictions at :178-205).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu import swim_math
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models import gossip
+
+
+def make_params(n, fanout=3, repeat_mult=3, loss=0.0, n_gossips=1):
+    config = ClusterConfig.default().replace(
+        gossip_fanout=fanout, gossip_repeat_mult=repeat_mult
+    )
+    return gossip.GossipSimParams.from_config(
+        config, n_members=n, n_gossips=n_gossips, loss_probability=loss
+    )
+
+
+class TestDissemination:
+    @pytest.mark.parametrize("n", [2, 3, 5, 10, 50])
+    def test_full_dissemination_no_loss(self, n):
+        """Lossless gossip reaches all N within the sweep window (reference
+        envelope: GossipProtocolTest.java:156-175 asserts the same)."""
+        params = make_params(n)
+        sweep = swim_math.gossip_periods_to_sweep(3, n)
+        _, metrics = gossip.run(jax.random.key(0), params, sweep)
+        rounds = gossip.dissemination_rounds(metrics, n)
+        assert int(rounds[0]) >= 0, "gossip never fully disseminated"
+
+    def test_dissemination_near_analytic_prediction(self):
+        """Measured full-dissemination round tracks repeatMult*ceilLog2(n+1)
+        (ClusterMath.java:111-113) within a small factor."""
+        n = 128
+        params = make_params(n)
+        predicted = swim_math.gossip_periods_to_spread(3, n)
+        _, metrics = gossip.run(jax.random.key(1), params, 4 * predicted)
+        measured = int(gossip.dissemination_rounds(metrics, n)[0])
+        assert 0 < measured <= predicted, (measured, predicted)
+
+    @pytest.mark.parametrize("loss", [0.10, 0.25])
+    def test_dissemination_under_loss(self, loss):
+        """Under <=25% loss, dissemination still completes within the sweep
+        window with margin (reference matrix runs loss in {0,10,25,50}%)."""
+        n = 50
+        params = make_params(n, loss=loss, n_gossips=4)
+        sweep = swim_math.gossip_periods_to_sweep(3, n)
+        _, metrics = gossip.run(jax.random.key(2), params, sweep)
+        rounds = np.asarray(gossip.dissemination_rounds(metrics, n))
+        assert np.all(rounds >= 0), rounds
+
+    def test_convergence_probability_vs_cluster_math(self):
+        """Fraction of fully-converged gossips >= the analytic lower-ish bound
+        (ClusterMath.java:38-43), the reference's published model."""
+        n, loss = 64, 0.25
+        params = make_params(n, loss=loss, n_gossips=64)
+        sweep = swim_math.gossip_periods_to_sweep(3, n)
+        _, metrics = gossip.run(jax.random.key(3), params, sweep)
+        rounds = np.asarray(gossip.dissemination_rounds(metrics, n))
+        measured = float(np.mean(rounds >= 0))
+        predicted = swim_math.gossip_convergence_probability(3, 3, n, loss)
+        assert measured >= predicted - 0.05, (measured, predicted)
+
+
+class TestProtocolInvariants:
+    def test_messages_bounded_by_cluster_math(self):
+        """Per-gossip transmissions <= fanout*repeatMult*ceilLog2(n+1) per node
+        (ClusterMath.java:65-67 worst-case bound) aggregated over nodes."""
+        n = 50
+        params = make_params(n)
+        sweep = swim_math.gossip_periods_to_sweep(3, n)
+        _, metrics = gossip.run(jax.random.key(4), params, sweep)
+        total = int(np.asarray(metrics["messages_sent"]).sum())
+        bound = swim_math.max_messages_per_gossip_total(3, 3, n)
+        assert total <= bound, (total, bound)
+
+    def test_no_double_delivery(self):
+        """newly_infected totals N-1 + origin exactly once per gossip — the
+        dedup-by-id assertion of GossipProtocolTest.java:156-175."""
+        n = 32
+        params = make_params(n, n_gossips=3)
+        sweep = swim_math.gossip_periods_to_sweep(3, n)
+        _, metrics = gossip.run(jax.random.key(5), params, sweep)
+        newly_total = np.asarray(metrics["newly_infected"]).sum(axis=0)
+        assert np.all(newly_total <= n - 1)
+
+    def test_spread_stops_after_sweep_window(self):
+        """After every member's spread window closes, no more messages flow
+        (sweepGossips analog, GossipProtocolImpl.java:283-308)."""
+        n = 16
+        params = make_params(n)
+        sweep = swim_math.gossip_periods_to_sweep(3, n)
+        horizon = 3 * sweep
+        _, metrics = gossip.run(jax.random.key(6), params, horizon)
+        sent = np.asarray(metrics["messages_sent"])[:, 0]
+        assert sent[-1] == 0
+        # Once it stops it stays stopped.
+        stopped_at = np.argmax(sent == 0)
+        assert np.all(sent[stopped_at:] == 0)
+
+    def test_determinism(self):
+        params = make_params(20, n_gossips=2)
+        _, m1 = gossip.run(jax.random.key(7), params, 30)
+        _, m2 = gossip.run(jax.random.key(7), params, 30)
+        np.testing.assert_array_equal(
+            np.asarray(m1["infected_count"]), np.asarray(m2["infected_count"])
+        )
+
+    def test_different_seed_different_trace(self):
+        params = make_params(20, loss=0.3, n_gossips=2)
+        _, m1 = gossip.run(jax.random.key(8), params, 30)
+        _, m2 = gossip.run(jax.random.key(9), params, 30)
+        assert not np.array_equal(
+            np.asarray(m1["infected_count"]), np.asarray(m2["infected_count"])
+        )

@@ -1,0 +1,205 @@
+"""Tests for the dense delivery ops (the TpuSimTransport fast path).
+
+Pins: pack/unpack bijection, scatter-max == brute-force numpy delivery,
+and — the load-bearing one — ``merge_inbox`` equals a per-message scalar
+serialization of the reference's updateMembership loop
+(MembershipProtocolImpl.java:475-541) over every small inbound multiset.
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu import records
+from scalecube_cluster_tpu.ops import delivery, prng
+
+ALIVE, SUSPECT, DEAD, ABSENT = (
+    records.ALIVE,
+    records.SUSPECT,
+    records.DEAD,
+    records.ABSENT,
+)
+
+
+class TestPackUnpack:
+    def test_roundtrip_all_statuses(self):
+        statuses = jnp.array([ALIVE, SUSPECT, DEAD] * 4, dtype=jnp.int8)
+        incs = jnp.array([0, 1, 7, 12345] * 3, dtype=jnp.int32)
+        key = delivery.pack_record(statuses, incs)
+        s2, i2 = delivery.unpack_record(key)
+        np.testing.assert_array_equal(np.asarray(s2), np.asarray(statuses))
+        np.testing.assert_array_equal(np.asarray(i2), np.asarray(incs))
+
+    def test_absent_packs_to_no_message(self):
+        key = delivery.pack_record(jnp.int8(ABSENT), jnp.int32(5))
+        assert int(key) == -1
+        s, i = delivery.unpack_record(key)
+        assert int(s) == ABSENT and int(i) == 0
+
+    def test_key_order_matches_merge_priority(self):
+        # DEAD > higher inc > SUSPECT-at-equal-inc > ALIVE (records.merge_key).
+        k = lambda s, i: int(delivery.pack_record(jnp.int8(s), jnp.int32(i)))
+        assert k(DEAD, 0) > k(SUSPECT, 10**6) > k(ALIVE, 10**6) > k(SUSPECT, 1) > k(ALIVE, 1)
+
+
+class TestScatter:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_scatter_max_matches_numpy(self, seed):
+        rng = np.random.default_rng(seed)
+        n_senders, n_rows, n_subjects, fanout = 17, 13, 5, 3
+        values = rng.integers(-1, 100, size=(n_senders, n_subjects)).astype(np.int32)
+        targets = rng.integers(0, n_rows, size=(n_senders, fanout)).astype(np.int32)
+        drop = rng.random((n_senders, fanout)) < 0.3
+
+        expected = np.full((n_rows, n_subjects), -1, dtype=np.int32)
+        for s in range(n_senders):
+            for f in range(fanout):
+                if not drop[s, f]:
+                    r = targets[s, f]
+                    expected[r] = np.maximum(expected[r], values[s])
+
+        got = delivery.scatter_max(
+            jnp.asarray(values), jnp.asarray(targets), jnp.asarray(drop), n_rows
+        )
+        np.testing.assert_array_equal(np.asarray(got), expected)
+
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_scatter_or_matches_numpy(self, seed):
+        rng = np.random.default_rng(seed)
+        n_senders, n_rows, n_subjects, fanout = 11, 9, 4, 2
+        flags = rng.random((n_senders, n_subjects)) < 0.4
+        targets = rng.integers(0, n_rows, size=(n_senders, fanout)).astype(np.int32)
+        drop = rng.random((n_senders, fanout)) < 0.3
+
+        expected = np.zeros((n_rows, n_subjects), dtype=bool)
+        for s in range(n_senders):
+            for f in range(fanout):
+                if not drop[s, f]:
+                    expected[targets[s, f]] |= flags[s]
+
+        got = delivery.scatter_or(
+            jnp.asarray(flags), jnp.asarray(targets), jnp.asarray(drop), n_rows
+        )
+        np.testing.assert_array_equal(np.asarray(got), expected)
+
+
+def _scalar_serialized_merge(entry, inbound):
+    """Apply inbound records one at a time, scalar is_overrides per record.
+
+    This is the arrival-order serialization merge_inbox canonicalizes:
+    non-DEAD records in ascending merge_key order, then DEAD records in
+    *descending* key order.  (Arrival order is arbitrary in the reference —
+    one scheduler thread drains messages as they come,
+    MembershipProtocolImpl.java:475-541 — so any fixed order is a faithful
+    schedule; this one is the one whose outcome the associative max-fold
+    reproduces.  The orders differ only in which incarnation a removed
+    record's death notice retains — the reference stores nothing at all for
+    removed records, MembershipProtocolImpl.java:512-516.)  A stored DEAD
+    gates like ABSENT (the entry was deleted); accepted records store as-is.
+    """
+    status, inc = entry
+    key_of = lambda r: int(records.merge_key(r[0], r[1]))
+    live = sorted((r for r in inbound if r[0] != DEAD), key=key_of)
+    dead = sorted((r for r in inbound if r[0] == DEAD), key=key_of, reverse=True)
+    for r_status, r_inc in live + dead:
+        gate = ABSENT if status == DEAD else status
+        if records.is_overrides(r_status, r_inc, gate, inc):
+            status, inc = r_status, r_inc
+    return status, inc
+
+
+class TestMergeInbox:
+    def test_exhaustive_small_multisets(self):
+        """Every entry x inbound multiset (size<=2) over status x inc {0,1,2}."""
+        wire_records = [
+            (s, i) for s in (ALIVE, SUSPECT, DEAD) for i in (0, 1, 2)
+        ]
+        entries = [(s, i) for s in (ALIVE, SUSPECT, DEAD, ABSENT) for i in (0, 1, 2)]
+        multisets = [()] + [(r,) for r in wire_records] + list(
+            itertools.combinations_with_replacement(wire_records, 2)
+        )
+
+        cases, expected = [], []
+        for entry in entries:
+            for ms in multisets:
+                cases.append((entry, ms))
+                expected.append(_scalar_serialized_merge(entry, ms))
+
+        entry_status = jnp.array([c[0][0] for c in cases], dtype=jnp.int8)
+        entry_inc = jnp.array([c[0][1] for c in cases], dtype=jnp.int32)
+        inbox_key = jnp.array(
+            [
+                max((int(records.merge_key(s, i)) for s, i in ms), default=-1)
+                for _, ms in cases
+            ],
+            dtype=jnp.int32,
+        )
+        any_alive = jnp.array(
+            [any(s == ALIVE for s, _ in ms) for _, ms in cases], dtype=jnp.bool_
+        )
+
+        got_status, got_inc, _ = delivery.merge_inbox(
+            entry_status, entry_inc, inbox_key, any_alive
+        )
+        exp_status = np.array([e[0] for e in expected], dtype=np.int8)
+        exp_inc = np.array([e[1] for e in expected], dtype=np.int32)
+        np.testing.assert_array_equal(np.asarray(got_status), exp_status)
+        np.testing.assert_array_equal(np.asarray(got_inc), exp_inc)
+
+    def test_changed_flag(self):
+        # Accepted-but-identical must not report change (stored DEAD + DEAD rebroadcast).
+        status, inc, changed = delivery.merge_inbox(
+            jnp.array([DEAD, ALIVE], dtype=jnp.int8),
+            jnp.array([3, 1], dtype=jnp.int32),
+            delivery.pack_record(
+                jnp.array([DEAD, SUSPECT], dtype=jnp.int8),
+                jnp.array([3, 1], dtype=jnp.int32),
+            ),
+            jnp.array([True, False]),
+        )
+        assert bool(changed[0]) is False
+        assert bool(changed[1]) is True and int(status[1]) == SUSPECT
+
+
+class TestPrng:
+    def test_targets_exclude_self_and_in_range(self):
+        key = jax.random.key(0)
+        t = prng.targets_excluding_self(key, 64, 64, 3)
+        t = np.asarray(t)
+        assert t.min() >= 0 and t.max() < 64
+        sender = np.arange(64)[:, None]
+        assert not np.any(t == sender)
+
+    def test_targets_with_offset(self):
+        key = jax.random.key(1)
+        t = np.asarray(prng.targets_excluding_self(key, 8, 64, 3, sender_offset=16))
+        sender = (np.arange(8) + 16)[:, None]
+        assert not np.any(t == sender)
+        assert t.min() >= 0 and t.max() < 64
+
+    def test_choose_eligible_respects_mask(self):
+        key = jax.random.key(2)
+        eligible = jnp.array([[True, False, True, False], [False, False, False, True]])
+        idx, any_ok = prng.choose_eligible(key, eligible)
+        assert bool(any_ok[0]) and bool(any_ok[1])
+        assert int(idx[0]) in (0, 2)
+        assert int(idx[1]) == 3
+
+    def test_choose_eligible_none(self):
+        key = jax.random.key(3)
+        _, any_ok = prng.choose_eligible(key, jnp.zeros((2, 4), dtype=bool))
+        assert not bool(any_ok[0]) and not bool(any_ok[1])
+
+    def test_choose_eligible_roughly_uniform(self):
+        keys = jax.random.split(jax.random.key(4), 2000)
+        eligible = jnp.array([[True, True, False, True]])
+        idxs = np.asarray(
+            jax.vmap(lambda k: prng.choose_eligible(k, eligible)[0])(keys)
+        ).ravel()
+        counts = np.bincount(idxs, minlength=4)
+        assert counts[2] == 0
+        for slot in (0, 1, 3):
+            assert 500 < counts[slot] < 840  # ~667 expected

@@ -2,16 +2,9 @@
 and the examples module (SURVEY.md §2.1 row 13): join semantics, user
 messaging, gossip, metadata propagation, graceful shutdown, dead seeds."""
 
-from scalecube_cluster_tpu.config import ClusterConfig
 from scalecube_cluster_tpu.oracle import Address, Cluster, Message, Simulator
 
-FAST = ClusterConfig.default_local().replace(
-    sync_interval=2_000, ping_interval=500, ping_timeout=200, gossip_interval=100
-)
-
-
-def ids(members):
-    return sorted(m.id for m in members)
+from tests.oracle_helpers import FAST, ids
 
 
 def test_join_await_semantics():

@@ -6,8 +6,6 @@ membership feed stubbed as pre-seeded member lists
 (FailureDetectorTest.java:414-428), so the component is tested in isolation.
 """
 
-import dataclasses
-
 from scalecube_cluster_tpu.config import ClusterConfig
 from scalecube_cluster_tpu.oracle import (
     CorrelationIdGenerator,

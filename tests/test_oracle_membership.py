@@ -4,20 +4,13 @@ suspicion->death, recovery, restart, seed chains, incarnation refutation —
 on virtual time with seeded randomness (the reference's wall-clock
 ``awaitSeconds`` sleeps become exact ``sim.run_for`` calls)."""
 
-from scalecube_cluster_tpu.config import ClusterConfig
 from scalecube_cluster_tpu.oracle import Cluster, Simulator
 from scalecube_cluster_tpu.records import MemberStatus
 
 
 # Fast test config in the spirit of MembershipProtocolTest.java:545-554
 # (sync=500ms, ping=200ms there; we keep local preset ratios).
-FAST = ClusterConfig.default_local().replace(
-    sync_interval=2_000, ping_interval=500, ping_timeout=200, gossip_interval=100
-)
-
-
-def ids(members):
-    return sorted(m.id for m in members)
+from tests.oracle_helpers import FAST, ids
 
 
 def statuses(cluster):

@@ -1,0 +1,86 @@
+"""Tests for the sharded SWIM runner (parallel/mesh.py) on the virtual
+8-device CPU mesh (tests/conftest.py), mirroring how the reference tests
+"multi-node" in one process (SURVEY.md §4).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu import records
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.parallel import mesh as pmesh
+
+from tests.test_swim_model import fast_config
+
+
+def make(n, k=None, loss=0.0, **overrides):
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, n_subjects=k, loss_probability=loss,
+        **overrides,
+    )
+    return params, swim.SwimWorld.healthy(params)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    return pmesh.make_mesh(8)
+
+
+class TestShardRun:
+    def test_healthy_no_false_positives(self, mesh8):
+        params, world = make(64)
+        _, metrics = pmesh.shard_run(jax.random.key(0), params, world, 60, mesh8)
+        assert np.asarray(metrics["false_positives"]).sum() == 0
+        assert np.all(np.asarray(metrics["alive"])[-1] == params.n_members - 1)
+
+    def test_crash_detected_and_disseminated(self, mesh8):
+        n = 64
+        params, world = make(n)
+        world = world.with_crash(5, at_round=0)
+        horizon = params.ping_every * n // 4 + params.suspicion_rounds + 200
+        _, metrics = pmesh.shard_run(jax.random.key(1), params, world, horizon, mesh8)
+        alive_view = np.asarray(metrics["alive"])[:, 5]
+        assert alive_view[-1] == 0, "sharded run failed to disseminate death"
+
+    def test_sharded_matches_single_device_invariants(self, mesh8):
+        """Sharded and single-device runs aren't bit-identical (per-device
+        PRNG folding) but must agree on protocol outcomes."""
+        n = 32
+        params, world = make(n)
+        world = world.with_crash(3, at_round=0)
+        _, m_shard = pmesh.shard_run(jax.random.key(2), params, world, 250, mesh8)
+        _, m_single = swim.run(jax.random.key(2), params, world, 250)
+        for m in (m_shard, m_single):
+            assert np.asarray(m["alive"])[-1, 3] == 0
+            # no live member ever declared dead
+            dead = np.asarray(m["dead"])
+            assert dead[:, np.arange(n) != 3].sum() == 0
+
+    def test_sharded_determinism(self, mesh8):
+        params, world = make(32, loss=0.2)
+        _, m1 = pmesh.shard_run(jax.random.key(3), params, world, 50, mesh8)
+        _, m2 = pmesh.shard_run(jax.random.key(3), params, world, 50, mesh8)
+        for k in m1:
+            np.testing.assert_array_equal(np.asarray(m1[k]), np.asarray(m2[k]))
+
+    def test_focal_mode_sharded(self, mesh8):
+        """Focal mode (K << N) under sharding: the 1M-member configuration
+        in miniature."""
+        params, world = make(512, k=8, ping_known_only=False)
+        world = world.with_crash(2, at_round=0)
+        _, metrics = pmesh.shard_run(jax.random.key(4), params, world, 400, mesh8)
+        alive_view = np.asarray(metrics["alive"])[:, 2]
+        assert alive_view[-1] < alive_view[0]
+        fp_other = np.asarray(metrics["false_positives"])
+        assert fp_other[:, np.arange(8) != 2].sum() == 0
+
+    def test_final_state_sharding(self, mesh8):
+        params, world = make(64)
+        final, _ = pmesh.shard_run(jax.random.key(5), params, world, 10, mesh8)
+        # Final state comes back sharded over the node axis.
+        assert final.status.shape == (64, 64)
+        shard_sizes = {s.data.shape[0] for s in final.status.addressable_shards}
+        assert shard_sizes == {8}
