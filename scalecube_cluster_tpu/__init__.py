@@ -15,9 +15,10 @@ Layout (mirrors SURVEY.md §7):
   - ``oracle``     event-driven small-N simulator (behavioral oracle,
                    stands in for the reference's in-JVM multi-node harness)
   - ``models``     the TPU tick functions (fd-only, gossip-only, full SWIM)
-  - ``ops``        dense delivery / merge kernels (MXU matmul delivery)
+  - ``ops``        dense delivery / merge kernels (scatter-max inbox
+                   delivery + counter-based PRNG)
   - ``parallel``   mesh + sharding layer (row-sharded N over devices)
-  - ``utils``      PRNG, metrics, checkpointing
+  - ``utils``      on-disk checkpointing + run logging for long scans
 """
 
 from scalecube_cluster_tpu.config import ClusterConfig

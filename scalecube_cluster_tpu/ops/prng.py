@@ -38,7 +38,13 @@ def targets_excluding_self(key, n_senders: int, n_members: int, fanout: int,
 
     ``sender_offset`` is the global row index of sender 0 (for sharded
     callers whose local rows are a slice of the global member axis).
+
+    Precondition: ``n_members >= 2`` (with one member there is no valid
+    non-self target and the randint range below would be empty).
     """
+    assert n_members >= 2, "targets_excluding_self requires n_members >= 2"
+    # maxval = n_members - 1 is intentional: draws land in [0, n-2] and the
+    # shift-past-self below maps them onto the n-1 non-self members.
     draws = jax.random.randint(key, (n_senders, fanout), 0, n_members - 1)
     sender_ids = jnp.arange(n_senders, dtype=draws.dtype)[:, None] + sender_offset
     # Shift draws >= self up by one: uniform over the other n-1 members.

@@ -21,10 +21,19 @@ table on acceptance (MembershipProtocolImpl.java:512-513).  The dense
   ALIVE=0, SUSPECT=1, DEAD=2 match the reference enum order
   (membership/MemberStatus.java:3-16); ABSENT=3 encodes the null record.
 
-A *table* only ever holds ALIVE/SUSPECT/ABSENT; DEAD exists transiently in
-messages (and maps to ABSENT on acceptance).  ``is_overrides`` handles all
-four codes so the same function gates both message merges and SYNC row
-merges.
+Two storage conventions exist for accepted DEAD records, one per layer:
+
+  - ``apply_record`` (oracle / row-merge path): an accepted DEAD maps to
+    ABSENT immediately — the table only ever holds ALIVE/SUSPECT/ABSENT,
+    exactly like the reference's map.
+  - ``ops/delivery.merge_inbox`` (dense tick): the DEAD code + incarnation
+    stay in the table so the death notice keeps gossiping for its remaining
+    spread window; for merge *gating* a stored DEAD behaves like ABSENT,
+    and transmission masks keep it off SYNC payloads.  See the
+    merge_inbox docstring for the argument.
+
+``is_overrides`` handles all four codes so the same function gates both
+message merges and SYNC row merges.
 """
 
 from __future__ import annotations
@@ -145,7 +154,9 @@ def apply_record(old_status, old_inc, new_status, new_inc):
     The acceptance gate is ``is_overrides_array``; on acceptance a DEAD
     record *removes* the entry (becomes ABSENT), matching
     MembershipProtocolImpl.java:512-516 where accepted DEAD records are
-    deleted from the membership table rather than stored.
+    deleted from the membership table rather than stored.  (The dense tick's
+    ``ops/delivery.merge_inbox`` deliberately deviates — it stores the DEAD
+    code so the tombstone keeps spreading; see the module docstring.)
     """
     accept = is_overrides_array(new_status, new_inc, old_status, old_inc)
     stored_status = jnp.where(new_status == DEAD, ABSENT, new_status)

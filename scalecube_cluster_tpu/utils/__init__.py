@@ -1,1 +1,13 @@
-"""utils subpackage of scalecube_cluster_tpu."""
+"""Host-side utilities for long runs: checkpointing and logging.
+
+  - ``checkpoint``  atomic on-disk save/resume of the scan carry
+    (SURVEY.md §5.4 — the subsystem the reference lacks but 10k-round
+    TPU sweeps need)
+  - ``runlog``      stdlib logging + metric digests + jax.profiler hook
+    (the SLF4J/JMX observability analog, SURVEY.md §5.1)
+"""
+
+from scalecube_cluster_tpu.utils import checkpoint, runlog
+from scalecube_cluster_tpu.utils.runlog import get_logger
+
+__all__ = ["checkpoint", "runlog", "get_logger"]

@@ -1,0 +1,133 @@
+"""On-disk checkpoint/resume of the scan carry for long runs.
+
+The reference keeps no persistent state (SURVEY.md §5.4: membership is
+ephemeral, a restarted node rejoins from seeds) — but a 1M-member ×
+10k-round TPU sweep needs to survive preemption.  The scan carry
+(models/swim.SwimState) plus the (key, params-hash, next round) cursor is
+everything required to re-enter ``swim.run`` at round r; the resume
+contract is bit-exact (tests/test_swim_model.py TestDeterminism and
+tests/test_checkpoint.py) because every draw is a pure function of
+(key, round) — ops/prng.py.
+
+Format: a single ``.npz`` (host offload — no orbax dependency needed for
+flat int arrays; np.savez is the natural host-offload container for a
+pytree of small-dtype leaves).  Writes are atomic (tmp file + rename) so
+a kill mid-write never corrupts the latest checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from scalecube_cluster_tpu.models.swim import SwimState
+
+
+def save(path: str, state: SwimState, next_round: int,
+         key=None, meta: Optional[dict] = None) -> None:
+    """Atomically write ``state`` + cursor to ``path`` (.npz).
+
+    ``meta`` is an arbitrary JSON-able dict (config snapshot, world hash)
+    stored alongside for validation at load time.
+    """
+    arrays = {
+        f"state/{f.name}": np.asarray(getattr(state, f.name))
+        for f in dataclasses.fields(state)
+    }
+    arrays["next_round"] = np.int64(next_round)
+    if key is not None:
+        arrays["key_data"] = np.asarray(jax.random.key_data(key))
+    arrays["meta_json"] = np.frombuffer(
+        json.dumps(meta or {}).encode(), dtype=np.uint8
+    )
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load(path: str) -> Tuple[SwimState, int, Optional[jax.Array], dict]:
+    """Load (state, next_round, key-or-None, meta) written by :func:`save`."""
+    with np.load(path) as z:
+        fields = {
+            name[len("state/"):]: jax.numpy.asarray(z[name])
+            for name in z.files if name.startswith("state/")
+        }
+        state = SwimState(**fields)
+        next_round = int(z["next_round"])
+        key = None
+        if "key_data" in z.files:
+            key = jax.random.wrap_key_data(jax.numpy.asarray(z["key_data"]))
+        meta = json.loads(bytes(z["meta_json"].tobytes()).decode() or "{}")
+    return state, next_round, key, meta
+
+
+def _metrics_path(path: str, upto_round: int) -> str:
+    return f"{path}.metrics-{upto_round:08d}.npz"
+
+
+def run_checkpointed(run_fn, key, params, world, n_rounds: int, path: str,
+                     chunk: int = 1000, state=None, start_round: int = 0,
+                     meta: Optional[dict] = None, log=None):
+    """Drive ``run_fn`` (swim.run-shaped) in chunks, checkpointing each.
+
+    Resumes from ``path`` if it exists (``start_round``/``state`` args are
+    then ignored).  On resume the stored ``meta`` must equal the caller's
+    ``meta`` — a mismatch (different config/world than the interrupted run)
+    raises instead of silently continuing a different experiment.
+
+    Each chunk's metric traces are persisted next to the checkpoint
+    (``<path>.metrics-<round>.npz``) and reloaded on resume, so the
+    returned list always covers rounds [0, n_rounds) even across
+    preemptions.  Returns (final_state, list of per-chunk metrics dicts).
+    """
+    metrics_chunks = []
+    if os.path.exists(path):
+        state, start_round, saved_key, saved_meta = load(path)
+        if saved_key is not None:
+            key = saved_key
+        if meta is not None and saved_meta != meta:
+            raise ValueError(
+                f"checkpoint meta mismatch: saved {saved_meta!r} != "
+                f"current {meta!r} — refusing to resume a different run"
+            )
+        meta = saved_meta
+        # Reload the already-produced metric chunks.
+        r0, upto = 0, start_round
+        while r0 < upto:
+            mpath = _metrics_path(path, min(r0 + chunk, upto))
+            if not os.path.exists(mpath):
+                break  # older run used a different chunking; traces partial
+            with np.load(mpath) as z:
+                metrics_chunks.append({k: z[k] for k in z.files})
+            r0 += chunk
+        if log is not None:
+            log.info("resumed from %s at round %d (%d metric chunks)",
+                     path, start_round, len(metrics_chunks))
+    r = start_round
+    while r < n_rounds:
+        step = min(chunk, n_rounds - r)
+        state, metrics = run_fn(key, params, world, step,
+                                state=state, start_round=r)
+        jax.block_until_ready(state.status)
+        r += step
+        save(path, state, r, key=key, meta=meta)
+        np.savez(_metrics_path(path, r),
+                 **{k: np.asarray(v) for k, v in metrics.items()})
+        metrics_chunks.append(metrics)
+        if log is not None:
+            log.info("checkpointed round %d/%d", r, n_rounds)
+    return state, metrics_chunks

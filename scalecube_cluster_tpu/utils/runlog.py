@@ -1,0 +1,78 @@
+"""Run logging + profiling hooks for long scans.
+
+The reference logs every protocol action through SLF4J (SURVEY.md §5.1:
+per-period counters in FailureDetectorImpl.java:148,156-164, gossip sweep
+logs at GossipProtocolImpl.java:300).  A dense 10k-round scan can't log
+per-action from inside jit; the equivalent observability is:
+
+  - a stdlib logger (:func:`get_logger`) for host-side progress — chunk
+    boundaries, checkpoint writes, compile times, device info;
+  - :func:`log_metrics_summary` to digest the per-round metric tensors the
+    scan carries (models/swim.py metrics) into the protocol-level counters
+    the reference logs;
+  - :func:`profiled` to wrap a run with a ``jax.profiler`` step trace when
+    ``SCALECUBE_TPU_PROFILE_DIR`` is set (inspect with TensorBoard).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+_FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
+
+
+def get_logger(name: str = "scalecube_tpu", level=None) -> logging.Logger:
+    """Package logger; level from SCALECUBE_TPU_LOGLEVEL (default INFO)."""
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        logger.addHandler(handler)
+        logger.propagate = False
+    logger.setLevel(level or os.environ.get("SCALECUBE_TPU_LOGLEVEL", "INFO"))
+    return logger
+
+
+def log_metrics_summary(log: logging.Logger, metrics: dict,
+                        round_offset: int = 0) -> None:
+    """Digest a run's metric traces into the reference-style counters.
+
+    ``metrics`` is the dict of [n_rounds, ...] traces returned by
+    models/swim.run: status counts, false_positives, messages_*,
+    refutations.
+    """
+    n_rounds = len(np.asarray(next(iter(metrics.values()))))
+    last = round_offset + n_rounds - 1
+
+    def total(name):
+        return int(np.asarray(metrics[name]).sum()) if name in metrics else 0
+
+    log.info(
+        "rounds [%d, %d]: gossip msgs %d, pings %d, refutations %d, "
+        "false-positive observer-rounds %d",
+        round_offset, last, total("messages_gossip"), total("messages_ping"),
+        total("refutations"), total("false_positives"),
+    )
+
+
+@contextlib.contextmanager
+def profiled(log: logging.Logger = None):
+    """jax.profiler trace when SCALECUBE_TPU_PROFILE_DIR is set, else no-op."""
+    trace_dir = os.environ.get("SCALECUBE_TPU_PROFILE_DIR")
+    t0 = time.perf_counter()
+    if not trace_dir:
+        yield
+    else:
+        import jax
+        with jax.profiler.trace(trace_dir):
+            yield
+        if log is not None:
+            log.info("profiler trace written to %s", trace_dir)
+    if log is not None:
+        log.info("profiled section took %.2fs", time.perf_counter() - t0)

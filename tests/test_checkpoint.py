@@ -1,0 +1,104 @@
+"""On-disk checkpoint/resume of the scan carry (utils/checkpoint.py).
+
+The reference has no persistence (SURVEY.md §5.4); this is the subsystem a
+10k-round TPU run needs: kill the driver mid-run, restart, and the resumed
+trace must be bit-identical to an unbroken run (possible because every draw
+is a pure function of (key, round) — ops/prng.py).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.utils import checkpoint
+
+from tests.test_swim_model import make
+
+
+def test_save_load_roundtrip(tmp_path):
+    params, world = make(12, loss=0.1)
+    key = jax.random.key(3)
+    state, _ = swim.run(key, params, world, 20)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.save(path, state, next_round=20, key=key, meta={"n": 12})
+
+    state2, next_round, key2, meta = checkpoint.load(path)
+    assert next_round == 20
+    assert meta == {"n": 12}
+    np.testing.assert_array_equal(np.asarray(state.status), np.asarray(state2.status))
+    np.testing.assert_array_equal(np.asarray(state.inc), np.asarray(state2.inc))
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(key)), np.asarray(jax.random.key_data(key2))
+    )
+
+
+def test_kill_and_resume_matches_unbroken_run(tmp_path):
+    """Simulated preemption: run chunks 0-2, 'kill', re-invoke — the driver
+    resumes from disk and the final state equals one unbroken run."""
+    params, world = make(12, loss=0.1)
+    world = world.with_crash(4, at_round=10)
+    key = jax.random.key(4)
+    n_rounds, chunk = 60, 20
+    path = str(tmp_path / "ckpt.npz")
+
+    final_unbroken, _ = swim.run(key, params, world, n_rounds)
+
+    # First driver invocation dies after 2 chunks (40 rounds).
+    calls = {"n": 0}
+
+    def dying_run(*args, **kwargs):
+        if calls["n"] == 2:
+            raise KeyboardInterrupt("simulated preemption")
+        calls["n"] += 1
+        return swim.run(*args, **kwargs)
+
+    with pytest.raises(KeyboardInterrupt):
+        checkpoint.run_checkpointed(
+            dying_run, key, params, world, n_rounds, path, chunk=chunk
+        )
+    assert os.path.exists(path)
+    _, saved_round, _, _ = checkpoint.load(path)
+    assert saved_round == 40
+
+    # Second invocation resumes from disk and completes; metrics from the
+    # pre-kill chunks are reloaded so the returned traces are complete.
+    final_resumed, chunks = checkpoint.run_checkpointed(
+        swim.run, key, params, world, n_rounds, path, chunk=chunk
+    )
+    assert len(chunks) == 3  # 2 reloaded + 1 re-run
+    full_alive = np.concatenate([np.asarray(c["alive"]) for c in chunks])
+    assert full_alive.shape[0] == n_rounds
+    np.testing.assert_array_equal(
+        np.asarray(final_unbroken.status), np.asarray(final_resumed.status)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(final_unbroken.inc), np.asarray(final_resumed.inc)
+    )
+
+
+def test_resume_meta_mismatch_refuses(tmp_path):
+    params, world = make(8)
+    key = jax.random.key(5)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.run_checkpointed(
+        swim.run, key, params, world, 10, path, chunk=5, meta={"cfg": "a"}
+    )
+    with pytest.raises(ValueError, match="meta mismatch"):
+        checkpoint.run_checkpointed(
+            swim.run, key, params, world, 20, path, chunk=5, meta={"cfg": "b"}
+        )
+
+
+def test_atomic_write_leaves_no_tmp(tmp_path):
+    params, world = make(8)
+    state = swim.initial_state(params, world)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.save(path, state, next_round=0)
+    checkpoint.save(path, state, next_round=5)  # overwrite in place
+    leftovers = [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    assert leftovers == []
+    _, r, _, _ = checkpoint.load(path)
+    assert r == 5

@@ -7,7 +7,6 @@ measured curves to ClusterMath predictions at :178-205).
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

@@ -7,8 +7,6 @@ import jax
 import numpy as np
 import pytest
 
-from scalecube_cluster_tpu import records
-from scalecube_cluster_tpu.config import ClusterConfig
 from scalecube_cluster_tpu.models import swim
 from scalecube_cluster_tpu.parallel import mesh as pmesh
 

@@ -81,7 +81,6 @@ class TestCrashDetection:
 
         suspects = np.asarray(metrics["suspect"])[:, 0]
         deads = np.asarray(metrics["dead"])[:, 0]
-        live_observers = n - 1
         assert suspects.max() > 0, "crashed node never suspected"
         # Eventually every live observer has processed the death (DEAD
         # tombstone or, post-sweep, a removed entry — both non-ALIVE).
@@ -100,9 +99,9 @@ class TestCrashDetection:
         deads = np.asarray(metrics["dead"])[:, 3]
         first_suspect = int(np.argmax(suspects > 0))
         assert suspects.max() > 0
-        if deads.max() > 0:
-            first_dead = int(np.argmax(deads > 0))
-            assert first_dead >= first_suspect + params.suspicion_rounds
+        assert deads.max() > 0, "death never declared within horizon"
+        first_dead = int(np.argmax(deads > 0))
+        assert first_dead >= first_suspect + params.suspicion_rounds
 
 
 class TestPartition:
@@ -193,12 +192,13 @@ class TestFocalMode:
             gone = alive_view == 0
             return int(np.argmax(gone)) if gone.any() else -1
 
-    # Both modes must fully disseminate the death; focal pings the subject
-    # at ~the same per-subject rate (uniform over cluster vs round over
-    # known members) so detection rounds are comparable.
+        # Both modes must fully disseminate the death; focal pings the
+        # subject at ~the same per-subject rate (uniform over cluster vs
+        # round-robin over known members) so detection rounds are comparable.
         r_full, r_focal = first_full_death(m_full), first_full_death(m_focal)
         assert r_full > 0 and r_focal > 0
-        assert r_focal < 4 * max(r_full, 1)
+        assert r_focal < 2 * max(r_full, 1)
+        assert r_focal > r_full // 3
 
     def test_focal_no_false_positives_lossless(self):
         params, world = make(256, k=8, ping_known_only=False)
