@@ -1,66 +1,143 @@
 """Headline benchmark: SWIM member-rounds/sec/chip on real TPU.
 
-Runs the full SWIM tick (FD + gossip + suspicion + SYNC,
-models/swim.swim_tick) in focal mode at 1M members — the BASELINE.md
-north-star configuration (1M members on a v5e; the reference never ran
-above N=50, SURVEY.md §6, and publishes no absolute numbers) — and reports
-throughput in member-rounds/sec/chip.
+Runs the full SWIM tick (FD + gossip + suspicion + SYNC) in focal mode at
+1M members — the BASELINE.md north-star configuration (the reference never
+ran above N=50, SURVEY.md §6, and publishes no absolute numbers) — using
+the shift-delivery fast path (models/swim.py module docstring,
+ops/shift.py) and reports throughput in member-rounds/sec/chip.
 
 ``vs_baseline`` is measured against the north-star requirement implied by
 BASELINE.json: simulate 1M members × 10k rounds on a v5e-8 in one hour,
-i.e. 1e6*1e4/(3600*8) ≈ 3.47e8 member-rounds/sec/chip.  vs_baseline 1.0
-means exactly that rate; higher is better.
+i.e. 1e6*1e4/(3600*8) ≈ 3.47e5 member-rounds/sec/chip.  (Round 1's bench
+docstring wrote this constant as 3.47e8 — a 1000x typo; the arithmetic
+below is and was 3.47e5.)  vs_baseline 1.0 means exactly that rate;
+higher is better.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Robustness contract (this file must never ship an empty round):
+  - backend init is retried, then falls back to CPU (clearly marked);
+  - a small-N canary runs first so a failure is diagnosed cheaply;
+  - every stage appends diagnostics to stderr;
+  - exactly ONE JSON line is printed to stdout no matter what — on any
+    failure it carries the best measurement achieved plus the error.
+
+Env overrides for debugging: SCALECUBE_BENCH_N, SCALECUBE_BENCH_ROUNDS,
+SCALECUBE_BENCH_DELIVERY, SCALECUBE_BENCH_SKIP_CANARY.
 """
 
 import json
+import os
+import sys
 import time
+import traceback
 
-N_MEMBERS = 1_000_000
-N_SUBJECTS = 16
-BENCH_ROUNDS = 200
 NORTH_STAR_RATE = 1e6 * 1e4 / (3600.0 * 8)  # member-rounds/sec/chip
 
+N_MEMBERS = int(os.environ.get("SCALECUBE_BENCH_N", 1_000_000))
+N_SUBJECTS = 16
+BENCH_ROUNDS = int(os.environ.get("SCALECUBE_BENCH_ROUNDS", 200))
+DELIVERY = os.environ.get("SCALECUBE_BENCH_DELIVERY", "shift")
+CANARY_N = 4096
 
-def main():
+
+def log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def init_backend():
+    """jax.devices() with retries; fall back to CPU if TPU init fails."""
     import jax
 
+    last_err = None
+    for attempt in range(3):
+        try:
+            devs = jax.devices()
+            log(f"backend ok (attempt {attempt + 1}): {devs}")
+            return jax, jax.default_backend()
+        except RuntimeError as e:  # backend init failure (e.g. tunnel down)
+            last_err = e
+            log(f"backend init failed (attempt {attempt + 1}): {e}")
+            time.sleep(5.0 * (attempt + 1))
+    log("falling back to CPU backend")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    devs = jax.devices("cpu")
+    log(f"cpu fallback devices: {devs}")
+    return jax, "cpu(fallback)"
+
+
+def timed_run(jax, n_members, rounds, label):
+    """Compile + steady-state-time a run; returns member-rounds/sec."""
     from scalecube_cluster_tpu.config import ClusterConfig
     from scalecube_cluster_tpu.models import swim
 
     params = swim.SwimParams.from_config(
         ClusterConfig.default(),
-        n_members=N_MEMBERS,
+        n_members=n_members,
         n_subjects=N_SUBJECTS,
         loss_probability=0.02,
         per_subject_metrics=True,
+        delivery=DELIVERY,
     )
     world = swim.SwimWorld.healthy(params).with_crash(3, at_round=50)
     key = jax.random.key(0)
 
-    # Compile + warm up with the SAME static args and pytree structure as
-    # the timed call (params, n_rounds, state-provided), so the timed
-    # region hits the jit cache and measures steady state only.
+    t0 = time.perf_counter()
     state = swim.initial_state(params, world)
-    state, _ = swim.run(key, params, world, BENCH_ROUNDS, state=state,
+    # Warm-up compiles the exact (params, n_rounds, state-provided)
+    # signature the timed call uses, so the timed region is steady state.
+    state, _ = swim.run(key, params, world, rounds, state=state,
                         start_round=0)
     jax.block_until_ready(state.status)
+    log(f"{label}: compile+first-run took {time.perf_counter() - t0:.1f}s")
 
     t0 = time.perf_counter()
     state, metrics = swim.run(
-        key, params, world, BENCH_ROUNDS, state=state, start_round=BENCH_ROUNDS
+        key, params, world, rounds, state=state, start_round=rounds
     )
     jax.block_until_ready(state.status)
     elapsed = time.perf_counter() - t0
+    rate = n_members * rounds / elapsed
+    log(f"{label}: {rounds} rounds in {elapsed:.3f}s -> {rate:.3e} "
+        f"member-rounds/sec")
+    # Sanity: the crash at round 50 must eventually be noticed.
+    dead_total = int(jax.numpy.asarray(metrics["dead"]).sum())
+    log(f"{label}: dead-view observer-rounds in window: {dead_total}")
+    return rate
 
-    member_rounds_per_sec = N_MEMBERS * BENCH_ROUNDS / elapsed
-    print(json.dumps({
+
+def main():
+    result = {
         "metric": "swim_member_rounds_per_sec_per_chip",
-        "value": round(member_rounds_per_sec, 1),
+        "value": None,
         "unit": "member-rounds/sec/chip",
-        "vs_baseline": round(member_rounds_per_sec / NORTH_STAR_RATE, 3),
-    }))
+        "vs_baseline": None,
+    }
+    try:
+        jax, platform = init_backend()
+        result["platform"] = platform
+
+        if not os.environ.get("SCALECUBE_BENCH_SKIP_CANARY"):
+            canary_rate = timed_run(jax, CANARY_N, 100, f"canary@{CANARY_N}")
+            result["canary_member_rounds_per_sec"] = round(canary_rate, 1)
+
+        rate = timed_run(jax, N_MEMBERS, BENCH_ROUNDS, f"main@{N_MEMBERS}")
+        result["value"] = round(rate, 1)
+        result["vs_baseline"] = round(rate / NORTH_STAR_RATE, 3)
+        result["n_members"] = N_MEMBERS
+        result["rounds_timed"] = BENCH_ROUNDS
+        result["delivery"] = DELIVERY
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+        if result["value"] is None and "canary_member_rounds_per_sec" in result:
+            # Ship the canary as a lower-bound datum rather than nothing.
+            result["value"] = result["canary_member_rounds_per_sec"]
+            result["vs_baseline"] = round(result["value"] / NORTH_STAR_RATE, 3)
+            result["n_members"] = CANARY_N
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,42 @@
+"""Metadata: join with KV metadata, discover it, and watch updates.
+
+Mirror of the reference's ClusterMetadataExample
+(examples/src/main/java/io/scalecube/examples/ClusterMetadataExample.java:21-57):
+Joe joins with metadata, Carol discovers it; Joe then updates a property
+and the change propagates via the incarnation-bump gossip + remote fetch
+(metadata itself is pulled, not gossiped — MetadataStoreImpl.java:149-186).
+
+Run: ``python examples/cluster_metadata_example.py``
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scalecube_cluster_tpu.oracle import Cluster, Simulator
+
+
+def main():
+    sim = Simulator(seed=31)
+    carol = Cluster.join(sim, alias="carol")
+    joe = Cluster.join(
+        sim, seeds=[carol.address],
+        metadata={"name": "Joe", "role": "worker"}, alias="joe",
+    )
+    sim.run_for(3_000)
+
+    print("carol's view of joe:", carol.metadata(joe.member()))
+    assert carol.metadata(joe.member()) == {"name": "Joe", "role": "worker"}
+
+    # Joe updates one property; the incarnation bump gossips and Carol
+    # re-fetches the metadata from Joe directly.
+    joe.update_metadata_property("role", "coordinator")
+    sim.run_for(5_000)
+
+    print("after update:        ", carol.metadata(joe.member()))
+    assert carol.metadata(joe.member())["role"] == "coordinator"
+
+
+if __name__ == "__main__":
+    main()

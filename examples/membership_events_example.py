@@ -1,0 +1,57 @@
+"""Membership events: ADDED on join, REMOVED on graceful leave and crash.
+
+Mirror of the reference's MembershipEventsExample
+(examples/src/main/java/io/scalecube/examples/MembershipEventsExample.java:21-53):
+Alice watches the cluster; Bob joins (ADDED), later leaves gracefully
+(REMOVED via his self-announced DEAD record, no suspicion delay), and
+Carol crashes hard (REMOVED only after suspicion timeout).
+
+Run: ``python examples/membership_events_example.py``
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scalecube_cluster_tpu.oracle import Cluster, Simulator
+
+
+def main():
+    sim = Simulator(seed=23)
+    alice = Cluster.join(sim, alias="alice")
+
+    events = []
+    alice.listen_membership(
+        lambda e: events.append((sim.now, e.type.name, e.member.id))
+    )
+
+    bob = Cluster.join(sim, seeds=[alice.address], alias="bob")
+    carol = Cluster.join(sim, seeds=[alice.address], alias="carol")
+    sim.run_for(3_000)
+
+    bob.shutdown()          # graceful leave: DEAD@inc+1 gossip, fast REMOVED
+    t_leave = sim.now
+    sim.run_for(3_000)
+    leave_events = [e for e in events if e[1] == "REMOVED"]
+
+    carol.transport.stop()  # hard crash: suspicion timeout must elapse
+    t_crash = sim.now
+    sim.run_for(30_000)
+
+    for t, kind, who in events:
+        print(f"t={t:>8.0f}ms  {kind:<7} {who}")
+
+    assert [e[2] for e in events if e[1] == "ADDED"] == ["bob", "carol"]
+    removed = [e for e in events if e[1] == "REMOVED"]
+    assert [e[2] for e in removed] == ["bob", "carol"]
+    # Graceful leave disseminates fast; the crash pays the suspicion timeout.
+    leave_latency = leave_events[0][0] - t_leave
+    crash_latency = removed[1][0] - t_crash
+    print(f"leave latency {leave_latency:.0f}ms vs crash latency "
+          f"{crash_latency:.0f}ms")
+    assert leave_latency < crash_latency
+
+
+if __name__ == "__main__":
+    main()

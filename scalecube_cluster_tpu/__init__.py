@@ -15,8 +15,9 @@ Layout (mirrors SURVEY.md §7):
   - ``oracle``     event-driven small-N simulator (behavioral oracle,
                    stands in for the reference's in-JVM multi-node harness)
   - ``models``     the TPU tick functions (fd-only, gossip-only, full SWIM)
-  - ``ops``        dense delivery / merge kernels (scatter-max inbox
-                   delivery + counter-based PRNG)
+  - ``ops``        dense delivery / merge kernels: scatter-max inbox,
+                   cyclic-shift fast path, counter-based PRNG
+  - ``sweep``      vmap hyperparameter sweeps + curve artifacts
   - ``parallel``   mesh + sharding layer (row-sharded N over devices)
   - ``utils``      on-disk checkpointing + run logging for long scans
 """

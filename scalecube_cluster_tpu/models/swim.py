@@ -25,6 +25,29 @@ State layout — the subject-view matrix
     dissemination / first-false-positive curves at the BASELINE.md scale
     (the reference itself never ran above N=50, SURVEY.md §6).
 
+Delivery modes (``SwimParams.delivery``)
+----------------------------------------
+  - ``"scatter"``: exact per-node uniform target draws, delivered with
+    XLA scatter-max (ops/delivery.py).  Reference-faithful sampling; the
+    validation mode.
+  - ``"shift"``: cyclic-shift mixing (ops/shift.py) — every send channel
+    uses one fresh random shift per round shared by all nodes, so the
+    whole exchange is contiguous vector ops.  This is the fast path the
+    1M-member benchmark runs; its statistics are validated against
+    scatter mode and the oracle (tests/test_shift_mode.py).
+
+Network faults — the NetworkEmulator analog
+-------------------------------------------
+Per-link loss/delay/block lives in :class:`LinkFaults`: an ordered list of
+override rules (sender-id range × receiver-id range × round window →
+loss probability, mean delay), the vectorization of the reference's
+per-destination link-settings map (transport/NetworkEmulator.java:132-192,
+NetworkLinkSettings.java:15-80; block == loss 1.0).  Rules evaluate
+elementwise against any (src, dst) id arrays — O(N·R) with no [N,N]
+materialization, so the same mechanism works at N=50 and N=1M.  Process
+faults (crash, revive, graceful leave) and rolling partitions are separate
+schedules on :class:`SwimWorld`.
+
 Time quantization: the gossip period is the base round
 (config.ClusterConfig.to_sim); pings fire every ``ping_every`` rounds,
 SYNC every ``sync_every``.  Sub-round timing (pingTimeout vs pingInterval,
@@ -33,9 +56,11 @@ sampling per-hop delays and comparing sums against the millisecond budgets
 — the phased collapse of the 3-hop ping-req flow (SURVEY.md §7 hard parts).
 
 Documented deviations from the reference (all statistical-regime-neutral):
-  - fanout targets drawn with replacement (ops/prng.py docstring);
-  - FD probe targets drawn uniformly per period instead of round-robin over
-    a shuffled pass (FailureDetectorImpl.java:338-347); detection-time
+  - scatter mode draws fanout targets with replacement (ops/prng.py
+    docstring); shift mode shares per-round target offsets across nodes
+    (ops/shift.py docstring);
+  - FD probe targets are drawn uniformly per period instead of round-robin
+    over a shuffled pass (FailureDetectorImpl.java:338-347); detection-time
     distributions at large N are indistinguishable, and the SWIM paper
     itself analyzes the uniform variant;
   - the SYNC exchange is push-only per round (the syncAck pull is replaced
@@ -43,20 +68,23 @@ Documented deviations from the reference (all statistical-regime-neutral):
     an FD ALIVE-verdict on a suspected member pushes the suspect record to
     the member itself (MembershipProtocolImpl.java:379-391's SYNC), whose
     self-refutation then travels back by gossip;
-  - gossip per-gossip "infected" sets are not tracked (models/gossip.py).
+  - gossip per-gossip "infected" sets are not tracked (models/gossip.py);
+  - link delay affects FD hop budgets; gossip/SYNC delivery is
+    same-round-or-lost (delay quantization for those channels is applied
+    by the experiment harness via round-length scaling).
 """
 
 from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Optional
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from scalecube_cluster_tpu import records, swim_math
-from scalecube_cluster_tpu.ops import delivery, prng
+from scalecube_cluster_tpu import records
+from scalecube_cluster_tpu.ops import delivery, prng, shift as shift_ops
 
 INT32_MAX = jnp.iinfo(jnp.int32).max
 
@@ -95,6 +123,13 @@ class SwimParams:
     ping_known_only: bool = True
     # Per-subject metric columns (disable for K too large to trace).
     per_subject_metrics: bool = True
+    # Delivery collective: "scatter" (exact uniform draws, XLA scatter) or
+    # "shift" (cyclic-shift mixing, the fast path — module docstring).
+    delivery: str = "scatter"
+
+    def __post_init__(self):
+        if self.delivery not in ("scatter", "shift"):
+            raise ValueError(f"unknown delivery mode {self.delivery!r}")
 
     @staticmethod
     def from_config(config, n_members: int, n_subjects: Optional[int] = None,
@@ -126,6 +161,151 @@ class SwimParams:
 
 
 # --------------------------------------------------------------------------
+# Sweepable knobs (dynamic overrides of SwimParams schedule fields)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Knobs:
+    """Traced overrides of the protocol schedule — the sweep axes.
+
+    ``SwimParams`` is a static jit argument (it fixes shapes and unrolled
+    channel counts); these five knobs are the subset that can vary as
+    *data*, which is what lets one compiled program sweep a whole
+    hyperparameter grid with ``jax.vmap`` (BASELINE config 5: fanout ×
+    ping-interval × suspicion-mult; sweep.py).  ``fanout`` must be
+    <= params.fanout (extra channels are masked off); ``ping_every``
+    sweeps the probe rate (the millisecond sub-round budgets stay at the
+    params values).
+    """
+
+    loss_probability: jnp.ndarray
+    suspicion_rounds: jnp.ndarray
+    ping_every: jnp.ndarray
+    sync_every: jnp.ndarray
+    fanout: jnp.ndarray
+
+    @staticmethod
+    def from_params(params: "SwimParams") -> "Knobs":
+        return Knobs(
+            loss_probability=jnp.float32(params.loss_probability),
+            suspicion_rounds=jnp.int32(params.suspicion_rounds),
+            ping_every=jnp.int32(params.ping_every),
+            sync_every=jnp.int32(params.sync_every),
+            fanout=jnp.int32(params.fanout),
+        )
+
+
+jax.tree_util.register_dataclass(
+    Knobs,
+    data_fields=["loss_probability", "suspicion_rounds", "ping_every",
+                 "sync_every", "fanout"],
+    meta_fields=[],
+)
+
+
+# --------------------------------------------------------------------------
+# Link faults: the per-link NetworkEmulator rules
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LinkFaults:
+    """Ordered per-link override rules — vectorized NetworkEmulator state.
+
+    Rule r matches messages with sender id in [src_lo[r], src_hi[r]),
+    receiver id in [dst_lo[r], dst_hi[r]), during rounds
+    [from_round[r], until_round[r]); the *last* matching rule wins,
+    mirroring the reference's setLink-overwrites-the-map semantics
+    (transport/NetworkEmulator.java:99-130).  ``loss == 1.0`` is a blocked
+    link (NetworkEmulator.block, :132-192); ``delay_ms`` is the mean of the
+    exponential per-hop delay (NetworkLinkSettings.java:64-74).
+
+    All arrays are [R]; R is static (part of the traced shapes), so rule
+    evaluation unrolls to R elementwise select passes — no [N, N] tensors,
+    which is what lets the same fault model run at N=1M.
+    """
+
+    src_lo: jnp.ndarray
+    src_hi: jnp.ndarray
+    dst_lo: jnp.ndarray
+    dst_hi: jnp.ndarray
+    from_round: jnp.ndarray
+    until_round: jnp.ndarray
+    loss: jnp.ndarray
+    delay_ms: jnp.ndarray
+
+    @staticmethod
+    def none() -> "LinkFaults":
+        z = jnp.zeros((0,), dtype=jnp.int32)
+        f = jnp.zeros((0,), dtype=jnp.float32)
+        return LinkFaults(z, z, z, z, z, z, f, f)
+
+    @property
+    def n_rules(self) -> int:
+        return self.src_lo.shape[0]
+
+    def add(self, src, dst, loss: float, delay_ms: float = 0.0,
+            from_round: int = 0, until_round: int = INT32_MAX) -> "LinkFaults":
+        """Append one rule.  ``src``/``dst`` are a node id or an (lo, hi)
+        half-open id range."""
+        def rng(x):
+            if isinstance(x, (tuple, list)):
+                return int(x[0]), int(x[1])
+            return int(x), int(x) + 1
+        s_lo, s_hi = rng(src)
+        d_lo, d_hi = rng(dst)
+
+        def cat(a, v, dtype):
+            return jnp.concatenate([a, jnp.asarray([v], dtype=dtype)])
+
+        return LinkFaults(
+            src_lo=cat(self.src_lo, s_lo, jnp.int32),
+            src_hi=cat(self.src_hi, s_hi, jnp.int32),
+            dst_lo=cat(self.dst_lo, d_lo, jnp.int32),
+            dst_hi=cat(self.dst_hi, d_hi, jnp.int32),
+            from_round=cat(self.from_round, from_round, jnp.int32),
+            until_round=cat(self.until_round, until_round, jnp.int32),
+            loss=cat(self.loss, loss, jnp.float32),
+            delay_ms=cat(self.delay_ms, delay_ms, jnp.float32),
+        )
+
+
+jax.tree_util.register_dataclass(
+    LinkFaults,
+    data_fields=["src_lo", "src_hi", "dst_lo", "dst_hi", "from_round",
+                 "until_round", "loss", "delay_ms"],
+    meta_fields=[],
+)
+
+
+def link_eval(faults: LinkFaults, round_idx, src_ids, dst_ids,
+              default_loss, default_delay_ms) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(loss probability, mean delay ms) per (src, dst) message this round.
+
+    ``src_ids``/``dst_ids`` broadcast against each other; the result has the
+    broadcast shape.  Vectorizes NetworkEmulator.resolveLinkSettings +
+    NetworkLinkSettings.evaluate{Loss,Delay}
+    (transport/NetworkEmulator.java:60-97, NetworkLinkSettings.java:54-74).
+    """
+    src_ids = jnp.asarray(src_ids, jnp.int32)
+    dst_ids = jnp.asarray(dst_ids, jnp.int32)
+    shape = jnp.broadcast_shapes(src_ids.shape, dst_ids.shape)
+    loss = jnp.full(shape, default_loss, dtype=jnp.float32)
+    delay = jnp.full(shape, default_delay_ms, dtype=jnp.float32)
+    for r in range(faults.n_rules):  # static unroll; last match wins
+        match = (
+            (src_ids >= faults.src_lo[r]) & (src_ids < faults.src_hi[r])
+            & (dst_ids >= faults.dst_lo[r]) & (dst_ids < faults.dst_hi[r])
+            & (round_idx >= faults.from_round[r])
+            & (round_idx < faults.until_round[r])
+        )
+        loss = jnp.where(match, faults.loss[r], loss)
+        delay = jnp.where(match, faults.delay_ms[r], delay)
+    return loss, delay
+
+
+# --------------------------------------------------------------------------
 # World model: ground truth + fault injection (the NetworkEmulator analog)
 # --------------------------------------------------------------------------
 
@@ -143,18 +323,32 @@ class SwimWorld:
         rounds [down_from, down_until) — it neither sends, receives, nor
         updates state (frozen, like a stopped JVM); on revival it resumes
         with its old identity and refutes its own death via gossip.
+      - ``leave_at`` [N] int32: node i *gracefully leaves* at that round —
+        it gossips its own DEAD record at incarnation+1 in its final round
+        and is down afterwards (MembershipProtocolImpl.leaveCluster,
+        :197-206); INT32_MAX = never.
       - ``partition_of`` [P, N] int8: rolling-partition schedule; at round
         r, phase (r // partition_phase_rounds) % P is active, and messages
         cross partition boundaries only if ids match.  A single all-zeros
         phase means no partition (the default).
+      - ``faults``: per-link loss/delay/block rules (:class:`LinkFaults`).
+      - ``seed_ids`` [S] int32: configured seed members.  When non-empty,
+        full-view senders only contact members they *know* (their table
+        entry is live) or seeds — the reference's join/contact rule
+        (MembershipProtocolImpl doSync picks from seeds ∪ live members,
+        :298-314).  When empty (the default), every member is implicitly a
+        seed, matching tests that pre-populate full views.
       - ``subject_ids`` [K] int32 / ``slot_of_node`` [N] int32: the focal
         subject mapping (slot -1 = node is not a tracked subject).
     """
 
     down_from: jnp.ndarray
     down_until: jnp.ndarray
+    leave_at: jnp.ndarray
     partition_of: jnp.ndarray
     partition_phase_rounds: jnp.ndarray  # int32 scalar
+    faults: LinkFaults
+    seed_ids: jnp.ndarray
     subject_ids: jnp.ndarray
     slot_of_node: jnp.ndarray
 
@@ -172,8 +366,11 @@ class SwimWorld:
         return SwimWorld(
             down_from=jnp.full((n,), INT32_MAX, dtype=jnp.int32),
             down_until=jnp.full((n,), INT32_MAX, dtype=jnp.int32),
+            leave_at=jnp.full((n,), INT32_MAX, dtype=jnp.int32),
             partition_of=jnp.zeros((1, n), dtype=jnp.int8),
             partition_phase_rounds=jnp.int32(1),
+            faults=LinkFaults.none(),
+            seed_ids=jnp.zeros((0,), dtype=jnp.int32),
             subject_ids=subject_ids,
             slot_of_node=slot_of_node,
         )
@@ -187,6 +384,17 @@ class SwimWorld:
             down_until=self.down_until.at[node].set(until_round),
         )
 
+    def with_leave(self, node, at_round: int):
+        """Graceful leave: gossip own DEAD@inc+1 at ``at_round``, then down
+        (MembershipProtocolImpl.leaveCluster, :197-206)."""
+        node = jnp.atleast_1d(jnp.asarray(node, dtype=jnp.int32))
+        return dataclasses.replace(
+            self,
+            leave_at=self.leave_at.at[node].set(at_round),
+            down_from=self.down_from.at[node].set(at_round + 1),
+            down_until=self.down_until.at[node].set(INT32_MAX),
+        )
+
     def with_partition_schedule(self, partition_of, phase_rounds: int):
         partition_of = jnp.asarray(partition_of, dtype=jnp.int8)
         if partition_of.ndim == 1:
@@ -195,6 +403,34 @@ class SwimWorld:
             self,
             partition_of=partition_of,
             partition_phase_rounds=jnp.int32(phase_rounds),
+        )
+
+    def with_link_fault(self, src, dst, loss: float, delay_ms: float = 0.0,
+                        from_round: int = 0,
+                        until_round: int = INT32_MAX) -> "SwimWorld":
+        """Per-link loss/delay override (NetworkEmulator.setLink analog).
+
+        ``src``/``dst``: node id or (lo, hi) half-open range.  Applies to
+        messages src → dst only (asymmetric, like the reference's
+        per-destination settings)."""
+        return dataclasses.replace(
+            self, faults=self.faults.add(src, dst, loss, delay_ms,
+                                         from_round, until_round)
+        )
+
+    def with_block(self, src, dst, from_round: int = 0,
+                   until_round: int = INT32_MAX) -> "SwimWorld":
+        """Block the src → dst link (100% loss — NetworkEmulator.block,
+        transport/NetworkEmulator.java:132-192).  Unblock = until_round."""
+        return self.with_link_fault(src, dst, loss=1.0,
+                                    from_round=from_round,
+                                    until_round=until_round)
+
+    def with_seeds(self, seed_ids) -> "SwimWorld":
+        """Configure seed members (enables the known-or-seed contact gate
+        in full-view mode — see class docstring)."""
+        return dataclasses.replace(
+            self, seed_ids=jnp.atleast_1d(jnp.asarray(seed_ids, jnp.int32))
         )
 
     def alive_at(self, round_idx):
@@ -212,7 +448,8 @@ class SwimWorld:
 jax.tree_util.register_dataclass(
     SwimWorld,
     data_fields=[
-        "down_from", "down_until", "partition_of", "partition_phase_rounds",
+        "down_from", "down_until", "leave_at", "partition_of",
+        "partition_phase_rounds", "faults", "seed_ids",
         "subject_ids", "slot_of_node",
     ],
     meta_fields=[],
@@ -259,21 +496,38 @@ jax.tree_util.register_dataclass(
 
 def initial_state(params: SwimParams, world: SwimWorld,
                   warm: bool = True) -> SwimState:
-    """Warm start: everyone knows every subject ALIVE at incarnation 0.
+    """Initial membership tables.
 
-    (The post-join steady state; seed-join growth is exercised separately
-    by starting rows ABSENT.)  A node's record about *itself* is pinned
-    ALIVE at its own incarnation.
+    ``warm=True``: everyone knows every subject ALIVE at incarnation 0 (the
+    post-join steady state).  ``warm=False``: cold start — rows are ABSENT
+    except each node's own record and the configured seeds
+    (``world.seed_ids``), which every node knows a priori
+    (MembershipProtocolImpl.start0 syncs to seeds, :216-251); the cluster
+    then grows by gossip/SYNC through the ABSENT→ALIVE gate.
     """
     n, k = params.n_members, params.n_subjects
     fill = records.ALIVE if warm else records.ABSENT
     status = jnp.full((n, k), fill, dtype=jnp.int8)
     is_self = world.subject_ids[None, :] == jnp.arange(n, dtype=jnp.int32)[:, None]
+    if not warm and world.seed_ids.shape[0] > 0:
+        seed_slot = world.slot_of_node[world.seed_ids]      # [S] (-1 untracked)
+        is_seed_col = jnp.any(
+            (jnp.arange(k, dtype=jnp.int32)[None, :] == seed_slot[:, None])
+            & (seed_slot >= 0)[:, None],
+            axis=0,
+        )
+        status = jnp.where(is_seed_col[None, :], records.ALIVE, status)
     status = jnp.where(is_self, records.ALIVE, status)
+    spread0 = jnp.zeros((n, k), dtype=jnp.int32)
+    if not warm:
+        # A joining node's own record is hot: it announces itself for a
+        # full spread window, the ADDED-dissemination path
+        # (MembershipProtocolTest seed-chain join, :432-462).
+        spread0 = jnp.where(is_self, params.periods_to_spread + 1, spread0)
     return SwimState(
         status=status,
         inc=jnp.zeros((n, k), dtype=jnp.int32),
-        spread_until=jnp.zeros((n, k), dtype=jnp.int32),
+        spread_until=spread0,
         suspect_deadline=jnp.full((n, k), INT32_MAX, dtype=jnp.int32),
         self_inc=jnp.zeros((n,), dtype=jnp.int32),
     )
@@ -284,25 +538,35 @@ def initial_state(params: SwimParams, world: SwimWorld,
 # --------------------------------------------------------------------------
 
 
-def _hop_ok(key, loss_probability, mean_delay_ms, budget_ms, n_hops, shape):
+def _chain_ok(key, hop_losses: Sequence[jnp.ndarray],
+              hop_delay_means: Sequence[jnp.ndarray], budget_ms, shape):
     """P2P multi-hop success: every hop delivered AND total delay <= budget.
 
     Vectorizes NetworkLinkSettings.evaluateLoss/evaluateDelay
-    (transport/NetworkLinkSettings.java:54-74) over ``n_hops`` chained hops
-    with a shared millisecond budget (the reference's Reactor
-    ``.timeout(duration)``, FailureDetectorImpl.java:152).
+    (transport/NetworkLinkSettings.java:54-74) over chained hops with
+    per-hop (possibly per-link, from link_eval) loss/delay and a shared
+    millisecond budget (the reference's Reactor ``.timeout(duration)``,
+    FailureDetectorImpl.java:152).
     """
-    keys = jax.random.split(key, n_hops * 2)
+    n_hops = len(hop_losses)
+    u = jax.random.uniform(key, (*shape, 2 * n_hops))
     ok = jnp.ones(shape, dtype=jnp.bool_)
     total_delay = jnp.zeros(shape, dtype=jnp.float32)
     for h in range(n_hops):
-        ok &= ~prng.bernoulli_mask(keys[2 * h], loss_probability, shape)
-        total_delay += prng.exponential_delay(keys[2 * h + 1], mean_delay_ms, shape)
+        ok &= u[..., 2 * h] >= hop_losses[h]
+        total_delay += -jnp.log1p(-u[..., 2 * h + 1]) * hop_delay_means[h]
     return ok & (total_delay <= budget_ms)
 
 
+def _entry_at_slot(mat, slot, k):
+    """mat[i, slot[i]] via a one-hot reduce over K (elementwise, no gather)."""
+    onehot = jnp.arange(k, dtype=jnp.int32)[None, :] == slot[:, None]
+    return jnp.max(jnp.where(onehot, mat, mat.dtype.type(0)), axis=1)
+
+
 def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
-              world: SwimWorld, offset=0, axis_name: Optional[str] = None):
+              world: SwimWorld, offset=0, axis_name: Optional[str] = None,
+              knobs: Optional[Knobs] = None):
     """One protocol round.  Pure: (state, r, key) -> (state', metrics).
 
     Phases (matching the reference's periodic loops, SURVEY.md §3.2-3.4):
@@ -316,14 +580,25 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
       4. Merge all inboxes through the is_overrides lattice; self-records
          refute (incarnation bump); suspicion timers set/cancel/fire.
 
-    Sharding: ``state`` rows may be a contiguous slice of the global member
-    axis (``offset`` = first global row).  Senders scatter into a
-    global-height inbox contribution; under ``shard_map`` the contributions
-    combine with one ``lax.pmax`` over ``axis_name`` — the ICI collective
-    that replaces the reference's point-to-point TCP (SURVEY.md §5.8) —
-    and each device keeps its own row slice.  With ``axis_name=None`` and
-    ``offset=0`` this is the single-device path unchanged.
+    Delivery is either exact-uniform scatter or cyclic-shift mixing
+    (module docstring); per-link faults apply in both via link_eval.
+
+    Sharding (scatter mode): ``state`` rows may be a contiguous slice of
+    the global member axis (``offset`` = first global row).  Senders
+    scatter into a global-height inbox contribution; under ``shard_map``
+    the contributions combine with one ``lax.pmax`` over ``axis_name`` —
+    the ICI collective that replaces the reference's point-to-point TCP
+    (SURVEY.md §5.8) — and each device keeps its own row slice.  With
+    ``axis_name=None`` and ``offset=0`` this is the single-device path
+    unchanged.  Shift mode is currently single-device (the sharded shift
+    exchange lives in parallel/mesh.py's roadmap).
     """
+    if params.delivery == "shift" and axis_name is not None:
+        raise NotImplementedError(
+            "shift delivery under shard_map is not wired yet; "
+            "use delivery='scatter' for sharded runs"
+        )
+    kn = knobs if knobs is not None else Knobs.from_params(params)
     n, k = params.n_members, params.n_subjects
     n_local = state.status.shape[0]
     # Fold both the round and the shard offset so draws are independent
@@ -331,6 +606,206 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     key = prng.round_key(prng.round_key(base_key, round_idx), offset)
     (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t, k_gossip_drop,
      k_sync_t, k_sync_drop) = jax.random.split(key, 8)
+
+    def global_sum(x):
+        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+
+    alive = world.alive_at(round_idx)                       # [N] ground truth
+    part = world.partition_at(round_idx)                    # [N]
+    node_ids = jnp.arange(n_local, dtype=jnp.int32) + offset    # global ids
+    alive_here = alive[node_ids] if n_local != n else alive     # [n_local]
+    part_here = part[node_ids] if n_local != n else part
+    is_self = world.subject_ids[None, :] == node_ids[:, None]   # [n_local, K]
+
+    # Row i's record about itself is pinned (a node always believes itself
+    # ALIVE at self_inc — MembershipProtocolImpl drops self-updates and
+    # refutes instead, :488-509).
+    status = jnp.where(is_self, records.ALIVE, state.status)
+    inc = jnp.where(is_self, state.self_inc[:, None], state.inc)
+
+    fd_round = (round_idx % kn.ping_every) == 0
+    sync_round = (round_idx % kn.sync_every) == 0
+
+    # Contact gating (full-view only, active when seeds are configured):
+    # a sender only gossips/syncs at members it knows live, or at seeds —
+    # the reference's peer-list rule (class docstring of SwimWorld).
+    gate_contacts = params.full_view and world.seed_ids.shape[0] > 0
+
+    def known_live(target_ids):
+        """[...]: sender's table holds ALIVE/SUSPECT for these targets
+        (full-view: slot == node id)."""
+        ts = jnp.take_along_axis(
+            status, target_ids.reshape(n_local, -1), axis=1
+        ).reshape(target_ids.shape)
+        return (ts == records.ALIVE) | (ts == records.SUSPECT)
+
+    def is_seed(target_ids):
+        return jnp.any(
+            target_ids[..., None] == world.seed_ids[None, :], axis=-1
+        )
+
+    if params.delivery == "shift":
+        new_state, aux = _tick_shift(
+            state, status, inc, round_idx, params, kn, world,
+            alive, part, node_ids, alive_here, part_here, is_self,
+            fd_round, sync_round, gate_contacts, known_live, is_seed,
+            (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t,
+             k_gossip_drop, k_sync_t, k_sync_drop),
+        )
+    else:
+        new_state, aux = _tick_scatter(
+            state, status, inc, round_idx, params, kn, world,
+            alive, part, node_ids, alive_here, part_here, is_self,
+            fd_round, sync_round, gate_contacts, known_live, is_seed,
+            (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t,
+             k_gossip_drop, k_sync_t, k_sync_drop),
+            offset, axis_name,
+        )
+
+    # ---- Metrics (the per-round observability tensors, SURVEY.md §5.1) ---
+    new_status = new_state.status
+    observer_alive = alive_here[:, None]
+    subject_alive = alive[world.subject_ids][None, :]
+    counts = {}
+    for name, code in (("alive", records.ALIVE), ("suspect", records.SUSPECT),
+                       ("dead", records.DEAD), ("absent", records.ABSENT)):
+        mask = (new_status == code) & observer_alive & ~is_self
+        counts[name] = global_sum(
+            jnp.sum(mask, axis=0, dtype=jnp.int32)
+            if params.per_subject_metrics
+            else jnp.sum(mask, dtype=jnp.int32)
+        )
+    # False positive: a live observer holds SUSPECT/DEAD about a live subject.
+    fp_mask = (
+        ((new_status == records.SUSPECT) | (new_status == records.DEAD))
+        & observer_alive & subject_alive & ~is_self
+    )
+    metrics = dict(
+        counts,
+        false_positives=global_sum(
+            jnp.sum(fp_mask, axis=0, dtype=jnp.int32)
+            if params.per_subject_metrics
+            else jnp.sum(fp_mask, dtype=jnp.int32)
+        ),
+        messages_gossip=global_sum(aux["messages_gossip"]),
+        messages_ping=global_sum(aux["messages_ping"]),
+        refutations=global_sum(aux["refutations"]),
+    )
+    return new_state, metrics
+
+
+# --------------------------------------------------------------------------
+# Shared phase 4: merge + refutation + timers + process faults
+# --------------------------------------------------------------------------
+
+
+def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
+                      params, kn, world, node_ids, alive_here, is_self):
+    """Inbox merge, self-refutation, suspicion timers, crash/leave freeze.
+
+    Shared tail of both delivery modes; all elementwise on [n_local, K].
+    Returns (new_state, refuted[n_local] bool).
+    """
+    new_status, new_inc, changed = delivery.merge_inbox(
+        status, inc, inbox, inbox_alive
+    )
+
+    # Self-refutation (updateMembership about-self branch, :488-509): if the
+    # inbound winner about ME overrides my ALIVE@self_inc record, bump to
+    # max(inc)+1 and gossip the refutation (spread reset via `changed`).
+    win_status, win_inc = delivery.unpack_record(inbox)
+    self_overridden = is_self & records.is_overrides_array(
+        win_status, win_inc, records.ALIVE, state.self_inc[:, None]
+    )
+    refuted = jnp.any(self_overridden, axis=1)
+    bumped_inc = jnp.maximum(
+        state.self_inc,
+        jnp.max(jnp.where(self_overridden, win_inc, 0), axis=1),
+    ) + 1
+    new_self_inc = jnp.where(refuted & alive_here, bumped_inc, state.self_inc)
+    new_status = jnp.where(is_self, records.ALIVE, new_status)
+    new_inc = jnp.where(is_self, new_self_inc[:, None], new_inc)
+    changed = jnp.where(is_self, self_overridden & alive_here[:, None], changed)
+
+    # Suspicion timers (scheduleSuspicionTimeoutTask / cancel,
+    # MembershipProtocolImpl.java:518-523,590-606).  ``computeIfAbsent``
+    # semantics: an accepted SUSPECT update does NOT reset a pending timer;
+    # any accepted non-SUSPECT update cancels it.
+    no_timer = state.suspect_deadline == INT32_MAX
+    start_timer = changed & (new_status == records.SUSPECT) & no_timer
+    cancel_timer = changed & (new_status != records.SUSPECT)
+    deadline = jnp.where(
+        start_timer,
+        round_idx + kn.suspicion_rounds,
+        jnp.where(cancel_timer, INT32_MAX, state.suspect_deadline),
+    )
+    # Timer fires -> DEAD at the same incarnation (onSuspicionTimeout,
+    # :608-618); the tombstone spreads its death notice.
+    fired = (new_status == records.SUSPECT) & (round_idx >= deadline)
+    new_status = jnp.where(fired, records.DEAD, new_status)
+    deadline = jnp.where(fired, INT32_MAX, deadline)
+    changed = changed | fired
+
+    # Crashed/left nodes are frozen (a stopped JVM): no state updates.
+    frozen = ~alive_here[:, None]
+    new_status = jnp.where(frozen, status, new_status)
+    new_inc = jnp.where(frozen, inc, new_inc)
+    deadline = jnp.where(frozen, state.suspect_deadline, deadline)
+    changed = changed & ~frozen
+
+    spread_until = jnp.where(
+        changed, round_idx + 1 + params.periods_to_spread, state.spread_until
+    )
+
+    new_state = SwimState(
+        status=new_status.astype(jnp.int8),
+        inc=new_inc.astype(jnp.int32),
+        spread_until=spread_until.astype(jnp.int32),
+        suspect_deadline=deadline.astype(jnp.int32),
+        self_inc=new_self_inc.astype(jnp.int32),
+    )
+    return new_state, refuted
+
+
+def _send_payloads(state, status, inc, round_idx, params, world,
+                   node_ids, is_self):
+    """(gossip_keys, sync_keys) — what each sender transmits this round.
+
+    Gossip carries hot records (changed within the spread window; DEAD
+    tombstones transmit their death notice, GossipProtocolImpl.java:239-250).
+    A gracefully leaving node's final-round gossip carries its own DEAD
+    record at incarnation+1 (leaveCluster, MembershipProtocolImpl.java:197-206).
+    SYNC pushes the full row minus tombstones (the reference table holds no
+    DEAD records, so SYNC never carries them).
+    """
+    leaving_now = (world.leave_at[node_ids] == round_idx)[:, None] & is_self
+    hot = (status != records.ABSENT) & (round_idx < state.spread_until)
+    hot = hot | leaving_now
+    record_keys = delivery.pack_record(status, inc)          # [n_local, K]
+    leave_key = delivery.pack_record(
+        jnp.int8(records.DEAD), state.self_inc[:, None] + 1
+    )
+    record_keys = jnp.where(leaving_now, leave_key, record_keys)
+    gossip_keys = jnp.where(hot, record_keys, delivery.NO_MESSAGE)
+    sync_keys = jnp.where(
+        status == records.DEAD, delivery.NO_MESSAGE, record_keys
+    )
+    return gossip_keys, sync_keys
+
+
+# --------------------------------------------------------------------------
+# Scatter-mode tick body (exact uniform target draws)
+# --------------------------------------------------------------------------
+
+
+def _tick_scatter(state, status, inc, round_idx, params, kn, world,
+                  alive, part, node_ids, alive_here, part_here, is_self,
+                  fd_round, sync_round, gate_contacts, known_live, is_seed,
+                  keys, offset, axis_name):
+    n, k = params.n_members, params.n_subjects
+    n_local = status.shape[0]
+    (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t, k_gossip_drop,
+     k_sync_t, k_sync_drop) = keys
 
     def combine_max(buf):
         """Cross-device inbox combine + own-row slice."""
@@ -340,27 +815,10 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
             return buf
         return jax.lax.dynamic_slice_in_dim(buf, offset, n_local, axis=0)
 
-    def global_sum(x):
-        return jax.lax.psum(x, axis_name) if axis_name is not None else x
-
-    alive = world.alive_at(round_idx)                       # [N] ground truth
-    part = world.partition_at(round_idx)                    # [N]
-    node_ids = jnp.arange(n_local, dtype=jnp.int32) + offset    # global ids
-    alive_here = alive[node_ids]                            # [n_local]
-    is_self = world.subject_ids[None, :] == node_ids[:, None]   # [n_local, K]
-
-    # Row i's record about itself is pinned (a node always believes itself
-    # ALIVE at self_inc — MembershipProtocolImpl drops self-updates and
-    # refutes instead, :488-509).
-    status = jnp.where(is_self, records.ALIVE, state.status)
-    inc = jnp.where(is_self, state.self_inc[:, None], state.inc)
-
     def same_partition(a_ids, b_ids):
         return part[a_ids] == part[b_ids]
 
     # ---- Phase 1: failure detector probe --------------------------------
-    fd_round = (round_idx % params.ping_every) == 0
-
     if params.ping_known_only:
         # Uniform among known live-record subjects (FailureDetectorImpl
         # pingMembers list, :48-49) — exact in full-view mode.
@@ -384,9 +842,13 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
 
     t = ping_target
     # Direct ping: 2 hops within ping_timeout (FailureDetectorImpl.java:128-176).
+    loss_it, delay_it = link_eval(world.faults, round_idx, node_ids, t,
+                                  kn.loss_probability, params.mean_delay_ms)
+    loss_ti, delay_ti = link_eval(world.faults, round_idx, t, node_ids,
+                                  kn.loss_probability, params.mean_delay_ms)
     direct_ok = (
-        _hop_ok(k_ping_net, params.loss_probability, params.mean_delay_ms,
-                params.ping_timeout_ms, 2, (n_local,))
+        _chain_ok(k_ping_net, [loss_it, loss_ti], [delay_it, delay_ti],
+                  params.ping_timeout_ms, (n_local,))
         & alive[t] & same_partition(node_ids, t)
     )
     # Ping-req through R proxies: 4 hops within (ping_interval - ping_timeout)
@@ -395,10 +857,22 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     proxies = prng.targets_excluding_self(
         k_proxy, n_local, n, r_proxies, sender_offset=offset
     )
+    hop_pairs = [
+        (node_ids[:, None], proxies),       # issuer -> proxy
+        (proxies, t[:, None]),              # proxy  -> target (transit ping)
+        (t[:, None], proxies),              # target -> proxy (ack)
+        (proxies, node_ids[:, None]),       # proxy  -> issuer (transit ack)
+    ]
+    hop_losses, hop_delays = [], []
+    for src, dst in hop_pairs:
+        lo, de = link_eval(world.faults, round_idx, src, dst,
+                           kn.loss_probability, params.mean_delay_ms)
+        hop_losses.append(lo)
+        hop_delays.append(de)
     proxy_ok = (
-        _hop_ok(k_proxy_net, params.loss_probability, params.mean_delay_ms,
-                params.ping_interval_ms - params.ping_timeout_ms, 4,
-                (n_local, r_proxies))
+        _chain_ok(k_proxy_net, hop_losses, hop_delays,
+                  params.ping_interval_ms - params.ping_timeout_ms,
+                  (n_local, r_proxies))
         & alive[proxies] & alive[t][:, None]
         & same_partition(node_ids[:, None], proxies)
         & same_partition(proxies, t[:, None])
@@ -432,28 +906,28 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     push_refute = verdict_alive & (entry_t_status == records.SUSPECT)
 
     # ---- Phase 2 + 3: gossip and SYNC sends ------------------------------
-    # Hot records: changed within the spread window; DEAD tombstones
-    # transmit their death notice (GossipProtocolImpl.java:239-250).
-    hot = (status != records.ABSENT) & (round_idx < state.spread_until)
-    record_keys = delivery.pack_record(status, inc)          # [n_local, K]
-    gossip_keys = jnp.where(hot, record_keys, delivery.NO_MESSAGE)
+    gossip_keys, sync_keys = _send_payloads(
+        state, status, inc, round_idx, params, world, node_ids, is_self
+    )
 
     gossip_targets = prng.targets_excluding_self(
         k_gossip_t, n_local, n, params.fanout, sender_offset=offset
     )
     send_ok = alive_here[:, None] & alive[gossip_targets] \
         & same_partition(node_ids[:, None], gossip_targets)
+    if gate_contacts:
+        send_ok &= known_live(gossip_targets) | is_seed(gossip_targets)
+    loss_g, _ = link_eval(world.faults, round_idx, node_ids[:, None],
+                          gossip_targets, kn.loss_probability,
+                          params.mean_delay_ms)
     gossip_drop = (
-        prng.bernoulli_mask(k_gossip_drop, params.loss_probability,
-                            (n_local, params.fanout))
+        prng.bernoulli_mask(k_gossip_drop, loss_g, (n_local, params.fanout))
         | ~send_ok
+        | (jnp.arange(params.fanout, dtype=jnp.int32)[None, :] >= kn.fanout)
     )
 
     # SYNC: full-row push to one random member (doSync,
-    # MembershipProtocolImpl.java:298-314) — tombstones masked out (the
-    # reference table holds no DEAD records, so SYNC never carries them).
-    sync_round = (round_idx % params.sync_every) == 0
-    sync_keys = jnp.where(status == records.DEAD, delivery.NO_MESSAGE, record_keys)
+    # MembershipProtocolImpl.java:298-314).
     sync_target = prng.targets_excluding_self(
         k_sync_t, n_local, n, 1, sender_offset=offset
     )
@@ -461,10 +935,18 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     # suspected member itself.
     sync_target = jnp.where(push_refute[:, None], t[:, None], sync_target)
     do_sync = (sync_round & alive_here) | push_refute
+    if gate_contacts:
+        do_sync &= (
+            known_live(sync_target)[:, 0] | is_seed(sync_target)[:, 0]
+            | push_refute
+        )
+    loss_s, _ = link_eval(world.faults, round_idx, node_ids,
+                          sync_target[:, 0], kn.loss_probability,
+                          params.mean_delay_ms)
     sync_ok = (
         alive[sync_target[:, 0]]
         & same_partition(node_ids, sync_target[:, 0])
-        & ~prng.bernoulli_mask(k_sync_drop, params.loss_probability, (n_local,))
+        & ~prng.bernoulli_mask(k_sync_drop, loss_s, (n_local,))
     )
     sync_drop = (~(do_sync & sync_ok))[:, None]
 
@@ -474,8 +956,8 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
         delivery.scatter_max(gossip_keys, gossip_targets, gossip_drop, n),
         delivery.scatter_max(sync_keys, sync_target, sync_drop, n),
     )
-    alive_flags = (gossip_keys >= 0) & (status == records.ALIVE)
-    sync_alive_flags = (sync_keys >= 0) & (status == records.ALIVE)
+    alive_flags = delivery.is_alive_key(gossip_keys)
+    sync_alive_flags = delivery.is_alive_key(sync_keys)
     alive_buf = (
         delivery.scatter_or(alive_flags, gossip_targets, gossip_drop, n)
         | delivery.scatter_or(sync_alive_flags, sync_target, sync_drop, n)
@@ -486,102 +968,302 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     # FD local verdicts fold into the same inbox (observer-local, no comm).
     inbox = jnp.maximum(inbox, fd_inbox)
 
-    # ---- Phase 4: merge + timers ----------------------------------------
-    new_status, new_inc, changed = delivery.merge_inbox(
-        status, inc, inbox, inbox_alive
+    new_state, refuted = _merge_and_timers(
+        state, status, inc, inbox, inbox_alive, round_idx, params, kn, world,
+        node_ids, alive_here, is_self,
     )
-
-    # Self-refutation (updateMembership about-self branch, :488-509): if the
-    # inbound winner about ME overrides my ALIVE@self_inc record, bump to
-    # max(inc)+1 and gossip the refutation (spread reset via `changed`).
-    win_status, win_inc = delivery.unpack_record(inbox)
-    self_overridden = is_self & records.is_overrides_array(
-        win_status, win_inc, records.ALIVE, state.self_inc[:, None]
-    )
-    refuted = jnp.any(self_overridden, axis=1)
-    bumped_inc = jnp.maximum(
-        state.self_inc,
-        jnp.max(jnp.where(self_overridden, win_inc, 0), axis=1),
-    ) + 1
-    new_self_inc = jnp.where(refuted & alive_here, bumped_inc, state.self_inc)
-    new_status = jnp.where(is_self, records.ALIVE, new_status)
-    new_inc = jnp.where(is_self, new_self_inc[:, None], new_inc)
-    changed = jnp.where(is_self, self_overridden & alive_here[:, None], changed)
-
-    # Suspicion timers (scheduleSuspicionTimeoutTask / cancel,
-    # MembershipProtocolImpl.java:518-523,590-606).  ``computeIfAbsent``
-    # semantics: an accepted SUSPECT update does NOT reset a pending timer;
-    # any accepted non-SUSPECT update cancels it.
-    no_timer = state.suspect_deadline == INT32_MAX
-    start_timer = changed & (new_status == records.SUSPECT) & no_timer
-    cancel_timer = changed & (new_status != records.SUSPECT)
-    deadline = jnp.where(
-        start_timer,
-        round_idx + params.suspicion_rounds,
-        jnp.where(cancel_timer, INT32_MAX, state.suspect_deadline),
-    )
-    # Timer fires -> DEAD at the same incarnation (onSuspicionTimeout,
-    # :608-618); the tombstone spreads its death notice.
-    fired = (new_status == records.SUSPECT) & (round_idx >= deadline)
-    new_status = jnp.where(fired, records.DEAD, new_status)
-    deadline = jnp.where(fired, INT32_MAX, deadline)
-    changed = changed | fired
-
-    # Crashed nodes are frozen (a stopped JVM): no state updates at all.
-    frozen = ~alive_here[:, None]
-    new_status = jnp.where(frozen, status, new_status)
-    new_inc = jnp.where(frozen, inc, new_inc)
-    deadline = jnp.where(frozen, state.suspect_deadline, deadline)
-    changed = changed & ~frozen
-
-    spread_until = jnp.where(
-        changed, round_idx + 1 + params.periods_to_spread, state.spread_until
-    )
-
-    new_state = SwimState(
-        status=new_status.astype(jnp.int8),
-        inc=new_inc.astype(jnp.int32),
-        spread_until=spread_until.astype(jnp.int32),
-        suspect_deadline=deadline.astype(jnp.int32),
-        self_inc=new_self_inc.astype(jnp.int32),
-    )
-
-    # ---- Metrics (the per-round observability tensors, SURVEY.md §5.1) ---
-    observer_alive = alive_here[:, None]
-    subject_alive = alive[world.subject_ids][None, :]
-    counts = {}
-    for name, code in (("alive", records.ALIVE), ("suspect", records.SUSPECT),
-                       ("dead", records.DEAD), ("absent", records.ABSENT)):
-        mask = (new_status == code) & observer_alive & ~is_self
-        counts[name] = global_sum(
-            jnp.sum(mask, axis=0, dtype=jnp.int32)
-            if params.per_subject_metrics
-            else jnp.sum(mask, dtype=jnp.int32)
-        )
-    # False positive: a live observer holds SUSPECT/DEAD about a live subject.
-    fp_mask = (
-        ((new_status == records.SUSPECT) | (new_status == records.DEAD))
-        & observer_alive & subject_alive & ~is_self
-    )
-    metrics = dict(
-        counts,
-        false_positives=global_sum(
-            jnp.sum(fp_mask, axis=0, dtype=jnp.int32)
-            if params.per_subject_metrics
-            else jnp.sum(fp_mask, dtype=jnp.int32)
+    hot_any = jnp.any(gossip_keys >= 0, axis=1)
+    aux = dict(
+        messages_gossip=jnp.sum(
+            hot_any[:, None] & ~gossip_drop, dtype=jnp.int32
         ),
-        messages_gossip=global_sum(jnp.sum(
-            jnp.any(hot, axis=1)[:, None] & ~gossip_drop, dtype=jnp.int32
-        )),
-        messages_ping=global_sum(jnp.sum(probe_active, dtype=jnp.int32)),
-        refutations=global_sum(jnp.sum(refuted & alive_here, dtype=jnp.int32)),
+        messages_ping=jnp.sum(probe_active, dtype=jnp.int32),
+        refutations=jnp.sum(refuted & alive_here, dtype=jnp.int32),
     )
-    return new_state, metrics
+    return new_state, aux
+
+
+# --------------------------------------------------------------------------
+# Shift-mode tick body (cyclic-shift mixing — the fast path)
+# --------------------------------------------------------------------------
+
+
+def _tick_shift(state, status, inc, round_idx, params, kn, world,
+                alive, part, node_ids, alive_here, part_here, is_self,
+                fd_round, sync_round, gate_contacts, known_live, is_seed,
+                keys):
+    n, k = params.n_members, params.n_subjects
+    (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t, k_gossip_drop,
+     k_sync_t, k_sync_drop) = keys
+    r_proxies = params.ping_req_members
+    f = params.fanout
+
+    # One shift per send channel: [fd, proxies..., gossip..., sync].
+    n_shifts = 1 + r_proxies + f + 1
+    shifts = jax.random.randint(
+        k_ping_t, (n_shifts,), 1, n, dtype=jnp.int32
+    )
+    fd_shift = shifts[0]
+    proxy_shifts = shifts[1:1 + r_proxies]
+    gossip_shifts = shifts[1 + r_proxies:1 + r_proxies + f]
+    sync_shift = shifts[-1]
+
+    # Doubled per-node info for shifted lookups: [2N] each.
+    d_alive = shift_ops.doubled(alive)
+    d_part = shift_ops.doubled(part)
+    d_ids = shift_ops.doubled(node_ids)
+
+    def at(shift, dv):
+        return shift_ops.look(dv, shift, n)
+
+    # ---- Phase 1: failure detector probe --------------------------------
+    t = at(fd_shift, d_ids)                                  # [N] target ids
+    alive_t = at(fd_shift, d_alive)
+    part_t = at(fd_shift, d_part)
+    if params.full_view:
+        slot = t
+        entry_t_status = jnp.take_along_axis(status, t[:, None], 1)[:, 0]
+        entry_t_inc = jnp.take_along_axis(inc, t[:, None], 1)[:, 0]
+        has_target = (
+            (entry_t_status == records.ALIVE)
+            | (entry_t_status == records.SUSPECT)
+        )
+    else:
+        d_slot = shift_ops.doubled(world.slot_of_node)
+        slot = at(fd_shift, d_slot)                          # -1 = untracked
+        slot_safe = jnp.maximum(slot, 0)
+        entry_t_status = _entry_at_slot(status, slot_safe, k)
+        entry_t_inc = _entry_at_slot(inc, slot_safe, k)
+        has_target = (slot >= 0) & (
+            (entry_t_status == records.ALIVE)
+            | (entry_t_status == records.SUSPECT)
+        )
+
+    loss_it, delay_it = link_eval(world.faults, round_idx, node_ids, t,
+                                  kn.loss_probability, params.mean_delay_ms)
+    loss_ti, delay_ti = link_eval(world.faults, round_idx, t, node_ids,
+                                  kn.loss_probability, params.mean_delay_ms)
+    direct_ok = (
+        _chain_ok(k_ping_net, [loss_it, loss_ti], [delay_it, delay_ti],
+                  params.ping_timeout_ms, (n,))
+        & alive_t & (part_here == part_t)
+    )
+    # Ping-req via proxy shifts; proxy r for node i is (i + ps_r) % n.
+    proxy_oks = []
+    for r in range(r_proxies):
+        ps = proxy_shifts[r]
+        p_ids = at(ps, d_ids)
+        p_alive = at(ps, d_alive)
+        p_part = at(ps, d_part)
+        hop_pairs = [(node_ids, p_ids), (p_ids, t), (t, p_ids),
+                     (p_ids, node_ids)]
+        hop_losses, hop_delays = [], []
+        for src, dst in hop_pairs:
+            lo, de = link_eval(world.faults, round_idx, src, dst,
+                               kn.loss_probability, params.mean_delay_ms)
+            hop_losses.append(lo)
+            hop_delays.append(de)
+        ok_r = (
+            _chain_ok(jax.random.fold_in(k_proxy_net, r),
+                      hop_losses, hop_delays,
+                      params.ping_interval_ms - params.ping_timeout_ms, (n,))
+            & p_alive & alive_t
+            & (part_here == p_part) & (p_part == part_t)
+            & (ps != fd_shift)                               # proxy != target
+        )
+        proxy_oks.append(ok_r)
+    ack_ok = direct_ok
+    for ok_r in proxy_oks:
+        ack_ok = ack_ok | ok_r
+    probe_active = fd_round & has_target & alive_here
+    verdict_suspect = probe_active & ~ack_ok
+    verdict_alive = probe_active & ack_ok
+
+    slot_safe = jnp.maximum(slot, 0)
+    fd_slot_onehot = (
+        jnp.arange(k, dtype=jnp.int32)[None, :] == slot_safe[:, None]
+    )
+    fd_suspect_key = delivery.pack_record(
+        jnp.int8(records.SUSPECT), entry_t_inc
+    )
+    fd_inbox = jnp.where(
+        fd_slot_onehot & verdict_suspect[:, None],
+        fd_suspect_key[:, None],
+        delivery.NO_MESSAGE,
+    )
+    push_refute = verdict_alive & (entry_t_status == records.SUSPECT)
+
+    # ---- Phase 2 + 3: gossip and SYNC sends ------------------------------
+    gossip_keys, sync_keys = _send_payloads(
+        state, status, inc, round_idx, params, world, node_ids, is_self
+    )
+
+    # Delivery: receiver j's channel-c message comes from sender
+    # (j - shift_c) % n; sender-side gates (alive, partition, contact gate,
+    # per-link loss) evaluate at the receiver via shifted views, which is
+    # distribution-identical and keeps everything contiguous.
+    d_gossip = shift_ops.doubled(gossip_keys)                # [2N, K]
+    d_sync = shift_ops.doubled(sync_keys)
+    d_status_alive = shift_ops.doubled(
+        delivery.is_alive_key(gossip_keys).astype(jnp.int8)
+    )
+    d_sync_alive = shift_ops.doubled(
+        delivery.is_alive_key(sync_keys).astype(jnp.int8)
+    )
+
+    drop_u = jax.random.uniform(k_gossip_drop, (n, f + 1))
+    d_hot_any = shift_ops.doubled(jnp.any(gossip_keys >= 0, axis=1))
+    d_status = shift_ops.doubled(status) if gate_contacts else None
+
+    inbox = fd_inbox
+    inbox_alive = jnp.zeros((n, k), dtype=jnp.bool_)
+    n_gossip_sent = jnp.int32(0)
+    for c in range(f):
+        s = gossip_shifts[c]
+        sender = shift_ops.deliver(d_ids, s, n)
+        sender_alive = shift_ops.deliver(d_alive, s, n)
+        sender_part = shift_ops.deliver(d_part, s, n)
+        loss_c, _ = link_eval(world.faults, round_idx, sender, node_ids,
+                              kn.loss_probability, params.mean_delay_ms)
+        ok_c = (
+            sender_alive & alive_here & (sender_part == part_here)
+            & (drop_u[:, c] >= loss_c)
+            & (jnp.int32(c) < kn.fanout)
+        )
+        if gate_contacts:
+            # Sender-side knowledge of the receiver, evaluated at the
+            # receiver: sender's record of me (full-view: my id column).
+            sender_knows = jnp.take_along_axis(
+                shift_ops.deliver(d_status, s, n),
+                node_ids[:, None], axis=1,
+            )[:, 0]
+            ok_c &= (
+                (sender_knows == records.ALIVE)
+                | (sender_knows == records.SUSPECT)
+                | is_seed(node_ids)
+            )
+        delivered = shift_ops.deliver(d_gossip, s, n)        # [N, K]
+        delivered = jnp.where(ok_c[:, None], delivered, delivery.NO_MESSAGE)
+        inbox = jnp.maximum(inbox, delivered)
+        inbox_alive |= (
+            shift_ops.deliver(d_status_alive, s, n).astype(jnp.bool_)
+            & ok_c[:, None]
+        )
+        n_gossip_sent += jnp.sum(
+            ok_c & shift_ops.deliver(d_hot_any, s, n), dtype=jnp.int32,
+        )
+
+    # SYNC channel: the periodic anti-entropy push, plus the FD
+    # alive-on-suspected refute push (aimed at the probed member = the
+    # fd_shift channel).
+    s = sync_shift
+    sender_alive = shift_ops.deliver(d_alive, s, n)
+    sender_part = shift_ops.deliver(d_part, s, n)
+    sender_ids_s = shift_ops.deliver(d_ids, s, n)
+    loss_sy, _ = link_eval(world.faults, round_idx, sender_ids_s, node_ids,
+                           kn.loss_probability, params.mean_delay_ms)
+    ok_s = (
+        sync_round & sender_alive & alive_here
+        & (sender_part == part_here) & (drop_u[:, f] >= loss_sy)
+    )
+    if gate_contacts:
+        sender_knows = jnp.take_along_axis(
+            shift_ops.deliver(d_status, s, n),
+            node_ids[:, None], axis=1,
+        )[:, 0]
+        ok_s &= (
+            (sender_knows == records.ALIVE)
+            | (sender_knows == records.SUSPECT)
+            | is_seed(node_ids)
+        )
+    delivered = shift_ops.deliver(d_sync, s, n)
+    delivered = jnp.where(ok_s[:, None], delivered, delivery.NO_MESSAGE)
+    inbox = jnp.maximum(inbox, delivered)
+    inbox_alive |= (
+        shift_ops.deliver(d_sync_alive, s, n).astype(jnp.bool_)
+        & ok_s[:, None]
+    )
+
+    # Refute push: issuer i sends its SUSPECT record of t = (i + fd_shift)
+    # to t itself; at the receiver that is the sender (j - fd_shift).
+    refute_row = jnp.where(
+        fd_slot_onehot & push_refute[:, None],
+        fd_suspect_key[:, None],                     # SUSPECT @ entry inc
+        delivery.NO_MESSAGE,
+    )
+    d_refute = shift_ops.doubled(refute_row)
+    sender_alive_r = shift_ops.deliver(d_alive, fd_shift, n)
+    # Loss for the refute push (issuer -> target hop).
+    sender_ids_r = shift_ops.deliver(d_ids, fd_shift, n)
+    loss_r, _ = link_eval(world.faults, round_idx, sender_ids_r, node_ids,
+                          kn.loss_probability, params.mean_delay_ms)
+    ok_r = (
+        sender_alive_r & alive_here
+        & (shift_ops.deliver(d_part, fd_shift, n) == part_here)
+        & (jax.random.uniform(k_sync_drop, (n,)) >= loss_r)
+    )
+    delivered_r = shift_ops.deliver(d_refute, fd_shift, n)
+    inbox = jnp.maximum(
+        inbox, jnp.where(ok_r[:, None], delivered_r, delivery.NO_MESSAGE)
+    )
+
+    new_state, refuted = _merge_and_timers(
+        state, status, inc, inbox, inbox_alive, round_idx, params, kn, world,
+        node_ids, alive_here, is_self,
+    )
+    aux = dict(
+        messages_gossip=n_gossip_sent,
+        messages_ping=jnp.sum(probe_active, dtype=jnp.int32),
+        refutations=jnp.sum(refuted & alive_here, dtype=jnp.int32),
+    )
+    return new_state, aux
+
+
+def node_snapshot(state: SwimState, params: SwimParams, world: SwimWorld,
+                  node_id: int) -> dict:
+    """Queryable per-node state dump — the JMX MBean analog for the tick.
+
+    Host-side digest of one observer row, mirroring the reference's
+    ``MembershipProtocolImpl.JmxMonitorMBean`` surface
+    (MembershipProtocolImpl.java:693-749: incarnation, alive/suspected
+    lists, removals) for any of the N simulated nodes; the oracle facade's
+    counterpart is ``oracle.Cluster.monitor``.
+    """
+    import numpy as np
+
+    status = np.asarray(state.status[node_id])
+    inc = np.asarray(state.inc[node_id])
+    deadline = np.asarray(state.suspect_deadline[node_id])
+    subjects = np.asarray(world.subject_ids)
+    not_self = subjects != node_id
+
+    def ids_with(code):
+        return subjects[(status == code) & not_self].tolist()
+
+    return {
+        "node_id": int(node_id),
+        "incarnation": int(np.asarray(state.self_inc)[node_id]),
+        "alive_members": ids_with(records.ALIVE),
+        "suspected_members": ids_with(records.SUSPECT),
+        "dead_tombstones": ids_with(records.DEAD),
+        "unknown_members": ids_with(records.ABSENT),
+        "pending_suspicion_timers": {
+            int(s): int(d)
+            for s, d in zip(subjects, deadline)
+            if d != INT32_MAX
+        },
+        "record_incarnations": {
+            int(s): int(i)
+            for s, i, st in zip(subjects, inc, status)
+            if st != records.ABSENT
+        },
+    }
 
 
 @partial(jax.jit, static_argnames=("params", "n_rounds"))
 def run(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
-        state: Optional[SwimState] = None, start_round: int = 0):
+        state: Optional[SwimState] = None, start_round: int = 0,
+        knobs: Optional[Knobs] = None):
     """Scan the SWIM tick over ``n_rounds`` rounds from ``start_round``.
 
     Returns (final_state, metrics-dict of [n_rounds, ...] traces).
@@ -592,7 +1274,8 @@ def run(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
         state = initial_state(params, world)
 
     def body(carry, round_idx):
-        return swim_tick(carry, round_idx, base_key, params, world)
+        return swim_tick(carry, round_idx, base_key, params, world,
+                         knobs=knobs)
 
     rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
     return jax.lax.scan(body, state, rounds)

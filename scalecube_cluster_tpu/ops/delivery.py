@@ -63,6 +63,19 @@ def unpack_record(key):
     return status, inc
 
 
+def is_alive_key(key):
+    """True where ``key`` packs an ALIVE record (dead/suspect bits clear).
+
+    The ALIVE-gate side channel must reflect the *transmitted* record, not
+    the sender's table status — they differ for a graceful leaver, whose
+    final-round gossip carries DEAD@inc+1 while its own table row is
+    pinned ALIVE (models/swim._send_payloads).  An ABSENT entry must not
+    open for that DEAD notice (MembershipRecord.java:67-69).
+    """
+    key = jnp.asarray(key, dtype=jnp.int32)
+    return (key >= 0) & (((key >> 30) & 1) == 0) & ((key & 1) == 0)
+
+
 def scatter_max(values, targets, drop, n_rows: int):
     """Deliver each sender's record row to its targets; inbox = per-cell max.
 

@@ -11,6 +11,8 @@ system messages filtered out of ``listen``/``listen_gossips``
 
 from __future__ import annotations
 
+import collections
+
 from typing import Callable, Dict, List, Optional
 
 from scalecube_cluster_tpu.config import ClusterConfig
@@ -31,6 +33,7 @@ from scalecube_cluster_tpu.oracle.gossip import GossipProtocol
 from scalecube_cluster_tpu.oracle.membership import MembershipEvent, MembershipProtocol
 from scalecube_cluster_tpu.oracle.metadata import MetadataStore
 from scalecube_cluster_tpu.oracle.transport import Message, NetworkEmulator, Transport
+from scalecube_cluster_tpu.records import MemberStatus
 
 # System qualifiers hidden from user listen() (ClusterImpl.java:44-58).
 SYSTEM_MESSAGES = frozenset(
@@ -93,6 +96,14 @@ class Cluster:
         # (ClusterImpl.java:103-118).
         self.membership.listen(self.failure_detector.on_member_event)
         self.membership.listen(self.gossip.on_member_event)
+
+        # Removal ring buffer for the monitor snapshot (the JMX MBean keeps
+        # the last 42 removals, MembershipProtocolImpl.java:695-703).
+        self._removals = collections.deque(maxlen=42)
+        self.membership.listen(
+            lambda e: self._removals.append((sim.now, e.member))
+            if e.is_removed() else None
+        )
 
         self._shutdown = False
         self.on_joined: SimFuture = SimFuture()
@@ -193,6 +204,33 @@ class Cluster:
         return self.update_metadata(metadata)
 
     # -- membership events (ClusterImpl.java:283-293) ----------------------
+
+    def monitor(self) -> Dict[str, object]:
+        """Queryable state snapshot — the JMX MBean analog.
+
+        Mirrors ClusterImpl.JmxMonitorMBean + MembershipProtocolImpl's
+        MBean surface (ClusterImpl.java:366-396,
+        MembershipProtocolImpl.java:693-749): incarnation, member id,
+        alive/suspected member lists, the last-42-removals ring, and the
+        metadata dump.
+        """
+        records = self.membership.membership_records()
+        return {
+            "member": str(self.local_member),
+            "incarnation": self.membership.incarnation,
+            "alive_members": sorted(
+                str(r.member) for r in records
+                if r.status == MemberStatus.ALIVE
+            ),
+            "suspected_members": sorted(
+                str(r.member) for r in records
+                if r.status == MemberStatus.SUSPECT
+            ),
+            "removed_members": [
+                {"at_ms": t, "member": str(m)} for t, m in self._removals
+            ],
+            "metadata": dict(self.metadata_store.metadata() or {}),
+        }
 
     def listen_membership(self, handler: Callable[[MembershipEvent], None]) -> None:
         """Prepends synthetic ADDED for already-known members, then live events."""

@@ -1,0 +1,142 @@
+"""Message serialization: the MessageCodec SPI and the default JSON codec.
+
+The reference makes every message cross a real wire: MessageCodec
+(transport/src/main/java/io/scalecube/transport/MessageCodec.java:9-27) is
+the pluggable seam, JacksonMessageCodec
+(transport/JacksonMessageCodec.java:15-52) the default — JSON with
+default-typing so polymorphic payloads (PingData, SyncData, GossipRequest,
+metadata requests) round-trip.  The oracle is in-process, so without a
+codec it would quietly pass live Python objects — a capability gap the
+round-1 review flagged.  This module restores the seam:
+
+  - :class:`MessageCodec`: serialize/deserialize interface;
+  - :class:`JsonMessageCodec`: tagged-JSON default covering every payload
+    type in the 9-qualifier wire protocol (SURVEY.md §2.1) plus plain
+    JSON-able user data;
+  - the oracle Transport routes every send through the configured codec
+    (encode → decode, the in-process stand-in for encode → TCP → decode),
+    so any unserializable payload fails loudly, exactly like the
+    reference's wire (GossipRequestTest.java:40-69 is the model test).
+
+The dense tick's analog is ops/delivery.pack_record/unpack_record — the
+record <-> int32 sort-key packing IS the TPU wire format; this module is
+the oracle/API-layer counterpart for full messages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+from scalecube_cluster_tpu.oracle.core import Address, Member
+from scalecube_cluster_tpu.records import MemberStatus
+
+
+class MessageCodec:
+    """Serialization SPI (reference: transport/MessageCodec.java:9-27)."""
+
+    def serialize(self, message) -> bytes:
+        raise NotImplementedError
+
+    def deserialize(self, payload: bytes):
+        raise NotImplementedError
+
+
+class CodecError(Exception):
+    pass
+
+
+class JsonMessageCodec(MessageCodec):
+    """Tagged-JSON codec for Message + all protocol payload types.
+
+    Mirrors JacksonMessageCodec's default-typing: every non-primitive value
+    is encoded as ``{"@type": <registered name>, ...fields}`` so payloads
+    reconstruct polymorphically (transport/JacksonMessageCodec.java:41-52).
+    """
+
+    def __init__(self):
+        # Late imports to avoid cycles (these modules import transport,
+        # which imports nothing from here at module level).
+        from scalecube_cluster_tpu.oracle import transport as tmod
+        from scalecube_cluster_tpu.oracle import membership as mmod
+        from scalecube_cluster_tpu.oracle import gossip as gmod
+        from scalecube_cluster_tpu.oracle import fdetector as fmod
+        from scalecube_cluster_tpu.oracle import metadata as dmod
+
+        self._types = {
+            "Message": tmod.Message,
+            "Address": Address,
+            "Member": Member,
+            "MembershipRecord": mmod.MembershipRecord,
+            "SyncData": mmod.SyncData,
+            "PingData": fmod.PingData,
+            "Gossip": gmod.Gossip,
+            "GossipRequest": gmod.GossipRequest,
+            "GetMetadataRequest": dmod.GetMetadataRequest,
+            "GetMetadataResponse": dmod.GetMetadataResponse,
+        }
+        self._names = {cls: name for name, cls in self._types.items()}
+
+    # -- encode -----------------------------------------------------------
+
+    def _enc(self, value: Any):
+        # MemberStatus first: it is an IntEnum, so the primitive check
+        # below would silently flatten it to a bare int.
+        if isinstance(value, MemberStatus):
+            return {"@type": "MemberStatus", "value": int(value)}
+        if value is None or isinstance(value, (bool, int, float, str)):
+            return value
+        if isinstance(value, list):
+            return [self._enc(v) for v in value]
+        if isinstance(value, tuple):
+            return {"@type": "tuple", "items": [self._enc(v) for v in value]}
+        if isinstance(value, dict):
+            return {"@type": "dict",
+                    "items": [[self._enc(k), self._enc(v)]
+                              for k, v in value.items()]}
+        cls = type(value)
+        name = self._names.get(cls)
+        if name is None:
+            raise CodecError(f"unserializable payload type: {cls.__name__}")
+        if dataclasses.is_dataclass(value):
+            fields = {
+                f.name: self._enc(getattr(value, f.name))
+                for f in dataclasses.fields(value)
+            }
+        else:  # plain-attribute payloads (metadata request/response)
+            fields = {
+                k: self._enc(v) for k, v in vars(value).items()
+            }
+        return {"@type": name, **fields}
+
+    # -- decode -----------------------------------------------------------
+
+    def _dec(self, value: Any):
+        if isinstance(value, list):
+            return [self._dec(v) for v in value]
+        if not isinstance(value, dict):
+            return value
+        tag = value.get("@type")
+        if tag == "tuple":
+            return tuple(self._dec(v) for v in value["items"])
+        if tag == "dict":
+            return {self._dec(k): self._dec(v) for k, v in value["items"]}
+        if tag == "MemberStatus":
+            return MemberStatus(value["value"])
+        cls = self._types.get(tag)
+        if cls is None:
+            raise CodecError(f"unknown payload tag: {tag!r}")
+        fields = {k: self._dec(v) for k, v in value.items() if k != "@type"}
+        return cls(**fields)
+
+    # -- SPI --------------------------------------------------------------
+
+    def serialize(self, message) -> bytes:
+        try:
+            return json.dumps(self._enc(message)).encode()
+        except (TypeError, ValueError) as e:
+            raise CodecError(str(e)) from e
+
+    def deserialize(self, payload: bytes):
+        return self._dec(json.loads(payload.decode()))

@@ -141,11 +141,21 @@ class Transport:
       - a stopped transport delivers nothing (:175-186).
     """
 
-    def __init__(self, sim: Simulator, address: Optional[Address] = None, enabled_emulator: bool = True):
+    def __init__(self, sim: Simulator, address: Optional[Address] = None,
+                 enabled_emulator: bool = True, codec="json"):
+        """``codec``: "json" (default) routes every send through the
+        JsonMessageCodec wire round-trip (the in-process analog of the
+        reference's encode -> TCP -> decode, JacksonMessageCodec.java:15-52);
+        a MessageCodec instance plugs in a custom codec; None disables
+        serialization (raw object hand-off)."""
         self.sim = sim
         self.address = address or Address("localhost", sim.allocate_port())
         if self.address in sim.transports:
             raise RuntimeError(f"address already in use: {self.address}")
+        if codec == "json":
+            from scalecube_cluster_tpu.oracle.codec import JsonMessageCodec
+            codec = JsonMessageCodec()
+        self.codec = codec
         self.network_emulator = NetworkEmulator(self.address, enabled_emulator)
         self._listeners: List[Callable[[Message], None]] = []
         # cid -> pending request-response futures.  A list, not a single slot:
@@ -171,6 +181,15 @@ class Transport:
             future.reject(RuntimeError("transport stopped"))
             return future
         message = message.with_sender(self.address)
+        if self.codec is not None:
+            # The wire: serialize before the emulator hook, deserialize at
+            # delivery — unserializable payloads fail the send future, like
+            # a codec error inside TransportImpl.send0 (:257-269).
+            try:
+                message = self.codec.deserialize(self.codec.serialize(message))
+            except Exception as e:  # noqa: BLE001 — surfaced on the future
+                future.reject(e)
+                return future
 
         # NetworkEmulator hook: tryFail then tryDelay (TransportImpl.java:257-269).
         settings = self.network_emulator.link_settings(destination)

@@ -1,0 +1,189 @@
+"""Parameter-sweep harness: vmap one compiled SWIM program over a knob grid.
+
+BASELINE config 5 ("1M-member SWIM parameter sweep: fanout × ping-interval
+× suspicion-mult, 10k rounds") and the reference's own experiment design
+(GossipProtocolTest.java:50-66 sweeps {N, loss, delay} as a parameterized
+matrix).  Here the grid is *data*: models/swim.Knobs carries the sweepable
+schedule fields as traced scalars, so a B-point grid is one ``jax.vmap``
+over one compiled scan — the TPU-native analog of EP/grid-search
+parallelism (SURVEY.md §2.5).
+
+Outputs per grid point, from one crash-at-round-0 scenario:
+  - ``dissemination_rounds``: crash → death known by every live observer
+    (the SWIM O(log n) dissemination curve's sample),
+  - ``detection_rounds``: crash → first DEAD declaration,
+  - ``first_false_positive``: first round a live member is suspected,
+  - ``false_positive_rate``: FP observer-rounds per observer per round.
+
+``main`` writes the curve artifact (JSON) and checks the analytic
+anchors from swim_math (the ClusterMath port): measured dissemination must
+sit within the spread window `repeat_mult*ceil(log2(n+1))` and detection
+must straddle the configured suspicion timeout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalecube_cluster_tpu import swim_math
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models import swim
+
+
+def knob_grid(params: swim.SwimParams, *,
+              fanout: Sequence[int] = (),
+              ping_every: Sequence[int] = (),
+              suspicion_rounds: Sequence[int] = (),
+              loss_probability: Sequence[float] = (),
+              sync_every: Sequence[int] = ()) -> swim.Knobs:
+    """Cartesian grid of knob values as one batched Knobs pytree [B].
+
+    Unspecified axes stay at the params value.  ``fanout`` entries must be
+    <= params.fanout (the static channel count).
+    """
+    axes = {
+        "fanout": list(fanout) or [params.fanout],
+        "ping_every": list(ping_every) or [params.ping_every],
+        "suspicion_rounds": list(suspicion_rounds) or [params.suspicion_rounds],
+        "loss_probability": list(loss_probability) or [params.loss_probability],
+        "sync_every": list(sync_every) or [params.sync_every],
+    }
+    if max(axes["fanout"]) > params.fanout:
+        raise ValueError(
+            f"fanout sweep max {max(axes['fanout'])} exceeds the static "
+            f"channel count params.fanout={params.fanout}"
+        )
+    points = list(itertools.product(*axes.values()))
+    cols = list(zip(*points))
+    named = dict(zip(axes.keys(), cols))
+    return swim.Knobs(
+        fanout=jnp.asarray(named["fanout"], jnp.int32),
+        ping_every=jnp.asarray(named["ping_every"], jnp.int32),
+        suspicion_rounds=jnp.asarray(named["suspicion_rounds"], jnp.int32),
+        loss_probability=jnp.asarray(named["loss_probability"], jnp.float32),
+        sync_every=jnp.asarray(named["sync_every"], jnp.int32),
+    )
+
+
+def sweep_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
+              n_rounds: int, knobs: swim.Knobs):
+    """Run the scenario once per grid point: vmap over the knob batch.
+
+    Returns metrics with a leading grid axis [B, n_rounds, ...].  Each grid
+    point gets an independent PRNG stream (fold_in of its index).
+    """
+    batch = knobs.fanout.shape[0]
+    keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+        jnp.arange(batch, dtype=jnp.int32)
+    )
+
+    def one(key, kn):
+        _, metrics = swim.run(key, params, world, n_rounds, knobs=kn)
+        return metrics
+
+    return jax.vmap(one)(keys, knobs)
+
+
+def crash_curves(metrics: Dict[str, np.ndarray], subject_slot: int,
+                 n_rounds: int, n_members: int) -> Dict[str, np.ndarray]:
+    """Digest sweep metrics into the headline curves, one value per grid
+    point (see module docstring)."""
+    suspects = np.asarray(metrics["suspect"])[:, :, subject_slot]    # [B, T]
+    deads = np.asarray(metrics["dead"])[:, :, subject_slot]
+    alive_view = np.asarray(metrics["alive"])[:, :, subject_slot]
+    fp = np.asarray(metrics["false_positives"]).sum(axis=2)          # [B, T]
+
+    def first(cond):  # [B, T] -> [B] (n_rounds = never)
+        hit = cond.any(axis=1)
+        idx = cond.argmax(axis=1)
+        return np.where(hit, idx, n_rounds).astype(np.float64)
+
+    return {
+        "detection_rounds": first(deads > 0),
+        "dissemination_rounds": first(
+            (alive_view == 0) & (suspects == 0) & (deads > 0)
+        ),
+        "first_false_positive": first(fp > 0),
+        "false_positive_rate": fp.mean(axis=1) / n_members,
+    }
+
+
+def run_crash_sweep(n_members: int, n_rounds: int, config=None, seed: int = 0,
+                    delivery: str = "shift",
+                    n_subjects: Optional[int] = None,
+                    **grid_axes) -> Dict[str, object]:
+    """One-call sweep: crash-at-0 scenario across the knob grid."""
+    config = config or ClusterConfig.default()
+    params = swim.SwimParams.from_config(
+        config, n_members=n_members, n_subjects=n_subjects,
+        delivery=delivery,
+        # Static channel count must cover the largest swept fanout.
+        **({"fanout": max(grid_axes["fanout"])} if grid_axes.get("fanout")
+           else {}),
+    )
+    world = swim.SwimWorld.healthy(params).with_crash(0, at_round=0)
+    knobs = knob_grid(params, **grid_axes)
+    metrics = sweep_run(jax.random.key(seed), params, world, n_rounds, knobs)
+    curves = crash_curves(metrics, subject_slot=0, n_rounds=n_rounds,
+                          n_members=n_members)
+    grid_cols = {
+        f.name: np.asarray(getattr(knobs, f.name)).tolist()
+        for f in dataclasses.fields(knobs)
+    }
+    return {
+        "n_members": n_members,
+        "n_rounds": n_rounds,
+        "delivery": delivery,
+        "grid": grid_cols,
+        "curves": {k: v.tolist() for k, v in curves.items()},
+        "analytic": {
+            "periods_to_spread": swim_math.gossip_periods_to_spread(
+                config.gossip_repeat_mult, n_members
+            ),
+            "suspicion_rounds_default": params.suspicion_rounds,
+        },
+    }
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n-members", type=int, default=4096)
+    ap.add_argument("--n-subjects", type=int, default=None)
+    ap.add_argument("--n-rounds", type=int, default=600)
+    ap.add_argument("--delivery", default="shift")
+    ap.add_argument("--fanout", type=int, nargs="*", default=[2, 3, 4])
+    ap.add_argument("--ping-every", type=int, nargs="*", default=[2, 5])
+    ap.add_argument("--suspicion-rounds", type=int, nargs="*", default=[])
+    ap.add_argument("--loss", type=float, nargs="*", default=[0.0, 0.05])
+    ap.add_argument("--out", default="sweep_curves.json")
+    args = ap.parse_args(argv)
+
+    result = run_crash_sweep(
+        args.n_members, args.n_rounds,
+        n_subjects=args.n_subjects,
+        delivery=args.delivery,
+        fanout=args.fanout,
+        ping_every=args.ping_every,
+        suspicion_rounds=args.suspicion_rounds,
+        loss_probability=args.loss,
+    )
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    det = result["curves"]["detection_rounds"]
+    dis = result["curves"]["dissemination_rounds"]
+    print(f"wrote {args.out}: {len(det)} grid points; "
+          f"detection rounds {min(det)}..{max(det)}, "
+          f"dissemination {min(dis)}..{max(dis)}")
+
+
+if __name__ == "__main__":
+    main()

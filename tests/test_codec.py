@@ -1,0 +1,108 @@
+"""MessageCodec round-trips (oracle/codec.py).
+
+The analog of the reference's serialization tests
+(gossip/GossipRequestTest.java:40-69: Jackson round-trip of nested
+polymorphic GossipRequest) for every payload type in the 9-qualifier wire
+protocol (SURVEY.md §2.1), plus the failure mode: an unserializable
+payload must fail the send, like a codec error on a real wire.
+"""
+
+import pytest
+
+from scalecube_cluster_tpu.oracle import (
+    Address, Cluster, Member, Message, Simulator, Transport,
+)
+from scalecube_cluster_tpu.oracle.codec import CodecError, JsonMessageCodec
+from scalecube_cluster_tpu.oracle.fdetector import PingData
+from scalecube_cluster_tpu.oracle.gossip import Gossip, GossipRequest
+from scalecube_cluster_tpu.oracle.membership import MembershipRecord, SyncData
+from scalecube_cluster_tpu.oracle.metadata import (
+    GetMetadataRequest, GetMetadataResponse,
+)
+from scalecube_cluster_tpu.records import MemberStatus
+
+CODEC = JsonMessageCodec()
+ALICE = Member(id="alice", address=Address("localhost", 4801))
+BOB = Member(id="bob", address=Address("localhost", 4802))
+
+
+def roundtrip(msg: Message) -> Message:
+    return CODEC.deserialize(CODEC.serialize(msg))
+
+
+def test_plain_user_message():
+    msg = Message(qualifier="greeting", correlation_id="cid-1",
+                  data={"text": "hello", "n": 3}, sender=ALICE.address)
+    back = roundtrip(msg)
+    assert back == msg
+
+
+def test_ping_data_with_transit_issuer():
+    msg = Message(qualifier="sc/fdetector/pingReq", correlation_id="c-9",
+                  data=PingData(from_=ALICE, to=BOB, original_issuer=ALICE))
+    back = roundtrip(msg)
+    assert back.data.from_ == ALICE
+    assert back.data.original_issuer == ALICE
+
+
+def test_sync_data_full_table():
+    table = (
+        MembershipRecord(ALICE, MemberStatus.ALIVE, 0),
+        MembershipRecord(BOB, MemberStatus.SUSPECT, 3),
+    )
+    msg = Message(qualifier="sc/membership/sync",
+                  data=SyncData(membership=table, sync_group="default"))
+    back = roundtrip(msg)
+    assert back.data.membership == table
+    assert back.data.membership[1].status is MemberStatus.SUSPECT
+
+
+def test_nested_polymorphic_gossip_request():
+    """The GossipRequestTest.java:40-69 case: gossips wrap whole Messages."""
+    inner = Message(qualifier="news", data=["a", 1, None])
+    req = GossipRequest(
+        gossips=(Gossip(gossip_id="alice-0", message=inner),),
+        from_id="alice",
+    )
+    back = roundtrip(Message(qualifier="sc/gossip/req", data=req))
+    assert back.data.from_id == "alice"
+    assert back.data.gossips[0].gossip_id == "alice-0"
+    assert back.data.gossips[0].message.qualifier == "news"
+    assert back.data.gossips[0].message.data == ["a", 1, None]
+
+
+def test_metadata_request_response():
+    req = roundtrip(Message(qualifier="sc/metadata/req",
+                            data=GetMetadataRequest(BOB)))
+    assert req.data.member == BOB
+    resp = roundtrip(Message(
+        qualifier="sc/metadata/resp",
+        data=GetMetadataResponse(BOB, {"role": "worker"}),
+    ))
+    assert resp.data.metadata == {"role": "worker"}
+
+
+def test_unserializable_payload_fails_the_send():
+    class NotWire:
+        pass
+
+    sim = Simulator(seed=1)
+    a = Transport(sim)
+    b = Transport(sim)
+    errors = []
+    fut = a.send(b.address, Message(qualifier="x", data=NotWire()))
+    fut.subscribe(None, errors.append)
+    sim.run_for(100)
+    assert errors and isinstance(errors[0], CodecError)
+
+
+def test_cluster_wire_is_codec_backed():
+    """End-to-end: a whole join + gossip cycle runs over serialized bytes
+    (the Transport default codec), not live object hand-off."""
+    sim = Simulator(seed=5)
+    alice = Cluster.join(sim, alias="alice")
+    assert alice.transport.codec is not None
+    bob = Cluster.join(sim, seeds=[alice.address], alias="bob")
+    sim.run_for(2_000)
+    assert sorted(m.id for m in alice.other_members()) == ["bob"]
+    assert sorted(m.id for m in bob.other_members()) == ["alice"]

@@ -1,0 +1,223 @@
+"""Oracle ↔ TPU-tick cross-validation (SURVEY.md §7 step 4 exit criterion).
+
+Runs the SAME scenario on both layers — the event-driven oracle (the
+behavioral stand-in for the reference's in-JVM harness,
+MembershipProtocolTest.java:312-366, FailureDetectorTest.java:117-147) and
+the dense TPU tick — with the oracle configured at exactly the tick's time
+quantization (gossip interval = 1 round), and compares protocol timescales
+across seeds:
+
+  - SUSPECT onset (crash -> first live observer marks SUSPECT),
+  - DEAD declaration (suspicion timeout fires),
+  - full dissemination (every live observer has dropped the victim),
+  - false-suspicion behavior under symmetric link loss.
+
+Medians across seeds must agree within the stated tolerance; the suite
+fails if either layer drifts.  Both delivery modes of the tick are pinned.
+
+The suspicion timeout is deterministic and identical by construction
+(suspicion_mult * ceil(log2(n+1)) * ping_interval, ClusterMath.java:123-125),
+so the compared quantities differ only by probe-discovery and dissemination
+dynamics — the parts the dense lift actually approximates.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.oracle import Cluster, Simulator
+from scalecube_cluster_tpu.records import MemberStatus
+
+N = 24
+ROUND_MS = 100  # gossip interval = the tick's base round
+
+# One config, both layers: tick quantization maps ping_every=2,
+# sync_every=10, suspicion_rounds = 3 * ceil(log2(25)) * 200/100 = 30.
+CFG = ClusterConfig.default_local().replace(
+    gossip_interval=ROUND_MS,
+    ping_interval=200,
+    ping_timeout=100,
+    sync_interval=1_000,
+    suspicion_mult=3,
+)
+
+N_SEEDS = 8          # per layer; medians compared
+HORIZON_ROUNDS = 250
+
+
+def _round(t_ms: float) -> float:
+    return t_ms / ROUND_MS
+
+
+# --------------------------------------------------------------------------
+# Oracle side
+# --------------------------------------------------------------------------
+
+
+def oracle_crash_timescales(seed: int, loss_percent: int = 0):
+    """(suspect_onset, dead_first, gone_all) in rounds after the crash."""
+    sim = Simulator(seed=seed)
+    clusters = [Cluster.join(sim, config=CFG, alias="m0")]
+    for i in range(1, N):
+        clusters.append(
+            Cluster.join(sim, seeds=[clusters[0].address], config=CFG,
+                         alias=f"m{i}")
+        )
+    sim.run_for(4_000)
+    victim = clusters[3]
+    observers = [c for c in clusters if c is not victim]
+    assert all(len(c.members()) == N for c in clusters), "warmup incomplete"
+
+    if loss_percent:
+        for c in clusters:
+            c.network_emulator.set_default_link_settings(loss_percent, 0)
+
+    t_crash = sim.now
+    victim.transport.stop()
+    vid = victim.member().id
+
+    suspect_onset = dead_first = gone_all = None
+    step_ms = ROUND_MS
+    for _ in range(HORIZON_ROUNDS):
+        sim.run_for(step_ms)
+        if suspect_onset is None:
+            for c in observers:
+                recs = {r.member.id: r.status
+                        for r in c.membership.membership_records()}
+                if recs.get(vid) == MemberStatus.SUSPECT:
+                    suspect_onset = sim.now - t_crash
+                    break
+        if dead_first is None:
+            if any(vid not in {m.id for m in c.members()} for c in observers):
+                dead_first = sim.now - t_crash
+        if all(vid not in {m.id for m in c.members()} for c in observers):
+            gone_all = sim.now - t_crash
+            break
+    return tuple(
+        _round(x) if x is not None else float("inf")
+        for x in (suspect_onset, dead_first, gone_all)
+    )
+
+
+def oracle_false_suspicion(seed: int, loss_percent: int):
+    """First false-suspicion round under symmetric loss (inf if none)."""
+    sim = Simulator(seed=seed)
+    clusters = [Cluster.join(sim, config=CFG, alias="m0")]
+    for i in range(1, N):
+        clusters.append(
+            Cluster.join(sim, seeds=[clusters[0].address], config=CFG,
+                         alias=f"m{i}")
+        )
+    sim.run_for(4_000)
+    for c in clusters:
+        c.network_emulator.set_default_link_settings(loss_percent, 0)
+    t0 = sim.now
+    for _ in range(120):
+        sim.run_for(ROUND_MS)
+        for c in clusters:
+            if any(r.status == MemberStatus.SUSPECT
+                   for r in c.membership.membership_records()):
+                return _round(sim.now - t0)
+    return float("inf")
+
+
+# --------------------------------------------------------------------------
+# Tick side
+# --------------------------------------------------------------------------
+
+
+def tick_crash_timescales(seed: int, delivery: str, loss: float = 0.0):
+    params = swim.SwimParams.from_config(
+        CFG, n_members=N, loss_probability=loss, delivery=delivery,
+    )
+    world = swim.SwimWorld.healthy(params).with_crash(3, at_round=0)
+    _, m = swim.run(jax.random.key(seed), params, world, HORIZON_ROUNDS)
+    suspects = np.asarray(m["suspect"])[:, 3]
+    deads = np.asarray(m["dead"])[:, 3]
+    alive_view = np.asarray(m["alive"])[:, 3]
+
+    def first(cond):
+        idx = np.flatnonzero(cond)
+        return float(idx[0]) if idx.size else float("inf")
+
+    # "Gone" = the death (not mere suspicion) reached every live observer:
+    # no observer holds ALIVE *or* SUSPECT anymore — the analog of the
+    # oracle's members()-no-longer-contains check (REMOVED emitted).
+    return (
+        first(suspects > 0),
+        first(deads > 0),
+        first((alive_view == 0) & (suspects == 0) & (deads > 0)),
+    )
+
+
+def tick_false_suspicion(seed: int, delivery: str, loss: float):
+    params = swim.SwimParams.from_config(
+        CFG, n_members=N, loss_probability=loss, delivery=delivery,
+    )
+    world = swim.SwimWorld.healthy(params)
+    _, m = swim.run(jax.random.key(seed), params, world, 120)
+    fp = np.asarray(m["false_positives"]).sum(axis=1)
+    idx = np.flatnonzero(fp > 0)
+    return float(idx[0]) if idx.size else float("inf")
+
+
+# --------------------------------------------------------------------------
+# The comparisons
+# --------------------------------------------------------------------------
+
+
+def medians(values):
+    return float(np.median([v for v in values]))
+
+
+@pytest.fixture(scope="module")
+def oracle_crash_stats():
+    runs = [oracle_crash_timescales(s) for s in range(N_SEEDS)]
+    return tuple(medians(col) for col in zip(*runs))
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+def test_crash_timescales_match_oracle(oracle_crash_stats, delivery):
+    o_onset, o_dead, o_gone = oracle_crash_stats
+    runs = [tick_crash_timescales(s, delivery) for s in range(N_SEEDS)]
+    t_onset, t_dead, t_gone = (medians(col) for col in zip(*runs))
+
+    # Every stage must complete on both layers.
+    assert np.isfinite([o_onset, o_dead, o_gone]).all()
+    assert np.isfinite([t_onset, t_dead, t_gone]).all()
+
+    # Onset: dominated by probe discovery (~(n-1)/probes-per-round rounds).
+    # The tick resolves probe -> verdict within the probe round (the phased
+    # collapse, SURVEY.md §7), while the oracle spends the full ping
+    # interval before the verdict lands, so allow 2x plus an additive slack
+    # of one ping cycle (2 * ping_every rounds) + 2 quantization edges.
+    slack = 2 * (CFG.ping_interval // ROUND_MS) + 2
+    assert t_onset <= 2 * o_onset + slack, (delivery, t_onset, o_onset)
+    assert o_onset <= 2 * t_onset + slack, (delivery, t_onset, o_onset)
+
+    # DEAD declaration: onset + the (identical, deterministic) suspicion
+    # timeout; must agree within 25% + 3 rounds.
+    assert abs(t_dead - o_dead) <= 0.25 * o_dead + 3, (delivery, t_dead, o_dead)
+
+    # Full dissemination of the death: within 35% + 5 rounds.
+    assert abs(t_gone - o_gone) <= 0.35 * o_gone + 5, (delivery, t_gone, o_gone)
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+def test_false_suspicion_under_loss_matches_oracle(delivery):
+    """At 25% symmetric loss both layers must produce false suspicions on
+    the same timescale; at 0% neither may produce any."""
+    o_first = medians([oracle_false_suspicion(s, 25) for s in range(N_SEEDS)])
+    t_first = medians(
+        [tick_false_suspicion(s, delivery, 0.25) for s in range(N_SEEDS)]
+    )
+    assert np.isfinite(o_first), "oracle produced no false suspicion at 25%"
+    assert np.isfinite(t_first), "tick produced no false suspicion at 25%"
+    ratio = (t_first + 1) / (o_first + 1)
+    assert 1 / 3 <= ratio <= 3, (t_first, o_first)
+
+    # Control: lossless runs stay clean on both layers.
+    assert oracle_false_suspicion(0, 0) == float("inf")
+    assert tick_false_suspicion(0, delivery, 0.0) == float("inf")

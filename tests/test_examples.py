@@ -1,0 +1,31 @@
+"""The runnable examples must actually run (reference ships 5 mains,
+examples/src/main/java/io/scalecube/examples/*.java — SURVEY.md §2.1 row 13).
+
+Each example asserts its own invariants; this suite just executes them.
+The TPU-scale demo is excluded (it sizes itself for an accelerator).
+"""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+EXAMPLES = [
+    "cluster_join_example",
+    "messaging_example",
+    "gossip_example",
+    "membership_events_example",
+    "cluster_metadata_example",
+]
+
+EXAMPLES_DIR = pathlib.Path(__file__).resolve().parent.parent / "examples"
+
+
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs(name):
+    spec = importlib.util.spec_from_file_location(
+        name, EXAMPLES_DIR / f"{name}.py"
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    module.main()

@@ -1,0 +1,68 @@
+"""Monitor/introspection snapshots — the JMX MBean analog on both layers.
+
+Reference: ClusterImpl.JmxMonitorMBean (ClusterImpl.java:366-396) and
+MembershipProtocolImpl.JmxMonitorMBean (:693-749): member identity,
+incarnation, alive/suspected member lists, removal ring, metadata dump.
+"""
+
+import jax
+import numpy as np
+
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.oracle import Cluster, Simulator
+
+from tests.test_swim_model import fast_config
+
+
+def test_oracle_monitor_snapshot():
+    sim = Simulator(seed=9)
+    alice = Cluster.join(sim, alias="alice", metadata={"role": "seed"})
+    bob = Cluster.join(sim, seeds=[alice.address], alias="bob")
+    carol = Cluster.join(sim, seeds=[alice.address], alias="carol")
+    sim.run_for(2_000)
+
+    snap = alice.monitor()
+    assert snap["member"].startswith("alice@")
+    assert any("bob@" in m for m in snap["alive_members"])
+    assert snap["metadata"] == {"role": "seed"}
+    assert snap["removed_members"] == []
+
+    carol.transport.stop()
+    sim.run_for(4_000)  # > FD rotation + ping interval + timeout
+    mid = alice.monitor()
+    assert any("carol@" in m for m in mid["suspected_members"])
+
+    sim.run_for(20_000)
+    end = alice.monitor()
+    assert [r["member"] for r in end["removed_members"]] == [str(carol.member())]
+    assert not any("carol@" in m for m in end["alive_members"])
+
+
+def test_tick_node_snapshot():
+    n = 12
+    params = swim.SwimParams.from_config(fast_config(), n_members=n)
+    world = swim.SwimWorld.healthy(params).with_crash(4, at_round=0)
+    state, _ = swim.run(jax.random.key(2), params, world, 12)
+
+    snap = swim.node_snapshot(state, params, world, node_id=0)
+    assert snap["node_id"] == 0
+    assert 4 in (snap["suspected_members"] + snap["dead_tombstones"]
+                 + snap["alive_members"])
+    # Every pending timer belongs to a currently-suspected subject.
+    for subject in snap["pending_suspicion_timers"]:
+        assert subject in snap["suspected_members"]
+    # All live members tracked at some incarnation.
+    assert set(snap["record_incarnations"]) >= set(snap["alive_members"])
+
+
+def test_tick_snapshot_after_refutation_shows_bumped_incarnation():
+    n = 12
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, loss_probability=0.3
+    )
+    world = swim.SwimWorld.healthy(params)
+    state, metrics = swim.run(jax.random.key(5), params, world, 300)
+    assert np.asarray(metrics["refutations"]).sum() > 0
+    incs = [swim.node_snapshot(state, params, world, i)["incarnation"]
+            for i in range(n)]
+    assert max(incs) > 0

@@ -1,0 +1,232 @@
+"""Shift-delivery mode (ops/shift.py + models/swim._tick_shift).
+
+The fast path must reproduce the protocol behavior of the exact-scatter
+mode: same scenarios as tests/test_swim_model.py plus a statistical
+equivalence check of detection timescales between the two modes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu import records
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.ops import shift as shift_ops
+
+from tests.test_swim_model import fast_config
+
+
+def make(n, k=None, loss=0.0, **overrides):
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, n_subjects=k, loss_probability=loss,
+        delivery="shift", **overrides,
+    )
+    world = swim.SwimWorld.healthy(params)
+    return params, world
+
+
+class TestShiftOps:
+    def test_deliver_and_look_are_duals(self):
+        x = jnp.arange(10, dtype=jnp.int32)
+        d = shift_ops.doubled(x)
+        for s in [1, 3, 9]:
+            # deliver: receiver j gets sender (j - s) % n
+            got = np.asarray(shift_ops.deliver(d, jnp.int32(s), 10))
+            want = np.asarray([(j - s) % 10 for j in range(10)])
+            np.testing.assert_array_equal(got, want)
+            # look: sender i sees target (i + s) % n
+            got = np.asarray(shift_ops.look(d, jnp.int32(s), 10))
+            want = np.asarray([(i + s) % 10 for i in range(10)])
+            np.testing.assert_array_equal(got, want)
+
+    def test_deliver_matrix_rows(self):
+        x = jnp.arange(12, dtype=jnp.int32).reshape(6, 2)
+        d = shift_ops.doubled(x)
+        got = np.asarray(shift_ops.deliver(d, jnp.int32(2), 6))
+        np.testing.assert_array_equal(got[2], np.asarray(x[0]))
+
+
+class TestShiftScenarios:
+    def test_no_false_positives_lossless(self):
+        params, world = make(16)
+        _, metrics = swim.run(jax.random.key(0), params, world, 100)
+        assert np.asarray(metrics["false_positives"]).sum() == 0
+        alive_counts = np.asarray(metrics["alive"])[-1]
+        assert np.all(alive_counts == params.n_members - 1)
+
+    def test_crash_suspect_then_dead_disseminates(self):
+        n = 16
+        params, world = make(n)
+        world = world.with_crash(0, at_round=10)
+        horizon = 10 + params.ping_every * n + params.suspicion_rounds \
+            + 4 * params.periods_to_spread
+        _, metrics = swim.run(jax.random.key(2), params, world, horizon)
+        assert np.asarray(metrics["suspect"])[:, 0].max() > 0
+        assert np.asarray(metrics["dead"])[:, 0].max() > 0
+        assert np.asarray(metrics["alive"])[-1, 0] == 0
+
+    def test_determinism(self):
+        params, world = make(16, loss=0.2)
+        world = world.with_crash(1, at_round=5)
+        _, m1 = swim.run(jax.random.key(9), params, world, 80)
+        _, m2 = swim.run(jax.random.key(9), params, world, 80)
+        for name in m1:
+            np.testing.assert_array_equal(np.asarray(m1[name]), np.asarray(m2[name]))
+
+    def test_restart_after_death_reaccepted(self):
+        n = 10
+        params, world = make(n)
+        down_from = 5
+        down_until = down_from + params.ping_every * n + params.suspicion_rounds \
+            + 3 * params.periods_to_spread
+        world = world.with_crash(2, at_round=down_from, until_round=down_until)
+        final, metrics = swim.run(jax.random.key(6), params, world,
+                                  down_until + 400)
+        assert np.asarray(metrics["alive"])[down_until - 1, 2] < n - 1
+        status = np.asarray(final.status)[:, 2]
+        observers = np.arange(n) != 2
+        assert np.all(status[observers] == records.ALIVE)
+
+    def test_focal_mode_detects_crash(self):
+        n = 256
+        params, world = make(n, k=8, ping_known_only=False)
+        world = world.with_crash(0, at_round=0)
+        _, metrics = swim.run(jax.random.key(7), params, world, 400)
+        alive_view = np.asarray(metrics["alive"])[:, 0]
+        assert alive_view[-1] == 0, "death never fully disseminated"
+
+
+class TestShiftMatchesScatterStatistically:
+    def test_detection_time_same_scale(self):
+        """Median full-dissemination round of a crash must be comparable
+        between the two delivery modes across seeds."""
+        n = 32
+
+        def detect_round(delivery_mode, seed):
+            params = swim.SwimParams.from_config(
+                fast_config(), n_members=n, loss_probability=0.05,
+                delivery=delivery_mode,
+            )
+            world = swim.SwimWorld.healthy(params).with_crash(0, at_round=0)
+            _, m = swim.run(jax.random.key(seed), params, world, 300)
+            alive_view = np.asarray(m["alive"])[:, 0]
+            gone = alive_view == 0
+            return int(np.argmax(gone)) if gone.any() else 300
+
+        seeds = range(6)
+        sc = np.median([detect_round("scatter", s) for s in seeds])
+        sh = np.median([detect_round("shift", s) for s in seeds])
+        assert sc < 300 and sh < 300
+        ratio = sh / max(sc, 1)
+        assert 0.5 < ratio < 2.0, f"shift/scatter detection ratio {ratio}"
+
+
+class TestLinkFaults:
+    def test_asymmetric_loss_rescued_by_ping_req(self):
+        """100% loss a->b: direct pings a->b all fail, but ping-req via
+        proxies rescues the verdict, so b is never declared dead and false
+        suspicion stays rare (FailureDetectorTest.java:117-147)."""
+        n = 8
+        params, world = make(n)
+        world = world.with_link_fault(src=0, dst=1, loss=1.0)
+        _, metrics = swim.run(jax.random.key(11), params, world, 400)
+        assert np.asarray(metrics["dead"]).sum() == 0
+        # ping-req keeps the cluster healthy: no suspicion survives to the
+        # end of the run.
+        assert np.asarray(metrics["suspect"])[-1].sum() == 0
+
+    def test_asymmetric_loss_without_ping_req_suspects(self):
+        """Same scenario with ping-req disabled: the lost direct pings must
+        produce SUSPECT verdicts (the rescue is really the proxies)."""
+        n = 8
+        params, world = make(n, ping_req_members=0)
+        world = world.with_link_fault(src=0, dst=1, loss=1.0)
+        _, metrics = swim.run(jax.random.key(12), params, world, 400)
+        assert np.asarray(metrics["suspect"]).sum() > 0
+
+    def test_block_unblock_recovers(self):
+        """Block all links of one node for a window shorter than the
+        suspicion timeout: suspicion arises, then the verdicts flip back
+        ALIVE after unblock and refutation cancels the timers
+        (NetworkEmulator block/unblock, TransportTest.java:334-355)."""
+        n = 12
+        params, world = make(n)
+        t0, t1 = 20, 20 + params.suspicion_rounds // 2
+        world = (
+            world.with_block(src=(0, n), dst=3, from_round=t0, until_round=t1)
+            .with_block(src=3, dst=(0, n), from_round=t0, until_round=t1)
+        )
+        _, metrics = swim.run(jax.random.key(13), params, world, 400)
+        suspects = np.asarray(metrics["suspect"])[:, 3]
+        deads = np.asarray(metrics["dead"])[:, 3]
+        assert suspects.max() > 0, "block never caused suspicion"
+        assert deads.sum() == 0, "node wrongly declared dead"
+        assert suspects[-1] == 0, "suspicion did not clear after unblock"
+
+    def test_scatter_mode_link_faults_too(self):
+        """The same per-link rules drive the exact-scatter path."""
+        n = 8
+        params = swim.SwimParams.from_config(
+            fast_config(), n_members=n, delivery="scatter",
+        )
+        world = swim.SwimWorld.healthy(params).with_link_fault(
+            src=0, dst=1, loss=1.0
+        )
+        _, metrics = swim.run(jax.random.key(14), params, world, 400)
+        assert np.asarray(metrics["dead"]).sum() == 0
+        assert np.asarray(metrics["suspect"])[-1].sum() == 0
+
+
+class TestGracefulLeave:
+    @pytest.mark.parametrize("mode", ["scatter", "shift"])
+    def test_leave_disseminates_dead_at_bumped_incarnation(self, mode):
+        """A leaving member gossips DEAD@inc+1 in its final round; everyone
+        converges to a non-ALIVE view of it without any suspicion phase
+        (MembershipProtocolImpl.leaveCluster, :197-206)."""
+        n = 12
+        params = swim.SwimParams.from_config(
+            fast_config(), n_members=n, delivery=mode,
+        )
+        world = swim.SwimWorld.healthy(params).with_leave(5, at_round=30)
+        horizon = 30 + 6 * params.periods_to_spread
+        final, metrics = swim.run(jax.random.key(15), params, world, horizon)
+        alive_view = np.asarray(metrics["alive"])[:, 5]
+        assert alive_view[-1] == 0, "leave never fully disseminated"
+        # The death notice is the bumped-incarnation DEAD record, not a
+        # suspicion timeout: observers that hold the tombstone store inc 1.
+        status = np.asarray(final.status)[:, 5]
+        inc = np.asarray(final.inc)[:, 5]
+        observers = np.arange(n) != 5
+        held = observers & (status == records.DEAD)
+        assert held.any()
+        assert np.all(inc[held] == 1)
+
+
+class TestColdStartJoin:
+    @pytest.mark.parametrize("mode", ["scatter", "shift"])
+    def test_growth_from_seeds_to_full_view(self, mode):
+        """Cold start: all rows ABSENT except self + seeds; the cluster
+        must converge to everyone-sees-everyone ALIVE through the
+        ABSENT->ALIVE gate (seed-chain join,
+        MembershipProtocolTest.java:432-462)."""
+        n = 16
+        params = swim.SwimParams.from_config(
+            fast_config(), n_members=n, delivery=mode,
+        )
+        world = swim.SwimWorld.healthy(params).with_seeds([0, 1])
+        state0 = swim.initial_state(params, world, warm=False)
+        assert (np.asarray(state0.status) == records.ABSENT).sum() > 0
+        horizon = 12 * params.periods_to_spread
+        final, metrics = swim.run(
+            jax.random.key(16), params, world, horizon, state=state0
+        )
+        status = np.asarray(final.status)
+        diag = np.eye(n, dtype=bool)
+        assert np.all(status[~diag] == records.ALIVE), (
+            "cold-start cluster did not converge to full membership"
+        )
+        # Convergence is monotone growth of the mean known-alive count.
+        alive_curve = np.asarray(metrics["alive"]).sum(axis=1)
+        assert alive_curve[0] < alive_curve[-1]
+        assert alive_curve[-1] == n * (n - 1)

@@ -1,0 +1,95 @@
+"""Sweep harness (sweep.py + models/swim.Knobs).
+
+The knob overrides must be semantics-preserving at the default point
+(knobs=None == Knobs.from_params), and the vmapped grid must reproduce
+single runs and the protocol's analytic trends (BASELINE config 5;
+ClusterMath as the anchor, GossipProtocolTest.java:178-205's pattern).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu import sweep
+from scalecube_cluster_tpu.models import swim
+
+from tests.test_swim_model import fast_config
+
+
+def make(n, delivery="shift", **overrides):
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery=delivery, **overrides
+    )
+    world = swim.SwimWorld.healthy(params).with_crash(0, at_round=0)
+    return params, world
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+def test_default_knobs_are_identity(delivery):
+    params, world = make(16, delivery=delivery)
+    key = jax.random.key(0)
+    _, m_plain = swim.run(key, params, world, 60)
+    _, m_knobs = swim.run(key, params, world, 60,
+                          knobs=swim.Knobs.from_params(params))
+    for name in m_plain:
+        np.testing.assert_array_equal(
+            np.asarray(m_plain[name]), np.asarray(m_knobs[name])
+        )
+
+
+def test_grid_point_matches_single_run():
+    """Grid point b of a sweep == a standalone run with that knob set and
+    the grid-point key."""
+    params, world = make(16)
+    base_key = jax.random.key(3)
+    knobs = sweep.knob_grid(params, ping_every=[2, 4])
+    metrics = sweep.sweep_run(base_key, params, world, 50, knobs)
+
+    kn1 = jax.tree.map(lambda a: a[1], knobs)
+    _, single = swim.run(jax.random.fold_in(base_key, 1), params, world, 50,
+                         knobs=kn1)
+    for name in single:
+        np.testing.assert_array_equal(
+            np.asarray(metrics[name])[1], np.asarray(single[name])
+        )
+
+
+def test_suspicion_knob_moves_detection_time():
+    """Detection (first DEAD) must track the swept suspicion timeout —
+    the ClusterMath.suspicionTimeout anchor (ClusterMath.java:123-125)."""
+    res = sweep.run_crash_sweep(
+        32, 260, config=fast_config(), suspicion_rounds=[10, 40],
+        delivery="shift",
+    )
+    det = res["curves"]["detection_rounds"]
+    assert det[0] + 20 <= det[1], det
+    # Detection can't beat the configured timeout.
+    assert det[0] >= 10
+    assert det[1] >= 40
+
+
+def test_fanout_knob_moves_dissemination():
+    """Higher fanout must not slow dissemination; measured dissemination
+    stays inside the analytic spread window (gossip_periods_to_spread)."""
+    res = sweep.run_crash_sweep(
+        64, 300, config=fast_config(), fanout=[1, 4], delivery="shift",
+    )
+    dis = res["curves"]["dissemination_rounds"]
+    det = res["curves"]["detection_rounds"]
+    assert dis[1] <= dis[0], dis
+    # Post-detection dissemination must finish within the analytic spread
+    # window (repeat_mult * ceil(log2(n+1)) periods, ClusterMath.java:111-113)
+    # at the default fanout or higher.
+    spread = res["analytic"]["periods_to_spread"]
+    assert dis[1] - det[1] <= spread, (dis, det, spread)
+
+
+def test_loss_knob_drives_false_positives():
+    res = sweep.run_crash_sweep(
+        32, 200, config=fast_config(), loss_probability=[0.0, 0.3],
+        delivery="scatter",
+    )
+    fp = res["curves"]["false_positive_rate"]
+    assert fp[0] == 0.0
+    assert fp[1] > 0.0
