@@ -23,9 +23,12 @@ Deviations, documented:
     message *counts* therefore track the ClusterMath worst-case bound
     (max_messages_per_gossip_per_node) rather than the slightly lower
     typical count.
-  - mean link delay quantizes to the period grid: a delayed message still
-    lands in the next period's inbox (the reference's 2ms-100ms delays vs
-    200ms periods round the same way).
+  - mean link delay quantizes to the period grid via a delayed-delivery
+    ring (``max_delay_rounds`` slots): a message's exponential delay draw
+    (NetworkLinkSettings.java:64-74) becomes a round offset
+    floor(delay/period), saturating at the ring depth.  With
+    ``max_delay_rounds=0`` delays below one period (the reference's
+    2ms-100ms sweep vs 200ms periods) round to same-period delivery.
 
 State is O(N·G) bits, not O(N²), so this model scales to millions of
 members on one chip; rows shard over devices via parallel/mesh.py.
@@ -41,7 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from scalecube_cluster_tpu import swim_math
-from scalecube_cluster_tpu.ops import delivery, prng
+from scalecube_cluster_tpu.ops import delivery, prng, ring as ring_ops
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,10 +60,15 @@ class GossipSimParams:
     fanout: int
     periods_to_spread: int
     loss_probability: float = 0.0
+    mean_delay_ms: float = 0.0
+    round_ms: float = 200.0
+    max_delay_rounds: int = 0
 
     @staticmethod
     def from_config(config, n_members: int, n_gossips: int = 1,
-                    loss_probability: float = 0.0) -> "GossipSimParams":
+                    loss_probability: float = 0.0,
+                    mean_delay_ms: float = 0.0,
+                    max_delay_rounds: int = 0) -> "GossipSimParams":
         return GossipSimParams(
             n_members=n_members,
             n_gossips=n_gossips,
@@ -69,6 +77,9 @@ class GossipSimParams:
                 config.gossip_repeat_mult, n_members
             ),
             loss_probability=loss_probability,
+            mean_delay_ms=mean_delay_ms,
+            round_ms=float(config.gossip_interval),
+            max_delay_rounds=max_delay_rounds,
         )
 
 
@@ -81,14 +92,18 @@ class GossipState:
     ``spread_until`` [N, G] int32 — first period this member no longer
                      retransmits it (GossipState.infectionPeriod analog,
                      gossip/GossipState.java:8-38).
+    ``ring``         [D, N, G] bool — infection bits due in future rounds
+                     (delayed-delivery ring; D = max_delay_rounds + 1 or 0).
     """
 
     infected: jnp.ndarray
     spread_until: jnp.ndarray
+    ring: jnp.ndarray
 
 
 jax.tree_util.register_dataclass(
-    GossipState, data_fields=["infected", "spread_until"], meta_fields=[]
+    GossipState, data_fields=["infected", "spread_until", "ring"],
+    meta_fields=[]
 )
 
 
@@ -104,7 +119,9 @@ def initial_state(params: GossipSimParams,
         origin = jnp.arange(g, dtype=jnp.int32) % n
     infected = jnp.zeros((n, g), dtype=jnp.bool_).at[origin, jnp.arange(g)].set(True)
     spread_until = jnp.where(infected, params.periods_to_spread, 0).astype(jnp.int32)
-    return GossipState(infected=infected, spread_until=spread_until)
+    d = params.max_delay_rounds + 1 if params.max_delay_rounds > 0 else 0
+    return GossipState(infected=infected, spread_until=spread_until,
+                       ring=jnp.zeros((d, n, g), dtype=jnp.bool_))
 
 
 def gossip_tick(state: GossipState, round_idx, base_key,
@@ -116,7 +133,7 @@ def gossip_tick(state: GossipState, round_idx, base_key,
     reference tests measure with, GossipProtocolTest.java:212-228).
     """
     key = prng.round_key(base_key, round_idx)
-    k_targets, k_drop = jax.random.split(key)
+    k_targets, k_drop, k_delay = jax.random.split(key, 3)
 
     # selectGossipsToSend (:239-250): alive == still within spread window.
     hot = state.infected & (round_idx < state.spread_until)
@@ -128,7 +145,26 @@ def gossip_tick(state: GossipState, round_idx, base_key,
         k_drop, params.loss_probability, (params.n_members, params.fanout)
     )
 
-    inbox = delivery.scatter_or(hot, targets, drop, params.n_members)
+    ring = state.ring
+    if params.max_delay_rounds == 0:
+        inbox = delivery.scatter_or(hot, targets, drop, params.n_members)
+    else:
+        # Quantized per-message delay (ops/ring.py): offset-0 messages land
+        # now, later offsets go to the ring slots.
+        d = params.max_delay_rounds + 1
+        slot0 = round_idx % d
+        q = ring_ops.delay_bins(
+            k_delay, params.mean_delay_ms, params.round_ms,
+            params.max_delay_rounds, (params.n_members, params.fanout),
+        )
+        due_now, ring = ring_ops.open_slot(ring, slot0, False)
+        inbox = delivery.scatter_or(hot, targets, drop | (q != 0),
+                                    params.n_members) | due_now
+        for j in range(1, d):
+            contribution = delivery.scatter_or(
+                hot, targets, drop | (q != j), params.n_members
+            )
+            ring = ring_ops.push_or(ring, (slot0 + j) % d, contribution)
 
     newly = inbox & ~state.infected
     infected = state.infected | inbox
@@ -143,7 +179,8 @@ def gossip_tick(state: GossipState, round_idx, base_key,
         "messages_sent": sent,
         "newly_infected": jnp.sum(newly, axis=0, dtype=jnp.int32),
     }
-    return GossipState(infected=infected, spread_until=spread_until), metrics
+    return GossipState(infected=infected, spread_until=spread_until,
+                       ring=ring), metrics
 
 
 @partial(jax.jit, static_argnames=("params", "n_rounds"))

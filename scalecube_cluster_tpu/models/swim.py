@@ -69,9 +69,12 @@ Documented deviations from the reference (all statistical-regime-neutral):
     the member itself (MembershipProtocolImpl.java:379-391's SYNC), whose
     self-refutation then travels back by gossip;
   - gossip per-gossip "infected" sets are not tracked (models/gossip.py);
-  - link delay affects FD hop budgets; gossip/SYNC delivery is
-    same-round-or-lost (delay quantization for those channels is applied
-    by the experiment harness via round-length scaling).
+  - link delay affects FD hop budgets exactly; for gossip/SYNC it
+    quantizes to round offsets through the delayed-delivery ring
+    (``SwimParams.max_delay_rounds``; offsets beyond the ring saturate at
+    its last slot rather than dropping).  With max_delay_rounds=0 those
+    channels are same-round-or-lost — exact for the reference's default
+    regime where mean delay << gossip interval.
 """
 
 from __future__ import annotations
@@ -84,7 +87,8 @@ import jax
 import jax.numpy as jnp
 
 from scalecube_cluster_tpu import records
-from scalecube_cluster_tpu.ops import delivery, prng, shift as shift_ops
+from scalecube_cluster_tpu.ops import delivery, prng, ring as ring_ops, \
+    shift as shift_ops
 
 INT32_MAX = jnp.iinfo(jnp.int32).max
 
@@ -126,6 +130,18 @@ class SwimParams:
     # Delivery collective: "scatter" (exact uniform draws, XLA scatter) or
     # "shift" (cyclic-shift mixing, the fast path — module docstring).
     delivery: str = "scatter"
+    # Base round length (= gossip interval) in ms, used to quantize link
+    # delays to round offsets for gossip/SYNC delivery.
+    round_ms: float = 200.0
+    # Max gossip/SYNC delivery delay in rounds (0 = same-round-or-lost).
+    # When > 0 the scan carry gains a (max_delay_rounds+1)-slot inbox ring
+    # and each message's sampled exponential delay quantizes to
+    # floor(delay / round_ms), clamped to this bound.  The reference's
+    # NetworkEmulator delays every message this way
+    # (NetworkLinkSettings.java:64-74); its test matrix sweeps mean delay
+    # to half a gossip period (GossipProtocolTest.java:50-66), where
+    # ~13% of messages cross into the next round.
+    max_delay_rounds: int = 0
 
     def __post_init__(self):
         if self.delivery not in ("scatter", "shift"):
@@ -151,6 +167,7 @@ class SwimParams:
             mean_delay_ms=mean_delay_ms,
             loss_probability=loss_probability,
             ping_known_only=(k == n_members),
+            round_ms=float(config.gossip_interval),
         )
         kwargs.update(overrides)
         return SwimParams(**kwargs)
@@ -478,6 +495,12 @@ class SwimState:
                         when no timer is pending.
     ``self_inc``        [N] int32: own incarnation (bumped by refutation,
                         MembershipProtocolImpl.java:488-509).
+    ``inbox_ring``/``flag_ring`` [D, N, K]: delayed-delivery buffers for
+                        gossip/SYNC messages quantized to future rounds
+                        (params.max_delay_rounds; D = max_delay_rounds + 1,
+                        or 0 when delay modeling is off — zero-size arrays
+                        cost nothing).  Slot (round % D) holds the messages
+                        due in that round.
     """
 
     status: jnp.ndarray
@@ -485,11 +508,14 @@ class SwimState:
     spread_until: jnp.ndarray
     suspect_deadline: jnp.ndarray
     self_inc: jnp.ndarray
+    inbox_ring: jnp.ndarray
+    flag_ring: jnp.ndarray
 
 
 jax.tree_util.register_dataclass(
     SwimState,
-    data_fields=["status", "inc", "spread_until", "suspect_deadline", "self_inc"],
+    data_fields=["status", "inc", "spread_until", "suspect_deadline",
+                 "self_inc", "inbox_ring", "flag_ring"],
     meta_fields=[],
 )
 
@@ -524,12 +550,15 @@ def initial_state(params: SwimParams, world: SwimWorld,
         # full spread window, the ADDED-dissemination path
         # (MembershipProtocolTest seed-chain join, :432-462).
         spread0 = jnp.where(is_self, params.periods_to_spread + 1, spread0)
+    d_slots = params.max_delay_rounds + 1 if params.max_delay_rounds > 0 else 0
     return SwimState(
         status=status,
         inc=jnp.zeros((n, k), dtype=jnp.int32),
         spread_until=spread0,
         suspect_deadline=jnp.full((n, k), INT32_MAX, dtype=jnp.int32),
         self_inc=jnp.zeros((n,), dtype=jnp.int32),
+        inbox_ring=jnp.full((d_slots, n, k), -1, dtype=jnp.int32),
+        flag_ring=jnp.zeros((d_slots, n, k), dtype=jnp.int8),
     )
 
 
@@ -558,6 +587,55 @@ def _chain_ok(key, hop_losses: Sequence[jnp.ndarray],
     return ok & (total_delay <= budget_ms)
 
 
+def _ring_open(state: SwimState, params: SwimParams, round_idx):
+    """Read this round's due slot and clear it for reuse (ops/ring.py).
+
+    Returns (inbox_now, flags_now, ring, fring, slot0) — ``ring``/``fring``
+    already have slot0 reset, ready to accumulate future arrivals.  With
+    delay modeling off (max_delay_rounds == 0) returns Nones.
+    """
+    if params.max_delay_rounds == 0:
+        return None, None, None, None, None
+    slot0 = round_idx % (params.max_delay_rounds + 1)
+    inbox_now, ring = ring_ops.open_slot(
+        state.inbox_ring, slot0, delivery.NO_MESSAGE
+    )
+    flags_now, fring = ring_ops.open_slot(
+        state.flag_ring, slot0, jnp.int8(0)
+    )
+    return inbox_now, flags_now.astype(jnp.bool_), ring, fring, slot0
+
+
+def _ring_push(ring, fring, slot, keys, flags):
+    """Max/or-merge a future (keys, flags) contribution into one slot."""
+    return (ring_ops.push_max(ring, slot, keys),
+            ring_ops.push_or(fring, slot, flags.astype(jnp.int8)))
+
+
+def _route_delayed(ok, delivered, delivered_flags, delay_mean, key, params,
+                   ring, fring, slot0):
+    """Split one channel's delivery into now vs future ring slots.
+
+    Returns (ok_now, ring, fring): ``ok_now`` masks the messages arriving
+    this round; later quantized offsets are max/or-merged into the ring.
+    Shared by the gossip, SYNC, and refute channels so the binning and
+    slot arithmetic exist once.
+    """
+    if params.max_delay_rounds == 0:
+        return ok, ring, fring
+    q = ring_ops.delay_bins(key, delay_mean, params.round_ms,
+                            params.max_delay_rounds, ok.shape)
+    d = params.max_delay_rounds + 1
+    for j in range(1, d):
+        m = (ok & (q == j))[:, None]
+        ring, fring = _ring_push(
+            ring, fring, (slot0 + j) % d,
+            jnp.where(m, delivered, delivery.NO_MESSAGE),
+            delivered_flags & m,
+        )
+    return ok & (q == 0), ring, fring
+
+
 def _entry_at_slot(mat, slot, k):
     """mat[i, slot[i]] via a one-hot reduce over K (elementwise, no gather)."""
     onehot = jnp.arange(k, dtype=jnp.int32)[None, :] == slot[:, None]
@@ -566,7 +644,7 @@ def _entry_at_slot(mat, slot, k):
 
 def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
               world: SwimWorld, offset=0, axis_name: Optional[str] = None,
-              knobs: Optional[Knobs] = None):
+              knobs: Optional[Knobs] = None, n_devices: int = 1):
     """One protocol round.  Pure: (state, r, key) -> (state', metrics).
 
     Phases (matching the reference's periodic loops, SURVEY.md §3.2-3.4):
@@ -590,22 +668,23 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     the ICI collective that replaces the reference's point-to-point TCP
     (SURVEY.md §5.8) — and each device keeps its own row slice.  With
     ``axis_name=None`` and ``offset=0`` this is the single-device path
-    unchanged.  Shift mode is currently single-device (the sharded shift
-    exchange lives in parallel/mesh.py's roadmap).
+    unchanged.  Sharded shift mode exchanges payload blocks with
+    block-rotation ppermutes instead (ops/shift.ShiftEngine); its
+    per-round traffic is O(n_local*K) per channel vs the pmax's O(N*K).
+    ``n_devices`` must be the static mesh size when ``axis_name`` is set.
     """
-    if params.delivery == "shift" and axis_name is not None:
-        raise NotImplementedError(
-            "shift delivery under shard_map is not wired yet; "
-            "use delivery='scatter' for sharded runs"
-        )
     kn = knobs if knobs is not None else Knobs.from_params(params)
     n, k = params.n_members, params.n_subjects
     n_local = state.status.shape[0]
     # Fold both the round and the shard offset so draws are independent
     # across rounds AND across devices (ops/prng.py module docstring).
-    key = prng.round_key(prng.round_key(base_key, round_idx), offset)
+    # The shift channel draws come from the UN-folded round key: every
+    # device must agree on the round's global shifts.
+    key_global = prng.round_key(base_key, round_idx)
+    key = prng.round_key(key_global, offset)
     (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t, k_gossip_drop,
      k_sync_t, k_sync_drop) = jax.random.split(key, 8)
+    k_shifts = jax.random.fold_in(key_global, 0x5317)
 
     def global_sum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
@@ -613,8 +692,11 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     alive = world.alive_at(round_idx)                       # [N] ground truth
     part = world.partition_at(round_idx)                    # [N]
     node_ids = jnp.arange(n_local, dtype=jnp.int32) + offset    # global ids
-    alive_here = alive[node_ids] if n_local != n else alive     # [n_local]
-    part_here = part[node_ids] if n_local != n else part
+    if n_local != n:  # contiguous local row slice of the replicated vectors
+        alive_here = jax.lax.dynamic_slice_in_dim(alive, offset, n_local)
+        part_here = jax.lax.dynamic_slice_in_dim(part, offset, n_local)
+    else:
+        alive_here, part_here = alive, part
     is_self = world.subject_ids[None, :] == node_ids[:, None]   # [n_local, K]
 
     # Row i's record about itself is pinned (a node always believes itself
@@ -649,8 +731,9 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
             state, status, inc, round_idx, params, kn, world,
             alive, part, node_ids, alive_here, part_here, is_self,
             fd_round, sync_round, gate_contacts, known_live, is_seed,
-            (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t,
+            (k_shifts, k_ping_net, k_proxy, k_proxy_net, k_gossip_t,
              k_gossip_drop, k_sync_t, k_sync_drop),
+            offset=offset, axis_name=axis_name, n_devices=n_devices,
         )
     else:
         new_state, aux = _tick_scatter(
@@ -700,7 +783,8 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
 
 
 def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
-                      params, kn, world, node_ids, alive_here, is_self):
+                      params, kn, world, node_ids, alive_here, is_self,
+                      inbox_ring=None, flag_ring=None):
     """Inbox merge, self-refutation, suspicion timers, crash/leave freeze.
 
     Shared tail of both delivery modes; all elementwise on [n_local, K].
@@ -763,6 +847,8 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
         spread_until=spread_until.astype(jnp.int32),
         suspect_deadline=deadline.astype(jnp.int32),
         self_inc=new_self_inc.astype(jnp.int32),
+        inbox_ring=state.inbox_ring if inbox_ring is None else inbox_ring,
+        flag_ring=state.flag_ring if flag_ring is None else flag_ring,
     )
     return new_state, refuted
 
@@ -917,9 +1003,9 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
         & same_partition(node_ids[:, None], gossip_targets)
     if gate_contacts:
         send_ok &= known_live(gossip_targets) | is_seed(gossip_targets)
-    loss_g, _ = link_eval(world.faults, round_idx, node_ids[:, None],
-                          gossip_targets, kn.loss_probability,
-                          params.mean_delay_ms)
+    loss_g, delay_g = link_eval(world.faults, round_idx, node_ids[:, None],
+                                gossip_targets, kn.loss_probability,
+                                params.mean_delay_ms)
     gossip_drop = (
         prng.bernoulli_mask(k_gossip_drop, loss_g, (n_local, params.fanout))
         | ~send_ok
@@ -940,9 +1026,9 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
             known_live(sync_target)[:, 0] | is_seed(sync_target)[:, 0]
             | push_refute
         )
-    loss_s, _ = link_eval(world.faults, round_idx, node_ids,
-                          sync_target[:, 0], kn.loss_probability,
-                          params.mean_delay_ms)
+    loss_s, delay_s = link_eval(world.faults, round_idx, node_ids,
+                                sync_target[:, 0], kn.loss_probability,
+                                params.mean_delay_ms)
     sync_ok = (
         alive[sync_target[:, 0]]
         & same_partition(node_ids, sync_target[:, 0])
@@ -951,26 +1037,56 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     sync_drop = (~(do_sync & sync_ok))[:, None]
 
     # Accumulate all send channels into one global-height contribution,
-    # then a single cross-device combine (one pmax per round).
-    inbox_buf = jnp.maximum(
-        delivery.scatter_max(gossip_keys, gossip_targets, gossip_drop, n),
-        delivery.scatter_max(sync_keys, sync_target, sync_drop, n),
-    )
+    # then one cross-device combine per delay bin (a single pmax per round
+    # in the default max_delay_rounds=0 configuration; the delay path is a
+    # small-N validation mode, so its extra per-bin combines are
+    # acceptable — the 1M shift path bins receiver-side instead).
     alive_flags = delivery.is_alive_key(gossip_keys)
     sync_alive_flags = delivery.is_alive_key(sync_keys)
-    alive_buf = (
-        delivery.scatter_or(alive_flags, gossip_targets, gossip_drop, n)
-        | delivery.scatter_or(sync_alive_flags, sync_target, sync_drop, n)
+    inbox_now, flags_now, ring, fring, slot0 = _ring_open(
+        state, params, round_idx
     )
-    inbox = combine_max(inbox_buf)
-    inbox_alive = combine_max(alive_buf.astype(jnp.int8)).astype(jnp.bool_)
+
+    def channel_bufs(gossip_extra_drop, sync_extra_drop):
+        g_drop = gossip_drop | gossip_extra_drop
+        s_drop = sync_drop | sync_extra_drop
+        buf = jnp.maximum(
+            delivery.scatter_max(gossip_keys, gossip_targets, g_drop, n),
+            delivery.scatter_max(sync_keys, sync_target, s_drop, n),
+        )
+        fbuf = (
+            delivery.scatter_or(alive_flags, gossip_targets, g_drop, n)
+            | delivery.scatter_or(sync_alive_flags, sync_target, s_drop, n)
+        )
+        return combine_max(buf), combine_max(fbuf.astype(jnp.int8))
+
+    if params.max_delay_rounds == 0:
+        inbox, inbox_alive8 = channel_bufs(False, False)
+        inbox_alive = inbox_alive8.astype(jnp.bool_)
+    else:
+        q_g = ring_ops.delay_bins(
+            jax.random.fold_in(k_gossip_drop, 7), delay_g,
+            params.round_ms, params.max_delay_rounds,
+            (n_local, params.fanout))
+        q_s = ring_ops.delay_bins(
+            jax.random.fold_in(k_sync_drop, 7), delay_s,
+            params.round_ms, params.max_delay_rounds,
+            (n_local,))[:, None]
+        inbox, inbox_alive8 = channel_bufs(q_g != 0, q_s != 0)
+        inbox = jnp.maximum(inbox, inbox_now)
+        inbox_alive = inbox_alive8.astype(jnp.bool_) | flags_now
+        d = params.max_delay_rounds + 1
+        for j in range(1, d):
+            buf_j, fbuf_j = channel_bufs(q_g != j, q_s != j)
+            ring, fring = _ring_push(ring, fring, (slot0 + j) % d,
+                                     buf_j, fbuf_j.astype(jnp.bool_))
 
     # FD local verdicts fold into the same inbox (observer-local, no comm).
     inbox = jnp.maximum(inbox, fd_inbox)
 
     new_state, refuted = _merge_and_timers(
         state, status, inc, inbox, inbox_alive, round_idx, params, kn, world,
-        node_ids, alive_here, is_self,
+        node_ids, alive_here, is_self, inbox_ring=ring, flag_ring=fring,
     )
     hot_any = jnp.any(gossip_keys >= 0, axis=1)
     aux = dict(
@@ -991,35 +1107,37 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
 def _tick_shift(state, status, inc, round_idx, params, kn, world,
                 alive, part, node_ids, alive_here, part_here, is_self,
                 fd_round, sync_round, gate_contacts, known_live, is_seed,
-                keys):
+                keys, offset=0, axis_name=None, n_devices=1):
     n, k = params.n_members, params.n_subjects
-    (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t, k_gossip_drop,
+    n_local = status.shape[0]
+    (k_shifts, k_ping_net, k_proxy, k_proxy_net, k_gossip_t, k_gossip_drop,
      k_sync_t, k_sync_drop) = keys
     r_proxies = params.ping_req_members
     f = params.fanout
+    eng = shift_ops.ShiftEngine(n, offset=offset, axis_name=axis_name,
+                                n_devices=n_devices, n_local=n_local)
 
     # One shift per send channel: [fd, proxies..., gossip..., sync].
+    # Drawn from the UN-offset-folded key: all devices must agree on the
+    # round's shifts (the per-node draws below use the folded keys).
     n_shifts = 1 + r_proxies + f + 1
     shifts = jax.random.randint(
-        k_ping_t, (n_shifts,), 1, n, dtype=jnp.int32
+        k_shifts, (n_shifts,), 1, n, dtype=jnp.int32
     )
     fd_shift = shifts[0]
     proxy_shifts = shifts[1:1 + r_proxies]
     gossip_shifts = shifts[1 + r_proxies:1 + r_proxies + f]
     sync_shift = shifts[-1]
 
-    # Doubled per-node info for shifted lookups: [2N] each.
-    d_alive = shift_ops.doubled(alive)
-    d_part = shift_ops.doubled(part)
-    d_ids = shift_ops.doubled(node_ids)
-
-    def at(shift, dv):
-        return shift_ops.look(dv, shift, n)
+    # Replicated world vectors: shifted views are plain doubled-slices.
+    d_alive = eng.prep_replicated(alive)
+    d_part = eng.prep_replicated(part)
+    d_ids = eng.prep_replicated(jnp.arange(n, dtype=jnp.int32))
 
     # ---- Phase 1: failure detector probe --------------------------------
-    t = at(fd_shift, d_ids)                                  # [N] target ids
-    alive_t = at(fd_shift, d_alive)
-    part_t = at(fd_shift, d_part)
+    t = eng.look_replicated(d_ids, fd_shift)            # [n_local] target ids
+    alive_t = eng.look_replicated(d_alive, fd_shift)
+    part_t = eng.look_replicated(d_part, fd_shift)
     if params.full_view:
         slot = t
         entry_t_status = jnp.take_along_axis(status, t[:, None], 1)[:, 0]
@@ -1029,8 +1147,8 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             | (entry_t_status == records.SUSPECT)
         )
     else:
-        d_slot = shift_ops.doubled(world.slot_of_node)
-        slot = at(fd_shift, d_slot)                          # -1 = untracked
+        d_slot = eng.prep_replicated(world.slot_of_node)
+        slot = eng.look_replicated(d_slot, fd_shift)         # -1 = untracked
         slot_safe = jnp.maximum(slot, 0)
         entry_t_status = _entry_at_slot(status, slot_safe, k)
         entry_t_inc = _entry_at_slot(inc, slot_safe, k)
@@ -1045,16 +1163,16 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
                                   kn.loss_probability, params.mean_delay_ms)
     direct_ok = (
         _chain_ok(k_ping_net, [loss_it, loss_ti], [delay_it, delay_ti],
-                  params.ping_timeout_ms, (n,))
+                  params.ping_timeout_ms, (n_local,))
         & alive_t & (part_here == part_t)
     )
     # Ping-req via proxy shifts; proxy r for node i is (i + ps_r) % n.
     proxy_oks = []
     for r in range(r_proxies):
         ps = proxy_shifts[r]
-        p_ids = at(ps, d_ids)
-        p_alive = at(ps, d_alive)
-        p_part = at(ps, d_part)
+        p_ids = eng.look_replicated(d_ids, ps)
+        p_alive = eng.look_replicated(d_alive, ps)
+        p_part = eng.look_replicated(d_part, ps)
         hop_pairs = [(node_ids, p_ids), (p_ids, t), (t, p_ids),
                      (p_ids, node_ids)]
         hop_losses, hop_delays = [], []
@@ -1066,7 +1184,8 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         ok_r = (
             _chain_ok(jax.random.fold_in(k_proxy_net, r),
                       hop_losses, hop_delays,
-                      params.ping_interval_ms - params.ping_timeout_ms, (n,))
+                      params.ping_interval_ms - params.ping_timeout_ms,
+                      (n_local,))
             & p_alive & alive_t
             & (part_here == p_part) & (p_part == part_t)
             & (ps != fd_shift)                               # proxy != target
@@ -1101,30 +1220,34 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     # Delivery: receiver j's channel-c message comes from sender
     # (j - shift_c) % n; sender-side gates (alive, partition, contact gate,
     # per-link loss) evaluate at the receiver via shifted views, which is
-    # distribution-identical and keeps everything contiguous.
-    d_gossip = shift_ops.doubled(gossip_keys)                # [2N, K]
-    d_sync = shift_ops.doubled(sync_keys)
-    d_status_alive = shift_ops.doubled(
-        delivery.is_alive_key(gossip_keys).astype(jnp.int8)
-    )
-    d_sync_alive = shift_ops.doubled(
-        delivery.is_alive_key(sync_keys).astype(jnp.int8)
-    )
+    # distribution-identical and keeps everything contiguous.  Sharded
+    # payloads travel by block-rotation ppermutes (ops/shift.ShiftEngine).
+    h_gossip = eng.prep(gossip_keys)                      # [2N, K] or local
+    h_sync = eng.prep(sync_keys)
+    h_gossip_alive = eng.prep(delivery.is_alive_key(gossip_keys).astype(jnp.int8))
+    h_sync_alive = eng.prep(delivery.is_alive_key(sync_keys).astype(jnp.int8))
+    h_hot_any = eng.prep(jnp.any(gossip_keys >= 0, axis=1))
+    h_status = eng.prep(status) if gate_contacts else None
 
-    drop_u = jax.random.uniform(k_gossip_drop, (n, f + 1))
-    d_hot_any = shift_ops.doubled(jnp.any(gossip_keys >= 0, axis=1))
-    d_status = shift_ops.doubled(status) if gate_contacts else None
+    drop_u = jax.random.uniform(k_gossip_drop, (n_local, f + 1))
 
+    inbox_now, flags_now, ring, fring, slot0 = _ring_open(
+        state, params, round_idx
+    )
     inbox = fd_inbox
-    inbox_alive = jnp.zeros((n, k), dtype=jnp.bool_)
+    inbox_alive = jnp.zeros((n_local, k), dtype=jnp.bool_)
+    if params.max_delay_rounds > 0:
+        inbox = jnp.maximum(inbox, inbox_now)
+        inbox_alive |= flags_now
     n_gossip_sent = jnp.int32(0)
     for c in range(f):
         s = gossip_shifts[c]
-        sender = shift_ops.deliver(d_ids, s, n)
-        sender_alive = shift_ops.deliver(d_alive, s, n)
-        sender_part = shift_ops.deliver(d_part, s, n)
-        loss_c, _ = link_eval(world.faults, round_idx, sender, node_ids,
-                              kn.loss_probability, params.mean_delay_ms)
+        sender = eng.deliver_replicated(d_ids, s)
+        sender_alive = eng.deliver_replicated(d_alive, s)
+        sender_part = eng.deliver_replicated(d_part, s)
+        loss_c, delay_c = link_eval(world.faults, round_idx, sender,
+                                    node_ids, kn.loss_probability,
+                                    params.mean_delay_ms)
         ok_c = (
             sender_alive & alive_here & (sender_part == part_here)
             & (drop_u[:, c] >= loss_c)
@@ -1134,7 +1257,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             # Sender-side knowledge of the receiver, evaluated at the
             # receiver: sender's record of me (full-view: my id column).
             sender_knows = jnp.take_along_axis(
-                shift_ops.deliver(d_status, s, n),
+                eng.deliver(h_status, s),
                 node_ids[:, None], axis=1,
             )[:, 0]
             ok_c &= (
@@ -1142,33 +1265,38 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
                 | (sender_knows == records.SUSPECT)
                 | is_seed(node_ids)
             )
-        delivered = shift_ops.deliver(d_gossip, s, n)        # [N, K]
-        delivered = jnp.where(ok_c[:, None], delivered, delivery.NO_MESSAGE)
-        inbox = jnp.maximum(inbox, delivered)
-        inbox_alive |= (
-            shift_ops.deliver(d_status_alive, s, n).astype(jnp.bool_)
-            & ok_c[:, None]
+        delivered = eng.deliver(h_gossip, s)              # [n_local, K]
+        delivered_flags = eng.deliver(h_gossip_alive, s).astype(jnp.bool_)
+        ok_now, ring, fring = _route_delayed(
+            ok_c, delivered, delivered_flags, delay_c,
+            jax.random.fold_in(k_gossip_drop, 11 + c), params,
+            ring, fring, slot0,
         )
+        inbox = jnp.maximum(
+            inbox, jnp.where(ok_now[:, None], delivered, delivery.NO_MESSAGE)
+        )
+        inbox_alive |= delivered_flags & ok_now[:, None]
         n_gossip_sent += jnp.sum(
-            ok_c & shift_ops.deliver(d_hot_any, s, n), dtype=jnp.int32,
+            ok_c & eng.deliver(h_hot_any, s), dtype=jnp.int32,
         )
 
     # SYNC channel: the periodic anti-entropy push, plus the FD
     # alive-on-suspected refute push (aimed at the probed member = the
     # fd_shift channel).
     s = sync_shift
-    sender_alive = shift_ops.deliver(d_alive, s, n)
-    sender_part = shift_ops.deliver(d_part, s, n)
-    sender_ids_s = shift_ops.deliver(d_ids, s, n)
-    loss_sy, _ = link_eval(world.faults, round_idx, sender_ids_s, node_ids,
-                           kn.loss_probability, params.mean_delay_ms)
+    sender_alive = eng.deliver_replicated(d_alive, s)
+    sender_part = eng.deliver_replicated(d_part, s)
+    sender_ids_s = eng.deliver_replicated(d_ids, s)
+    loss_sy, delay_sy = link_eval(world.faults, round_idx, sender_ids_s,
+                                  node_ids, kn.loss_probability,
+                                  params.mean_delay_ms)
     ok_s = (
         sync_round & sender_alive & alive_here
         & (sender_part == part_here) & (drop_u[:, f] >= loss_sy)
     )
     if gate_contacts:
         sender_knows = jnp.take_along_axis(
-            shift_ops.deliver(d_status, s, n),
+            eng.deliver(h_status, s),
             node_ids[:, None], axis=1,
         )[:, 0]
         ok_s &= (
@@ -1176,13 +1304,16 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             | (sender_knows == records.SUSPECT)
             | is_seed(node_ids)
         )
-    delivered = shift_ops.deliver(d_sync, s, n)
-    delivered = jnp.where(ok_s[:, None], delivered, delivery.NO_MESSAGE)
-    inbox = jnp.maximum(inbox, delivered)
-    inbox_alive |= (
-        shift_ops.deliver(d_sync_alive, s, n).astype(jnp.bool_)
-        & ok_s[:, None]
+    delivered = eng.deliver(h_sync, s)
+    delivered_flags = eng.deliver(h_sync_alive, s).astype(jnp.bool_)
+    ok_s_now, ring, fring = _route_delayed(
+        ok_s, delivered, delivered_flags, delay_sy,
+        jax.random.fold_in(k_sync_drop, 11), params, ring, fring, slot0,
     )
+    inbox = jnp.maximum(
+        inbox, jnp.where(ok_s_now[:, None], delivered, delivery.NO_MESSAGE)
+    )
+    inbox_alive |= delivered_flags & ok_s_now[:, None]
 
     # Refute push: issuer i sends its SUSPECT record of t = (i + fd_shift)
     # to t itself; at the receiver that is the sender (j - fd_shift).
@@ -1191,25 +1322,33 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         fd_suspect_key[:, None],                     # SUSPECT @ entry inc
         delivery.NO_MESSAGE,
     )
-    d_refute = shift_ops.doubled(refute_row)
-    sender_alive_r = shift_ops.deliver(d_alive, fd_shift, n)
-    # Loss for the refute push (issuer -> target hop).
-    sender_ids_r = shift_ops.deliver(d_ids, fd_shift, n)
-    loss_r, _ = link_eval(world.faults, round_idx, sender_ids_r, node_ids,
-                          kn.loss_probability, params.mean_delay_ms)
+    h_refute = eng.prep(refute_row)
+    sender_alive_r = eng.deliver_replicated(d_alive, fd_shift)
+    # Loss/delay for the refute push (issuer -> target hop); it rides the
+    # same delayed-delivery ring as the other channels so both delivery
+    # modes agree under max_delay_rounds > 0.
+    sender_ids_r = eng.deliver_replicated(d_ids, fd_shift)
+    loss_r, delay_r = link_eval(world.faults, round_idx, sender_ids_r,
+                                node_ids, kn.loss_probability,
+                                params.mean_delay_ms)
     ok_r = (
         sender_alive_r & alive_here
-        & (shift_ops.deliver(d_part, fd_shift, n) == part_here)
-        & (jax.random.uniform(k_sync_drop, (n,)) >= loss_r)
+        & (eng.deliver_replicated(d_part, fd_shift) == part_here)
+        & (jax.random.uniform(k_sync_drop, (n_local,)) >= loss_r)
     )
-    delivered_r = shift_ops.deliver(d_refute, fd_shift, n)
+    delivered_r = eng.deliver(h_refute, fd_shift)
+    flags_r = jnp.zeros_like(delivered_r, dtype=jnp.bool_)  # never ALIVE
+    ok_r_now, ring, fring = _route_delayed(
+        ok_r, delivered_r, flags_r, delay_r,
+        jax.random.fold_in(k_sync_drop, 13), params, ring, fring, slot0,
+    )
     inbox = jnp.maximum(
-        inbox, jnp.where(ok_r[:, None], delivered_r, delivery.NO_MESSAGE)
+        inbox, jnp.where(ok_r_now[:, None], delivered_r, delivery.NO_MESSAGE)
     )
 
     new_state, refuted = _merge_and_timers(
         state, status, inc, inbox, inbox_alive, round_idx, params, kn, world,
-        node_ids, alive_here, is_self,
+        node_ids, alive_here, is_self, inbox_ring=ring, flag_ring=fring,
     )
     aux = dict(
         messages_gossip=n_gossip_sent,

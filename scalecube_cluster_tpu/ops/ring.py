@@ -1,0 +1,55 @@
+"""Delayed-delivery ring primitives, shared by the swim and gossip models.
+
+The NetworkEmulator delays every message by an exponential draw
+(transport/NetworkLinkSettings.java:64-74); on the round-quantized tick a
+message's delay becomes a round offset ``floor(delay / round_ms)``,
+saturating at the ring depth (documented saturation, not loss).  The ring
+is a ``[D, N, ...]`` carry buffer: slot ``round % D`` holds the messages
+due in that round; reading a round's slot clears it for reuse.
+
+One implementation here, three users: models/swim.py (int32 record-key
+ring + int8 ALIVE-flag ring), models/gossip.py (bool infection ring) —
+keeping the slot arithmetic and saturation rule in a single place.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def delay_bins(key, mean_ms, round_ms: float, max_delay_rounds: int, shape):
+    """Quantized round offset per message: floor(Exp(mean)/round), clamped.
+
+    ``mean_ms`` broadcasts against ``shape`` (per-link means from
+    models/swim.link_eval).
+    """
+    u = jax.random.uniform(key, shape)
+    d_ms = -jnp.log1p(-u) * mean_ms
+    q = jnp.floor(d_ms / round_ms).astype(jnp.int32)
+    return jnp.clip(q, 0, max_delay_rounds)
+
+
+def open_slot(ring, slot0, fill_value):
+    """(due-now slice, ring with that slot reset to ``fill_value``)."""
+    now = jax.lax.dynamic_index_in_dim(ring, slot0, axis=0, keepdims=False)
+    cleared = jax.lax.dynamic_update_index_in_dim(
+        ring, jnp.full_like(now, fill_value), slot0, axis=0
+    )
+    return now, cleared
+
+
+def push_max(ring, slot, values):
+    """Max-merge ``values`` into ring slot ``slot`` (record keys)."""
+    cur = jax.lax.dynamic_index_in_dim(ring, slot, axis=0, keepdims=False)
+    return jax.lax.dynamic_update_index_in_dim(
+        ring, jnp.maximum(cur, values), slot, axis=0
+    )
+
+
+def push_or(ring, slot, values):
+    """Or-merge ``values`` into ring slot ``slot`` (flag/infection bits)."""
+    cur = jax.lax.dynamic_index_in_dim(ring, slot, axis=0, keepdims=False)
+    return jax.lax.dynamic_update_index_in_dim(
+        ring, cur | values, slot, axis=0
+    )

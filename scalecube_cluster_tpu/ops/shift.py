@@ -21,9 +21,13 @@ vector ops only:
   (models/swim.py module docstring lists the full set):
     - within one round all nodes share the same ``F`` target offsets, so
       per-round in-degree is exactly ``F`` instead of Poisson(F);
-    - a node cannot pick the same target twice in one round (shifts are
-      drawn per channel), matching the reference's distinct-targets rule
-      *better* than the with-replacement scatter mode does.
+    - channel shifts are drawn i.i.d., so two channels collide with
+      probability ~F(F-1)/2/(N-1) per round; on such a round EVERY node
+      duplicates one target simultaneously (a correlated analog of
+      scatter mode's independent with-replacement collisions).  Duplicate
+      delivery is harmless in both modes — the inbox combine is an
+      idempotent max (ops/delivery.py) — but it slightly lowers the
+      effective fanout on collision rounds, identically for all nodes.
 
 A delivery or lookup by a traced shift is one ``dynamic_slice`` on a
 doubled buffer — contiguous reads at full HBM bandwidth, which is what
@@ -70,3 +74,81 @@ def look(doubled_x: jnp.ndarray, shift, n: int) -> jnp.ndarray:
     return jax.lax.dynamic_slice_in_dim(
         doubled_x, jnp.asarray(shift, jnp.int32), n, axis=0
     )
+
+
+class ShiftEngine:
+    """Global-cyclic-shift delivery, single-device or row-sharded.
+
+    Single device: the doubled-buffer dynamic-slice fast path above.
+
+    Sharded (``axis_name`` set): rows are split into ``n_devices``
+    contiguous blocks of ``n_local``; a global shift ``s = d*L + r``
+    means receiver block ``m`` needs sender rows from blocks ``m-d`` and
+    ``m-d-1``.  Those two blocks arrive via block-rotation collectives —
+    ``lax.switch`` over the ``n_devices`` static ``ppermute`` rotations
+    (a ppermute's permutation must be static; the switch makes the rotation
+    amount data-dependent) — then one concat + dynamic-slice finishes the
+    roll.  Per delivered array that is 2 ppermutes of one [L, ...] block
+    over ICI — the neighbor-exchange analog of the scatter path's
+    full-height pmax (parallel/mesh.py), moving O(L·K) per channel instead
+    of O(N·K).
+
+    Replicated arrays (world vectors: liveness, partition ids, node ids)
+    skip the collectives entirely: every device holds the full height, so
+    a shifted view is a plain doubled-slice at the device's row offset.
+    """
+
+    def __init__(self, n: int, offset=0, axis_name=None, n_devices: int = 1,
+                 n_local: int = None):
+        self.n = n
+        self.offset = offset            # traced scalar under shard_map
+        self.axis_name = axis_name
+        self.n_devices = n_devices
+        self.n_local = n if n_local is None else n_local
+
+    # -- replicated world vectors ([N] on every device) -------------------
+
+    def prep_replicated(self, x_full):
+        return doubled(x_full)
+
+    def look_replicated(self, dx, shift):
+        """Local senders' view of target attribute: x[(off + l + s) % n]."""
+        start = jnp.asarray(self.offset + shift, jnp.int32)
+        return jax.lax.dynamic_slice_in_dim(dx, start, self.n_local, axis=0)
+
+    def deliver_replicated(self, dx, shift):
+        """Local receivers' view of sender attribute: x[(off + l - s) % n]."""
+        start = jnp.asarray(self.n + self.offset - shift, jnp.int32)
+        return jax.lax.dynamic_slice_in_dim(dx, start, self.n_local, axis=0)
+
+    # -- sharded payloads ([n_local, ...] row slice per device) -----------
+
+    def prep(self, x_local):
+        if self.axis_name is None:
+            return doubled(x_local)
+        return x_local
+
+    def _rotate_blocks(self, x_local, d_blocks):
+        """Device m ends up holding device (m - d_blocks) % M's block."""
+        if self.n_devices == 1:
+            return x_local
+
+        def rotation(k):
+            perm = [(j, (j + k) % self.n_devices)
+                    for j in range(self.n_devices)]
+            return lambda x: jax.lax.ppermute(x, self.axis_name, perm)
+
+        branches = [rotation(k) for k in range(self.n_devices)]
+        return jax.lax.switch(d_blocks % self.n_devices, branches, x_local)
+
+    def deliver(self, h, shift):
+        """Receiver row l gets sender row (off + l - shift) % n."""
+        if self.axis_name is None:
+            return deliver(h, shift, self.n)
+        ll = self.n_local
+        d_blocks = shift // ll
+        r = shift % ll
+        x_a = self._rotate_blocks(h, d_blocks)          # block (m - d)
+        x_b = self._rotate_blocks(h, d_blocks + 1)      # block (m - d - 1)
+        both = jnp.concatenate([x_b, x_a], axis=0)      # rows of blocks
+        return jax.lax.dynamic_slice_in_dim(both, ll - r, ll, axis=0)

@@ -76,6 +76,8 @@ def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
     state_specs = swim.SwimState(
         status=P(axis), inc=P(axis), spread_until=P(axis),
         suspect_deadline=P(axis), self_inc=P(axis),
+        # Delay rings are [D, rows, K]: receiver rows on axis 1.
+        inbox_ring=P(None, axis), flag_ring=P(None, axis),
     )
     world_specs = jax.tree.map(lambda _: P(), world)
     metric_spec = P()
@@ -86,7 +88,7 @@ def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
         def body(carry, round_idx):
             return swim.swim_tick(
                 carry, round_idx, base_key, params, world,
-                offset=offset, axis_name=axis,
+                offset=offset, axis_name=axis, n_devices=n_dev,
             )
 
         rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round

@@ -82,3 +82,46 @@ class TestShardRun:
         assert final.status.shape == (64, 64)
         shard_sizes = {s.data.shape[0] for s in final.status.addressable_shards}
         assert shard_sizes == {8}
+
+
+class TestShardedShiftMode:
+    """Shift delivery under shard_map: payload blocks ride block-rotation
+    ppermutes (ops/shift.ShiftEngine) instead of the scatter path's
+    full-height pmax."""
+
+    def test_crash_detected_and_disseminated(self, mesh8):
+        n = 64
+        params, world = make(n, delivery="shift")
+        world = world.with_crash(0, at_round=0)
+        horizon = params.ping_every * n // 4 + params.suspicion_rounds + 200
+        _, metrics = pmesh.shard_run(
+            jax.random.key(7), params, world, horizon, mesh8
+        )
+        alive_view = np.asarray(metrics["alive"])[:, 0]
+        deads = np.asarray(metrics["dead"])[:, 0]
+        assert deads.max() > 0
+        assert alive_view[-1] == 0
+
+    def test_healthy_no_false_positives(self, mesh8):
+        params, world = make(64, delivery="shift")
+        _, metrics = pmesh.shard_run(
+            jax.random.key(8), params, world, 60, mesh8
+        )
+        assert np.asarray(metrics["false_positives"]).sum() == 0
+
+    def test_sharded_determinism(self, mesh8):
+        params, world = make(32, loss=0.2, delivery="shift")
+        _, m1 = pmesh.shard_run(jax.random.key(9), params, world, 50, mesh8)
+        _, m2 = pmesh.shard_run(jax.random.key(9), params, world, 50, mesh8)
+        for k in m1:
+            np.testing.assert_array_equal(np.asarray(m1[k]), np.asarray(m2[k]))
+
+    def test_focal_mode_sharded_shift(self, mesh8):
+        params, world = make(512, k=8, ping_known_only=False,
+                             delivery="shift")
+        world = world.with_crash(2, at_round=0)
+        _, metrics = pmesh.shard_run(
+            jax.random.key(10), params, world, 400, mesh8
+        )
+        alive_view = np.asarray(metrics["alive"])[:, 2]
+        assert alive_view[-1] < alive_view[0]

@@ -7,6 +7,8 @@ dissemination, network partition + heal via SYNC, crashed-node restart
 (tombstone re-acceptance + self-refutation), and determinism.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -230,3 +232,28 @@ class TestDeterminism:
             np.asarray(m_a["alive"]),
             np.concatenate([np.asarray(m1["alive"]), np.asarray(m2["alive"])]),
         )
+
+
+class TestAggregateMetricsPath:
+    @pytest.mark.parametrize("delivery", ["scatter", "shift"])
+    def test_aggregate_equals_summed_per_subject(self, delivery):
+        """per_subject_metrics=False (the 1M-bench observability path) must
+        equal the per-subject traces summed over subjects."""
+        n = 24
+        params_ps = swim.SwimParams.from_config(
+            fast_config(), n_members=n, loss_probability=0.1,
+            delivery=delivery, per_subject_metrics=True,
+        )
+        params_agg = dataclasses.replace(params_ps, per_subject_metrics=False)
+        world = swim.SwimWorld.healthy(params_ps).with_crash(1, at_round=5)
+        key = jax.random.key(11)
+        _, m_ps = swim.run(key, params_ps, world, 80)
+        _, m_agg = swim.run(key, params_agg, world, 80)
+        for name in ("alive", "suspect", "dead", "absent", "false_positives"):
+            np.testing.assert_array_equal(
+                np.asarray(m_ps[name]).sum(axis=1), np.asarray(m_agg[name])
+            )
+        for name in ("messages_gossip", "messages_ping", "refutations"):
+            np.testing.assert_array_equal(
+                np.asarray(m_ps[name]), np.asarray(m_agg[name])
+            )
