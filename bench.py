@@ -108,6 +108,35 @@ def timed_run(jax, n_members, rounds, label):
     return rate
 
 
+def dissemination_at_scale(jax, n_members):
+    """Rounds-to-full-dissemination at scale (BASELINE.json's 2nd metric).
+
+    A graceful leave at round 10 emits one DEAD@inc+1 record whose
+    infection-style spread to all N live observers is timed in rounds —
+    pure dissemination, no suspicion-timeout wait.  Compare with the
+    analytic window repeat_mult*ceil(log2(n+1)) (ClusterMath.java:111-113).
+    """
+    import numpy as np
+
+    from scalecube_cluster_tpu.config import ClusterConfig
+    from scalecube_cluster_tpu.models import swim
+
+    params = swim.SwimParams.from_config(
+        ClusterConfig.default(),
+        n_members=n_members,
+        n_subjects=N_SUBJECTS,
+        delivery=DELIVERY,
+    )
+    world = swim.SwimWorld.healthy(params).with_leave(3, at_round=10)
+    _, metrics = swim.run(jax.random.key(1), params, world, 60)
+    alive_view = np.asarray(metrics["alive"])[:, 3]
+    gone = np.flatnonzero(alive_view == 0)
+    rounds = int(gone[0]) - 10 if gone.size else -1
+    log(f"dissemination@{n_members}: leave@10 fully known by round "
+        f"{int(gone[0]) if gone.size else 'never'} -> {rounds} rounds")
+    return rounds
+
+
 def main():
     result = {
         "metric": "swim_member_rounds_per_sec_per_chip",
@@ -129,6 +158,7 @@ def main():
         result["n_members"] = N_MEMBERS
         result["rounds_timed"] = BENCH_ROUNDS
         result["delivery"] = DELIVERY
+        result["dissemination_rounds"] = dissemination_at_scale(jax, N_MEMBERS)
     except BaseException as e:  # noqa: BLE001 — partial result by contract
         log(traceback.format_exc())
         result["error"] = f"{type(e).__name__}: {e}"

@@ -1135,70 +1135,85 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     d_ids = eng.prep_replicated(jnp.arange(n, dtype=jnp.int32))
 
     # ---- Phase 1: failure detector probe --------------------------------
-    t = eng.look_replicated(d_ids, fd_shift)            # [n_local] target ids
-    alive_t = eng.look_replicated(d_alive, fd_shift)
-    part_t = eng.look_replicated(d_part, fd_shift)
-    if params.full_view:
-        slot = t
-        entry_t_status = jnp.take_along_axis(status, t[:, None], 1)[:, 0]
-        entry_t_inc = jnp.take_along_axis(inc, t[:, None], 1)[:, 0]
-        has_target = (
-            (entry_t_status == records.ALIVE)
-            | (entry_t_status == records.SUSPECT)
-        )
-    else:
-        d_slot = eng.prep_replicated(world.slot_of_node)
-        slot = eng.look_replicated(d_slot, fd_shift)         # -1 = untracked
-        slot_safe = jnp.maximum(slot, 0)
-        entry_t_status = _entry_at_slot(status, slot_safe, k)
-        entry_t_inc = _entry_at_slot(inc, slot_safe, k)
-        has_target = (slot >= 0) & (
-            (entry_t_status == records.ALIVE)
-            | (entry_t_status == records.SUSPECT)
-        )
+    # The whole probe (target/proxy lookups, per-hop loss/delay chains)
+    # only runs on fd rounds: lax.cond skips ~2ms/round of work on the
+    # other ping_every-1 rounds at 1M members.  (Under vmap sweeps the
+    # cond lowers to select and both branches run - correct, just without
+    # the saving.)
+    def fd_phase(_):
+        t = eng.look_replicated(d_ids, fd_shift)        # [n_local] targets
+        alive_t = eng.look_replicated(d_alive, fd_shift)
+        part_t = eng.look_replicated(d_part, fd_shift)
+        if params.full_view:
+            slot = t
+            entry_t_status = jnp.take_along_axis(status, t[:, None], 1)[:, 0]
+            entry_t_inc = jnp.take_along_axis(inc, t[:, None], 1)[:, 0]
+            has_target = (
+                (entry_t_status == records.ALIVE)
+                | (entry_t_status == records.SUSPECT)
+            )
+        else:
+            d_slot = eng.prep_replicated(world.slot_of_node)
+            slot = eng.look_replicated(d_slot, fd_shift)     # -1 = untracked
+            slot_sf = jnp.maximum(slot, 0)
+            entry_t_status = _entry_at_slot(status, slot_sf, k)
+            entry_t_inc = _entry_at_slot(inc, slot_sf, k)
+            has_target = (slot >= 0) & (
+                (entry_t_status == records.ALIVE)
+                | (entry_t_status == records.SUSPECT)
+            )
 
-    loss_it, delay_it = link_eval(world.faults, round_idx, node_ids, t,
-                                  kn.loss_probability, params.mean_delay_ms)
-    loss_ti, delay_ti = link_eval(world.faults, round_idx, t, node_ids,
-                                  kn.loss_probability, params.mean_delay_ms)
-    direct_ok = (
-        _chain_ok(k_ping_net, [loss_it, loss_ti], [delay_it, delay_ti],
-                  params.ping_timeout_ms, (n_local,))
-        & alive_t & (part_here == part_t)
-    )
-    # Ping-req via proxy shifts; proxy r for node i is (i + ps_r) % n.
-    proxy_oks = []
-    for r in range(r_proxies):
-        ps = proxy_shifts[r]
-        p_ids = eng.look_replicated(d_ids, ps)
-        p_alive = eng.look_replicated(d_alive, ps)
-        p_part = eng.look_replicated(d_part, ps)
-        hop_pairs = [(node_ids, p_ids), (p_ids, t), (t, p_ids),
-                     (p_ids, node_ids)]
-        hop_losses, hop_delays = [], []
-        for src, dst in hop_pairs:
-            lo, de = link_eval(world.faults, round_idx, src, dst,
-                               kn.loss_probability, params.mean_delay_ms)
-            hop_losses.append(lo)
-            hop_delays.append(de)
-        ok_r = (
-            _chain_ok(jax.random.fold_in(k_proxy_net, r),
-                      hop_losses, hop_delays,
-                      params.ping_interval_ms - params.ping_timeout_ms,
-                      (n_local,))
-            & p_alive & alive_t
-            & (part_here == p_part) & (p_part == part_t)
-            & (ps != fd_shift)                               # proxy != target
+        loss_it, delay_it = link_eval(world.faults, round_idx, node_ids, t,
+                                      kn.loss_probability,
+                                      params.mean_delay_ms)
+        loss_ti, delay_ti = link_eval(world.faults, round_idx, t, node_ids,
+                                      kn.loss_probability,
+                                      params.mean_delay_ms)
+        direct_ok = (
+            _chain_ok(k_ping_net, [loss_it, loss_ti], [delay_it, delay_ti],
+                      params.ping_timeout_ms, (n_local,))
+            & alive_t & (part_here == part_t)
         )
-        proxy_oks.append(ok_r)
-    ack_ok = direct_ok
-    for ok_r in proxy_oks:
-        ack_ok = ack_ok | ok_r
-    probe_active = fd_round & has_target & alive_here
-    verdict_suspect = probe_active & ~ack_ok
-    verdict_alive = probe_active & ack_ok
+        # Ping-req via proxy shifts; proxy r for node i is (i + ps_r) % n.
+        ack_ok = direct_ok
+        for r in range(r_proxies):
+            ps = proxy_shifts[r]
+            p_ids = eng.look_replicated(d_ids, ps)
+            p_alive = eng.look_replicated(d_alive, ps)
+            p_part = eng.look_replicated(d_part, ps)
+            hop_pairs = [(node_ids, p_ids), (p_ids, t), (t, p_ids),
+                         (p_ids, node_ids)]
+            hop_losses, hop_delays = [], []
+            for src, dst in hop_pairs:
+                lo, de = link_eval(world.faults, round_idx, src, dst,
+                                   kn.loss_probability,
+                                   params.mean_delay_ms)
+                hop_losses.append(lo)
+                hop_delays.append(de)
+            ok_pr = (
+                _chain_ok(jax.random.fold_in(k_proxy_net, r),
+                          hop_losses, hop_delays,
+                          params.ping_interval_ms - params.ping_timeout_ms,
+                          (n_local,))
+                & p_alive & alive_t
+                & (part_here == p_part) & (p_part == part_t)
+                & (ps != fd_shift)                           # proxy != target
+            )
+            ack_ok = ack_ok | ok_pr
+        active = has_target & alive_here
+        suspect_v = active & ~ack_ok
+        refute_v = active & ack_ok & (entry_t_status == records.SUSPECT)
+        return (suspect_v, refute_v, active,
+                jnp.maximum(slot, 0), entry_t_inc)
 
-    slot_safe = jnp.maximum(slot, 0)
+    def fd_skip(_):
+        zb = jnp.zeros((n_local,), jnp.bool_)
+        zi = jnp.zeros((n_local,), jnp.int32)
+        return zb, zb, zb, zi, zi
+
+    (verdict_suspect, push_refute, probe_active, slot_safe,
+     entry_t_inc) = jax.lax.cond(fd_round, fd_phase, fd_skip, 0)
+
     fd_slot_onehot = (
         jnp.arange(k, dtype=jnp.int32)[None, :] == slot_safe[:, None]
     )
@@ -1210,7 +1225,6 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         fd_suspect_key[:, None],
         delivery.NO_MESSAGE,
     )
-    push_refute = verdict_alive & (entry_t_status == records.SUSPECT)
 
     # ---- Phase 2 + 3: gossip and SYNC sends ------------------------------
     gossip_keys, sync_keys = _send_payloads(
@@ -1316,35 +1330,50 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     inbox_alive |= delivered_flags & ok_s_now[:, None]
 
     # Refute push: issuer i sends its SUSPECT record of t = (i + fd_shift)
-    # to t itself; at the receiver that is the sender (j - fd_shift).
-    refute_row = jnp.where(
-        fd_slot_onehot & push_refute[:, None],
-        fd_suspect_key[:, None],                     # SUSPECT @ entry inc
-        delivery.NO_MESSAGE,
+    # to t itself; at the receiver that is the sender (j - fd_shift).  Only
+    # fd rounds can produce push_refute, so the whole delivery (payload
+    # prep + block exchange + link draws) is cond-gated with the probe.
+    def refute_deliver(rf):
+        ring_, fring_ = rf
+        refute_row = jnp.where(
+            fd_slot_onehot & push_refute[:, None],
+            fd_suspect_key[:, None],                 # SUSPECT @ entry inc
+            delivery.NO_MESSAGE,
+        )
+        h_refute = eng.prep(refute_row)
+        sender_alive_r = eng.deliver_replicated(d_alive, fd_shift)
+        # Loss/delay for the refute push (issuer -> target hop); it rides
+        # the same delayed-delivery ring as the other channels so both
+        # delivery modes agree under max_delay_rounds > 0.
+        sender_ids_r = eng.deliver_replicated(d_ids, fd_shift)
+        loss_r, delay_r = link_eval(world.faults, round_idx, sender_ids_r,
+                                    node_ids, kn.loss_probability,
+                                    params.mean_delay_ms)
+        ok_r = (
+            sender_alive_r & alive_here
+            & (eng.deliver_replicated(d_part, fd_shift) == part_here)
+            & (jax.random.uniform(k_sync_drop, (n_local,)) >= loss_r)
+        )
+        delivered_r = eng.deliver(h_refute, fd_shift)
+        flags_r = jnp.zeros_like(delivered_r, dtype=jnp.bool_)  # never ALIVE
+        ok_r_now, ring_, fring_ = _route_delayed(
+            ok_r, delivered_r, flags_r, delay_r,
+            jax.random.fold_in(k_sync_drop, 13), params, ring_, fring_,
+            slot0,
+        )
+        contrib = jnp.where(ok_r_now[:, None], delivered_r,
+                            delivery.NO_MESSAGE)
+        return contrib, ring_, fring_
+
+    def refute_skip(rf):
+        ring_, fring_ = rf
+        return (jnp.full((n_local, k), delivery.NO_MESSAGE, jnp.int32),
+                ring_, fring_)
+
+    refute_contrib, ring, fring = jax.lax.cond(
+        fd_round, refute_deliver, refute_skip, (ring, fring)
     )
-    h_refute = eng.prep(refute_row)
-    sender_alive_r = eng.deliver_replicated(d_alive, fd_shift)
-    # Loss/delay for the refute push (issuer -> target hop); it rides the
-    # same delayed-delivery ring as the other channels so both delivery
-    # modes agree under max_delay_rounds > 0.
-    sender_ids_r = eng.deliver_replicated(d_ids, fd_shift)
-    loss_r, delay_r = link_eval(world.faults, round_idx, sender_ids_r,
-                                node_ids, kn.loss_probability,
-                                params.mean_delay_ms)
-    ok_r = (
-        sender_alive_r & alive_here
-        & (eng.deliver_replicated(d_part, fd_shift) == part_here)
-        & (jax.random.uniform(k_sync_drop, (n_local,)) >= loss_r)
-    )
-    delivered_r = eng.deliver(h_refute, fd_shift)
-    flags_r = jnp.zeros_like(delivered_r, dtype=jnp.bool_)  # never ALIVE
-    ok_r_now, ring, fring = _route_delayed(
-        ok_r, delivered_r, flags_r, delay_r,
-        jax.random.fold_in(k_sync_drop, 13), params, ring, fring, slot0,
-    )
-    inbox = jnp.maximum(
-        inbox, jnp.where(ok_r_now[:, None], delivered_r, delivery.NO_MESSAGE)
-    )
+    inbox = jnp.maximum(inbox, refute_contrib)
 
     new_state, refuted = _merge_and_timers(
         state, status, inc, inbox, inbox_alive, round_idx, params, kn, world,

@@ -71,7 +71,20 @@ class Cluster:
             address=None if config.port == 0 else Address("localhost", config.port),
         )
         member_id = generate_member_id(sim.rng) if alias is None else alias
-        self.local_member = Member(member_id, self.transport.address)
+        # memberHost/memberPort override: the member ADVERTISES a different
+        # address than the transport bind (ClusterImpl.createLocalMember
+        # honoring TransportConfig.memberHost/memberPort; exercised by
+        # MembershipProtocolTest.java:464-535).  The advertised address is
+        # aliased to the same transport so peers can reach it.
+        if config.member_host is not None:
+            advertised = Address(
+                config.member_host,
+                config.member_port or self.transport.address.port,
+            )
+            self.transport.add_alias(advertised)
+        else:
+            advertised = self.transport.address
+        self.local_member = Member(member_id, advertised)
         cid_generator = CorrelationIdGenerator(member_id)
 
         # Component construction + wiring (ClusterImpl.join0, :85-155).

@@ -165,7 +165,17 @@ class Transport:
         # (TransportImpl.java:205-232).
         self._pending: Dict[str, List[SimFuture]] = {}
         self.stopped = False
+        self._bound_addresses: List[Address] = [self.address]
         sim.transports[self.address] = self
+
+    def add_alias(self, address: Address) -> None:
+        """Bind an additional advertised address to this transport (the
+        memberHost/memberPort override seam, TransportConfig.java:107-110).
+        Collides like a real bind; unregistered on stop()."""
+        if address in self.sim.transports:
+            raise RuntimeError(f"address already in use: {address}")
+        self.sim.transports[address] = self
+        self._bound_addresses.append(address)
 
     # -- SPI ---------------------------------------------------------------
 
@@ -239,7 +249,8 @@ class Transport:
         if self.stopped:
             return
         self.stopped = True
-        self.sim.transports.pop(self.address, None)
+        for bound in self._bound_addresses:
+            self.sim.transports.pop(bound, None)
         self._listeners.clear()
         for futures in list(self._pending.values()):
             for future in list(futures):

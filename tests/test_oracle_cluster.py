@@ -154,3 +154,28 @@ def test_listen_membership_prepends_existing_members():
     events = []
     alice.listen_membership(events.append)
     assert [(e.type.value, e.member.id) for e in events] == [("added", "bob")]
+
+
+def test_member_host_override():
+    """TransportConfig.memberHost/memberPort: a member advertises a
+    different address than its bind address, and peers reach it there
+    (MembershipProtocolTest.java:464-535)."""
+    from scalecube_cluster_tpu.config import ClusterConfig
+
+    sim = Simulator(seed=21)
+    alice = Cluster.join(sim, alias="alice", config=FAST)
+    override = FAST.replace(member_host="10.1.2.3", member_port=7777)
+    bob = Cluster.join(sim, seeds=[alice.address], config=override,
+                       alias="bob")
+    sim.run_for(3_000)
+
+    assert str(bob.member().address) == "10.1.2.3:7777"
+    seen = {m.id: str(m.address) for m in alice.other_members()}
+    assert seen == {"bob": "10.1.2.3:7777"}
+
+    # Messaging to the advertised address reaches bob's transport.
+    got = []
+    bob.listen(lambda m: got.append(m.data))
+    alice.send(bob.member(), Message(qualifier="hi", data="via-override"))
+    sim.run_for(500)
+    assert got == ["via-override"]

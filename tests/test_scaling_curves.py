@@ -1,0 +1,77 @@
+"""The SWIM paper's headline curves, asserted (the BASELINE north star:
+"reproduce the paper's O(log n) dissemination and first-false-positive
+curves"; ClusterMath as the analytic anchor).
+
+tests/test_gossip_model.py pins per-n values against ClusterMath; this
+suite pins the *shape across n*: dissemination grows log-linearly in
+cluster size (infection-style spread, README.md:10-12), with small
+residuals, and first-false-positive timing scales with the loss rate.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu import swim_math
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models import gossip as gmodel
+from scalecube_cluster_tpu.models import swim
+
+from tests.test_swim_model import fast_config
+
+NS = [64, 256, 1024, 4096]
+
+
+def median_dissemination(n, seeds=3):
+    cfg = ClusterConfig.default()
+    rounds = []
+    for seed in range(seeds):
+        p = gmodel.GossipSimParams.from_config(cfg, n_members=n, n_gossips=4)
+        _, m = gmodel.run(jax.random.key(seed), p, 80)
+        r = np.asarray(gmodel.dissemination_rounds(m, n))
+        rounds.extend(r[r > 0].tolist())
+    assert rounds, f"no gossip fully disseminated at n={n}"
+    return float(np.median(rounds))
+
+
+def test_dissemination_is_log_linear_in_n():
+    """Median dissemination rounds fit a + b*log2(n) with <=10% residuals
+    and a slope consistent with fanout-3 epidemic growth."""
+    meds = np.asarray([median_dissemination(n) for n in NS])
+    x = np.log2(np.asarray(NS, dtype=np.float64))
+    b, a = np.polyfit(x, meds, 1)
+    fit = a + b * x
+    rel_resid = np.abs(meds - fit) / fit
+    assert rel_resid.max() <= 0.10, (meds.tolist(), fit.tolist())
+    # Epidemic growth with fanout 3 multiplies the infected set by ~4 per
+    # round (slope 1/log2(4) = 0.5) plus a straggler tail; measured slope
+    # lands between those regimes.
+    assert 0.4 <= b <= 1.2, b
+    # Shape sanity: strictly increasing with n, and every point within the
+    # analytic spread window (ClusterMath.java:111-113).
+    assert np.all(np.diff(meds) > 0)
+    for n, med in zip(NS, meds):
+        assert med <= swim_math.gossip_periods_to_spread(3, n), (n, med)
+
+
+def test_first_false_positive_scales_with_loss():
+    """Higher symmetric loss -> earlier first false suspicion; lossless ->
+    none (the first-false-positive curve's monotone backbone)."""
+    n = 32
+
+    def first_fp(loss, seed):
+        params = swim.SwimParams.from_config(
+            fast_config(), n_members=n, loss_probability=loss,
+            delivery="scatter",
+        )
+        world = swim.SwimWorld.healthy(params)
+        _, m = swim.run(jax.random.key(seed), params, world, 150)
+        fp = np.asarray(m["false_positives"]).sum(axis=1)
+        idx = np.flatnonzero(fp > 0)
+        return float(idx[0]) if idx.size else float("inf")
+
+    assert first_fp(0.0, 0) == float("inf")
+    med_10 = np.median([first_fp(0.10, s) for s in range(4)])
+    med_30 = np.median([first_fp(0.30, s) for s in range(4)])
+    assert np.isfinite(med_30), "30% loss never produced a false suspicion"
+    assert med_30 <= med_10, (med_30, med_10)
