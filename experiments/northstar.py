@@ -1,0 +1,183 @@
+"""The BASELINE north-star run: 1M SWIM members × 10k rounds on one chip.
+
+Executes the full target workload ("simulate 1M SWIM members for 10k
+gossip rounds", BASELINE.json) with a realistic fault schedule — 2% loss,
+a hard crash, a graceful leave, and a crash-with-revival — checkpointing
+the carry every 2500 rounds (utils/checkpoint.py), then a BASELINE
+config-5 parameter sweep (fanout × ping-interval × suspicion-mult) at the
+same 1M scale.  Writes ``artifacts/northstar_1m_10k.json`` with event
+timelines, throughput, and the sweep curves.
+
+Run: ``python experiments/northstar.py`` (TPU; ~2 min total).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.utils import checkpoint, get_logger
+
+N = 1_000_000
+K = 16
+ROUNDS = 10_000
+CRASH_NODE, CRASH_AT = 3, 500
+LEAVE_NODE, LEAVE_AT = 5, 2_000
+REVIVE_NODE, REVIVE_DOWN, REVIVE_UP = 7, 4_000, 7_000
+
+log = get_logger("northstar")
+
+
+def first(cond, default=-1):
+    idx = np.flatnonzero(cond)
+    return int(idx[0]) if idx.size else default
+
+
+def event_timeline(metrics, slot, t0):
+    alive_view = np.asarray(metrics["alive"])[:, slot]
+    suspects = np.asarray(metrics["suspect"])[:, slot]
+    deads = np.asarray(metrics["dead"])[:, slot]
+    return {
+        "suspect_onset": first((suspects > 0) & (np.arange(len(suspects)) >= t0)),
+        "dead_declared": first((deads > 0) & (np.arange(len(deads)) >= t0)),
+        "fully_disseminated": first(
+            (alive_view == 0) & (suspects == 0) & (deads > 0)
+            & (np.arange(len(deads)) >= t0)
+        ),
+    }
+
+
+def main():
+    params = swim.SwimParams.from_config(
+        ClusterConfig.default(), n_members=N, n_subjects=K,
+        loss_probability=0.02, delivery="shift",
+    )
+    world = (
+        swim.SwimWorld.healthy(params)
+        .with_crash(CRASH_NODE, at_round=CRASH_AT)
+        .with_leave(LEAVE_NODE, at_round=LEAVE_AT)
+        .with_crash(REVIVE_NODE, at_round=REVIVE_DOWN, until_round=REVIVE_UP)
+    )
+    key = jax.random.key(0)
+
+    ckpt = "artifacts/northstar_ckpt.npz"
+    os.makedirs("artifacts", exist_ok=True)
+    for f in os.listdir("artifacts"):
+        if f.startswith("northstar_ckpt"):
+            os.unlink(os.path.join("artifacts", f))
+
+    t0 = time.perf_counter()
+    final, chunks = checkpoint.run_checkpointed(
+        swim.run, key, params, world, ROUNDS, ckpt, chunk=2_500,
+        meta={"n": N, "rounds": ROUNDS}, log=log,
+    )
+    jax.block_until_ready(final.status)
+    elapsed = time.perf_counter() - t0
+    metrics = {
+        name: np.concatenate([np.asarray(c[name]) for c in chunks])
+        for name in chunks[0]
+    }
+    log.info("10k rounds in %.1fs (%.2e member-rounds/s incl. compile + io)",
+             elapsed, N * ROUNDS / elapsed)
+
+    suspicion = params.suspicion_rounds
+    result = {
+        "workload": f"{N} members x {ROUNDS} rounds, 2% loss, shift delivery",
+        "wall_seconds": round(elapsed, 1),
+        "member_rounds_per_sec_incl_overheads": round(N * ROUNDS / elapsed, 1),
+        "suspicion_rounds": suspicion,
+        "events": {
+            f"crash@{CRASH_AT}": event_timeline(metrics, CRASH_NODE,
+                                                CRASH_AT),
+            f"leave@{LEAVE_AT}": event_timeline(metrics, LEAVE_NODE,
+                                                LEAVE_AT),
+            f"crash@{REVIVE_DOWN}_revive@{REVIVE_UP}": event_timeline(
+                metrics, REVIVE_NODE, REVIVE_DOWN
+            ),
+        },
+        # Live observers of the revived node at the end: everyone except
+        # itself, the permanently crashed node, and the leaver.
+        "revived_reaccepted": bool(
+            np.asarray(metrics["alive"])[-1, REVIVE_NODE] == N - 3
+        ),
+        "revival_disseminated_round": first(
+            (np.asarray(metrics["alive"])[:, REVIVE_NODE] == N - 3)
+            & (np.arange(ROUNDS) >= REVIVE_UP)
+        ),
+        "total_refutations": int(np.asarray(metrics["refutations"]).sum()),
+        "false_positive_observer_rounds": int(
+            np.asarray(metrics["false_positives"]).sum()
+        ),
+    }
+
+    # ---- BASELINE config 5: the 1M parameter sweep -----------------------
+    # One compiled program (knobs are traced), looped over the grid points
+    # sequentially; 2k rounds per point keeps the whole sweep ~2 min.
+    grid = []
+    for fanout in (2, 3):
+        for ping_every in (2, 5):
+            for suspicion_mult in (3, 5):
+                grid.append((fanout, ping_every, suspicion_mult))
+    sweep_params = swim.SwimParams.from_config(
+        ClusterConfig.default(), n_members=N, n_subjects=K,
+        loss_probability=0.02, delivery="shift", fanout=3,
+    )
+    sweep_world = swim.SwimWorld.healthy(sweep_params).with_crash(
+        0, at_round=0
+    )
+    sweep_rows = []
+    base_cfg = ClusterConfig.default()
+    for i, (fanout, ping_every, sus_mult) in enumerate(grid):
+        # Derive the suspicion timeout exactly the way every other run
+        # does: sweep ping_every by scaling ping_interval on the config,
+        # then let to_sim quantize (ClusterMath.suspicionTimeout ties the
+        # timeout to the swept ping interval, ClusterMath.java:123-125).
+        cfg_i = base_cfg.replace(
+            ping_interval=base_cfg.gossip_interval * ping_every,
+            ping_timeout=base_cfg.gossip_interval * ping_every // 2,
+            suspicion_mult=sus_mult,
+        )
+        sim_i = cfg_i.to_sim(N)
+        kn = swim.Knobs(
+            loss_probability=jax.numpy.float32(0.02),
+            suspicion_rounds=jax.numpy.int32(sim_i.suspicion_rounds),
+            ping_every=jax.numpy.int32(sim_i.ping_every),
+            sync_every=jax.numpy.int32(sweep_params.sync_every),
+            fanout=jax.numpy.int32(fanout),
+        )
+        _, m = swim.run(jax.random.fold_in(key, i), sweep_params,
+                        sweep_world, 2_000, knobs=kn)
+        deads = np.asarray(m["dead"])[:, 0]
+        alive_view = np.asarray(m["alive"])[:, 0]
+        suspects = np.asarray(m["suspect"])[:, 0]
+        sweep_rows.append({
+            "fanout": fanout, "ping_every": ping_every,
+            "suspicion_mult": sus_mult,
+            "detection_round": first(deads > 0),
+            "dissemination_round": first(
+                (alive_view == 0) & (suspects == 0) & (deads > 0)
+            ),
+            "fp_observer_rounds": int(
+                np.asarray(m["false_positives"]).sum()
+            ),
+        })
+        log.info("sweep point %d/%d done", i + 1, len(grid))
+    result["sweep_1m"] = sweep_rows
+
+    out = "artifacts/northstar_1m_10k.json"
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({k: v for k, v in result.items() if k != "sweep_1m"},
+                     indent=1))
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
