@@ -1,1 +1,8 @@
-"""models subpackage of scalecube_cluster_tpu."""
+"""The dense TPU tick models.
+
+  - ``swim``    the flagship full-protocol tick (FD + gossip + suspicion
+                + SYNC), two delivery modes, fault injection, delay rings
+  - ``gossip``  infection-only dissemination (GossipProtocolImpl analog)
+  - ``fd``      failure detection in isolation (FailureDetectorTest's
+                stubbed-membership setup; BASELINE config 3)
+"""

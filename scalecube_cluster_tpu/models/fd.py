@@ -1,0 +1,56 @@
+"""Failure-detector-only model: the SWIM probe in isolation.
+
+The reference tests its FD component with membership stubbed out
+(FailureDetectorTest.java:414-428 fakes the peer list as a pre-seeded
+event stream) — BASELINE config 3 is exactly that setup at scale: "10k
+members, FailureDetectorImpl ping/ping-req under 5% packet loss".
+
+On the dense tick the same isolation is a *configuration*, not a fork:
+the full swim tick (models/swim.py) with the gossip channel masked off
+(Knobs.fanout = 0) and SYNC pushed past the horizon.  What remains per
+round is the probe phase — direct ping within ping_timeout, ping-req via
+k proxies within the remaining interval — and the local SUSPECT/ALIVE
+verdict stream, with no dissemination between observers.  Suspicion
+timeouts still fire locally, mirroring the FD's per-period verdicts
+feeding a mute membership.
+
+This module packages that configuration so "FD-only" runs are one call,
+with the same delivery modes, link faults, and world schedules as the
+full model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu.models import swim
+
+
+def fd_only_knobs(params: swim.SwimParams) -> swim.Knobs:
+    """Knobs that silence gossip + SYNC, leaving only the probe phase.
+
+    ``sync_every=0`` is the never-sync sentinel (models/swim.py gates the
+    sync round on ``sync_every > 0``; a huge modulo would still fire at
+    round 0).
+    """
+    return dataclasses.replace(
+        swim.Knobs.from_params(params),
+        sync_every=jnp.int32(0),
+        fanout=jnp.int32(0),
+    )
+
+
+def run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
+        n_rounds: int, state: Optional[swim.SwimState] = None,
+        start_round: int = 0):
+    """swim.run with gossip/SYNC silenced (see module docstring).
+
+    Returns (final_state, metrics); ``suspect``/``alive`` traces are the
+    per-period FailureDetectorEvent stream aggregated over observers
+    (FailureDetectorImpl.java:363-366).
+    """
+    return swim.run(base_key, params, world, n_rounds, state=state,
+                    start_round=start_round, knobs=fd_only_knobs(params))

@@ -706,7 +706,11 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     inc = jnp.where(is_self, state.self_inc[:, None], state.inc)
 
     fd_round = (round_idx % kn.ping_every) == 0
-    sync_round = (round_idx % kn.sync_every) == 0
+    # sync_every <= 0 disables SYNC entirely (a plain modulo sentinel like
+    # INT32_MAX would still fire at round 0).
+    sync_round = (kn.sync_every > 0) & (
+        (round_idx % jnp.maximum(kn.sync_every, 1)) == 0
+    )
 
     # Contact gating (full-view only, active when seeds are configured):
     # a sender only gossips/syncs at members it knows live, or at seeds —

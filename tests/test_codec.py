@@ -7,8 +7,6 @@ protocol (SURVEY.md §2.1), plus the failure mode: an unserializable
 payload must fail the send, like a codec error on a real wire.
 """
 
-import pytest
-
 from scalecube_cluster_tpu.oracle import (
     Address, Cluster, Member, Message, Simulator, Transport,
 )

@@ -1,7 +1,6 @@
 """Analytic-model checks against hand-computed values from the reference
 (ClusterMath.java; defaults from ClusterConfig.java:26-57)."""
 
-import numpy as np
 import pytest
 
 from scalecube_cluster_tpu import swim_math

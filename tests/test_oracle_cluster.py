@@ -160,8 +160,6 @@ def test_member_host_override():
     """TransportConfig.memberHost/memberPort: a member advertises a
     different address than its bind address, and peers reach it there
     (MembershipProtocolTest.java:464-535)."""
-    from scalecube_cluster_tpu.config import ClusterConfig
-
     sim = Simulator(seed=21)
     alice = Cluster.join(sim, alias="alice", config=FAST)
     override = FAST.replace(member_host="10.1.2.3", member_port=7777)

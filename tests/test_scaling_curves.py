@@ -10,7 +10,6 @@ residuals, and first-false-positive timing scales with the loss rate.
 
 import jax
 import numpy as np
-import pytest
 
 from scalecube_cluster_tpu import swim_math
 from scalecube_cluster_tpu.config import ClusterConfig

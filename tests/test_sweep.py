@@ -7,7 +7,6 @@ ClusterMath as the anchor, GossipProtocolTest.java:178-205's pattern).
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
