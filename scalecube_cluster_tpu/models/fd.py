@@ -34,7 +34,10 @@ def fd_only_knobs(params: swim.SwimParams) -> swim.Knobs:
 
     ``sync_every=0`` is the never-sync sentinel (models/swim.py gates the
     sync round on ``sync_every > 0``; a huge modulo would still fire at
-    round 0).
+    round 0).  It also disables the FD's alive-on-suspected refute push —
+    that push is a SYNC issued by *membership*
+    (MembershipProtocolImpl.java:379-391), which this isolation stubs out,
+    so verdicts stay strictly observer-local.
     """
     return dataclasses.replace(
         swim.Knobs.from_params(params),

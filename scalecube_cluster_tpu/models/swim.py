@@ -705,9 +705,11 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     status = jnp.where(is_self, records.ALIVE, state.status)
     inc = jnp.where(is_self, state.self_inc[:, None], state.inc)
 
-    fd_round = (round_idx % kn.ping_every) == 0
-    # sync_every <= 0 disables SYNC entirely (a plain modulo sentinel like
-    # INT32_MAX would still fire at round 0).
+    # ping_every/sync_every <= 0 disable the phase entirely (a plain
+    # modulo sentinel like INT32_MAX would still fire at round 0).
+    fd_round = (kn.ping_every > 0) & (
+        (round_idx % jnp.maximum(kn.ping_every, 1)) == 0
+    )
     sync_round = (kn.sync_every > 0) & (
         (round_idx % jnp.maximum(kn.sync_every, 1)) == 0
     )
@@ -1023,6 +1025,10 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     )
     # FD's alive-on-suspected push reuses the sync channel, aimed at the
     # suspected member itself.
+    # The refute push rides the sync channel (it IS a SYNC to the
+    # suspected member, MembershipProtocolImpl.java:379-391), so disabling
+    # the channel (sync_every <= 0) disables it too.
+    push_refute = push_refute & (kn.sync_every > 0)
     sync_target = jnp.where(push_refute[:, None], t[:, None], sync_target)
     do_sync = (sync_round & alive_here) | push_refute
     if gate_contacts:
@@ -1333,18 +1339,15 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     )
     inbox_alive |= delivered_flags & ok_s_now[:, None]
 
-    # Refute push: issuer i sends its SUSPECT record of t = (i + fd_shift)
-    # to t itself; at the receiver that is the sender (j - fd_shift).  Only
-    # fd rounds can produce push_refute, so the whole delivery (payload
-    # prep + block exchange + link draws) is cond-gated with the probe.
+    # Refute push: issuer i sends a SYNC (its full row minus tombstones,
+    # matching MembershipProtocolImpl.java:379-391 and the scatter path) to
+    # the suspected member t = (i + fd_shift); at the receiver that is the
+    # sender (j - fd_shift).  Only fd rounds with the sync channel enabled
+    # can produce push_refute, so the whole delivery (payload prep + block
+    # exchange + link draws) is cond-gated with the probe.
     def refute_deliver(rf):
         ring_, fring_ = rf
-        refute_row = jnp.where(
-            fd_slot_onehot & push_refute[:, None],
-            fd_suspect_key[:, None],                 # SUSPECT @ entry inc
-            delivery.NO_MESSAGE,
-        )
-        h_refute = eng.prep(refute_row)
+        h_pushers = eng.prep(push_refute)
         sender_alive_r = eng.deliver_replicated(d_alive, fd_shift)
         # Loss/delay for the refute push (issuer -> target hop); it rides
         # the same delayed-delivery ring as the other channels so both
@@ -1358,8 +1361,9 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             & (eng.deliver_replicated(d_part, fd_shift) == part_here)
             & (jax.random.uniform(k_sync_drop, (n_local,)) >= loss_r)
         )
-        delivered_r = eng.deliver(h_refute, fd_shift)
-        flags_r = jnp.zeros_like(delivered_r, dtype=jnp.bool_)  # never ALIVE
+        ok_r = ok_r & eng.deliver(h_pushers, fd_shift)
+        delivered_r = eng.deliver(h_sync, fd_shift)
+        flags_r = eng.deliver(h_sync_alive, fd_shift).astype(jnp.bool_)
         ok_r_now, ring_, fring_ = _route_delayed(
             ok_r, delivered_r, flags_r, delay_r,
             jax.random.fold_in(k_sync_drop, 13), params, ring_, fring_,
@@ -1367,17 +1371,21 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         )
         contrib = jnp.where(ok_r_now[:, None], delivered_r,
                             delivery.NO_MESSAGE)
-        return contrib, ring_, fring_
+        fcontrib = flags_r & ok_r_now[:, None]
+        return contrib, fcontrib, ring_, fring_
 
     def refute_skip(rf):
         ring_, fring_ = rf
         return (jnp.full((n_local, k), delivery.NO_MESSAGE, jnp.int32),
+                jnp.zeros((n_local, k), jnp.bool_),
                 ring_, fring_)
 
-    refute_contrib, ring, fring = jax.lax.cond(
-        fd_round, refute_deliver, refute_skip, (ring, fring)
+    refute_contrib, refute_flags, ring, fring = jax.lax.cond(
+        fd_round & (kn.sync_every > 0), refute_deliver, refute_skip,
+        (ring, fring)
     )
     inbox = jnp.maximum(inbox, refute_contrib)
+    inbox_alive |= refute_flags
 
     new_state, refuted = _merge_and_timers(
         state, status, inc, inbox, inbox_alive, round_idx, params, kn, world,

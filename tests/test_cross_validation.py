@@ -221,3 +221,64 @@ def test_false_suspicion_under_loss_matches_oracle(delivery):
     # Control: lossless runs stay clean on both layers.
     assert oracle_false_suspicion(0, 0) == float("inf")
     assert tick_false_suspicion(0, delivery, 0.0) == float("inf")
+
+
+# --------------------------------------------------------------------------
+# Gossip dissemination curve shape: oracle component vs dense model
+# --------------------------------------------------------------------------
+
+
+def oracle_gossip_curve(seed: int, n: int, horizon_rounds: int):
+    """Fraction of members infected per round for one spread_gossip."""
+    from scalecube_cluster_tpu.oracle import Message
+
+    sim = Simulator(seed=seed)
+    clusters = [Cluster.join(sim, config=CFG, alias="m0")]
+    for i in range(1, n):
+        clusters.append(
+            Cluster.join(sim, seeds=[clusters[0].address], config=CFG,
+                         alias=f"m{i}")
+        )
+    sim.run_for(4_000)
+    got = set()
+    for c in clusters[1:]:
+        c.listen_gossips(lambda m, c=c: got.add(c.member().id))
+    clusters[0].spread_gossip(Message(qualifier="x", data="payload"))
+    curve = []
+    for _ in range(horizon_rounds):
+        sim.run_for(ROUND_MS)
+        curve.append((len(got) + 1) / n)   # +1: the origin itself
+    return np.asarray(curve)
+
+
+def tick_gossip_curve(seed: int, n: int, horizon_rounds: int):
+    from scalecube_cluster_tpu.models import gossip as gmodel
+
+    p = gmodel.GossipSimParams.from_config(CFG, n_members=n, n_gossips=1)
+    _, m = gmodel.run(jax.random.key(seed), p, horizon_rounds)
+    return np.asarray(m["infected_count"])[:, 0] / n
+
+
+def quartile_rounds(curve, q):
+    idx = np.flatnonzero(curve >= q)
+    return float(idx[0]) if idx.size else float(len(curve))
+
+
+def test_gossip_dissemination_curve_shape_matches_oracle():
+    """The infection S-curve's quartile crossings (25/50/75/100%) agree
+    between the oracle's real gossip component and the dense gossip model
+    across seeds — the curve-level form of GossipProtocolTest's
+    measured-vs-ClusterMath comparison (:178-205)."""
+    n, horizon = 48, 40
+    seeds = range(4)
+    o = np.asarray([[quartile_rounds(oracle_gossip_curve(s, n, horizon), q)
+                     for q in (0.25, 0.5, 0.75, 1.0)] for s in seeds])
+    t = np.asarray([[quartile_rounds(tick_gossip_curve(s, n, horizon), q)
+                     for q in (0.25, 0.5, 0.75, 1.0)] for s in seeds])
+    o_med = np.median(o, axis=0)
+    t_med = np.median(t, axis=0)
+    assert np.all(o_med < horizon) and np.all(t_med < horizon), (o_med, t_med)
+    # Each quartile crossing within 50% + 2 rounds (small-n epidemic
+    # curves are steep, so a 1-2 round shift is a large relative error).
+    for q, om, tm in zip((25, 50, 75, 100), o_med, t_med):
+        assert abs(om - tm) <= 0.5 * om + 2, (q, om, tm)
