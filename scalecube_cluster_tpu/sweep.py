@@ -19,6 +19,13 @@ Outputs per grid point, from one crash-at-round-0 scenario:
 anchors from swim_math (the ClusterMath port): measured dissemination must
 sit within the spread window `repeat_mult*ceil(log2(n+1))` and detection
 must straddle the configured suspicion timeout.
+
+Performance note: under vmap, shift-mode delivery's per-instance
+dynamic-slices lower to gathers (each grid point draws different shifts),
+which runs at the slow random-access rate on TPU.  The vmapped sweep is
+therefore best at small/medium N; for 1M-scale sweeps loop the grid
+sequentially over one compiled program with traced knobs instead
+(experiments/northstar.py does exactly this), or use delivery="scatter".
 """
 
 from __future__ import annotations
