@@ -33,7 +33,9 @@ import traceback
 NORTH_STAR_RATE = 1e6 * 1e4 / (3600.0 * 8)  # member-rounds/sec/chip
 
 N_MEMBERS = int(os.environ.get("SCALECUBE_BENCH_N", 1_000_000))
-N_SUBJECTS = 16
+# "full" = full-view mode (K == N, exact reference semantics, O(N^2) state).
+_subj = os.environ.get("SCALECUBE_BENCH_SUBJECTS", "16")
+N_SUBJECTS = None if _subj == "full" else int(_subj)
 BENCH_ROUNDS = int(os.environ.get("SCALECUBE_BENCH_ROUNDS", 200))
 DELIVERY = os.environ.get("SCALECUBE_BENCH_DELIVERY", "shift")
 CANARY_N = 4096

@@ -203,3 +203,20 @@ class TestPrng:
         assert counts[2] == 0
         for slot in (0, 1, 3):
             assert 500 < counts[slot] < 840  # ~667 expected
+
+
+def test_is_alive_key_matches_pack_record():
+    """The ALIVE-gate classifier agrees with pack_record for every status
+    at several incarnations, and rejects NO_MESSAGE."""
+    for inc in (0, 1, 7, 2**29 - 1):
+        for status, expect in (
+            (records.ALIVE, True),
+            (records.SUSPECT, False),
+            (records.DEAD, False),
+        ):
+            key = delivery.pack_record(jnp.int8(status), jnp.int32(inc))
+            assert bool(delivery.is_alive_key(key)) is expect, (status, inc)
+    assert not bool(delivery.is_alive_key(delivery.NO_MESSAGE))
+    # ABSENT packs to NO_MESSAGE and must not read as alive.
+    key = delivery.pack_record(jnp.int8(records.ABSENT), jnp.int32(5))
+    assert not bool(delivery.is_alive_key(key))
