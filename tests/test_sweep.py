@@ -92,3 +92,21 @@ def test_loss_knob_drives_false_positives():
     fp = res["curves"]["false_positive_rate"]
     assert fp[0] == 0.0
     assert fp[1] > 0.0
+
+
+def test_cli_writes_curve_artifact(tmp_path):
+    """The sweep CLI (python -m scalecube_cluster_tpu.sweep) produces the
+    curve artifact end to end."""
+    import json
+
+    out = str(tmp_path / "curves.json")
+    sweep.main([
+        "--n-members", "64", "--n-rounds", "120",
+        "--fanout", "2", "3", "--ping-every", "2",
+        "--loss", "0.0", "--out", out,
+    ])
+    with open(out) as f:
+        result = json.load(f)
+    assert result["n_members"] == 64
+    assert len(result["curves"]["detection_rounds"]) == 2  # 2 fanouts
+    assert result["analytic"]["periods_to_spread"] > 0
