@@ -115,6 +115,15 @@ def main():
         "false_positive_observer_rounds": int(
             np.asarray(metrics["false_positives"]).sum()
         ),
+        # The FP split (see swim_tick metrics docs): genuine FD false-alarm
+        # onset events vs stale-DEAD-tombstone observer-rounds (dominated
+        # by the post-revival window until re-dissemination).
+        "false_suspicion_onsets": int(
+            np.asarray(metrics["false_suspicion_onsets"]).sum()
+        ),
+        "stale_view_observer_rounds": int(
+            np.asarray(metrics["stale_view_rounds"]).sum()
+        ),
     }
 
     # ---- BASELINE config 5: the 1M parameter sweep -----------------------
@@ -166,6 +175,12 @@ def main():
             ),
             "fp_observer_rounds": int(
                 np.asarray(m["false_positives"]).sum()
+            ),
+            "false_suspicion_onsets": int(
+                np.asarray(m["false_suspicion_onsets"]).sum()
+            ),
+            "stale_view_observer_rounds": int(
+                np.asarray(m["stale_view_rounds"]).sum()
             ),
         })
         log.info("sweep point %d/%d done", i + 1, len(grid))

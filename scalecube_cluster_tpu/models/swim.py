@@ -755,27 +755,48 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     new_status = new_state.status
     observer_alive = alive_here[:, None]
     subject_alive = alive[world.subject_ids][None, :]
-    counts = {}
-    for name, code in (("alive", records.ALIVE), ("suspect", records.SUSPECT),
-                       ("dead", records.DEAD), ("absent", records.ABSENT)):
-        mask = (new_status == code) & observer_alive & ~is_self
-        counts[name] = global_sum(
+    def reduce_metric(mask):
+        return global_sum(
             jnp.sum(mask, axis=0, dtype=jnp.int32)
             if params.per_subject_metrics
             else jnp.sum(mask, dtype=jnp.int32)
         )
+
+    counts = {}
+    for name, code in (("alive", records.ALIVE), ("suspect", records.SUSPECT),
+                       ("dead", records.DEAD), ("absent", records.ABSENT)):
+        counts[name] = reduce_metric(
+            (new_status == code) & observer_alive & ~is_self
+        )
     # False positive: a live observer holds SUSPECT/DEAD about a live subject.
+    # The aggregate conflates two distinct phenomena, so it is also split:
+    #   - ``false_suspicion_onsets``: EVENTS — an observer newly turning
+    #     SUSPECT about a live subject this round (a genuine FD false
+    #     alarm beginning, the thing the SWIM paper's FP curves count);
+    #   - ``stale_view_rounds``: observer-ROUNDS holding a DEAD tombstone
+    #     about a live subject — dominated by the window after a revival
+    #     until the refuted record re-disseminates (the reference has the
+    #     same window between restart and ADDED re-emission,
+    #     MembershipProtocolImpl.java:512-516 deletes then re-adds).
+    # ``false_positives`` (their per-round union, observer-rounds) is kept
+    # for continuity with round-1/2 artifacts.
     fp_mask = (
         ((new_status == records.SUSPECT) | (new_status == records.DEAD))
         & observer_alive & subject_alive & ~is_self
     )
+    onset_mask = (
+        (new_status == records.SUSPECT) & (status != records.SUSPECT)
+        & observer_alive & subject_alive & ~is_self
+    )
+    stale_mask = (
+        (new_status == records.DEAD)
+        & observer_alive & subject_alive & ~is_self
+    )
     metrics = dict(
         counts,
-        false_positives=global_sum(
-            jnp.sum(fp_mask, axis=0, dtype=jnp.int32)
-            if params.per_subject_metrics
-            else jnp.sum(fp_mask, dtype=jnp.int32)
-        ),
+        false_positives=reduce_metric(fp_mask),
+        false_suspicion_onsets=reduce_metric(onset_mask),
+        stale_view_rounds=reduce_metric(stale_mask),
         messages_gossip=global_sum(aux["messages_gossip"]),
         messages_ping=global_sum(aux["messages_ping"]),
         refutations=global_sum(aux["refutations"]),

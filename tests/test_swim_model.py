@@ -208,6 +208,48 @@ class TestFocalMode:
         assert np.asarray(metrics["false_positives"]).sum() == 0
 
 
+class TestFalsePositiveSplit:
+    """The FP aggregate splits into onset EVENTS vs stale-view ROUNDS
+    (swim_tick metrics docs) — two phenomena with different semantics:
+    genuine FD false alarms vs lingering DEAD tombstones about a revived
+    member (the reference's delete-then-re-add window,
+    MembershipProtocolImpl.java:512-516)."""
+
+    @pytest.mark.parametrize("delivery", ["scatter", "shift"])
+    def test_revival_stale_view_not_counted_as_suspicion(self, delivery):
+        n = 10
+        params, world = make(n, delivery=delivery)
+        down_from = 5
+        down_until = down_from + params.ping_every * n \
+            + params.suspicion_rounds + 3 * params.periods_to_spread
+        world = world.with_crash(2, at_round=down_from,
+                                 until_round=down_until)
+        _, m = swim.run(jax.random.key(20), params, world, down_until + 200)
+
+        stale = np.asarray(m["stale_view_rounds"]).sum()
+        onsets = np.asarray(m["false_suspicion_onsets"]).sum()
+        fp = np.asarray(m["false_positives"]).sum()
+        # Lossless: the only FP phenomenon is the post-revival stale-DEAD
+        # window, so it accounts for the whole aggregate and no
+        # false-suspicion onset ever fires.
+        assert stale > 0, "revival produced no stale-view window"
+        assert onsets == 0
+        assert fp == stale
+
+    def test_loss_false_suspicions_are_onsets_not_stale(self):
+        # Suspicion timeout pushed out of the horizon: suspicions never
+        # mature to DEAD, so every FP round is a SUSPECT round.
+        params, world = make(32, loss=0.3, suspicion_rounds=10_000)
+        _, m = swim.run(jax.random.key(21), params, world, 150)
+        onsets = np.asarray(m["false_suspicion_onsets"]).sum()
+        stale = np.asarray(m["stale_view_rounds"]).sum()
+        fp = np.asarray(m["false_positives"]).sum()
+        assert onsets > 0, "30% loss produced no false suspicions"
+        assert stale == 0
+        # Each onset event holds SUSPECT for >= 1 observer-round.
+        assert fp >= onsets
+
+
 class TestDeterminism:
     def test_same_key_same_trace(self):
         params, world = make(16, loss=0.2)
@@ -249,7 +291,8 @@ class TestAggregateMetricsPath:
         key = jax.random.key(11)
         _, m_ps = swim.run(key, params_ps, world, 80)
         _, m_agg = swim.run(key, params_agg, world, 80)
-        for name in ("alive", "suspect", "dead", "absent", "false_positives"):
+        for name in ("alive", "suspect", "dead", "absent", "false_positives",
+                     "false_suspicion_onsets", "stale_view_rounds"):
             np.testing.assert_array_equal(
                 np.asarray(m_ps[name]).sum(axis=1), np.asarray(m_agg[name])
             )
