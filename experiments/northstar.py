@@ -121,6 +121,9 @@ def main():
         "false_suspicion_onsets": int(
             np.asarray(metrics["false_suspicion_onsets"]).sum()
         ),
+        "false_suspect_observer_rounds": int(
+            np.asarray(metrics["false_suspect_rounds"]).sum()
+        ),
         "stale_view_observer_rounds": int(
             np.asarray(metrics["stale_view_rounds"]).sum()
         ),
@@ -178,6 +181,9 @@ def main():
             ),
             "false_suspicion_onsets": int(
                 np.asarray(m["false_suspicion_onsets"]).sum()
+            ),
+            "false_suspect_observer_rounds": int(
+                np.asarray(m["false_suspect_rounds"]).sum()
             ),
             "stale_view_observer_rounds": int(
                 np.asarray(m["stale_view_rounds"]).sum()

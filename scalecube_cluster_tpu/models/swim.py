@@ -63,6 +63,14 @@ Documented deviations from the reference (all statistical-regime-neutral):
     over a shuffled pass (FailureDetectorImpl.java:338-347); detection-time
     distributions at large N are indistinguishable, and the SWIM paper
     itself analyzes the uniform variant;
+  - shift-mode FD probing draws ONE shared target offset per fd round: a
+    node probes only when that offset lands on an entry it knows
+    ALIVE/SUSPECT, so its per-round probe probability equals its
+    fraction-known instead of re-drawing uniformly among known members.
+    In the warm steady state (everyone known) this is statistically
+    neutral; during cold-start joins or heavy churn, partially-joined
+    nodes probe proportionally less often than the reference would —
+    use scatter mode to validate cold-start FD behavior;
   - the SYNC exchange is push-only per round (the syncAck pull is replaced
     by the partner's own future random pushes — symmetric in distribution);
     an FD ALIVE-verdict on a suspected member pushes the suspect record to
@@ -769,34 +777,44 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
             (new_status == code) & observer_alive & ~is_self
         )
     # False positive: a live observer holds SUSPECT/DEAD about a live subject.
-    # The aggregate conflates two distinct phenomena, so it is also split:
-    #   - ``false_suspicion_onsets``: EVENTS — an observer newly turning
-    #     SUSPECT about a live subject this round (a genuine FD false
-    #     alarm beginning, the thing the SWIM paper's FP curves count);
+    # The aggregate partitions EXACTLY by the held status
+    # (false_positives == false_suspect_rounds + stale_view_rounds):
+    #   - ``false_suspect_rounds``: observer-ROUNDS holding SUSPECT about a
+    #     live subject — active false-suspicion episodes, plus genuine
+    #     suspicions begun while the subject was down that outlived a quick
+    #     revival without maturing to DEAD;
     #   - ``stale_view_rounds``: observer-ROUNDS holding a DEAD tombstone
     #     about a live subject — dominated by the window after a revival
     #     until the refuted record re-disseminates (the reference has the
     #     same window between restart and ADDED re-emission,
     #     MembershipProtocolImpl.java:512-516 deletes then re-adds).
-    # ``false_positives`` (their per-round union, observer-rounds) is kept
-    # for continuity with round-1/2 artifacts.
-    fp_mask = (
-        ((new_status == records.SUSPECT) | (new_status == records.DEAD))
-        & observer_alive & subject_alive & ~is_self
-    )
+    # ``false_suspicion_onsets`` counts EVENTS, not rounds — a live
+    # observer newly turning SUSPECT about a live subject this round (a
+    # genuine FD false alarm beginning, the thing the SWIM paper's FP
+    # curves count).  ``false_positives`` (observer-rounds) is kept for
+    # continuity with round-1/2 artifacts.
     onset_mask = (
         (new_status == records.SUSPECT) & (status != records.SUSPECT)
+        & observer_alive & subject_alive & ~is_self
+    )
+    suspect_live_mask = (
+        (new_status == records.SUSPECT)
         & observer_alive & subject_alive & ~is_self
     )
     stale_mask = (
         (new_status == records.DEAD)
         & observer_alive & subject_alive & ~is_self
     )
+    false_suspect_rounds = reduce_metric(suspect_live_mask)
+    stale_view_rounds = reduce_metric(stale_mask)
     metrics = dict(
         counts,
-        false_positives=reduce_metric(fp_mask),
+        # The aggregate is the partition sum by construction (the two
+        # masks are disjoint: an entry holds SUSPECT xor DEAD).
+        false_positives=false_suspect_rounds + stale_view_rounds,
         false_suspicion_onsets=reduce_metric(onset_mask),
-        stale_view_rounds=reduce_metric(stale_mask),
+        false_suspect_rounds=false_suspect_rounds,
+        stale_view_rounds=stale_view_rounds,
         messages_gossip=global_sum(aux["messages_gossip"]),
         messages_ping=global_sum(aux["messages_ping"]),
         refutations=global_sum(aux["refutations"]),
@@ -1325,47 +1343,17 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             ok_c & eng.deliver(h_hot_any, s), dtype=jnp.int32,
         )
 
-    # SYNC channel: the periodic anti-entropy push, plus the FD
-    # alive-on-suspected refute push (aimed at the probed member = the
-    # fd_shift channel).
-    s = sync_shift
-    sender_alive = eng.deliver_replicated(d_alive, s)
-    sender_part = eng.deliver_replicated(d_part, s)
-    sender_ids_s = eng.deliver_replicated(d_ids, s)
-    loss_sy, delay_sy = link_eval(world.faults, round_idx, sender_ids_s,
-                                  node_ids, kn.loss_probability,
-                                  params.mean_delay_ms)
-    ok_s = (
-        sync_round & sender_alive & alive_here
-        & (sender_part == part_here) & (drop_u[:, f] >= loss_sy)
-    )
-    if gate_contacts:
-        sender_knows = jnp.take_along_axis(
-            eng.deliver(h_status, s),
-            node_ids[:, None], axis=1,
-        )[:, 0]
-        ok_s &= (
-            (sender_knows == records.ALIVE)
-            | (sender_knows == records.SUSPECT)
-            | is_seed(node_ids)
-        )
-    delivered = eng.deliver(h_sync, s)
-    delivered_flags = eng.deliver(h_sync_alive, s).astype(jnp.bool_)
-    ok_s_now, ring, fring = _route_delayed(
-        ok_s, delivered, delivered_flags, delay_sy,
-        jax.random.fold_in(k_sync_drop, 11), params, ring, fring, slot0,
-    )
-    inbox = jnp.maximum(
-        inbox, jnp.where(ok_s_now[:, None], delivered, delivery.NO_MESSAGE)
-    )
-    inbox_alive |= delivered_flags & ok_s_now[:, None]
-
     # Refute push: issuer i sends a SYNC (its full row minus tombstones,
     # matching MembershipProtocolImpl.java:379-391 and the scatter path) to
     # the suspected member t = (i + fd_shift); at the receiver that is the
     # sender (j - fd_shift).  Only fd rounds with the sync channel enabled
     # can produce push_refute, so the whole delivery (payload prep + block
-    # exchange + link draws) is cond-gated with the probe.
+    # exchange + link draws) is cond-gated with the probe.  The cond also
+    # reports which senders are refuting as seen through the sync shift, so
+    # the regular sync channel below can suppress them — in scatter mode the
+    # refute push REPLACES the sender's regular sync target (do_sync
+    # override), and without the suppression shift mode would emit one
+    # extra message per refuting sender.
     def refute_deliver(rf):
         ring_, fring_ = rf
         h_pushers = eng.prep(push_refute)
@@ -1393,20 +1381,57 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         contrib = jnp.where(ok_r_now[:, None], delivered_r,
                             delivery.NO_MESSAGE)
         fcontrib = flags_r & ok_r_now[:, None]
-        return contrib, fcontrib, ring_, fring_
+        return contrib, fcontrib, ring_, fring_, \
+            eng.deliver(h_pushers, sync_shift)
 
     def refute_skip(rf):
         ring_, fring_ = rf
         return (jnp.full((n_local, k), delivery.NO_MESSAGE, jnp.int32),
                 jnp.zeros((n_local, k), jnp.bool_),
-                ring_, fring_)
+                ring_, fring_,
+                jnp.zeros((n_local,), jnp.bool_))
 
-    refute_contrib, refute_flags, ring, fring = jax.lax.cond(
+    refute_contrib, refute_flags, ring, fring, sender_refuting = jax.lax.cond(
         fd_round & (kn.sync_every > 0), refute_deliver, refute_skip,
         (ring, fring)
     )
     inbox = jnp.maximum(inbox, refute_contrib)
     inbox_alive |= refute_flags
+
+    # SYNC channel: the periodic anti-entropy push, plus the FD
+    # alive-on-suspected refute push (aimed at the probed member = the
+    # fd_shift channel, delivered above).
+    s = sync_shift
+    sender_alive = eng.deliver_replicated(d_alive, s)
+    sender_part = eng.deliver_replicated(d_part, s)
+    sender_ids_s = eng.deliver_replicated(d_ids, s)
+    loss_sy, delay_sy = link_eval(world.faults, round_idx, sender_ids_s,
+                                  node_ids, kn.loss_probability,
+                                  params.mean_delay_ms)
+    ok_s = (
+        sync_round & sender_alive & alive_here & ~sender_refuting
+        & (sender_part == part_here) & (drop_u[:, f] >= loss_sy)
+    )
+    if gate_contacts:
+        sender_knows = jnp.take_along_axis(
+            eng.deliver(h_status, s),
+            node_ids[:, None], axis=1,
+        )[:, 0]
+        ok_s &= (
+            (sender_knows == records.ALIVE)
+            | (sender_knows == records.SUSPECT)
+            | is_seed(node_ids)
+        )
+    delivered = eng.deliver(h_sync, s)
+    delivered_flags = eng.deliver(h_sync_alive, s).astype(jnp.bool_)
+    ok_s_now, ring, fring = _route_delayed(
+        ok_s, delivered, delivered_flags, delay_sy,
+        jax.random.fold_in(k_sync_drop, 11), params, ring, fring, slot0,
+    )
+    inbox = jnp.maximum(
+        inbox, jnp.where(ok_s_now[:, None], delivered, delivery.NO_MESSAGE)
+    )
+    inbox_alive |= delivered_flags & ok_s_now[:, None]
 
     new_state, refuted = _merge_and_timers(
         state, status, inc, inbox, inbox_alive, round_idx, params, kn, world,

@@ -97,7 +97,8 @@ def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
     out_metric_specs = {
         name: metric_spec
         for name in ("alive", "suspect", "dead", "absent", "false_positives",
-                     "false_suspicion_onsets", "stale_view_rounds",
+                     "false_suspicion_onsets", "false_suspect_rounds",
+                     "stale_view_rounds",
                      "messages_gossip", "messages_ping", "refutations")
     }
     return jax.shard_map(

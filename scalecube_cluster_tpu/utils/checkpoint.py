@@ -46,6 +46,12 @@ def save(path: str, state: SwimState, next_round: int,
     arrays["meta_json"] = np.frombuffer(
         json.dumps(meta or {}).encode(), dtype=np.uint8
     )
+    _atomic_savez(path, arrays)
+
+
+def _atomic_savez(path: str, arrays: dict) -> None:
+    """np.savez to ``path`` via tmp-file + rename — a kill mid-write never
+    leaves a truncated .npz at the final name."""
     directory = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(directory, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
@@ -79,6 +85,41 @@ def _metrics_path(path: str, upto_round: int) -> str:
     return f"{path}.metrics-{upto_round:08d}.npz"
 
 
+def _metric_rounds_on_disk(path: str) -> list:
+    """Sorted end-rounds of the metric-trace files written next to ``path``.
+
+    The boundaries are discovered from disk rather than assumed to sit on a
+    fixed chunk grid: a run whose ``n_rounds`` is not a multiple of
+    ``chunk`` writes a short final chunk, so a later extension's boundaries
+    are offset from the grid.
+    """
+    import re
+
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    if not os.path.isdir(directory):
+        return []  # fresh run into a directory _atomic_savez will create
+    pat = re.compile(
+        re.escape(os.path.basename(path)) + r"\.metrics-(\d{8})\.npz$"
+    )
+    rounds = []
+    for fn in os.listdir(directory):
+        m = pat.match(fn)
+        if m:
+            rounds.append(int(m.group(1)))
+    return sorted(rounds)
+
+
+def _delete_traces_above(path: str, above_round: int) -> None:
+    """Delete trace files past ``above_round`` — stale leftovers of an
+    earlier run lineage (e.g. the checkpoint was deleted to re-chunk, or a
+    preemption landed between the trace write and the checkpoint write).
+    Keeps the on-disk invariant: traces always cover a prefix of
+    [0, next_round)."""
+    for upto in _metric_rounds_on_disk(path):
+        if upto > above_round:
+            os.unlink(_metrics_path(path, upto))
+
+
 def run_checkpointed(run_fn, key, params, world, n_rounds: int, path: str,
                      chunk: int = 1000, state=None, start_round: int = 0,
                      meta: Optional[dict] = None, log=None):
@@ -87,13 +128,23 @@ def run_checkpointed(run_fn, key, params, world, n_rounds: int, path: str,
     Resumes from ``path`` if it exists (``start_round``/``state`` args are
     then ignored).  On resume the stored ``meta`` must equal the caller's
     ``meta`` — a mismatch (different config/world than the interrupted run)
-    raises instead of silently continuing a different experiment.
+    raises instead of silently continuing a different experiment.  ``meta``
+    is JSON-normalized on both sides before comparing (tuples become lists,
+    int keys become strings), so JSON-lossy values don't spuriously refuse a
+    legitimate resume.  Resuming with a different ``chunk`` is fine: trace
+    boundaries are discovered from the files on disk, not assumed to sit on
+    a chunk grid.
 
     Each chunk's metric traces are persisted next to the checkpoint
-    (``<path>.metrics-<round>.npz``) and reloaded on resume, so the
-    returned list always covers rounds [0, n_rounds) even across
-    preemptions.  Returns (final_state, list of per-chunk metrics dicts).
+    (``<path>.metrics-<round>.npz``) and reloaded on resume (boundaries
+    discovered from the files on disk), so the returned list always covers
+    rounds [0, n_rounds) even across preemptions.  If a trace file was
+    deleted out-of-band, resume raises rather than return a list with a
+    silent interior gap.  Returns (final_state, list of per-chunk metrics
+    dicts).
     """
+    # JSON round-trip so the resume equality check compares what was stored.
+    meta = json.loads(json.dumps(meta)) if meta is not None else None
     metrics_chunks = []
     if os.path.exists(path):
         state, start_round, saved_key, saved_meta = load(path)
@@ -105,18 +156,49 @@ def run_checkpointed(run_fn, key, params, world, n_rounds: int, path: str,
                 f"current {meta!r} — refusing to resume a different run"
             )
         meta = saved_meta
-        # Reload the already-produced metric chunks.
-        r0, upto = 0, start_round
-        while r0 < upto:
-            mpath = _metrics_path(path, min(r0 + chunk, upto))
-            if not os.path.exists(mpath):
-                break  # older run used a different chunking; traces partial
-            with np.load(mpath) as z:
-                metrics_chunks.append({k: z[k] for k in z.files})
-            r0 += chunk
+        # Reload the already-produced metric chunks, discovering their
+        # boundaries from the files on disk (chunk ends need not sit on a
+        # fixed grid — a previous run's final chunk may have been short).
+        covered = 0
+        for upto in _metric_rounds_on_disk(path):
+            if upto > start_round:
+                break
+            with np.load(_metrics_path(path, upto)) as z:
+                mchunk = {k: z[k] for k in z.files}
+            n_in_chunk = len(next(iter(mchunk.values())))
+            if covered + n_in_chunk != upto:
+                # Trace files are written contiguously, so an interior hole
+                # can only come from an out-of-band deletion.  Returning a
+                # list with a silent gap would misalign every round-indexed
+                # consumer — refuse instead.
+                raise ValueError(
+                    f"metric traces covering rounds [{covered}, "
+                    f"{upto - n_in_chunk}) are missing next to {path!r} — "
+                    f"a trace file was deleted out-of-band; restore it or "
+                    f"delete the checkpoint to start over"
+                )
+            metrics_chunks.append(mchunk)
+            covered = upto
+        if covered != start_round:
+            # Same contract for a missing suffix: the trace ending at the
+            # checkpoint cursor is gone (out-of-band deletion, or a
+            # checkpoint from the pre-round-3 write order interrupted
+            # between its checkpoint and trace writes).
+            raise ValueError(
+                f"metric traces covering rounds [{covered}, {start_round}) "
+                f"are missing next to {path!r} — a trace file was deleted "
+                f"out-of-band; restore it or delete the checkpoint to "
+                f"start over"
+            )
         if log is not None:
             log.info("resumed from %s at round %d (%d metric chunks)",
                      path, start_round, len(metrics_chunks))
+        # Traces past the checkpoint cursor are stale (a preemption landed
+        # between the trace write and the checkpoint write, or leftovers of
+        # a deleted checkpoint) — the rounds they claim will re-run below.
+        _delete_traces_above(path, start_round)
+    else:
+        _delete_traces_above(path, -1)  # fresh run: clear any leftovers
     r = start_round
     while r < n_rounds:
         step = min(chunk, n_rounds - r)
@@ -124,9 +206,13 @@ def run_checkpointed(run_fn, key, params, world, n_rounds: int, path: str,
                                 state=state, start_round=r)
         jax.block_until_ready(state.status)
         r += step
+        # Trace first, checkpoint second: a preemption between the two
+        # re-runs this chunk on resume and deterministically overwrites the
+        # orphaned trace (runs are bit-reproducible), so resumed traces
+        # never have a hole.  Both writes are atomic.
+        _atomic_savez(_metrics_path(path, r),
+                      {k: np.asarray(v) for k, v in metrics.items()})
         save(path, state, r, key=key, meta=meta)
-        np.savez(_metrics_path(path, r),
-                 **{k: np.asarray(v) for k, v in metrics.items()})
         metrics_chunks.append(metrics)
         if log is not None:
             log.info("checkpointed round %d/%d", r, n_rounds)

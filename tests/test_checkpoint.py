@@ -92,6 +92,105 @@ def test_resume_meta_mismatch_refuses(tmp_path):
         )
 
 
+def test_resume_with_different_chunk_reloads_all_traces(tmp_path):
+    """Re-chunking an interrupted run is safe: trace boundaries are
+    discovered from disk, so the reloaded list still covers every round."""
+    params, world = make(8)
+    key = jax.random.key(6)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.run_checkpointed(
+        swim.run, key, params, world, 10, path, chunk=5
+    )
+    final, chunks = checkpoint.run_checkpointed(
+        swim.run, key, params, world, 22, path, chunk=4
+    )
+    total = sum(len(np.asarray(c["alive"])) for c in chunks)
+    assert total == 22  # 5+5 reloaded, then 4+4+4 re-chunked
+    unbroken, _ = swim.run(key, params, world, 22)
+    np.testing.assert_array_equal(
+        np.asarray(unbroken.status), np.asarray(final.status)
+    )
+
+
+def test_json_lossy_meta_resumes(tmp_path):
+    """JSON-lossy meta values (tuples, int keys) must not spuriously refuse
+    a legitimate resume: both sides normalize through a JSON round-trip."""
+    params, world = make(8)
+    key = jax.random.key(7)
+    path = str(tmp_path / "ckpt.npz")
+    meta = {"shape": (8, 4), "knobs": {1: "a"}}
+    checkpoint.run_checkpointed(
+        swim.run, key, params, world, 10, path, chunk=5, meta=meta
+    )
+    _, chunks = checkpoint.run_checkpointed(
+        swim.run, key, params, world, 20, path, chunk=5, meta=meta
+    )
+    assert len(chunks) == 4  # 2 reloaded + 2 run
+
+
+def test_extension_past_nonaligned_end_reloads_all_traces(tmp_path):
+    """A run whose n_rounds is not a multiple of chunk writes a short final
+    chunk; extending and resuming must still reload every trace file (the
+    boundaries are discovered from disk, not assumed grid-aligned)."""
+    params, world = make(8)
+    key = jax.random.key(8)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.run_checkpointed(swim.run, key, params, world, 12, path, chunk=5)
+    checkpoint.run_checkpointed(swim.run, key, params, world, 20, path, chunk=5)
+    _, chunks = checkpoint.run_checkpointed(
+        swim.run, key, params, world, 20, path, chunk=5
+    )
+    total = sum(len(np.asarray(c["alive"])) for c in chunks)
+    assert total == 20  # rounds [0, 20) fully covered: 5+5+2+5+3
+
+
+def test_interior_trace_hole_raises(tmp_path):
+    """An out-of-band deletion of a mid-prefix trace file must raise on
+    resume — returning a list with a silent gap would misalign every
+    round-indexed consumer."""
+    params, world = make(8)
+    key = jax.random.key(12)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.run_checkpointed(swim.run, key, params, world, 15, path, chunk=5)
+    os.unlink(checkpoint._metrics_path(path, 10))
+    with pytest.raises(ValueError, match="deleted out-of-band"):
+        checkpoint.run_checkpointed(
+            swim.run, key, params, world, 20, path, chunk=5
+        )
+
+
+def test_missing_suffix_trace_raises(tmp_path):
+    """Deleting the trace that ends at the checkpoint cursor must also
+    raise — a suffix gap misaligns consumers just like an interior one."""
+    params, world = make(8)
+    key = jax.random.key(13)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.run_checkpointed(swim.run, key, params, world, 15, path, chunk=5)
+    os.unlink(checkpoint._metrics_path(path, 15))
+    with pytest.raises(ValueError, match="deleted out-of-band"):
+        checkpoint.run_checkpointed(
+            swim.run, key, params, world, 20, path, chunk=5
+        )
+
+
+def test_orphan_trace_beyond_cursor_is_rewritten(tmp_path):
+    """A preemption between the trace write and the checkpoint write leaves
+    an orphan trace past the cursor; resume must discard it and re-run the
+    chunk (bit-reproducible), not reload the orphan."""
+    params, world = make(8)
+    key = jax.random.key(9)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.run_checkpointed(swim.run, key, params, world, 10, path, chunk=5)
+    checkpoint._atomic_savez(
+        checkpoint._metrics_path(path, 15), {"alive": np.zeros((5, 1))}
+    )
+    _, chunks = checkpoint.run_checkpointed(
+        swim.run, key, params, world, 15, path, chunk=5
+    )
+    assert len(chunks) == 3
+    assert np.asarray(chunks[-1]["alive"]).sum() > 0  # re-run, not the fake
+
+
 def test_atomic_write_leaves_no_tmp(tmp_path):
     params, world = make(8)
     state = swim.initial_state(params, world)

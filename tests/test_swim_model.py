@@ -228,12 +228,14 @@ class TestFalsePositiveSplit:
 
         stale = np.asarray(m["stale_view_rounds"]).sum()
         onsets = np.asarray(m["false_suspicion_onsets"]).sum()
+        suspect_live = np.asarray(m["false_suspect_rounds"]).sum()
         fp = np.asarray(m["false_positives"]).sum()
         # Lossless: the only FP phenomenon is the post-revival stale-DEAD
         # window, so it accounts for the whole aggregate and no
         # false-suspicion onset ever fires.
         assert stale > 0, "revival produced no stale-view window"
         assert onsets == 0
+        assert fp == stale + suspect_live  # exact status partition
         assert fp == stale
 
     def test_loss_false_suspicions_are_onsets_not_stale(self):
@@ -242,12 +244,40 @@ class TestFalsePositiveSplit:
         params, world = make(32, loss=0.3, suspicion_rounds=10_000)
         _, m = swim.run(jax.random.key(21), params, world, 150)
         onsets = np.asarray(m["false_suspicion_onsets"]).sum()
+        suspect_live = np.asarray(m["false_suspect_rounds"]).sum()
         stale = np.asarray(m["stale_view_rounds"]).sum()
         fp = np.asarray(m["false_positives"]).sum()
         assert onsets > 0, "30% loss produced no false suspicions"
         assert stale == 0
+        assert fp == suspect_live  # every FP round holds SUSPECT here
         # Each onset event holds SUSPECT for >= 1 observer-round.
         assert fp >= onsets
+
+    @pytest.mark.parametrize("delivery", ["scatter", "shift"])
+    def test_quick_revival_suspect_rounds_partition(self, delivery):
+        """A member that revives before its suspicion matures to DEAD
+        leaves observers holding SUSPECT about a live subject: those rounds
+        are false_suspect_rounds (not onsets — the transition happened
+        while the subject was down; not stale — never DEAD), and the
+        aggregate still partitions exactly."""
+        n = 10
+        params, world = make(n, delivery=delivery)
+        # Down long enough to get suspected, revived well before the
+        # suspicion_rounds timeout matures the SUSPECT to DEAD.
+        down_from = 5
+        down_until = down_from + params.ping_every * n + 2
+        assert down_until - down_from < params.suspicion_rounds + down_from
+        world = world.with_crash(2, at_round=down_from,
+                                 until_round=down_until)
+        _, m = swim.run(jax.random.key(22), params, world, down_until + 120)
+        onsets = np.asarray(m["false_suspicion_onsets"]).sum()
+        suspect_live = np.asarray(m["false_suspect_rounds"]).sum()
+        stale = np.asarray(m["stale_view_rounds"]).sum()
+        fp = np.asarray(m["false_positives"]).sum()
+        assert fp == suspect_live + stale  # exact status partition
+        if fp > 0:  # suspicion arose before revival in this seed
+            assert suspect_live > 0
+            assert onsets == 0
 
 
 class TestDeterminism:
@@ -292,7 +322,8 @@ class TestAggregateMetricsPath:
         _, m_ps = swim.run(key, params_ps, world, 80)
         _, m_agg = swim.run(key, params_agg, world, 80)
         for name in ("alive", "suspect", "dead", "absent", "false_positives",
-                     "false_suspicion_onsets", "stale_view_rounds"):
+                     "false_suspicion_onsets", "false_suspect_rounds",
+                     "stale_view_rounds"):
             np.testing.assert_array_equal(
                 np.asarray(m_ps[name]).sum(axis=1), np.asarray(m_agg[name])
             )
