@@ -71,10 +71,20 @@ def init_backend():
 
 
 def timed_run(jax, n_members, rounds, label):
-    """Compile + steady-state-time a run; returns member-rounds/sec."""
+    """Compile + steady-state-time a run; returns member-rounds/sec.
+
+    The timed region is wrapped in ``runlog.profiled`` — a no-op unless
+    ``SCALECUBE_TPU_PROFILE_DIR`` is set, in which case a ``jax.profiler``
+    step trace lands there (the input to experiments/profile_roofline.py's
+    kernel table), and the run's protocol counters are digested through
+    ``runlog.log_metrics_summary`` (the reference-style per-period logs,
+    SURVEY.md §5.1).
+    """
     from scalecube_cluster_tpu.config import ClusterConfig
     from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.utils import runlog
 
+    rlog = runlog.get_logger("bench")
     params = swim.SwimParams.from_config(
         ClusterConfig.default(),
         n_members=n_members,
@@ -96,14 +106,16 @@ def timed_run(jax, n_members, rounds, label):
     log(f"{label}: compile+first-run took {time.perf_counter() - t0:.1f}s")
 
     t0 = time.perf_counter()
-    state, metrics = swim.run(
-        key, params, world, rounds, state=state, start_round=rounds
-    )
-    jax.block_until_ready(state.status)
+    with runlog.profiled(rlog):
+        state, metrics = swim.run(
+            key, params, world, rounds, state=state, start_round=rounds
+        )
+        jax.block_until_ready(state.status)
     elapsed = time.perf_counter() - t0
     rate = n_members * rounds / elapsed
     log(f"{label}: {rounds} rounds in {elapsed:.3f}s -> {rate:.3e} "
         f"member-rounds/sec")
+    runlog.log_metrics_summary(rlog, metrics, round_offset=rounds)
     # Sanity: the crash at round 50 must eventually be noticed.
     dead_total = int(jax.numpy.asarray(metrics["dead"]).sum())
     log(f"{label}: dead-view observer-rounds in window: {dead_total}")

@@ -74,8 +74,12 @@ def main():
             os.unlink(os.path.join("artifacts", f))
 
     t0 = time.perf_counter()
+    # Passing the initial state explicitly keeps every chunk on ONE
+    # compiled trace (state=None would trace chunk 1 without a state
+    # argument and chunk 2 with one — two ~45s compiles instead of one).
     final, chunks = checkpoint.run_checkpointed(
         swim.run, key, params, world, ROUNDS, ckpt, chunk=2_500,
+        state=swim.initial_state(params, world),
         meta={"n": N, "rounds": ROUNDS}, log=log,
     )
     jax.block_until_ready(final.status)

@@ -312,12 +312,25 @@ def link_eval(faults: LinkFaults, round_idx, src_ids, dst_ids,
     broadcast shape.  Vectorizes NetworkEmulator.resolveLinkSettings +
     NetworkLinkSettings.evaluate{Loss,Delay}
     (transport/NetworkEmulator.java:60-97, NetworkLinkSettings.java:54-74).
+
+    When every delay is STATICALLY zero (no fault rules and a zero default,
+    both compile-time facts), the delay result is ``None``: downstream
+    consumers (_chain_ok, _route_delayed) then skip the exponential
+    delay sampling entirely.  XLA cannot fold ``-log1p(-u) * 0`` to zero
+    itself (0·x is unsafe for non-finite x), and at 1M members the dead
+    sampling is tens of millions of transcendentals per FD round.
     """
     src_ids = jnp.asarray(src_ids, jnp.int32)
     dst_ids = jnp.asarray(dst_ids, jnp.int32)
     shape = jnp.broadcast_shapes(src_ids.shape, dst_ids.shape)
     loss = jnp.full(shape, default_loss, dtype=jnp.float32)
-    delay = jnp.full(shape, default_delay_ms, dtype=jnp.float32)
+    static_zero_delay = (
+        faults.n_rules == 0
+        and isinstance(default_delay_ms, (int, float))
+        and float(default_delay_ms) == 0.0
+    )
+    delay = (None if static_zero_delay
+             else jnp.full(shape, default_delay_ms, dtype=jnp.float32))
     for r in range(faults.n_rules):  # static unroll; last match wins
         match = (
             (src_ids >= faults.src_lo[r]) & (src_ids < faults.src_hi[r])
@@ -497,6 +510,9 @@ class SwimState:
 
     ``spread_until``    [N, K] int32: gossip retransmission window for the
                         current record (GossipState.infectionPeriod analog).
+                        (A remaining-rounds int8 form was tried and measured
+                        SLOWER at 1M — narrow-int carry lanes cost more in
+                        the merge fusion than the saved bandwidth.)
     ``suspect_deadline`` [N, K] int32: round at which a SUSPECT entry is
                         declared DEAD (suspicionTimeoutTasks analog,
                         MembershipProtocolImpl.java:96,597-606); INT32_MAX
@@ -584,14 +600,34 @@ def _chain_ok(key, hop_losses: Sequence[jnp.ndarray],
     per-hop (possibly per-link, from link_eval) loss/delay and a shared
     millisecond budget (the reference's Reactor ``.timeout(duration)``,
     FailureDetectorImpl.java:152).
+
+    A hop's delay mean may be ``None`` (statically zero — link_eval
+    docstring): that hop contributes no delay and no exponential sample.
+    With every hop static-zero the whole chain collapses to ONE Bernoulli
+    draw against the product of per-hop success probabilities — exact,
+    because the per-hop losses are independent (each message's loss is an
+    independent event in the reference emulator too,
+    NetworkEmulator.java:60-97), and the all-hops-succeed probability of
+    independent events is their product.  This cuts the FD probe's
+    per-round PRNG volume ~7x at 1M members (threefry bits are the
+    dominant probe cost on TPU, not the comparisons).
     """
     n_hops = len(hop_losses)
-    u = jax.random.uniform(key, (*shape, 2 * n_hops))
+    delayed = [h for h in range(n_hops) if hop_delay_means[h] is not None]
+    if not delayed:
+        p_chain = jnp.ones(shape, dtype=jnp.float32)
+        for h in range(n_hops):
+            p_chain = p_chain * (1.0 - hop_losses[h])
+        return jax.random.uniform(key, shape) < p_chain
+    u = jax.random.uniform(key, (*shape, n_hops + len(delayed)))
     ok = jnp.ones(shape, dtype=jnp.bool_)
-    total_delay = jnp.zeros(shape, dtype=jnp.float32)
     for h in range(n_hops):
-        ok &= u[..., 2 * h] >= hop_losses[h]
-        total_delay += -jnp.log1p(-u[..., 2 * h + 1]) * hop_delay_means[h]
+        ok &= u[..., h] >= hop_losses[h]
+    total_delay = jnp.zeros(shape, dtype=jnp.float32)
+    for j, h in enumerate(delayed):
+        total_delay += (
+            -jnp.log1p(-u[..., n_hops + j]) * hop_delay_means[h]
+        )
     return ok & (total_delay <= budget_ms)
 
 
@@ -627,9 +663,10 @@ def _route_delayed(ok, delivered, delivered_flags, delay_mean, key, params,
     Returns (ok_now, ring, fring): ``ok_now`` masks the messages arriving
     this round; later quantized offsets are max/or-merged into the ring.
     Shared by the gossip, SYNC, and refute channels so the binning and
-    slot arithmetic exist once.
+    slot arithmetic exist once.  ``delay_mean is None`` (statically zero,
+    link_eval docstring) means everything arrives this round.
     """
-    if params.max_delay_rounds == 0:
+    if params.max_delay_rounds == 0 or delay_mean is None:
         return ok, ring, fring
     q = ring_ops.delay_bins(key, delay_mean, params.round_ms,
                             params.max_delay_rounds, ok.shape)
@@ -645,7 +682,13 @@ def _route_delayed(ok, delivered, delivered_flags, delay_mean, key, params,
 
 
 def _entry_at_slot(mat, slot, k):
-    """mat[i, slot[i]] via a one-hot reduce over K (elementwise, no gather)."""
+    """mat[i, slot[i]] via a one-hot reduce over K (elementwise, no gather).
+
+    Standalone, a ``take_along_axis`` row-local gather micro-benchmarks
+    2x faster — but inside the scanned tick it de-optimizes the whole
+    round (measured 4.3 -> 10+ ms/round at 1M): the gather forces layout
+    changes on the [N, K] operands that cascade into every neighboring
+    fusion.  Keep the branch-free one-hot form."""
     onehot = jnp.arange(k, dtype=jnp.int32)[None, :] == slot[:, None]
     return jnp.max(jnp.where(onehot, mat, mat.dtype.type(0)), axis=1)
 
@@ -898,16 +941,18 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
     return new_state, refuted
 
 
-def _send_payloads(state, status, inc, round_idx, params, world,
-                   node_ids, is_self):
-    """(gossip_keys, sync_keys) — what each sender transmits this round.
+def _send_components(state, status, inc, round_idx, params, world,
+                     node_ids, is_self):
+    """(record_keys, hot, syncable) — one payload, two transmit masks.
 
     Gossip carries hot records (changed within the spread window; DEAD
     tombstones transmit their death notice, GossipProtocolImpl.java:239-250).
     A gracefully leaving node's final-round gossip carries its own DEAD
     record at incarnation+1 (leaveCluster, MembershipProtocolImpl.java:197-206).
     SYNC pushes the full row minus tombstones (the reference table holds no
-    DEAD records, so SYNC never carries them).
+    DEAD records, so SYNC never carries them) — masked on the sender's
+    TABLE status, not the key's DEAD bit: a leaver's key carries DEAD@inc+1
+    while its table row is pinned ALIVE, and that record must still sync.
     """
     leaving_now = (world.leave_at[node_ids] == round_idx)[:, None] & is_self
     hot = (status != records.ABSENT) & (round_idx < state.spread_until)
@@ -917,10 +962,20 @@ def _send_payloads(state, status, inc, round_idx, params, world,
         jnp.int8(records.DEAD), state.self_inc[:, None] + 1
     )
     record_keys = jnp.where(leaving_now, leave_key, record_keys)
-    gossip_keys = jnp.where(hot, record_keys, delivery.NO_MESSAGE)
-    sync_keys = jnp.where(
-        status == records.DEAD, delivery.NO_MESSAGE, record_keys
+    syncable = status != records.DEAD
+    return record_keys, hot, syncable
+
+
+def _send_payloads(state, status, inc, round_idx, params, world,
+                   node_ids, is_self):
+    """(gossip_keys, sync_keys) — the masked per-channel payload matrices
+    (scatter mode materializes both; shift mode ships the shared key buffer
+    plus the int8 masks instead — see _tick_shift)."""
+    record_keys, hot, syncable = _send_components(
+        state, status, inc, round_idx, params, world, node_ids, is_self
     )
+    gossip_keys = jnp.where(hot, record_keys, delivery.NO_MESSAGE)
+    sync_keys = jnp.where(syncable, record_keys, delivery.NO_MESSAGE)
     return gossip_keys, sync_keys
 
 
@@ -1113,14 +1168,17 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
         inbox, inbox_alive8 = channel_bufs(False, False)
         inbox_alive = inbox_alive8.astype(jnp.bool_)
     else:
-        q_g = ring_ops.delay_bins(
-            jax.random.fold_in(k_gossip_drop, 7), delay_g,
-            params.round_ms, params.max_delay_rounds,
-            (n_local, params.fanout))
-        q_s = ring_ops.delay_bins(
-            jax.random.fold_in(k_sync_drop, 7), delay_s,
-            params.round_ms, params.max_delay_rounds,
-            (n_local,))[:, None]
+        # delay None = statically zero (link_eval docstring): bin 0 always.
+        q_g = (jnp.zeros((n_local, params.fanout), jnp.int32)
+               if delay_g is None else ring_ops.delay_bins(
+                   jax.random.fold_in(k_gossip_drop, 7), delay_g,
+                   params.round_ms, params.max_delay_rounds,
+                   (n_local, params.fanout)))
+        q_s = (jnp.zeros((n_local,), jnp.int32)
+               if delay_s is None else ring_ops.delay_bins(
+                   jax.random.fold_in(k_sync_drop, 7), delay_s,
+                   params.round_ms, params.max_delay_rounds,
+                   (n_local,)))[:, None]
         inbox, inbox_alive8 = channel_bufs(q_g != 0, q_s != 0)
         inbox = jnp.maximum(inbox, inbox_now)
         inbox_alive = inbox_alive8.astype(jnp.bool_) | flags_now
@@ -1184,11 +1242,12 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     d_ids = eng.prep_replicated(jnp.arange(n, dtype=jnp.int32))
 
     # ---- Phase 1: failure detector probe --------------------------------
-    # The whole probe (target/proxy lookups, per-hop loss/delay chains)
-    # only runs on fd rounds: lax.cond skips ~2ms/round of work on the
-    # other ping_every-1 rounds at 1M members.  (Under vmap sweeps the
-    # cond lowers to select and both branches run - correct, just without
-    # the saving.)
+    # The probe runs every round and its verdicts are masked by fd_round.
+    # A lax.cond gate looks cheaper but measures WORSE at 1M members: the
+    # conditional's operand/result tupling costs ~1 ms/round on TPU even
+    # when the branch never fires, while the probe body itself (uniform
+    # draws + [N]-vector chains) is ~0.3 ms — and under vmap sweeps a cond
+    # lowers to select-both-branches anyway.
     def fd_phase(_):
         t = eng.look_replicated(d_ids, fd_shift)        # [n_local] targets
         alive_t = eng.look_replicated(d_alive, fd_shift)
@@ -1249,19 +1308,14 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
                 & (ps != fd_shift)                           # proxy != target
             )
             ack_ok = ack_ok | ok_pr
-        active = has_target & alive_here
+        active = fd_round & has_target & alive_here
         suspect_v = active & ~ack_ok
         refute_v = active & ack_ok & (entry_t_status == records.SUSPECT)
         return (suspect_v, refute_v, active,
                 jnp.maximum(slot, 0), entry_t_inc)
 
-    def fd_skip(_):
-        zb = jnp.zeros((n_local,), jnp.bool_)
-        zi = jnp.zeros((n_local,), jnp.int32)
-        return zb, zb, zb, zi, zi
-
     (verdict_suspect, push_refute, probe_active, slot_safe,
-     entry_t_inc) = jax.lax.cond(fd_round, fd_phase, fd_skip, 0)
+     entry_t_inc) = fd_phase(0)
 
     fd_slot_onehot = (
         jnp.arange(k, dtype=jnp.int32)[None, :] == slot_safe[:, None]
@@ -1276,7 +1330,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     )
 
     # ---- Phase 2 + 3: gossip and SYNC sends ------------------------------
-    gossip_keys, sync_keys = _send_payloads(
+    record_keys, hot, syncable = _send_components(
         state, status, inc, round_idx, params, world, node_ids, is_self
     )
 
@@ -1285,12 +1339,37 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     # per-link loss) evaluate at the receiver via shifted views, which is
     # distribution-identical and keeps everything contiguous.  Sharded
     # payloads travel by block-rotation ppermutes (ops/shift.ShiftEngine).
-    h_gossip = eng.prep(gossip_keys)                      # [2N, K] or local
-    h_sync = eng.prep(sync_keys)
-    h_gossip_alive = eng.prep(delivery.is_alive_key(gossip_keys).astype(jnp.int8))
-    h_sync_alive = eng.prep(delivery.is_alive_key(sync_keys).astype(jnp.int8))
-    h_hot_any = eng.prep(jnp.any(gossip_keys >= 0, axis=1))
+    #
+    # HBM economy: every channel ships the SAME packed-key buffer; gossip
+    # and SYNC differ only by their sender-side transmit masks (hot window
+    # / not-table-DEAD), which travel as int8 — 4x narrower than a second
+    # masked int32 copy of the keys.  The per-message ALIVE gate needs no
+    # buffer at all: it is a pure function of the delivered key bits
+    # (delivery.is_alive_key), and in shift mode each channel's delivered
+    # key IS the individual message (unlike scatter mode, where the
+    # scatter-max folds messages and the gate must be scattered
+    # separately).
+    h_keys = eng.prep(record_keys)                        # [2N, K] or local
+    # Both transmit masks ride one int8 buffer (bit 0 = hot, bit 1 =
+    # syncable): halves the doubled-mask writes and lets a channel fetch
+    # its mask with one slice.
+    h_tx = eng.prep(hot.astype(jnp.int8) | (syncable.astype(jnp.int8) << 1))
+    h_hot_any = eng.prep(jnp.any(hot, axis=1))
     h_status = eng.prep(status) if gate_contacts else None
+
+    def deliver_channel(s, tx_bit):
+        """(payload, alive-flags) of the channel at shift ``s`` whose
+        transmit mask is ``tx_bit`` of the packed mask buffer."""
+        keys = eng.deliver(h_keys, s)
+        tx = (eng.deliver(h_tx, s) & tx_bit) != 0
+        payload = jnp.where(tx, keys, delivery.NO_MESSAGE)
+        return payload, delivery.is_alive_key(payload)
+
+    def deliver_gossip(s):
+        return deliver_channel(s, 1)
+
+    def deliver_sync(s):
+        return deliver_channel(s, 2)
 
     drop_u = jax.random.uniform(k_gossip_drop, (n_local, f + 1))
 
@@ -1328,8 +1407,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
                 | (sender_knows == records.SUSPECT)
                 | is_seed(node_ids)
             )
-        delivered = eng.deliver(h_gossip, s)              # [n_local, K]
-        delivered_flags = eng.deliver(h_gossip_alive, s).astype(jnp.bool_)
+        delivered, delivered_flags = deliver_gossip(s)    # [n_local, K]
         ok_now, ring, fring = _route_delayed(
             ok_c, delivered, delivered_flags, delay_c,
             jax.random.fold_in(k_gossip_drop, 11 + c), params,
@@ -1347,13 +1425,16 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     # matching MembershipProtocolImpl.java:379-391 and the scatter path) to
     # the suspected member t = (i + fd_shift); at the receiver that is the
     # sender (j - fd_shift).  Only fd rounds with the sync channel enabled
-    # can produce push_refute, so the whole delivery (payload prep + block
-    # exchange + link draws) is cond-gated with the probe.  The cond also
-    # reports which senders are refuting as seen through the sync shift, so
-    # the regular sync channel below can suppress them — in scatter mode the
-    # refute push REPLACES the sender's regular sync target (do_sync
-    # override), and without the suppression shift mode would emit one
-    # extra message per refuting sender.
+    # can produce push_refute (masked below), so on other rounds the
+    # delivery contributes nothing — it still executes (same no-cond
+    # rationale as the probe above).  It also reports which senders are
+    # refuting as seen through the sync shift, so the regular sync channel
+    # below can suppress them — in scatter mode the refute push REPLACES
+    # the sender's regular sync target (do_sync override), and without the
+    # suppression shift mode would emit one extra message per refuting
+    # sender.
+    push_refute = push_refute & (kn.sync_every > 0)
+
     def refute_deliver(rf):
         ring_, fring_ = rf
         h_pushers = eng.prep(push_refute)
@@ -1371,8 +1452,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             & (jax.random.uniform(k_sync_drop, (n_local,)) >= loss_r)
         )
         ok_r = ok_r & eng.deliver(h_pushers, fd_shift)
-        delivered_r = eng.deliver(h_sync, fd_shift)
-        flags_r = eng.deliver(h_sync_alive, fd_shift).astype(jnp.bool_)
+        delivered_r, flags_r = deliver_sync(fd_shift)
         ok_r_now, ring_, fring_ = _route_delayed(
             ok_r, delivered_r, flags_r, delay_r,
             jax.random.fold_in(k_sync_drop, 13), params, ring_, fring_,
@@ -1384,17 +1464,8 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         return contrib, fcontrib, ring_, fring_, \
             eng.deliver(h_pushers, sync_shift)
 
-    def refute_skip(rf):
-        ring_, fring_ = rf
-        return (jnp.full((n_local, k), delivery.NO_MESSAGE, jnp.int32),
-                jnp.zeros((n_local, k), jnp.bool_),
-                ring_, fring_,
-                jnp.zeros((n_local,), jnp.bool_))
-
-    refute_contrib, refute_flags, ring, fring, sender_refuting = jax.lax.cond(
-        fd_round & (kn.sync_every > 0), refute_deliver, refute_skip,
-        (ring, fring)
-    )
+    refute_contrib, refute_flags, ring, fring, sender_refuting = \
+        refute_deliver((ring, fring))
     inbox = jnp.maximum(inbox, refute_contrib)
     inbox_alive |= refute_flags
 
@@ -1422,8 +1493,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             | (sender_knows == records.SUSPECT)
             | is_seed(node_ids)
         )
-    delivered = eng.deliver(h_sync, s)
-    delivered_flags = eng.deliver(h_sync_alive, s).astype(jnp.bool_)
+    delivered, delivered_flags = deliver_sync(s)
     ok_s_now, ring, fring = _route_delayed(
         ok_s, delivered, delivered_flags, delay_sy,
         jax.random.fold_in(k_sync_drop, 11), params, ring, fring, slot0,

@@ -1,0 +1,103 @@
+"""Per-round collective-traffic model for the sharded SWIM tick.
+
+SURVEY.md §5.8 promises an accounting of what the two delivery modes move
+over ICI when the member rows are sharded across ``D`` devices
+(``parallel/mesh.py``); this module is that accounting as executable
+formulas, pinned to the actual tick by ``tests/test_traffic.py`` (which
+counts the block exchanges the tick really performs against
+:func:`shift_exchanges_per_round`).
+
+Shift mode (ops/shift.ShiftEngine)
+----------------------------------
+Every sharded ``deliver`` moves the device's whole local block twice
+(rotations by ``d`` and ``d+1`` blocks — ShiftEngine docstring), i.e.
+``2 * n_local * row_bytes`` sent per device per exchange, neighbor-to-
+neighbor.  The tick performs, per round:
+
+  - ``fanout + 2`` payload channels (gossip channels, SYNC, refute push),
+    each delivering the packed-key buffer (``4K`` B/row) and the packed
+    int8 transmit-mask buffer (``K`` B/row);
+  - per gossip channel, the [N] hot-sender flags for message counting
+    (1 B/row), and 2 deliveries of the [N] refuting-sender flags;
+  - full-view contact gating adds one status delivery (``K`` B/row) per
+    payload channel (models/swim._tick_shift ``gate_contacts``).
+
+Per-device ICI bytes therefore scale as **O(n_local * K)** — they *shrink*
+as devices are added at fixed N, so the shift path weak-scales: doubling
+D halves both the per-device compute and the per-device ICI traffic.
+
+Scatter mode (ops/delivery + lax.pmax)
+--------------------------------------
+The inbox combine is a ``pmax`` over the full-height [N, K] int32
+contribution buffer plus the int8 ALIVE-flag buffer (2 collectives per
+round with delay modeling off; each extra delay bin adds 2 more).  A ring
+all-reduce sends ``2 * (D-1)/D * size`` per device, i.e. per-device ICI
+bytes are **O(N * K) — constant in D**.  Scatter mode is the validation
+path; at scale the shift path's advantage grows linearly in D.
+
+DCN note: block rotations are neighbor exchanges on the device ring, so
+on a multi-slice mesh only the rotations that cross a slice boundary pay
+DCN — 2 boundary crossings per exchange regardless of D, giving per-device
+DCN bytes ~ ``(2/D)`` of the ICI figure.  The scatter pmax is a full
+all-reduce and pays DCN proportional to its whole buffer.  The crossover
+is therefore immediate: for any D >= 2 the shift path moves less per
+device, and the gap grows as D (matching the reference seam it replaces —
+per-message TCP in TransportImpl.java:257-269 scales per-node traffic
+with cluster-wide message volume, not cluster size).
+"""
+
+from __future__ import annotations
+
+INT32 = 4
+INT8 = 1
+
+
+def shift_exchanges_per_round(params, gate_contacts: bool = False):
+    """Sharded block exchanges (ShiftEngine.deliver calls) per tick.
+
+    Returns a dict of exchange-name -> row_bytes; the exchange count is
+    its length.  Pinned to models/swim._tick_shift by tests/test_traffic.py.
+    """
+    k = params.n_subjects
+    channels = params.fanout + 2            # gossip channels + SYNC + refute
+    exchanges = {}
+    for c in range(channels):
+        exchanges[f"keys[{c}]"] = k * INT32
+        exchanges[f"txmask[{c}]"] = k * INT8
+    for c in range(params.fanout):          # gossip message counting
+        exchanges[f"hot_any[{c}]"] = INT8
+    exchanges["refuting_senders@fd"] = INT8      # h_pushers at fd_shift
+    exchanges["refuting_senders@sync"] = INT8    # h_pushers at sync_shift
+    if gate_contacts:
+        for c in range(channels - 1):       # refute push skips the gate
+            exchanges[f"status_gate[{c}]"] = k * INT8
+    return exchanges
+
+
+def shift_ici_bytes_per_device_round(params, n_devices: int,
+                                     gate_contacts: bool = False) -> int:
+    """Bytes each device sends over ICI per round, shift mode.
+
+    2 block rotations of [n_local, ...] per exchange (ShiftEngine
+    docstring; rotation distance 0 still counted — upper bound).
+    """
+    n_local = params.n_members // n_devices
+    per_row = sum(shift_exchanges_per_round(params, gate_contacts).values())
+    return 2 * n_local * per_row
+
+
+def scatter_collectives_per_round(params) -> int:
+    """Full-height pmax combines per tick, scatter mode (delay off: the
+    key buffer + the ALIVE-flag buffer; each delay bin doubles that)."""
+    bins = params.max_delay_rounds + 1 if params.max_delay_rounds > 0 else 1
+    return 2 * bins
+
+
+def scatter_ici_bytes_per_device_round(params, n_devices: int) -> int:
+    """Bytes each device sends over ICI per round, scatter mode: ring
+    all-reduce cost 2*(D-1)/D * buffer over the [N,K] int32 + int8
+    buffers."""
+    n, k = params.n_members, params.n_subjects
+    bins = params.max_delay_rounds + 1 if params.max_delay_rounds > 0 else 1
+    buffer_bytes = n * k * (INT32 + INT8) * bins
+    return int(2 * (n_devices - 1) / n_devices * buffer_bytes)

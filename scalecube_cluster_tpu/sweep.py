@@ -33,6 +33,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import json
+import warnings
 from typing import Dict, Optional, Sequence
 
 import jax
@@ -122,11 +123,35 @@ def crash_curves(metrics: Dict[str, np.ndarray], subject_slot: int,
     }
 
 
+# Above this N, a vmapped shift-mode sweep degrades to gathers (module
+# docstring performance note) and silently runs orders of magnitude below
+# the un-vmapped shift path.  16k is comfortably inside the regime where
+# the degradation is still minor on one chip.
+SHIFT_VMAP_N_WARN = 16_384
+
+
 def run_crash_sweep(n_members: int, n_rounds: int, config=None, seed: int = 0,
                     delivery: str = "shift",
                     n_subjects: Optional[int] = None,
                     **grid_axes) -> Dict[str, object]:
-    """One-call sweep: crash-at-0 scenario across the knob grid."""
+    """One-call sweep: crash-at-0 scenario across the knob grid.
+
+    Warns when invoked with ``delivery="shift"`` above
+    ``SHIFT_VMAP_N_WARN`` members — the vmapped grid turns shift mode's
+    dynamic-slices into gathers (the docstring trap made operational): for
+    large-N sweeps loop the grid sequentially over one compiled program
+    instead (experiments/northstar.py does exactly this) or use
+    ``delivery="scatter"``.
+    """
+    if delivery == "shift" and n_members > SHIFT_VMAP_N_WARN:
+        warnings.warn(
+            f"vmapped shift-mode sweep at n_members={n_members} > "
+            f"{SHIFT_VMAP_N_WARN}: per-instance dynamic-slices lower to "
+            f"gathers under vmap and run at the slow random-access rate. "
+            f"Loop the grid sequentially over one compiled program "
+            f"(see experiments/northstar.py) or pass delivery='scatter'.",
+            stacklevel=2,
+        )
     config = config or ClusterConfig.default()
     params = swim.SwimParams.from_config(
         config, n_members=n_members, n_subjects=n_subjects,

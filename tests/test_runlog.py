@@ -1,0 +1,55 @@
+"""The observability helpers (utils/runlog.py): profiler hook + counters.
+
+These are live in bench.py (the timed region is wrapped in ``profiled``,
+its metrics digested by ``log_metrics_summary``) and in
+experiments/profile_roofline.py; the tests pin their contracts: the
+profiler hook only activates under SCALECUBE_TPU_PROFILE_DIR and writes a
+real trace, and the summary digests the tick's metric tensors into the
+reference-style counters (SURVEY.md §5.1).
+"""
+
+import logging
+import os
+
+import jax
+import numpy as np
+
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.utils import runlog
+
+from tests.test_swim_model import make
+
+
+def test_log_metrics_summary_digests_counters(caplog):
+    params, world = make(16, loss=0.2)
+    _, metrics = swim.run(jax.random.key(2), params, world, 60)
+    logger = runlog.get_logger("test_runlog")
+    logger.propagate = True  # let caplog's root handler see it
+    with caplog.at_level(logging.INFO, logger="test_runlog"):
+        runlog.log_metrics_summary(logger, metrics, round_offset=0)
+    assert len(caplog.records) == 1
+    msg = caplog.records[0].getMessage()
+    assert "rounds [0, 59]" in msg
+    gossip = int(np.asarray(metrics["messages_gossip"]).sum())
+    pings = int(np.asarray(metrics["messages_ping"]).sum())
+    assert f"gossip msgs {gossip}" in msg
+    assert f"pings {pings}" in msg
+
+
+def test_profiled_noop_without_env(monkeypatch):
+    monkeypatch.delenv("SCALECUBE_TPU_PROFILE_DIR", raising=False)
+    with runlog.profiled():
+        x = jax.numpy.arange(8).sum()
+    assert int(x) == 28
+
+
+def test_profiled_writes_trace_when_env_set(tmp_path, monkeypatch):
+    trace_dir = str(tmp_path / "trace")
+    monkeypatch.setenv("SCALECUBE_TPU_PROFILE_DIR", trace_dir)
+    with runlog.profiled():
+        jax.block_until_ready(jax.numpy.arange(128).sum())
+    produced = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(trace_dir) for f in files
+    ]
+    assert produced, "profiled() wrote no trace files under the env dir"

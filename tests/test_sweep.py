@@ -110,3 +110,23 @@ def test_cli_writes_curve_artifact(tmp_path):
     assert result["n_members"] == 64
     assert len(result["curves"]["detection_rounds"]) == 2  # 2 fanouts
     assert result["analytic"]["periods_to_spread"] > 0
+
+
+def test_shift_vmap_guard_warns_above_threshold(monkeypatch):
+    """The documented vmap-gather trap (sweep.py performance note) is
+    operational: a large-N shift-mode sweep warns; scatter and small-N
+    shift do not."""
+    # Shrink the threshold so the test doesn't need a big compile.
+    monkeypatch.setattr(sweep, "SHIFT_VMAP_N_WARN", 32)
+    with pytest.warns(UserWarning, match="vmapped shift-mode sweep"):
+        sweep.run_crash_sweep(64, 30, config=fast_config(),
+                              fanout=[2, 3])
+    import warnings as _w
+    with _w.catch_warnings():
+        # Only the guard's own message is promoted to an error, so an
+        # unrelated upstream warning can't fail this test spuriously.
+        _w.filterwarnings("error", message=".*vmapped shift-mode sweep.*")
+        sweep.run_crash_sweep(16, 30, config=fast_config(),
+                              fanout=[2, 3])
+        sweep.run_crash_sweep(64, 30, config=fast_config(),
+                              delivery="scatter", fanout=[2, 3])

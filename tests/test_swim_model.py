@@ -179,28 +179,72 @@ class TestRestart:
 class TestFocalMode:
     def test_focal_matches_full_view_statistically(self):
         """Focal mode (K<N) detects a crashed focal subject on the same
-        timescale as full-view mode."""
-        n = 64
-        params_full, world_full = make(n)
-        world_full = world_full.with_crash(0, at_round=0)
-        _, m_full = swim.run(jax.random.key(7), params_full, world_full, 250)
+        timescale as full-view mode.
 
-        params_focal, world_focal = make(n, k=4, ping_known_only=False)
-        world_focal = world_focal.with_crash(0, at_round=0)
-        _, m_focal = swim.run(jax.random.key(7), params_focal, world_focal, 250)
+        Band justified by the measured seed spread (8 seeds, printed on
+        failure): full-view first-full-death rounds {5..10} (median 7),
+        focal {4..7} (median 5) — the medians sit within 3 rounds and no
+        seed pair differs by more than 6.  Round 2's tolerance was
+        [r/3, 2r], loose enough to hide a 1.8x fidelity drift; this is
+        the measured envelope plus one round of slack."""
+        n = 64
+        rs_full, rs_focal = [], []
 
         def first_full_death(metrics):
-            alive_view = np.asarray(metrics["alive"])[:, 0]
-            gone = alive_view == 0
+            gone = np.asarray(metrics["alive"])[:, 0] == 0
             return int(np.argmax(gone)) if gone.any() else -1
 
-        # Both modes must fully disseminate the death; focal pings the
-        # subject at ~the same per-subject rate (uniform over cluster vs
-        # round-robin over known members) so detection rounds are comparable.
-        r_full, r_focal = first_full_death(m_full), first_full_death(m_focal)
-        assert r_full > 0 and r_focal > 0
-        assert r_focal < 2 * max(r_full, 1)
-        assert r_focal > r_full // 3
+        for seed in range(8):
+            params_full, world_full = make(n)
+            world_full = world_full.with_crash(0, at_round=0)
+            _, m_full = swim.run(jax.random.key(seed), params_full,
+                                 world_full, 250)
+            params_focal, world_focal = make(n, k=4, ping_known_only=False)
+            world_focal = world_focal.with_crash(0, at_round=0)
+            _, m_focal = swim.run(jax.random.key(seed), params_focal,
+                                  world_focal, 250)
+            rs_full.append(first_full_death(m_full))
+            rs_focal.append(first_full_death(m_focal))
+
+        spread = list(zip(rs_full, rs_focal))
+        assert all(r > 0 for r in rs_full + rs_focal), spread
+        assert abs(np.median(rs_full) - np.median(rs_focal)) <= 3, spread
+        assert max(abs(a - b) for a, b in spread) <= 7, spread
+
+    def test_detection_K_invariant(self):
+        """Detection/dissemination of a crash is invariant in the tracked-
+        subject count K — the measured envelope at N=4096 is EXACT
+        (detection round 78, dissemination 85, for every K in
+        {8, 64, 512, 4096=full} and every seed tried), so the band here is
+        +-2 rounds.  This is the measured K-invariance curve behind the 1M
+        focal-mode headline (K=16 <<< N)."""
+        n = 4096
+        meds = {}
+        for k in (8, 64, 512, n):
+            det, dis = [], []
+            for seed in range(3):
+                params = swim.SwimParams.from_config(
+                    fast_config(), n_members=n,
+                    n_subjects=(None if k == n else k), delivery="shift",
+                )
+                world = swim.SwimWorld.healthy(params).with_crash(
+                    0, at_round=0
+                )
+                _, m = swim.run(jax.random.key(seed), params, world, 160)
+                deads = np.asarray(m["dead"])[:, 0]
+                alive_view = np.asarray(m["alive"])[:, 0]
+                suspects = np.asarray(m["suspect"])[:, 0]
+                det.append(int(np.flatnonzero(deads > 0)[0]))
+                full = np.flatnonzero(
+                    (alive_view == 0) & (suspects == 0) & (deads > 0)
+                )
+                assert full.size, f"K={k} seed={seed}: never disseminated"
+                dis.append(int(full[0]))
+            meds[k] = (float(np.median(det)), float(np.median(dis)))
+        base = meds[n]  # full view = exact reference semantics
+        for k, (d, s) in meds.items():
+            assert abs(d - base[0]) <= 2, meds
+            assert abs(s - base[1]) <= 2, meds
 
     def test_focal_no_false_positives_lossless(self):
         params, world = make(256, k=8, ping_known_only=False)
@@ -266,7 +310,7 @@ class TestFalsePositiveSplit:
         # suspicion_rounds timeout matures the SUSPECT to DEAD.
         down_from = 5
         down_until = down_from + params.ping_every * n + 2
-        assert down_until - down_from < params.suspicion_rounds + down_from
+        assert down_until - down_from < params.suspicion_rounds
         world = world.with_crash(2, at_round=down_from,
                                  until_round=down_until)
         _, m = swim.run(jax.random.key(22), params, world, down_until + 120)
