@@ -49,6 +49,11 @@ def init_backend():
     """jax.devices() with retries; fall back to CPU if TPU init fails."""
     import jax
 
+    from scalecube_cluster_tpu.utils import runlog
+    cache = runlog.enable_compilation_cache()
+    if cache:
+        log(f"xla compilation cache at {cache}")
+
     last_err = None
     for attempt in range(3):
         try:

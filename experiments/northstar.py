@@ -24,6 +24,7 @@ import numpy as np
 from scalecube_cluster_tpu.config import ClusterConfig
 from scalecube_cluster_tpu.models import swim
 from scalecube_cluster_tpu.utils import checkpoint, get_logger
+from scalecube_cluster_tpu.utils.runlog import enable_compilation_cache
 
 N = 1_000_000
 K = 16
@@ -33,6 +34,7 @@ LEAVE_NODE, LEAVE_AT = 5, 2_000
 REVIVE_NODE, REVIVE_DOWN, REVIVE_UP = 7, 4_000, 7_000
 
 log = get_logger("northstar")
+enable_compilation_cache(log)
 
 
 def first(cond, default=-1):

@@ -44,6 +44,7 @@ import numpy as np
 from scalecube_cluster_tpu.config import ClusterConfig
 from scalecube_cluster_tpu.models import swim
 from scalecube_cluster_tpu.utils import get_logger
+from scalecube_cluster_tpu.utils.runlog import enable_compilation_cache
 
 N = int(os.environ.get("SCALECUBE_PROFILE_N", 1_000_000))
 K = int(os.environ.get("SCALECUBE_PROFILE_K", 16))
@@ -52,6 +53,7 @@ ROUNDS = int(os.environ.get("SCALECUBE_PROFILE_ROUNDS", 200))
 HBM_PEAK_GBPS = float(os.environ.get("SCALECUBE_HBM_PEAK_GBPS", 819.0))
 
 log = get_logger("roofline")
+enable_compilation_cache(log)
 
 
 def traffic_model(n, k, fanout, ping_every):

@@ -44,7 +44,12 @@ CFG = ClusterConfig.default_local().replace(
     suspicion_mult=3,
 )
 
-N_SEEDS = 8          # per layer; medians compared
+# Per layer; medians compared.  32 seeds on the small-N comparisons:
+# cheap (one compile per config, ~ms per extra seed) and tight enough
+# that the tolerance bands below could be set from the PRINTED seed
+# spread rather than guessed — a 1.5x systematic fidelity drift now
+# fails where round 2's 2-3x bands would have hidden it.
+N_SEEDS = 32
 HORIZON_ROUNDS = 250
 
 
@@ -188,35 +193,45 @@ def test_crash_timescales_match_oracle(oracle_crash_stats, delivery):
     assert np.isfinite([o_onset, o_dead, o_gone]).all()
     assert np.isfinite([t_onset, t_dead, t_gone]).all()
 
-    # Onset: dominated by probe discovery (~(n-1)/probes-per-round rounds).
-    # The tick resolves probe -> verdict within the probe round (the phased
-    # collapse, SURVEY.md §7), while the oracle spends the full ping
-    # interval before the verdict lands, so allow 2x plus an additive slack
-    # of one ping cycle (2 * ping_every rounds) + 2 quantization edges.
+    # Tolerances set from the measured 32-seed spread (printed in the
+    # assertion message on failure), not guessed:
+    #   oracle  onset med 3 (3..11), dead med 33 (33..41), gone med 35
+    #   tick    onset med 0 (0..6),  dead med 30 (30..36), gone med 33
+    # Onset: the tick resolves probe -> verdict within the probe round
+    # (the phased collapse, SURVEY.md §7) while the oracle spends the
+    # full ping interval, a deterministic offset < one ping cycle; the
+    # medians must agree ADDITIVELY within one ping cycle + 2
+    # quantization edges (round 2 allowed 2x multiplicative on top —
+    # loose enough to hide a 2x drift; this band's headroom is ~2
+    # rounds).
     slack = 2 * (CFG.ping_interval // ROUND_MS) + 2
-    assert t_onset <= 2 * o_onset + slack, (delivery, t_onset, o_onset)
-    assert o_onset <= 2 * t_onset + slack, (delivery, t_onset, o_onset)
+    assert abs(t_onset - o_onset) <= slack, (delivery, t_onset, o_onset, runs)
 
-    # DEAD declaration: onset + the (identical, deterministic) suspicion
-    # timeout; must agree within 25% + 3 rounds.
-    assert abs(t_dead - o_dead) <= 0.25 * o_dead + 3, (delivery, t_dead, o_dead)
+    # DEAD declaration: onset offset + the (identical, deterministic)
+    # suspicion timeout; within 15% + 3 rounds (measured diff: 3).
+    assert abs(t_dead - o_dead) <= 0.15 * o_dead + 3, \
+        (delivery, t_dead, o_dead, runs)
 
-    # Full dissemination of the death: within 35% + 5 rounds.
-    assert abs(t_gone - o_gone) <= 0.35 * o_gone + 5, (delivery, t_gone, o_gone)
+    # Full dissemination of the death: within 15% + 3 (measured diff: 2).
+    assert abs(t_gone - o_gone) <= 0.15 * o_gone + 3, \
+        (delivery, t_gone, o_gone, runs)
 
 
 @pytest.mark.parametrize("delivery", ["scatter", "shift"])
 def test_false_suspicion_under_loss_matches_oracle(delivery):
     """At 25% symmetric loss both layers must produce false suspicions on
     the same timescale; at 0% neither may produce any."""
-    o_first = medians([oracle_false_suspicion(s, 25) for s in range(N_SEEDS)])
-    t_first = medians(
-        [tick_false_suspicion(s, delivery, 0.25) for s in range(N_SEEDS)]
-    )
+    o_runs = [oracle_false_suspicion(s, 25) for s in range(N_SEEDS)]
+    t_runs = [tick_false_suspicion(s, delivery, 0.25) for s in range(N_SEEDS)]
+    o_first, t_first = medians(o_runs), medians(t_runs)
     assert np.isfinite(o_first), "oracle produced no false suspicion at 25%"
     assert np.isfinite(t_first), "tick produced no false suspicion at 25%"
-    ratio = (t_first + 1) / (o_first + 1)
-    assert 1 / 3 <= ratio <= 3, (t_first, o_first)
+    # Measured 32-seed spread: oracle med 2 (2..4), tick med 0 (0..0) —
+    # both layers false-suspect within the first probe cycle at 25% loss;
+    # the offset is the same within-round-verdict quantization as the
+    # crash-onset comparison.  Additive band: one ping cycle + 2.
+    slack = 2 * (CFG.ping_interval // ROUND_MS) + 2
+    assert abs(t_first - o_first) <= slack, (t_first, o_first, o_runs, t_runs)
 
     # Control: lossless runs stay clean on both layers.
     assert oracle_false_suspicion(0, 0) == float("inf")

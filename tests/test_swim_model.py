@@ -217,12 +217,15 @@ class TestFocalMode:
         (detection round 78, dissemination 85, for every K in
         {8, 64, 512, 4096=full} and every seed tried), so the band here is
         +-2 rounds.  This is the measured K-invariance curve behind the 1M
-        focal-mode headline (K=16 <<< N)."""
+        focal-mode headline (K=16 <<< N).  2 seeds per K: the observed
+        spread is zero and the K=4096 full-view compiles dominate the
+        test's runtime (the 6-seed exploratory run is recorded in
+        RESULTS.md)."""
         n = 4096
         meds = {}
         for k in (8, 64, 512, n):
             det, dis = [], []
-            for seed in range(3):
+            for seed in range(2):
                 params = swim.SwimParams.from_config(
                     fast_config(), n_members=n,
                     n_subjects=(None if k == n else k), delivery="shift",
