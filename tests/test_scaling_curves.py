@@ -34,14 +34,22 @@ def median_dissemination(n, seeds=3):
 
 
 def test_dissemination_is_log_linear_in_n():
-    """Median dissemination rounds fit a + b*log2(n) with <=10% residuals
-    and a slope consistent with fanout-3 epidemic growth."""
+    """Median dissemination rounds fit a + b*log2(n) with <=7% residuals
+    and a slope consistent with fanout-3 epidemic growth.
+
+    The 7% band is a REGRESSION PIN on the measured values, not a derived
+    bound: residuals are 5.3% today (stable from 3 to 8 seeds — the
+    integer round medians 4/6/7/9 don't move), and a single median
+    shifting by one round (the quantization grain) would exceed the band
+    by design — such a shift is exactly the protocol-behavior change this
+    test exists to surface; re-justify the band from fresh medians if one
+    ever does."""
     meds = np.asarray([median_dissemination(n) for n in NS])
     x = np.log2(np.asarray(NS, dtype=np.float64))
     b, a = np.polyfit(x, meds, 1)
     fit = a + b * x
     rel_resid = np.abs(meds - fit) / fit
-    assert rel_resid.max() <= 0.10, (meds.tolist(), fit.tolist())
+    assert rel_resid.max() <= 0.07, (meds.tolist(), fit.tolist())
     # Epidemic growth with fanout 3 multiplies the infected set by ~4 per
     # round (slope 1/log2(4) = 0.5) plus a straggler tail; measured slope
     # lands between those regimes.
