@@ -150,10 +150,42 @@ class SwimParams:
     # to half a gossip period (GossipProtocolTest.java:50-66), where
     # ~13% of messages cross into the next round.
     max_delay_rounds: int = 0
+    # Capacity-oriented carry/wire layout for full-view scale runs: the
+    # carry stores int16 incarnation (saturating at 8191, like the wire),
+    # int8 remaining-spread-rounds and int16 remaining-suspicion-rounds
+    # (re-relativized to the round cursor every tick — lossless while the
+    # deadline is < 32767 rounds ahead), and every key buffer (payloads,
+    # inbox, scatter contributions) uses the int16 records.merge_key16
+    # wire format: 6 B/cell of carry + 2 B/cell of inbox vs 13 + 4 wide.
+    # Protocol-trace-identical to the wide layout while incarnations stay
+    # below the 8191 saturation point (tests/test_compact_carry.py).
+    # The round-3 narrow-int experiment measured this layout ~12% SLOWER
+    # at 1M focal (narrow lanes cost more in the merge fusion than the
+    # saved bandwidth) — it exists to raise the [N, N] single-chip
+    # CEILING, where the regime is capacity-, not compute-bound.
+    compact_carry: bool = False
 
     def __post_init__(self):
         if self.delivery not in ("scatter", "shift"):
             raise ValueError(f"unknown delivery mode {self.delivery!r}")
+        if self.compact_carry:
+            if self.max_delay_rounds != 0:
+                raise ValueError(
+                    "compact_carry supports max_delay_rounds=0 only (the "
+                    "delay ring is a small-N validation mode and stays "
+                    "int32)"
+                )
+            if self.periods_to_spread + 1 > 127:
+                raise ValueError(
+                    f"compact_carry stores remaining spread rounds as int8; "
+                    f"periods_to_spread={self.periods_to_spread} exceeds 126"
+                )
+            if self.suspicion_rounds >= 32766:
+                raise ValueError(
+                    f"compact_carry stores remaining suspicion rounds as "
+                    f"int16; suspicion_rounds={self.suspicion_rounds} "
+                    f"exceeds 32765 (also applies to Knobs overrides)"
+                )
 
     @staticmethod
     def from_config(config, n_members: int, n_subjects: Optional[int] = None,
@@ -575,6 +607,20 @@ def initial_state(params: SwimParams, world: SwimWorld,
         # (MembershipProtocolTest seed-chain join, :432-462).
         spread0 = jnp.where(is_self, params.periods_to_spread + 1, spread0)
     d_slots = params.max_delay_rounds + 1 if params.max_delay_rounds > 0 else 0
+    if params.compact_carry:
+        # Relative encodings (the carry is re-relativized every tick by
+        # _carry_encode): spread_until / suspect_deadline as remaining
+        # rounds from round 0.
+        return SwimState(
+            status=status,
+            inc=jnp.zeros((n, k), dtype=jnp.int16),
+            spread_until=spread0.astype(jnp.int8),
+            suspect_deadline=jnp.full((n, k), _DEADLINE_NONE16,
+                                      dtype=jnp.int16),
+            self_inc=jnp.zeros((n,), dtype=jnp.int32),
+            inbox_ring=jnp.full((d_slots, n, k), -1, dtype=jnp.int32),
+            flag_ring=jnp.zeros((d_slots, n, k), dtype=jnp.int8),
+        )
     return SwimState(
         status=status,
         inc=jnp.zeros((n, k), dtype=jnp.int32),
@@ -589,6 +635,65 @@ def initial_state(params: SwimParams, world: SwimWorld,
 # --------------------------------------------------------------------------
 # The tick
 # --------------------------------------------------------------------------
+
+# compact_carry sentinel: "no suspicion timer" in the int16
+# remaining-rounds encoding (decodes to INT32_MAX).
+_DEADLINE_NONE16 = 32767
+_INC_SAT16 = (1 << 13) - 1      # matches the int16 wire format's inc field
+
+
+def _carry_decode(state: SwimState, round_idx) -> SwimState:
+    """compact -> wide: absolute rounds + int32, at the current cursor.
+
+    The tick body then runs unchanged on the wide form; _carry_encode
+    narrows the result back.  Lossless both ways while deadlines are
+    < 32767 rounds ahead and incarnations <= 8191 (validated statically
+    for params; Knobs overrides share the caps — SwimParams docstring).
+    """
+    dl = state.suspect_deadline.astype(jnp.int32)
+    return dataclasses.replace(
+        state,
+        inc=state.inc.astype(jnp.int32),
+        spread_until=round_idx + state.spread_until.astype(jnp.int32),
+        suspect_deadline=jnp.where(
+            dl == _DEADLINE_NONE16, INT32_MAX, round_idx + dl
+        ),
+    )
+
+
+def _carry_encode(state: SwimState, round_idx) -> SwimState:
+    """wide -> compact, relative to the NEXT round's cursor.
+
+    A deadline already at/below next-round clips to 0 remaining — it
+    decodes to "fires immediately", which is exactly the absolute
+    semantics (any past deadline fires on the next live evaluation; a
+    frozen crashed row's pending timer therefore fires on revival, same
+    as the wide layout).
+
+    A deadline MORE than 32765 rounds out (possible only through a
+    traced ``Knobs.suspicion_rounds`` override — static params are
+    validated in ``SwimParams.__post_init__``) cannot be represented;
+    it encodes as "no timer" rather than clipping, so a
+    beyond-the-horizon suspicion never matures instead of silently
+    firing ~32766 rounds in (the FD-isolation pattern that sets
+    suspicion past the run length gets exactly its intent; a >32k-round
+    run genuinely needing such timers must use the wide layout).
+    """
+    nxt = round_idx + 1
+    dl = state.suspect_deadline
+    remaining = dl - nxt
+    return dataclasses.replace(
+        state,
+        inc=jnp.minimum(state.inc, _INC_SAT16).astype(jnp.int16),
+        spread_until=jnp.clip(
+            state.spread_until - nxt, 0, 127
+        ).astype(jnp.int8),
+        suspect_deadline=jnp.where(
+            (dl == INT32_MAX) | (remaining > _DEADLINE_NONE16 - 1),
+            _DEADLINE_NONE16,
+            jnp.clip(remaining, 0, _DEADLINE_NONE16 - 1),
+        ).astype(jnp.int16),
+    )
 
 
 def _chain_ok(key, hop_losses: Sequence[jnp.ndarray],
@@ -615,10 +720,19 @@ def _chain_ok(key, hop_losses: Sequence[jnp.ndarray],
     n_hops = len(hop_losses)
     delayed = [h for h in range(n_hops) if hop_delay_means[h] is not None]
     if not delayed:
+        # The delayed path still compares total_delay (= 0 here) against the
+        # budget, which fails every chain for a negative budget (e.g. a
+        # misconfigured ping_timeout >= ping_interval).  Keep the collapse
+        # exactly equivalent; budget_ms is static, so this folds away.
+        if isinstance(budget_ms, (int, float)) and not 0.0 <= float(budget_ms):
+            return jnp.zeros(shape, dtype=jnp.bool_)
         p_chain = jnp.ones(shape, dtype=jnp.float32)
         for h in range(n_hops):
             p_chain = p_chain * (1.0 - hop_losses[h])
-        return jax.random.uniform(key, shape) < p_chain
+        ok = jax.random.uniform(key, shape) < p_chain
+        if not isinstance(budget_ms, (int, float)):
+            ok &= jnp.float32(0.0) <= budget_ms
+        return ok
     u = jax.random.uniform(key, (*shape, n_hops + len(delayed)))
     ok = jnp.ones(shape, dtype=jnp.bool_)
     for h in range(n_hops):
@@ -727,6 +841,8 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     kn = knobs if knobs is not None else Knobs.from_params(params)
     n, k = params.n_members, params.n_subjects
     n_local = state.status.shape[0]
+    if params.compact_carry:
+        state = _carry_decode(state, round_idx)
     # Fold both the round and the shard offset so draws are independent
     # across rounds AND across devices (ops/prng.py module docstring).
     # The shift channel draws come from the UN-folded round key: every
@@ -803,24 +919,46 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
         )
 
     # ---- Metrics (the per-round observability tensors, SURVEY.md §5.1) ---
+    # Restructured in round 4 from seven [N, K] pred masks (each ANDing in
+    # the per-column subject-liveness and the one-hot self mask) to FOUR
+    # row-space reductions plus per-column post-processing:
+    #   - the self cell is pinned ALIVE, so it contributes exactly
+    #     alive[subject_k] to column k's ALIVE histogram and nothing to
+    #     any other metric — subtract it after the reduce instead of
+    #     materializing ~is_self into every mask;
+    #   - subject liveness is a per-column [K] factor — multiply after
+    #     the reduce instead of broadcasting it into the [N, K] masks;
+    #   - "absent" follows from the histogram identity: each live
+    #     observer row contributes exactly one status code per column,
+    #     so sum_code hist[code] == live observer count.
     new_status = new_state.status
     observer_alive = alive_here[:, None]
-    subject_alive = alive[world.subject_ids][None, :]
-    def reduce_metric(mask):
-        return global_sum(
-            jnp.sum(mask, axis=0, dtype=jnp.int32)
-            if params.per_subject_metrics
-            else jnp.sum(mask, dtype=jnp.int32)
-        )
+    subject_alive_i = alive[world.subject_ids].astype(jnp.int32)    # [K]
 
-    counts = {}
-    for name, code in (("alive", records.ALIVE), ("suspect", records.SUSPECT),
-                       ("dead", records.DEAD), ("absent", records.ABSENT)):
-        counts[name] = reduce_metric(
-            (new_status == code) & observer_alive & ~is_self
-        )
-    # False positive: a live observer holds SUSPECT/DEAD about a live subject.
-    # The aggregate partitions EXACTLY by the held status
+    def col_sum(mask):
+        return jnp.sum(mask, axis=0, dtype=jnp.int32)               # [K]
+
+    hist_alive = global_sum(col_sum(
+        (new_status == records.ALIVE) & observer_alive))
+    hist_suspect = global_sum(col_sum(
+        (new_status == records.SUSPECT) & observer_alive))
+    hist_dead = global_sum(col_sum(
+        (new_status == records.DEAD) & observer_alive))
+    # SUSPECT now AND at tick start — subtracted from hist_suspect to
+    # count NEW suspicions (onsets).
+    still_suspect = global_sum(col_sum(
+        (new_status == records.SUSPECT) & (status == records.SUSPECT)
+        & observer_alive))
+    live_observers = global_sum(jnp.sum(alive_here, dtype=jnp.int32))
+
+    counts = {
+        "alive": hist_alive - subject_alive_i,
+        "suspect": hist_suspect,
+        "dead": hist_dead,
+        "absent": live_observers - hist_alive - hist_suspect - hist_dead,
+    }
+    # False positive: a live observer holds SUSPECT/DEAD about a live
+    # subject.  The aggregate partitions EXACTLY by the held status
     # (false_positives == false_suspect_rounds + stale_view_rounds):
     #   - ``false_suspect_rounds``: observer-ROUNDS holding SUSPECT about a
     #     live subject — active false-suspicion episodes, plus genuine
@@ -836,32 +974,40 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     # genuine FD false alarm beginning, the thing the SWIM paper's FP
     # curves count).  ``false_positives`` (observer-rounds) is kept for
     # continuity with round-1/2 artifacts.
-    onset_mask = (
-        (new_status == records.SUSPECT) & (status != records.SUSPECT)
-        & observer_alive & subject_alive & ~is_self
-    )
-    suspect_live_mask = (
-        (new_status == records.SUSPECT)
-        & observer_alive & subject_alive & ~is_self
-    )
-    stale_mask = (
-        (new_status == records.DEAD)
-        & observer_alive & subject_alive & ~is_self
-    )
-    false_suspect_rounds = reduce_metric(suspect_live_mask)
-    stale_view_rounds = reduce_metric(stale_mask)
+    false_suspect_rounds = hist_suspect * subject_alive_i
+    stale_view_rounds = hist_dead * subject_alive_i
+    onsets = (hist_suspect - still_suspect) * subject_alive_i
+    if not params.per_subject_metrics:
+        counts = {name: jnp.sum(v) for name, v in counts.items()}
+        false_suspect_rounds = jnp.sum(false_suspect_rounds)
+        stale_view_rounds = jnp.sum(stale_view_rounds)
+        onsets = jnp.sum(onsets)
     metrics = dict(
         counts,
         # The aggregate is the partition sum by construction (the two
-        # masks are disjoint: an entry holds SUSPECT xor DEAD).
+        # terms gate disjoint statuses: SUSPECT xor DEAD).
         false_positives=false_suspect_rounds + stale_view_rounds,
-        false_suspicion_onsets=reduce_metric(onset_mask),
+        false_suspicion_onsets=onsets,
         false_suspect_rounds=false_suspect_rounds,
         stale_view_rounds=stale_view_rounds,
         messages_gossip=global_sum(aux["messages_gossip"]),
+        # Two probe-counter families (both per round):
+        #   ``messages_ping``      — probes whose verdict lands on a
+        #     *tracked subject* (drives suspicion state; in focal mode
+        #     ~N·K/N² of real traffic, so at 1M×16 it reads "3 pings" a
+        #     round while the cluster issues ~1M);
+        #   ``messages_ping_sent`` — PINGs actually issued by live
+        #     members this round, the reference's per-period probe count
+        #     (FailureDetectorImpl.java:148,156-164); plus
+        #   ``messages_ping_req_sent`` — PING_REQ fan-out messages for
+        #     probes whose direct ping failed (k proxies each).
         messages_ping=global_sum(aux["messages_ping"]),
+        messages_ping_sent=global_sum(aux["messages_ping_sent"]),
+        messages_ping_req_sent=global_sum(aux["messages_ping_req_sent"]),
         refutations=global_sum(aux["refutations"]),
     )
+    if params.compact_carry:
+        new_state = _carry_encode(new_state, round_idx)
     return new_state, metrics
 
 
@@ -879,13 +1025,15 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
     Returns (new_state, refuted[n_local] bool).
     """
     new_status, new_inc, changed = delivery.merge_inbox(
-        status, inc, inbox, inbox_alive
+        status, inc, inbox, inbox_alive, compact=params.compact_carry
     )
 
     # Self-refutation (updateMembership about-self branch, :488-509): if the
     # inbound winner about ME overrides my ALIVE@self_inc record, bump to
     # max(inc)+1 and gossip the refutation (spread reset via `changed`).
-    win_status, win_inc = delivery.unpack_record(inbox)
+    win_status, win_inc = delivery.unpack_record(
+        inbox, compact=params.compact_carry
+    )
     self_overridden = is_self & records.is_overrides_array(
         win_status, win_inc, records.ALIVE, state.self_inc[:, None]
     )
@@ -957,9 +1105,10 @@ def _send_components(state, status, inc, round_idx, params, world,
     leaving_now = (world.leave_at[node_ids] == round_idx)[:, None] & is_self
     hot = (status != records.ABSENT) & (round_idx < state.spread_until)
     hot = hot | leaving_now
-    record_keys = delivery.pack_record(status, inc)          # [n_local, K]
+    compact = params.compact_carry
+    record_keys = delivery.pack_record(status, inc, compact=compact)
     leave_key = delivery.pack_record(
-        jnp.int8(records.DEAD), state.self_inc[:, None] + 1
+        jnp.int8(records.DEAD), state.self_inc[:, None] + 1, compact=compact
     )
     record_keys = jnp.where(leaving_now, leave_key, record_keys)
     syncable = status != records.DEAD
@@ -974,8 +1123,9 @@ def _send_payloads(state, status, inc, round_idx, params, world,
     record_keys, hot, syncable = _send_components(
         state, status, inc, round_idx, params, world, node_ids, is_self
     )
-    gossip_keys = jnp.where(hot, record_keys, delivery.NO_MESSAGE)
-    sync_keys = jnp.where(syncable, record_keys, delivery.NO_MESSAGE)
+    no_msg = delivery.no_message(params.compact_carry)
+    gossip_keys = jnp.where(hot, record_keys, no_msg)
+    sync_keys = jnp.where(syncable, record_keys, no_msg)
     return gossip_keys, sync_keys
 
 
@@ -1068,6 +1218,15 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     probe_active = fd_round & has_target & alive_here       # [n_local]
     verdict_suspect = probe_active & ~ack_ok
     verdict_alive = probe_active & ack_ok
+    # True wire-message accounting (the reference logs per-period probe
+    # counts, FailureDetectorImpl.java:148,156-164): every live member
+    # issues one PING per fd round — in focal mode regardless of whether
+    # the target is a *tracked* subject (``probe_active`` gates only the
+    # verdict bookkeeping, not the send).  Full-view senders probe only
+    # members they know live (the reference's pingMembers list).
+    probes_sent = (probe_active if params.ping_known_only
+                   else fd_round & alive_here)
+    ping_req_launches = probes_sent & ~direct_ok
 
     # SUSPECT verdict -> local record (SUSPECT, entry inc) for the target
     # slot (onFailureDetectorEvent, MembershipProtocolImpl.java:392-397).
@@ -1075,14 +1234,17 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     fd_slot_onehot = (
         jnp.arange(k, dtype=jnp.int32)[None, :] == slot_safe[:, None]
     )
+    compact = params.compact_carry
+    no_msg = delivery.no_message(compact)
     fd_suspect_key = delivery.pack_record(
         jnp.int8(records.SUSPECT),
         jnp.take_along_axis(inc, slot_safe[:, None], 1)[:, 0],
+        compact=compact,
     )
     fd_inbox = jnp.where(
         fd_slot_onehot & verdict_suspect[:, None],
         fd_suspect_key[:, None],
-        delivery.NO_MESSAGE,
+        no_msg,
     )
 
     # ALIVE verdict on a suspected entry -> push the suspect record to the
@@ -1145,8 +1307,8 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     # in the default max_delay_rounds=0 configuration; the delay path is a
     # small-N validation mode, so its extra per-bin combines are
     # acceptable — the 1M shift path bins receiver-side instead).
-    alive_flags = delivery.is_alive_key(gossip_keys)
-    sync_alive_flags = delivery.is_alive_key(sync_keys)
+    alive_flags = delivery.is_alive_key(gossip_keys, compact=compact)
+    sync_alive_flags = delivery.is_alive_key(sync_keys, compact=compact)
     inbox_now, flags_now, ring, fring, slot0 = _ring_open(
         state, params, round_idx
     )
@@ -1201,6 +1363,10 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
             hot_any[:, None] & ~gossip_drop, dtype=jnp.int32
         ),
         messages_ping=jnp.sum(probe_active, dtype=jnp.int32),
+        messages_ping_sent=jnp.sum(probes_sent, dtype=jnp.int32),
+        messages_ping_req_sent=(
+            jnp.sum(ping_req_launches, dtype=jnp.int32) * r_proxies
+        ),
         refutations=jnp.sum(refuted & alive_here, dtype=jnp.int32),
     )
     return new_state, aux
@@ -1311,22 +1477,32 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         active = fd_round & has_target & alive_here
         suspect_v = active & ~ack_ok
         refute_v = active & ack_ok & (entry_t_status == records.SUSPECT)
+        # True wire-message accounting — see _tick_scatter's probes_sent
+        # comment: every live member probes its offset target each fd
+        # round; ``active`` gates only the tracked-subject bookkeeping.
+        probes_sent = (active if params.full_view
+                       else fd_round & alive_here)
+        ping_req_n = jnp.sum(
+            probes_sent & ~direct_ok, dtype=jnp.int32
+        ) * r_proxies
         return (suspect_v, refute_v, active,
-                jnp.maximum(slot, 0), entry_t_inc)
+                jnp.maximum(slot, 0), entry_t_inc, probes_sent, ping_req_n)
 
     (verdict_suspect, push_refute, probe_active, slot_safe,
-     entry_t_inc) = fd_phase(0)
+     entry_t_inc, probes_sent, ping_req_n) = fd_phase(0)
 
+    compact = params.compact_carry
+    no_msg = delivery.no_message(compact)
     fd_slot_onehot = (
         jnp.arange(k, dtype=jnp.int32)[None, :] == slot_safe[:, None]
     )
     fd_suspect_key = delivery.pack_record(
-        jnp.int8(records.SUSPECT), entry_t_inc
+        jnp.int8(records.SUSPECT), entry_t_inc, compact=compact
     )
     fd_inbox = jnp.where(
         fd_slot_onehot & verdict_suspect[:, None],
         fd_suspect_key[:, None],
-        delivery.NO_MESSAGE,
+        no_msg,
     )
 
     # ---- Phase 2 + 3: gossip and SYNC sends ------------------------------
@@ -1362,8 +1538,8 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         transmit mask is ``tx_bit`` of the packed mask buffer."""
         keys = eng.deliver(h_keys, s)
         tx = (eng.deliver(h_tx, s) & tx_bit) != 0
-        payload = jnp.where(tx, keys, delivery.NO_MESSAGE)
-        return payload, delivery.is_alive_key(payload)
+        payload = jnp.where(tx, keys, no_msg)
+        return payload, delivery.is_alive_key(payload, compact=compact)
 
     def deliver_gossip(s):
         return deliver_channel(s, 1)
@@ -1414,7 +1590,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             ring, fring, slot0,
         )
         inbox = jnp.maximum(
-            inbox, jnp.where(ok_now[:, None], delivered, delivery.NO_MESSAGE)
+            inbox, jnp.where(ok_now[:, None], delivered, no_msg)
         )
         inbox_alive |= delivered_flags & ok_now[:, None]
         n_gossip_sent += jnp.sum(
@@ -1458,8 +1634,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             jax.random.fold_in(k_sync_drop, 13), params, ring_, fring_,
             slot0,
         )
-        contrib = jnp.where(ok_r_now[:, None], delivered_r,
-                            delivery.NO_MESSAGE)
+        contrib = jnp.where(ok_r_now[:, None], delivered_r, no_msg)
         fcontrib = flags_r & ok_r_now[:, None]
         return contrib, fcontrib, ring_, fring_, \
             eng.deliver(h_pushers, sync_shift)
@@ -1499,7 +1674,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         jax.random.fold_in(k_sync_drop, 11), params, ring, fring, slot0,
     )
     inbox = jnp.maximum(
-        inbox, jnp.where(ok_s_now[:, None], delivered, delivery.NO_MESSAGE)
+        inbox, jnp.where(ok_s_now[:, None], delivered, no_msg)
     )
     inbox_alive |= delivered_flags & ok_s_now[:, None]
 
@@ -1510,13 +1685,15 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     aux = dict(
         messages_gossip=n_gossip_sent,
         messages_ping=jnp.sum(probe_active, dtype=jnp.int32),
+        messages_ping_sent=jnp.sum(probes_sent, dtype=jnp.int32),
+        messages_ping_req_sent=ping_req_n,
         refutations=jnp.sum(refuted & alive_here, dtype=jnp.int32),
     )
     return new_state, aux
 
 
 def node_snapshot(state: SwimState, params: SwimParams, world: SwimWorld,
-                  node_id: int) -> dict:
+                  node_id: int, round_idx: int = 0) -> dict:
     """Queryable per-node state dump — the JMX MBean analog for the tick.
 
     Host-side digest of one observer row, mirroring the reference's
@@ -1524,9 +1701,17 @@ def node_snapshot(state: SwimState, params: SwimParams, world: SwimWorld,
     (MembershipProtocolImpl.java:693-749: incarnation, alive/suspected
     lists, removals) for any of the N simulated nodes; the oracle facade's
     counterpart is ``oracle.Cluster.monitor``.
+
+    ``round_idx``: the round cursor the state is encoded against — pass
+    the next round the state would run (e.g. the number of rounds
+    executed so far) so a ``compact_carry`` state's relative
+    remaining-rounds encodings decode to the same absolute rounds the
+    wide layout reports.
     """
     import numpy as np
 
+    if params.compact_carry:
+        state = _carry_decode(state, round_idx)
     status = np.asarray(state.status[node_id])
     inc = np.asarray(state.inc[node_id])
     deadline = np.asarray(state.suspect_deadline[node_id])

@@ -34,24 +34,44 @@ NO_MESSAGE = jnp.int32(-1)
 
 _INC_MASK = (1 << 29) - 1
 
+# Compact (int16) wire format — records.merge_key16: dead bit 14,
+# incarnation bits 1..13, suspect bit 0.  Chosen by
+# models/swim.SwimParams.compact_carry to halve the [.., K] key buffers
+# (wire + inbox + carry) in full-view capacity runs.
+_INC_MASK16 = (1 << 13) - 1
 
-def pack_record(status, inc):
-    """Pack (status, incarnation) into the int32 merge key (records.merge_key).
 
-    ABSENT packs to -1 == NO_MESSAGE: absent entries are simply never
-    transmitted, matching the reference where only table-present records go
-    into SYNC/gossip payloads (MembershipProtocolImpl.java:446-454).
+def no_message(compact: bool = False):
+    """The "no message" key in the wire dtype.
+
+    Mixing the int32 constant into int16 expressions would silently
+    promote whole buffers back to int32 — always take the constant from
+    here when the key dtype is mode-dependent."""
+    return jnp.int16(-1) if compact else NO_MESSAGE
+
+
+def pack_record(status, inc, compact: bool = False):
+    """Pack (status, incarnation) into the merge key (records.merge_key,
+    or the int16 records.merge_key16 when ``compact``).
+
+    ABSENT packs to -1 == no_message(compact): absent entries are simply
+    never transmitted, matching the reference where only table-present
+    records go into SYNC/gossip payloads
+    (MembershipProtocolImpl.java:446-454).
     """
+    if compact:
+        return records.merge_key16(status, inc)
     return records.merge_key(status, inc)
 
 
-def unpack_record(key):
+def unpack_record(key, compact: bool = False):
     """Invert :func:`pack_record`: key -> (status int8, incarnation int32).
 
     Keys < 0 unpack to (ABSENT, 0).
     """
+    dead_bit, inc_mask = (14, _INC_MASK16) if compact else (30, _INC_MASK)
     key = jnp.asarray(key, dtype=jnp.int32)
-    is_dead = (key >> 30) & 1
+    is_dead = (key >> dead_bit) & 1
     is_suspect = key & 1
     status = jnp.where(
         is_dead == 1,
@@ -59,11 +79,11 @@ def unpack_record(key):
         jnp.where(is_suspect == 1, records.SUSPECT, records.ALIVE),
     )
     status = jnp.where(key < 0, records.ABSENT, status).astype(jnp.int8)
-    inc = jnp.where(key < 0, 0, (key >> 1) & _INC_MASK).astype(jnp.int32)
+    inc = jnp.where(key < 0, 0, (key >> 1) & inc_mask).astype(jnp.int32)
     return status, inc
 
 
-def is_alive_key(key):
+def is_alive_key(key, compact: bool = False):
     """True where ``key`` packs an ALIVE record (dead/suspect bits clear).
 
     The ALIVE-gate side channel must reflect the *transmitted* record, not
@@ -72,8 +92,9 @@ def is_alive_key(key):
     pinned ALIVE (models/swim._send_payloads).  An ABSENT entry must not
     open for that DEAD notice (MembershipRecord.java:67-69).
     """
-    key = jnp.asarray(key, dtype=jnp.int32)
-    return (key >= 0) & (((key >> 30) & 1) == 0) & ((key & 1) == 0)
+    dead_bit = 14 if compact else 30
+    key = jnp.asarray(key)
+    return (key >= 0) & (((key >> dead_bit) & 1) == 0) & ((key & 1) == 0)
 
 
 def scatter_max(values, targets, drop, n_rows: int):
@@ -95,9 +116,10 @@ def scatter_max(values, targets, drop, n_rows: int):
     lowers natively; duplicate-index collisions combine associatively.
     """
     n_fanout = targets.shape[1]
-    inbox = jnp.full((n_rows, values.shape[1]), NO_MESSAGE, dtype=jnp.int32)
+    no_msg = values.dtype.type(-1)  # key dtype follows the wire format
+    inbox = jnp.full((n_rows, values.shape[1]), no_msg, dtype=values.dtype)
     for f in range(n_fanout):
-        contribution = jnp.where(drop[:, f, None], NO_MESSAGE, values)
+        contribution = jnp.where(drop[:, f, None], no_msg, values)
         inbox = inbox.at[targets[:, f]].max(contribution, mode="drop")
     return inbox
 
@@ -118,7 +140,8 @@ def scatter_or(flags, targets, drop, n_rows: int):
     return inbox
 
 
-def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive):
+def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
+                compact: bool = False):
     """Merge one round's inbox into the membership table rows.
 
     Equivalent to one valid arrival-order serialization of the reference's
@@ -145,7 +168,7 @@ def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive):
 
     Returns (status int8, inc int32, changed bool).
     """
-    win_status, win_inc = unpack_record(inbox_key)
+    win_status, win_inc = unpack_record(inbox_key, compact=compact)
 
     # Stored DEAD gates like ABSENT (record was deleted in the reference).
     gate_status = jnp.where(entry_status == records.DEAD, records.ABSENT, entry_status)

@@ -99,7 +99,9 @@ def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
         for name in ("alive", "suspect", "dead", "absent", "false_positives",
                      "false_suspicion_onsets", "false_suspect_rounds",
                      "stale_view_rounds",
-                     "messages_gossip", "messages_ping", "refutations")
+                     "messages_gossip", "messages_ping",
+                     "messages_ping_sent", "messages_ping_req_sent",
+                     "refutations")
     }
     return jax.shard_map(
         sharded_body,

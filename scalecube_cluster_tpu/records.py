@@ -148,6 +148,28 @@ def merge_key(status, inc):
     return jnp.where(status == ABSENT, jnp.int32(-1), key)
 
 
+def merge_key16(status, inc):
+    """int16 variant of :func:`merge_key` — the capacity-oriented wire
+    format (models/swim.SwimParams.compact_carry).
+
+    Layout: bit 14 = dead flag, bits 1..13 = incarnation (saturating at
+    2^13 - 1 = 8191), bit 0 = suspect; ABSENT -> -1.  Same lattice order
+    as the int32 key — DEAD absorbs, then incarnation, then SUSPECT at
+    equal incarnation — at half the wire/table bytes.  Incarnations only
+    grow by refutation bumps (one per false suspicion or revival of the
+    same member), so 8k is far past any realistic run; saturation
+    degrades order among such records instead of corrupting the DEAD
+    rule, exactly like the int32 key's 2^29 cap.
+    """
+    status = jnp.asarray(status)
+    inc = jnp.asarray(inc, dtype=jnp.int32)
+    is_dead = (status == DEAD).astype(jnp.int32)
+    is_suspect = (status == SUSPECT).astype(jnp.int32)
+    inc_sat = jnp.minimum(inc, jnp.int32(2**13 - 1))
+    key = (is_dead << 14) | (inc_sat << 1) | is_suspect
+    return jnp.where(status == ABSENT, -1, key).astype(jnp.int16)
+
+
 def apply_record(old_status, old_inc, new_status, new_inc):
     """Merge one inbound record into a table entry; returns (status, inc).
 

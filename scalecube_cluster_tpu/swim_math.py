@@ -86,3 +86,60 @@ def gossip_timeout_to_sweep(repeat_mult: int, cluster_size: int, gossip_interval
 def suspicion_timeout(suspicion_mult: int, cluster_size: int, ping_interval_ms: int) -> int:
     """``suspicionMult * ceilLog2(n) * pingInterval`` — ClusterMath.java:123-125."""
     return suspicion_mult * ceil_log2(cluster_size) * ping_interval_ms
+
+
+# ---------------------------------------------------------------------------
+# Failure-detector false-positive model (this repo's extension)
+# ---------------------------------------------------------------------------
+#
+# The reference's ClusterMath covers gossip; its FD has no closed-form
+# analog even though its tests measure FD behavior (FailureDetectorTest).
+# The TPU tick's probe collapse (models/swim._chain_ok: one Bernoulli per
+# chain against the product of per-hop delivery probabilities — exact for
+# independent per-hop losses) makes the per-probe false-suspicion
+# probability computable, which is what lets the measured
+# first-false-positive curve be validated quantitatively (BASELINE.md
+# north star; tests/test_scaling_curves.py, experiments/fp_curve.py).
+
+
+def fd_false_suspect_probability(loss: float, ping_req_members: int,
+                                 cluster_size: int) -> float:
+    """P(one probe of a LIVE member yields a SUSPECT verdict) under
+    symmetric i.i.d. per-message loss.
+
+    The probe (FailureDetectorImpl.java:128-213, collapsed in
+    models/swim 3.2-phase form) fails only if the 2-hop direct ping
+    chain drops AND every one of the ``ping_req_members`` 4-hop proxy
+    chains drops:
+
+      P = (1 - (1-p)^2) * prod_r (1 - (1 - 1/(n-1)) * (1-p)^4)
+
+    The ``1/(n-1)`` term is the probability a uniformly drawn proxy
+    collides with the target (a proxy cannot rescue its own probe;
+    both delivery modes exclude that chain — models/swim.py
+    ``proxies != t`` / ``ps != fd_shift``).
+    """
+    p = float(loss)
+    n = cluster_size
+    direct_fail = 1.0 - (1.0 - p) ** 2
+    proxy_rescue = (1.0 - 1.0 / (n - 1)) * (1.0 - p) ** 4
+    return direct_fail * (1.0 - proxy_rescue) ** ping_req_members
+
+
+def fd_expected_false_onsets(loss: float, ping_req_members: int,
+                             cluster_size: int, fd_rounds: int) -> float:
+    """Expected first-false-suspicion events in an FD-only run.
+
+    Setup (models/fd.py isolation, warm full view, everyone live,
+    suspicion horizon > run length): each live observer probes one
+    uniformly chosen known-live entry per fd round, so a given
+    (observer, subject) entry is probed with probability 1/(n-1) per fd
+    round and transitions ALIVE -> SUSPECT exactly once (nothing refutes
+    or kills it).  Each of the n*(n-1) entries is an absorbing 2-state
+    chain:
+
+      E[onsets] = n * (n-1) * (1 - (1 - P_fs/(n-1))^fd_rounds)
+    """
+    n = cluster_size
+    q = fd_false_suspect_probability(loss, ping_req_members, n) / (n - 1)
+    return n * (n - 1) * (1.0 - (1.0 - q) ** fd_rounds)

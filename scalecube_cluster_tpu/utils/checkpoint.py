@@ -98,8 +98,10 @@ def _metric_rounds_on_disk(path: str) -> list:
     directory = os.path.dirname(os.path.abspath(path)) or "."
     if not os.path.isdir(directory):
         return []  # fresh run into a directory _atomic_savez will create
+    # {:08d} zero-pads to 8 digits but grows wider past 99,999,999 rounds;
+    # accept any width >= 8 so such traces stay visible on resume.
     pat = re.compile(
-        re.escape(os.path.basename(path)) + r"\.metrics-(\d{8})\.npz$"
+        re.escape(os.path.basename(path)) + r"\.metrics-(\d{8,})\.npz$"
     )
     rounds = []
     for fn in os.listdir(directory):
@@ -109,15 +111,19 @@ def _metric_rounds_on_disk(path: str) -> list:
     return sorted(rounds)
 
 
-def _delete_traces_above(path: str, above_round: int) -> None:
+def _delete_traces_above(path: str, above_round: int) -> list:
     """Delete trace files past ``above_round`` — stale leftovers of an
     earlier run lineage (e.g. the checkpoint was deleted to re-chunk, or a
     preemption landed between the trace write and the checkpoint write).
     Keeps the on-disk invariant: traces always cover a prefix of
-    [0, next_round)."""
+    [0, next_round).  Returns the deleted paths."""
+    deleted = []
     for upto in _metric_rounds_on_disk(path):
         if upto > above_round:
-            os.unlink(_metrics_path(path, upto))
+            fn = _metrics_path(path, upto)
+            os.unlink(fn)
+            deleted.append(fn)
+    return deleted
 
 
 def run_checkpointed(run_fn, key, params, world, n_rounds: int, path: str,
@@ -198,7 +204,18 @@ def run_checkpointed(run_fn, key, params, world, n_rounds: int, path: str,
         # a deleted checkpoint) — the rounds they claim will re-run below.
         _delete_traces_above(path, start_round)
     else:
-        _delete_traces_above(path, -1)  # fresh run: clear any leftovers
+        # Fresh run (no checkpoint at ``path``): any metric traces sitting
+        # next to it are leftovers of a deleted run lineage and would
+        # corrupt this run's coverage invariant — but the user may have
+        # kept them on purpose, so say what is being removed.
+        deleted = _delete_traces_above(path, -1)
+        if deleted:
+            import warnings
+            msg = (f"fresh run at {path!r}: removing {len(deleted)} "
+                   f"pre-existing metric trace file(s) from an earlier "
+                   f"run lineage: {deleted}")
+            (log.warning if log is not None else
+             lambda m: warnings.warn(m, stacklevel=2))(msg)
     r = start_round
     while r < n_rounds:
         step = min(chunk, n_rounds - r)

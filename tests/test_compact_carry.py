@@ -1,0 +1,180 @@
+"""compact_carry (int16 wire + narrow relative carry) is protocol-trace-
+identical to the wide layout.
+
+The compact layout exists to raise the full-view [N, N] single-chip
+capacity ceiling (SwimParams.compact_carry docstring; measured on TPU in
+experiments/fullview_scale.py).  Its contract: below the saturation
+points (incarnation 8191, deadline 32766 rounds ahead) every protocol
+outcome is bit-identical to the wide layout — same PRNG draws, same
+merge winners, same timers — because the encodings are lossless in range
+and re-relativized each round.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.models import swim
+
+from tests.test_swim_model import fast_config
+
+
+def run_pair(n, rounds, world_fn=None, seed=0, **overrides):
+    """(wide metrics+state, compact metrics+state) for the same scenario."""
+    out = []
+    for compact in (False, True):
+        params = swim.SwimParams.from_config(
+            fast_config(), n_members=n, compact_carry=compact, **overrides
+        )
+        world = swim.SwimWorld.healthy(params)
+        if world_fn is not None:
+            world = world_fn(world)
+        state, metrics = swim.run(jax.random.key(seed), params, world, rounds)
+        out.append((state, metrics))
+    return out
+
+
+SCENARIOS = {
+    "crash_revive": lambda w: w.with_crash(3, at_round=5, until_round=60),
+    "leave": lambda w: w.with_leave(2, at_round=10),
+    "asym_link": lambda w: w.with_link_fault(1, 4, loss=0.9),
+    "partition": lambda w: w.with_partition_schedule(
+        np.r_[np.zeros(16), np.ones(16)].astype(np.int8), phase_rounds=40
+    ),
+}
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+@pytest.mark.parametrize("scenario", sorted(SCENARIOS))
+def test_compact_trace_identical(delivery, scenario):
+    (s_w, m_w), (s_c, m_c) = run_pair(
+        32, 120, SCENARIOS[scenario], delivery=delivery,
+        loss_probability=0.1,
+    )
+    for name in m_w:
+        np.testing.assert_array_equal(
+            np.asarray(m_w[name]), np.asarray(m_c[name]),
+            err_msg=f"{scenario}/{delivery}: metric {name} diverged",
+        )
+    # Final tables agree (the compact state decoded at the final cursor).
+    dec = swim._carry_decode(s_c, 120)
+    np.testing.assert_array_equal(np.asarray(s_w.status), np.asarray(dec.status))
+    np.testing.assert_array_equal(np.asarray(s_w.inc), np.asarray(dec.inc))
+    np.testing.assert_array_equal(
+        np.asarray(s_w.self_inc), np.asarray(dec.self_inc)
+    )
+    # Timers: equal wherever pending; cancelled is INT32_MAX in both.
+    np.testing.assert_array_equal(
+        np.asarray(s_w.suspect_deadline == swim.INT32_MAX),
+        np.asarray(dec.suspect_deadline == swim.INT32_MAX),
+    )
+    pending = np.asarray(s_w.suspect_deadline) != swim.INT32_MAX
+    np.testing.assert_array_equal(
+        np.asarray(s_w.suspect_deadline)[pending],
+        np.asarray(dec.suspect_deadline)[pending],
+    )
+
+
+def test_compact_state_dtypes_and_size():
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=16, compact_carry=True
+    )
+    state = swim.initial_state(params, swim.SwimWorld.healthy(params))
+    assert state.inc.dtype == np.int16
+    assert state.spread_until.dtype == np.int8
+    assert state.suspect_deadline.dtype == np.int16
+    assert state.status.dtype == np.int8
+    # 6 B/cell of [N, K] carry vs 13 wide.
+    per_cell = sum(a.dtype.itemsize for a in
+                   (state.status, state.inc, state.spread_until,
+                    state.suspect_deadline))
+    assert per_cell == 6
+
+
+def test_compact_checkpoint_roundtrip(tmp_path):
+    from scalecube_cluster_tpu.utils import checkpoint
+
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=24, compact_carry=True, delivery="shift",
+    )
+    world = swim.SwimWorld.healthy(params).with_crash(1, at_round=3)
+    path = str(tmp_path / "ck.npz")
+    final_a, chunks = checkpoint.run_checkpointed(
+        swim.run, jax.random.key(7), params, world, 60, path, chunk=25,
+        state=swim.initial_state(params, world),
+    )
+    final_b, _ = swim.run(jax.random.key(7), params, world, 60)
+    np.testing.assert_array_equal(np.asarray(final_a.status),
+                                  np.asarray(final_b.status))
+    assert final_a.inc.dtype == np.int16
+
+
+def test_compact_far_deadline_becomes_no_timer():
+    """A traced Knobs.suspicion_rounds beyond the int16 horizon (the
+    FD-isolation pattern: push timers past the run) must NOT silently
+    fire ~32766 rounds in — it encodes as "no timer", so suspicions
+    never mature, and observable behavior matches the wide layout for
+    any run shorter than the horizon."""
+    import jax.numpy as jnp
+
+    from scalecube_cluster_tpu.models import fd as fdmodel
+
+    rounds, n = 120, 32
+    out = {}
+    for compact in (False, True):
+        params = swim.SwimParams.from_config(
+            fast_config(), n_members=n, loss_probability=0.3,
+            delivery="shift", compact_carry=compact,
+        )
+        knobs = dataclasses.replace(
+            fdmodel.fd_only_knobs(params),
+            ping_every=jnp.int32(1),
+            suspicion_rounds=jnp.int32(1_000_000),
+        )
+        world = swim.SwimWorld.healthy(params)
+        state, metrics = swim.run(jax.random.key(5), params, world, rounds,
+                                  knobs=knobs)
+        out[compact] = (state, metrics)
+    (s_w, m_w), (s_c, m_c) = out[False], out[True]
+    # Suspicions happened but never matured, identically in both layouts.
+    assert np.asarray(m_w["suspect"]).sum() > 0
+    for name in m_w:
+        np.testing.assert_array_equal(np.asarray(m_w[name]),
+                                      np.asarray(m_c[name]), err_msg=name)
+    assert np.asarray(m_w["dead"]).sum() == 0
+    # Wide holds far deadlines; compact dropped them to the sentinel.
+    assert (np.asarray(s_w.suspect_deadline) < swim.INT32_MAX).any()
+    dl_c = np.asarray(s_c.suspect_deadline)
+    assert (dl_c == 32767).all()
+
+
+def test_compact_node_snapshot_matches_wide():
+    """The JMX-analog snapshot decodes the compact encodings: absolute
+    deadlines, int32 incarnations, sentinel timers excluded."""
+    rounds = 60
+    (s_w, _), (s_c, _) = run_pair(
+        24, rounds, lambda w: w.with_crash(3, at_round=5),
+        delivery="shift", loss_probability=0.2, seed=9,
+    )
+    params_w = swim.SwimParams.from_config(fast_config(), n_members=24)
+    params_c = dataclasses.replace(params_w, compact_carry=True)
+    world = swim.SwimWorld.healthy(params_w)
+    for node in (0, 7):
+        snap_w = swim.node_snapshot(s_w, params_w, world, node,
+                                    round_idx=rounds)
+        snap_c = swim.node_snapshot(s_c, params_c, world, node,
+                                    round_idx=rounds)
+        assert snap_w == snap_c, (node, snap_w, snap_c)
+
+
+def test_compact_validation():
+    base = swim.SwimParams.from_config(fast_config(), n_members=16)
+    with pytest.raises(ValueError, match="max_delay_rounds"):
+        dataclasses.replace(base, compact_carry=True, max_delay_rounds=2)
+    with pytest.raises(ValueError, match="suspicion"):
+        dataclasses.replace(base, compact_carry=True,
+                            suspicion_rounds=40_000)
+    with pytest.raises(ValueError, match="spread"):
+        dataclasses.replace(base, compact_carry=True, periods_to_spread=200)

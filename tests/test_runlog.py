@@ -31,9 +31,12 @@ def test_log_metrics_summary_digests_counters(caplog):
     msg = caplog.records[0].getMessage()
     assert "rounds [0, 59]" in msg
     gossip = int(np.asarray(metrics["messages_gossip"]).sum())
-    pings = int(np.asarray(metrics["messages_ping"]).sum())
+    verdicts = int(np.asarray(metrics["messages_ping"]).sum())
+    sent = int(np.asarray(metrics["messages_ping_sent"]).sum())
+    pingreq = int(np.asarray(metrics["messages_ping_req_sent"]).sum())
     assert f"gossip msgs {gossip}" in msg
-    assert f"pings {pings}" in msg
+    assert f"pings sent {sent} (+{pingreq} ping-req fan-outs)" in msg
+    assert f"tracked-subject probe verdicts {verdicts}" in msg
 
 
 def test_profiled_noop_without_env(monkeypatch):
