@@ -3,9 +3,11 @@
 SURVEY.md §5.8 promises an accounting of what the two delivery modes move
 over ICI when the member rows are sharded across ``D`` devices
 (``parallel/mesh.py``); this module is that accounting as executable
-formulas, pinned to the actual tick by ``tests/test_traffic.py`` (which
-counts the block exchanges the tick really performs against
-:func:`shift_exchanges_per_round`).
+formulas, pinned to the actual tick by ``tests/test_traffic.py`` at two
+levels: trace-time exchange counters, and the COMPILED program — the
+lowered HLO of ``shard_run`` on the 8-device mesh is parsed and its
+collective-permute / all-reduce counts and operand bytes asserted equal
+to these formulas.
 
 Shift mode (ops/shift.ShiftEngine)
 ----------------------------------

@@ -125,3 +125,50 @@ class TestShardedShiftMode:
         )
         alive_view = np.asarray(metrics["alive"])[:, 2]
         assert alive_view[-1] < alive_view[0]
+
+    def test_fullview_256_crash_heal_timeline(self, mesh8):
+        """The 32k sharded crash+heal demo's shape at CI cost: N=256
+        exact-semantics full view over 8 devices, shift delivery — the
+        same sharded path (ShiftEngine block rotations) the ~100-min
+        `experiments/fullview_scale.py` artifact exercises, asserting the
+        suspected -> DEAD -> disseminated -> healed timeline every run.
+        """
+        n, crash_node = 256, 9
+        crash_at, revive_at, horizon = 2, 150, 320
+        params, world = make(n, delivery="shift")
+        assert params.full_view
+        world = world.with_crash(crash_node, at_round=crash_at,
+                                 until_round=revive_at)
+        _, metrics = pmesh.shard_run(
+            jax.random.key(11), params, world, horizon, mesh8
+        )
+        suspects = np.asarray(metrics["suspect"])[:, crash_node]
+        deads = np.asarray(metrics["dead"])[:, crash_node]
+        alive_view = np.asarray(metrics["alive"])[:, crash_node]
+
+        def first(cond):
+            idx = np.flatnonzero(cond)
+            assert idx.size, "timeline event never happened"
+            return int(idx[0])
+
+        suspected = first(suspects > 0)
+        dead_declared = first(deads > 0)
+        # Death disseminated: every live observer (n-2: all but the
+        # subject and itself... the subject is down, so n-1 observers
+        # minus none — alive observers exclude the crashed subject) holds
+        # the tombstone and nobody holds ALIVE/SUSPECT.
+        disseminated = first(
+            (alive_view == 0) & (suspects == 0) & (deads == n - 1)
+        )
+        healed = first(
+            (np.arange(horizon) >= revive_at) & (alive_view == n - 1)
+        )
+        assert crash_at <= suspected <= crash_at + 3 * params.ping_every
+        # DEAD at the first suspicion's timeout (+ slack for stragglers).
+        assert suspected + params.suspicion_rounds <= dead_declared \
+            <= suspected + params.suspicion_rounds + 4 * params.ping_every
+        assert dead_declared < disseminated < revive_at
+        assert revive_at < healed < horizon
+        # The revival is a refutation (incarnation bump), not a
+        # false-positive: no live member was ever wrongly suspected.
+        assert np.asarray(metrics["false_suspicion_onsets"]).sum() == 0

@@ -1,8 +1,19 @@
 """The collective-traffic model (parallel/traffic.py) is pinned to the
 tick: the exchange counts the formulas assume are the exchange counts the
-code performs.  SURVEY.md §5.8's promise, made checkable."""
+code performs.  SURVEY.md §5.8's promise, made checkable.
+
+Two layers of pinning:
+  - trace-time counters (mock ShiftEngine.deliver / lax.pmax) — fast,
+    per-exchange granularity;
+  - the COMPILED program: ``shard_run`` lowered on the virtual 8-device
+    mesh, its HLO parsed, and the collective ops' counts and operand
+    bytes asserted against the model (the round-3 verdict's demand: the
+    byte model must be pinned by the compiler, not by its own
+    arithmetic re-derived in a test comment).
+"""
 
 import dataclasses
+import re
 from unittest import mock
 
 import jax
@@ -10,9 +21,95 @@ import pytest
 
 from scalecube_cluster_tpu.models import swim
 from scalecube_cluster_tpu.ops import shift as shift_ops
+from scalecube_cluster_tpu.parallel import mesh as pmesh
 from scalecube_cluster_tpu.parallel import traffic
 
 from tests.test_swim_model import fast_config
+
+N_DEV = 8
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+                "s32": 4, "u32": 4, "f32": 4}
+
+
+def _compiled_hlo(params, world, n_rounds=4):
+    mesh = pmesh.make_mesh(N_DEV)
+    state = swim.initial_state(params, world)
+    return pmesh.shard_run.lower(
+        jax.random.key(0), params, world, n_rounds, mesh,
+        state=state, start_round=0,
+    ).compile().as_text()
+
+
+def _op_operand_bytes(hlo_text, op_name):
+    """[(dtype, dims, bytes)] for every non-tuple ``op_name`` instruction."""
+    out = []
+    for m in re.finditer(
+        r"= (\w+)\[([\d,]*)\]\S* " + re.escape(op_name) + r"\(", hlo_text
+    ):
+        dtype, dims = m.group(1), m.group(2)
+        size = 1
+        for d in dims.split(","):
+            if d:
+                size *= int(d)
+        out.append((dtype, dims, size * _DTYPE_BYTES[dtype]))
+    return out
+
+
+@pytest.mark.parametrize("n,k,gate", [(256, 16, False), (128, 128, True)])
+def test_shift_hlo_collectives_match_traffic_model(n, k, gate):
+    """The compiled sharded shift program's collective-permutes ARE the
+    model: count == exchanges x 2 rotations x D branches (one ppermute
+    per lax.switch branch; exactly 2 execute per exchange), and total
+    operand bytes / D == shift_ici_bytes_per_device_round."""
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n,
+        n_subjects=(None if gate else k), delivery="shift",
+    )
+    world = swim.SwimWorld.healthy(params)
+    if gate:
+        world = world.with_seeds([0, 1])   # enables full-view contact gate
+    hlo = _compiled_hlo(params, world)
+
+    cps = _op_operand_bytes(hlo, "collective-permute")
+    exchanges = traffic.shift_exchanges_per_round(params, gate_contacts=gate)
+    assert len(cps) == len(exchanges) * 2 * N_DEV, (
+        f"compiled program holds {len(cps)} collective-permutes; model "
+        f"expects {len(exchanges)} exchanges x 2 rotations x {N_DEV} "
+        f"switch branches"
+    )
+    # Every branch of one rotation switch moves the same block, so summing
+    # all instances and dividing by the branch count D gives the bytes one
+    # device actually sends per round.
+    hlo_bytes_per_device = sum(b for _, _, b in cps) // N_DEV
+    assert hlo_bytes_per_device == traffic.shift_ici_bytes_per_device_round(
+        params, N_DEV, gate_contacts=gate
+    )
+    # Shift mode's delivery uses no all-reduce; the only one is the fused
+    # variadic metrics psum (a tuple op, excluded by the non-tuple regex).
+    assert _op_operand_bytes(hlo, "all-reduce") == []
+
+
+def test_scatter_hlo_collectives_match_traffic_model():
+    n, k = 256, 16
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, n_subjects=k, delivery="scatter",
+    )
+    world = swim.SwimWorld.healthy(params)
+    hlo = _compiled_hlo(params, world)
+
+    ars = _op_operand_bytes(hlo, "all-reduce")
+    # The full-height pmax combines: one s32[N,K] key buffer + one
+    # s8[N,K] ALIVE-flag buffer per round (delay modeling off).
+    assert len(ars) == traffic.scatter_collectives_per_round(params)
+    dims = sorted(d for _, d, _ in ars)
+    assert dims == [f"{n},{k}", f"{n},{k}"]
+    buffer_bytes = sum(b for _, _, b in ars)
+    # Ring all-reduce: each device sends 2*(D-1)/D of the buffer.
+    assert int(2 * (N_DEV - 1) / N_DEV * buffer_bytes) == (
+        traffic.scatter_ici_bytes_per_device_round(params, N_DEV)
+    )
+    assert _op_operand_bytes(hlo, "collective-permute") == []
 
 
 def _tick_once(params, world, axis_name=None):
