@@ -1,0 +1,148 @@
+"""Single-chip full-view capacity ceiling, wide vs compact carry layout.
+
+Full-view mode is the reference's per-node O(cluster) table
+(MembershipProtocolImpl.java:82) as [N, N] state.  The wide layout
+(13 B/cell carry + int32 wire) measured 16,384 fits / 20,480
+RESOURCE_EXHAUSTED in round 3; ``SwimParams.compact_carry`` (6 B/cell
+carry + int16 wire — the capacity trade round 3 measured slower at 1M
+*focal* and rejected *for speed*, re-purposed here *for capacity*)
+should roughly double the reachable N^2.
+
+Each (layout, N) attempt runs in a SUBPROCESS so a RESOURCE_EXHAUSTED
+cannot poison the runtime for later attempts, probing a ladder of N per
+layout and timing ms/round where it fits.  Writes
+``artifacts/fullview_ceiling.json``.
+
+Run: ``python experiments/fullview_ceiling.py`` (TPU, ~10 min).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROUNDS = 60          # timed window per fitting attempt (plus 1 warmup run)
+# Finer rungs near the boundary: the wide layout's ceiling moved up in
+# round 4 (the metrics restructure removed seven [N, N]-sized pred masks
+# from live range), so both layouts are probed from 16k upward.
+LADDERS = {
+    "wide": [16_384, 20_480, 22_528, 24_576, 26_624],
+    "compact": [16_384, 20_480, 22_528, 24_576, 26_624, 28_672, 30_720,
+                32_768, 36_864],
+}
+
+_CHILD = r"""
+import json, sys, time
+sys.path.insert(0, %(repo)r)
+import jax, jax.numpy as jnp, numpy as np
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.utils.runlog import enable_compilation_cache
+
+enable_compilation_cache()
+n, compact, rounds = %(n)d, %(compact)r, %(rounds)d
+try:
+    params = swim.SwimParams.from_config(
+        ClusterConfig.default_local(), n_members=n, delivery="shift",
+        compact_carry=compact, suspicion_rounds=6, ping_every=2,
+        sync_every=4, per_subject_metrics=False,
+    )
+    world = swim.SwimWorld.healthy(params).with_crash(3, at_round=2)
+    key = jax.random.key(0)
+
+    # Donate the carry: the caller never reuses the previous window's
+    # state, so XLA may alias it into the scan instead of holding input
+    # + output copies live — a full carry's worth of HBM at [N, N].
+    step = jax.jit(
+        lambda k, w, s, r0: swim.run(
+            k, params, w, rounds, state=s, start_round=r0),
+        donate_argnums=(2,))
+
+    from scalecube_cluster_tpu.utils import runlog
+
+    def force(s):
+        return runlog.completion_barrier(s.status)
+
+    state = swim.initial_state(params, world)
+    t0 = time.perf_counter()
+    state, _ = step(key, world, state, 0)
+    force(state)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    state, m = step(key, world, state, rounds)
+    force(state)
+    elapsed = time.perf_counter() - t0
+    # The crash at round 2 must be noticed (suspicion window 6 rounds).
+    dead = int(np.asarray(m["dead"]).sum())
+    print(json.dumps({
+        "fits": True,
+        "ms_per_round": round(elapsed / rounds * 1e3, 2),
+        "record_updates_per_sec": round(n * n * rounds / elapsed, 1),
+        "compile_plus_first_window_s": round(compile_s, 1),
+        "crash_noticed": dead > 0,
+    }))
+except Exception as e:  # noqa: BLE001 — OOM classification by message
+    oom = "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
+    print(json.dumps({"fits": False, "oom": oom,
+                      "error": f"{type(e).__name__}: {str(e)[:300]}"}))
+"""
+
+
+def attempt(n, compact):
+    code = _CHILD % {"repo": REPO, "n": n, "compact": compact,
+                     "rounds": ROUNDS}
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=1200, cwd=REPO)
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    return {"fits": False, "oom": False,
+            "error": f"no output; rc={out.returncode}; "
+                     f"stderr tail: {out.stderr[-300:]}"}
+
+
+def main():
+    results = {}
+    for layout, ladder in LADDERS.items():
+        rows = []
+        for n in ladder:
+            t0 = time.perf_counter()
+            r = attempt(n, layout == "compact")
+            r.update(n_members=n,
+                     attempt_wall_s=round(time.perf_counter() - t0, 1))
+            rows.append(r)
+            print(f"[{layout}] N={n}: {json.dumps(r)}", file=sys.stderr)
+            if not r["fits"]:
+                break
+        fitting = [r for r in rows if r["fits"]]
+        results[layout] = {
+            "bytes_per_cell_carry": 6 if layout == "compact" else 13,
+            "attempts": rows,
+            "max_fits": max((r["n_members"] for r in fitting), default=0),
+            "first_oom": next((r["n_members"] for r in rows
+                               if not r["fits"]), None),
+        }
+
+    ratio = (results["compact"]["max_fits"]
+             / max(results["wide"]["max_fits"], 1))
+    out = {
+        "mode": "full-view [N, N], shift delivery, single real TPU chip",
+        "rounds_timed": ROUNDS,
+        "layouts": results,
+        "compact_over_wide_members": round(ratio, 3),
+        "compact_over_wide_cells": round(ratio ** 2, 2),
+    }
+    os.makedirs(os.path.join(REPO, "artifacts"), exist_ok=True)
+    path = os.path.join(REPO, "artifacts", "fullview_ceiling.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+    print(f"wrote {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
