@@ -51,20 +51,33 @@ with cluster-wide message volume, not cluster size).
 from __future__ import annotations
 
 INT32 = 4
+INT16 = 2
 INT8 = 1
+
+
+def _key_bytes(params) -> int:
+    """Wire bytes per packed record key.
+
+    ``compact_carry`` ships int16 keys (records.merge_key16), halving
+    every key exchange's ICI bytes — the sharded full-view capacity
+    layout is also the cheaper one to scale out.
+    """
+    return INT16 if params.compact_carry else INT32
 
 
 def shift_exchanges_per_round(params, gate_contacts: bool = False):
     """Sharded block exchanges (ShiftEngine.deliver calls) per tick.
 
     Returns a dict of exchange-name -> row_bytes; the exchange count is
-    its length.  Pinned to models/swim._tick_shift by tests/test_traffic.py.
+    its length.  Pinned to models/swim._tick_shift by tests/test_traffic.py
+    (trace-time call counts AND the compiled HLO's collective operands).
     """
     k = params.n_subjects
+    kb = _key_bytes(params)
     channels = params.fanout + 2            # gossip channels + SYNC + refute
     exchanges = {}
     for c in range(channels):
-        exchanges[f"keys[{c}]"] = k * INT32
+        exchanges[f"keys[{c}]"] = k * kb
         exchanges[f"txmask[{c}]"] = k * INT8
     for c in range(params.fanout):          # gossip message counting
         exchanges[f"hot_any[{c}]"] = INT8
@@ -97,9 +110,9 @@ def scatter_collectives_per_round(params) -> int:
 
 def scatter_ici_bytes_per_device_round(params, n_devices: int) -> int:
     """Bytes each device sends over ICI per round, scatter mode: ring
-    all-reduce cost 2*(D-1)/D * buffer over the [N,K] int32 + int8
+    all-reduce cost 2*(D-1)/D * buffer over the [N,K] key + int8 flag
     buffers."""
     n, k = params.n_members, params.n_subjects
     bins = params.max_delay_rounds + 1 if params.max_delay_rounds > 0 else 1
-    buffer_bytes = n * k * (INT32 + INT8) * bins
+    buffer_bytes = n * k * (_key_bytes(params) + INT8) * bins
     return int(2 * (n_devices - 1) / n_devices * buffer_bytes)

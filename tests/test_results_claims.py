@@ -81,7 +81,14 @@ def claim(text, pattern):
 
 
 def rounded(value, digits=0):
-    """Round half away from zero, as the prose does (2.695 -> 2.70)."""
+    """Round half away from zero on the BINARY value (2.698 -> 2.70).
+
+    Note the usual FP caveat: a decimal .5 boundary stored inexactly
+    (e.g. 2.695 == 2.69499...) rounds by its binary value, i.e. down.
+    Artifact values come from measurements, so exact decimal halfway
+    points are measure-zero; if one ever bites, restate the prose digit
+    rather than complicating this helper.
+    """
     scale = 10 ** digits
     return math.floor(abs(value) * scale + 0.5) / scale * (1 if value >= 0 else -1)
 

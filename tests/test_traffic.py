@@ -56,15 +56,26 @@ def _op_operand_bytes(hlo_text, op_name):
     return out
 
 
-@pytest.mark.parametrize("n,k,gate", [(256, 16, False), (128, 128, True)])
-def test_shift_hlo_collectives_match_traffic_model(n, k, gate):
+@pytest.mark.parametrize("n,k,gate,compact", [
+    (256, 16, False, False),
+    (128, 128, True, False),
+    # compact layout: int16 keys must halve the key exchanges' ICI bytes
+    # in the compiled program too — full-view and focal (the no_message
+    # dtype discipline is what keeps int16 buffers from silently
+    # promoting back to int32; a promotion doubles the compiled bytes
+    # and fails here).
+    (128, 128, False, True),
+    (256, 16, False, True),
+])
+def test_shift_hlo_collectives_match_traffic_model(n, k, gate, compact):
     """The compiled sharded shift program's collective-permutes ARE the
     model: count == exchanges x 2 rotations x D branches (one ppermute
     per lax.switch branch; exactly 2 execute per exchange), and total
     operand bytes / D == shift_ici_bytes_per_device_round."""
     params = swim.SwimParams.from_config(
         fast_config(), n_members=n,
-        n_subjects=(None if gate else k), delivery="shift",
+        n_subjects=(None if k == n else k), delivery="shift",
+        compact_carry=compact,
     )
     world = swim.SwimWorld.healthy(params)
     if gate:
@@ -90,20 +101,24 @@ def test_shift_hlo_collectives_match_traffic_model(n, k, gate):
     assert _op_operand_bytes(hlo, "all-reduce") == []
 
 
-def test_scatter_hlo_collectives_match_traffic_model():
+@pytest.mark.parametrize("compact", [False, True])
+def test_scatter_hlo_collectives_match_traffic_model(compact):
     n, k = 256, 16
     params = swim.SwimParams.from_config(
         fast_config(), n_members=n, n_subjects=k, delivery="scatter",
+        compact_carry=compact,
     )
     world = swim.SwimWorld.healthy(params)
     hlo = _compiled_hlo(params, world)
 
     ars = _op_operand_bytes(hlo, "all-reduce")
-    # The full-height pmax combines: one s32[N,K] key buffer + one
-    # s8[N,K] ALIVE-flag buffer per round (delay modeling off).
+    # The full-height pmax combines: one key buffer (s32 wide, s16
+    # compact) + one s8 ALIVE-flag buffer per round (delay modeling off).
     assert len(ars) == traffic.scatter_collectives_per_round(params)
     dims = sorted(d for _, d, _ in ars)
     assert dims == [f"{n},{k}", f"{n},{k}"]
+    key_dtypes = {t for t, _, _ in ars}
+    assert key_dtypes == ({"s16", "s8"} if compact else {"s32", "s8"})
     buffer_bytes = sum(b for _, _, b in ars)
     # Ring all-reduce: each device sends 2*(D-1)/D of the buffer.
     assert int(2 * (N_DEV - 1) / N_DEV * buffer_bytes) == (
