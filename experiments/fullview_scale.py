@@ -56,6 +56,10 @@ from scalecube_cluster_tpu.utils import get_logger
 from scalecube_cluster_tpu.utils.runlog import enable_compilation_cache
 
 N = int(os.environ.get("SCALECUBE_FULLVIEW_N", 32_768))
+# 1 = the capacity-oriented compact carry layout (6 B/cell + int16 wire,
+# SwimParams.compact_carry) — halves per-device state on the mesh.
+COMPACT = os.environ.get("SCALECUBE_FULLVIEW_COMPACT", "") == "1"
+BYTES_PER_CELL = 6 if COMPACT else 13
 # Measured N=32k timeline: suspected 2, DEAD 8, disseminated 16; the
 # revived node's first sync push lands on the next sync_every boundary
 # and the re-accept gossips out in ~log4(N)+sweep rounds, so heal lands
@@ -82,13 +86,16 @@ def main():
     params = swim.SwimParams.from_config(
         config, n_members=N, delivery="shift",  # full view: n_subjects=None
         suspicion_rounds=6, ping_every=2, sync_every=4,
+        compact_carry=COMPACT,
     )
     world = swim.SwimWorld.healthy(params).with_crash(
         CRASH_NODE, at_round=CRASH_AT, until_round=REVIVE_AT
     )
-    log.info("N=%d full-view rows over %d devices (%.1f GB state, "
-             "%.2f GB/device)", N, mesh.devices.size, 13 * N * N / 1e9,
-             13 * N * N / mesh.devices.size / 1e9)
+    log.info("N=%d full-view rows over %d devices (%s layout: %.1f GB "
+             "state, %.2f GB/device)", N, mesh.devices.size,
+             "compact" if COMPACT else "wide",
+             BYTES_PER_CELL * N * N / 1e9,
+             BYTES_PER_CELL * N * N / mesh.devices.size / 1e9)
 
     t0 = time.perf_counter()
     state, metrics = mesh_lib.shard_run(
@@ -118,17 +125,18 @@ def main():
     result = {
         "n_members": N,
         "mode": "full-view (exact reference semantics, [N, N] state)",
+        "carry_layout": "compact" if COMPACT else "wide",
+        "bytes_per_cell": BYTES_PER_CELL,
         "devices": int(mesh.devices.size),
-        "state_gb": round(13 * N * N / 1e9, 2),
-        "state_gb_per_device": round(13 * N * N / mesh.devices.size / 1e9, 2),
+        "state_gb": round(BYTES_PER_CELL * N * N / 1e9, 2),
+        "state_gb_per_device": round(
+            BYTES_PER_CELL * N * N / mesh.devices.size / 1e9, 2),
         "rounds": ROUNDS,
         "wall_seconds_virtual_mesh": round(wall, 1),
         "timeline": timeline,
         "false_suspicion_onsets": fp,
-        "single_chip_ceiling": {
-            "fits": 16384, "oom": 20480,
-            "ms_per_round_at_16384_tpu": 45,
-        },
+        # Measured separately (per layout) by experiments/fullview_ceiling.py.
+        "single_chip_ceiling": "see artifacts/fullview_ceiling.json",
         "note": "virtual 8-device CPU mesh shares one host core; timing "
                 "is a correctness artifact, not perf — see "
                 "parallel/traffic.py for the multi-chip projection",
@@ -136,7 +144,17 @@ def main():
     # Artifact first (a ~1.5h compute run must not evaporate on a failed
     # expectation), assertions second.
     os.makedirs("artifacts", exist_ok=True)
-    out = "artifacts/fullview_scale.json"
+    # Non-default configurations get their own artifact name (derived
+    # from N + layout) so the canonical 32k wide demo — cited by
+    # RESULTS.md and pinned by tests/test_results_claims.py — is never
+    # silently overwritten by a differently-configured run.
+    default_out = (
+        "artifacts/fullview_scale.json"
+        if (N, COMPACT) == (32_768, False)
+        else f"artifacts/fullview_scale_{N // 1024}k_"
+             f"{'compact' if COMPACT else 'wide'}.json"
+    )
+    out = os.environ.get("SCALECUBE_FULLVIEW_OUT", default_out)
     with open(out, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result, indent=1))
