@@ -33,6 +33,10 @@ LADDERS = {
     "wide": [16_384, 20_480, 22_528, 24_576, 26_624],
     "compact": [16_384, 20_480, 22_528, 24_576, 26_624, 28_672, 30_720,
                 32_768, 36_864],
+    # compact + roll-based payload delivery (no persistent doubled
+    # [2N, N] buffers — value-identical, slower, but the doubled copies
+    # bind the ceiling; SwimParams.shift_roll_payloads).
+    "compact_roll": [26_624, 28_672, 30_720, 32_768, 36_864],
 }
 
 _CHILD = r"""
@@ -44,11 +48,12 @@ from scalecube_cluster_tpu.config import ClusterConfig
 from scalecube_cluster_tpu.utils.runlog import enable_compilation_cache
 
 enable_compilation_cache()
-n, compact, rounds = %(n)d, %(compact)r, %(rounds)d
+n, compact, roll, rounds = %(n)d, %(compact)r, %(roll)r, %(rounds)d
 try:
     params = swim.SwimParams.from_config(
         ClusterConfig.default_local(), n_members=n, delivery="shift",
-        compact_carry=compact, suspicion_rounds=6, ping_every=2,
+        compact_carry=compact, shift_roll_payloads=roll,
+        suspicion_rounds=6, ping_every=2,
         sync_every=4, per_subject_metrics=False,
     )
     world = swim.SwimWorld.healthy(params).with_crash(3, at_round=2)
@@ -92,8 +97,10 @@ except Exception as e:  # noqa: BLE001 — OOM classification by message
 """
 
 
-def attempt(n, compact):
-    code = _CHILD % {"repo": REPO, "n": n, "compact": compact,
+def attempt(n, layout):
+    code = _CHILD % {"repo": REPO, "n": n,
+                     "compact": layout.startswith("compact"),
+                     "roll": layout.endswith("_roll"),
                      "rounds": ROUNDS}
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
                          text=True, timeout=1200, cwd=REPO)
@@ -111,7 +118,7 @@ def main():
         rows = []
         for n in ladder:
             t0 = time.perf_counter()
-            r = attempt(n, layout == "compact")
+            r = attempt(n, layout)
             r.update(n_members=n,
                      attempt_wall_s=round(time.perf_counter() - t0, 1))
             rows.append(r)
@@ -120,7 +127,7 @@ def main():
                 break
         fitting = [r for r in rows if r["fits"]]
         results[layout] = {
-            "bytes_per_cell_carry": 6 if layout == "compact" else 13,
+            "bytes_per_cell_carry": 13 if layout == "wide" else 6,
             "attempts": rows,
             "max_fits": max((r["n_members"] for r in fitting), default=0),
             "first_oom": next((r["n_members"] for r in rows

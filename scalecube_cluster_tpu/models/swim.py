@@ -164,6 +164,15 @@ class SwimParams:
     # saved bandwidth) — it exists to raise the [N, N] single-chip
     # CEILING, where the regime is capacity-, not compute-bound.
     compact_carry: bool = False
+    # Single-device shift delivery: replace the persistent doubled
+    # [2N, K] payload buffers with a jnp.roll per channel (transient
+    # two-slice concats) — value-identical (ops/shift.ShiftEngine
+    # docstring), measured ~equal speed at full-view scale, and a
+    # negative result for capacity: the ceiling boundary turned out to
+    # be compile-stage, not HBM (RESULTS.md round-4 optimization log).
+    # No effect on sharded runs (sharded payloads never double) or
+    # scatter mode.
+    shift_roll_payloads: bool = False
 
     def __post_init__(self):
         if self.delivery not in ("scatter", "shift"):
@@ -1388,7 +1397,8 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     r_proxies = params.ping_req_members
     f = params.fanout
     eng = shift_ops.ShiftEngine(n, offset=offset, axis_name=axis_name,
-                                n_devices=n_devices, n_local=n_local)
+                                n_devices=n_devices, n_local=n_local,
+                                roll_payloads=params.shift_roll_payloads)
 
     # One shift per send channel: [fd, proxies..., gossip..., sync].
     # Drawn from the UN-offset-folded key: all devices must agree on the

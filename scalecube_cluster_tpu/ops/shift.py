@@ -99,12 +99,24 @@ class ShiftEngine:
     """
 
     def __init__(self, n: int, offset=0, axis_name=None, n_devices: int = 1,
-                 n_local: int = None):
+                 n_local: int = None, roll_payloads: bool = False):
         self.n = n
         self.offset = offset            # traced scalar under shard_map
         self.axis_name = axis_name
         self.n_devices = n_devices
         self.n_local = n if n_local is None else n_local
+        # Single-device payload delivery normally doubles the buffer once
+        # ([2N, K]) and slices per channel; the doubled copy is
+        # PERSISTENT across the whole round.  ``roll_payloads`` trades it
+        # for a jnp.roll per channel (two slices + concat, a transient
+        # [N, K] the consumer fuses), value-identical:
+        # roll(x, s)[j] == doubled(x)[n - s + j] == x[(j - s) % n].
+        # Measured ~equal speed at full-view 26,624 (100.8 vs 101.4
+        # ms/round) and did NOT move the capacity ceiling — the 28,672
+        # boundary is compile-stage, not HBM (RESULTS.md round-4 log).
+        # Sharded payloads never double (blocks travel by ppermute), so
+        # the flag only affects the axis_name=None path.
+        self.roll_payloads = roll_payloads
 
     # -- replicated world vectors ([N] on every device) -------------------
 
@@ -125,7 +137,7 @@ class ShiftEngine:
 
     def prep(self, x_local):
         if self.axis_name is None:
-            return doubled(x_local)
+            return x_local if self.roll_payloads else doubled(x_local)
         return x_local
 
     def _rotate_blocks(self, x_local, d_blocks):
@@ -144,6 +156,8 @@ class ShiftEngine:
     def deliver(self, h, shift):
         """Receiver row l gets sender row (off + l - shift) % n."""
         if self.axis_name is None:
+            if self.roll_payloads:
+                return jnp.roll(h, jnp.asarray(shift, jnp.int32), axis=0)
             return deliver(h, shift, self.n)
         ll = self.n_local
         d_blocks = shift // ll

@@ -204,6 +204,28 @@ def test_compact_sharded_matches_wide_sharded():
     assert alive9[-1] == params.n_members - 1
 
 
+@pytest.mark.parametrize("compact", [False, True])
+def test_roll_payload_delivery_is_bit_identical(compact):
+    """shift_roll_payloads (jnp.roll per channel instead of a persistent
+    doubled [2N, K] buffer — the capacity variant) must not change a
+    single bit of the trace in either carry layout."""
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=48, delivery="shift",
+        compact_carry=compact, loss_probability=0.15,
+    )
+    params_roll = dataclasses.replace(params, shift_roll_payloads=True)
+    world = (swim.SwimWorld.healthy(params)
+             .with_crash(4, at_round=10, until_round=80))
+    s_a, m_a = swim.run(jax.random.key(21), params, world, 150)
+    s_b, m_b = swim.run(jax.random.key(21), params_roll, world, 150)
+    for name in m_a:
+        np.testing.assert_array_equal(np.asarray(m_a[name]),
+                                      np.asarray(m_b[name]), err_msg=name)
+    np.testing.assert_array_equal(np.asarray(s_a.status),
+                                  np.asarray(s_b.status))
+    np.testing.assert_array_equal(np.asarray(s_a.inc), np.asarray(s_b.inc))
+
+
 def test_compact_validation():
     base = swim.SwimParams.from_config(fast_config(), n_members=16)
     with pytest.raises(ValueError, match="max_delay_rounds"):

@@ -364,6 +364,20 @@ def test_fullview_ceiling_table(results_text, ceiling):
     )
     assert wide_reach == ceiling["layouts"]["wide"]["max_fits"]
 
+    # The roll-probe claims: same boundary, ~equal ms at the ceiling.
+    roll = ceiling["layouts"]["compact_roll"]
+    compact = ceiling["layouts"]["compact"]
+    (roll_fail,) = claim(
+        results_text, r"still fails at ([\d,]+) in the same\s+way"
+    )
+    assert roll_fail == roll["first_oom"] == compact["first_oom"]
+    roll_ms, compact_ms = claim(
+        results_text,
+        r"costing nothing at 26,624 \((\d+\.\d) vs (\d+\.\d) ms/round\)",
+    )
+    assert roll_ms == rounded(at("compact_roll", 26_624)["ms_per_round"], 1)
+    assert compact_ms == rounded(at("compact", 26_624)["ms_per_round"], 1)
+
 
 def test_stated_suite_size_matches_collection(results_text):
     """Round 2 said "218 tests" when 245 existed; round 3 repeated it.
