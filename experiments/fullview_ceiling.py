@@ -31,12 +31,12 @@ ROUNDS = 60          # timed window per fitting attempt (plus 1 warmup run)
 # from live range), so both layouts are probed from 16k upward.
 LADDERS = {
     "wide": [16_384, 20_480, 22_528, 24_576, 26_624],
-    "compact": [16_384, 20_480, 22_528, 24_576, 26_624, 28_672, 30_720,
-                32_768, 36_864],
+    "compact": [16_384, 20_480, 22_528, 24_576, 26_624, 27_648, 28_672,
+                30_720, 32_768, 36_864],
     # compact + roll-based payload delivery (no persistent doubled
     # [2N, N] buffers — value-identical, slower, but the doubled copies
     # bind the ceiling; SwimParams.shift_roll_payloads).
-    "compact_roll": [26_624, 28_672, 30_720, 32_768, 36_864],
+    "compact_roll": [26_624, 27_648, 28_672, 30_720, 32_768, 36_864],
 }
 
 _CHILD = r"""
