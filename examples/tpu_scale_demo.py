@@ -20,6 +20,7 @@ import numpy as np
 
 from scalecube_cluster_tpu.config import ClusterConfig
 from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.utils import runlog
 
 
 def main():
@@ -41,7 +42,9 @@ def main():
 
     t0 = time.perf_counter()
     _, metrics = swim.run(jax.random.key(0), params, world, rounds)
-    jax.block_until_ready(metrics["alive"])
+    # Scalar-fetch barrier: block_until_ready can return before execution
+    # finishes on the axon TPU platform (utils/runlog.completion_barrier).
+    runlog.completion_barrier(metrics["alive"])
     elapsed = time.perf_counter() - t0
 
     suspects = np.asarray(metrics["suspect"])[:, 0]
