@@ -31,13 +31,19 @@ ROUNDS = 60          # timed window per fitting attempt (plus 1 warmup run)
 # from live range), so both layouts are probed from 16k upward.
 LADDERS = {
     "wide": [16_384, 20_480, 22_528, 24_576, 26_624],
-    "compact": [16_384, 20_480, 22_528, 24_576, 26_624, 27_648, 28_672,
-                30_720, 32_768, 36_864],
+    # 28,160 brackets the compact boundary at 512-row granularity
+    # (27,648 fits / 28,160 fails — round-4 measurement).
+    "compact": [16_384, 20_480, 22_528, 24_576, 26_624, 27_648, 28_160,
+                28_672, 30_720, 32_768, 36_864],
     # compact + roll-based payload delivery (no persistent doubled
     # [2N, N] buffers — value-identical, slower, but the doubled copies
     # bind the ceiling; SwimParams.shift_roll_payloads).
-    "compact_roll": [26_624, 27_648, 28_672, 30_720, 32_768, 36_864],
+    "compact_roll": [26_624, 28_160, 28_672, 30_720, 32_768, 36_864],
 }
+# Keep probing past the first failure so the boundary gets bracketed
+# (compile-stage failures at rung r don't imply failure at every r' > r a
+# priori); stop only once this many consecutive rungs fail.
+CONSECUTIVE_FAILURES_TO_STOP = 2
 
 _CHILD = r"""
 import json, sys, time
@@ -102,8 +108,22 @@ def attempt(n, layout):
                      "compact": layout.startswith("compact"),
                      "roll": layout.endswith("_roll"),
                      "rounds": ROUNDS}
-    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                         text=True, timeout=1200, cwd=REPO)
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=1200,
+                             cwd=REPO)
+    except subprocess.TimeoutExpired as e:
+        # A hung child is a non-fitting rung, not a lost ladder: record it
+        # and keep probing so the partial results still reach the artifact.
+        # But first salvage any result the child already printed — a
+        # completed measurement followed by a teardown hang is a fit.
+        stdout = e.stdout or b""
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode("utf-8", "replace")
+        for line in reversed(stdout.splitlines()):
+            if line.startswith("{"):
+                return json.loads(line)
+        return {"fits": False, "oom": False, "error": "timeout (1200s)"}
     for line in reversed(out.stdout.splitlines()):
         if line.startswith("{"):
             return json.loads(line)
@@ -116,6 +136,7 @@ def main():
     results = {}
     for layout, ladder in LADDERS.items():
         rows = []
+        consecutive_failures = 0
         for n in ladder:
             t0 = time.perf_counter()
             r = attempt(n, layout)
@@ -123,15 +144,22 @@ def main():
                      attempt_wall_s=round(time.perf_counter() - t0, 1))
             rows.append(r)
             print(f"[{layout}] N={n}: {json.dumps(r)}", file=sys.stderr)
-            if not r["fits"]:
+            consecutive_failures = 0 if r["fits"] else consecutive_failures + 1
+            if consecutive_failures >= CONSECUTIVE_FAILURES_TO_STOP:
                 break
         fitting = [r for r in rows if r["fits"]]
+        max_fits = max((r["n_members"] for r in fitting), default=0)
         results[layout] = {
             "bytes_per_cell_carry": 13 if layout == "wide" else 6,
             "attempts": rows,
-            "max_fits": max((r["n_members"] for r in fitting), default=0),
+            "max_fits": max_fits,
+            # The capacity boundary: smallest non-fitting rung ABOVE every
+            # fitting rung (bracketing may probe past a transient failure
+            # that a later rung contradicts, so "first failure in probe
+            # order" is not the boundary).
             "first_oom": next((r["n_members"] for r in rows
-                               if not r["fits"]), None),
+                               if not r["fits"]
+                               and r["n_members"] > max_fits), None),
         }
 
     ratio = (results["compact"]["max_fits"]

@@ -177,6 +177,18 @@ class SwimParams:
     def __post_init__(self):
         if self.delivery not in ("scatter", "shift"):
             raise ValueError(f"unknown delivery mode {self.delivery!r}")
+        if self.delivery == "shift" and self.ping_known_only != self.full_view:
+            # Shift mode has no known-only probe path at K < N (its FD
+            # target is the shared offset; eligibility is evaluated at the
+            # slot) — the two flags must agree so wire-probe counters and
+            # FD targeting mean the same thing in both delivery modes.
+            # from_config derives ping_known_only = (K == N); direct
+            # constructions must do the same.
+            raise ValueError(
+                f"shift delivery requires ping_known_only == full_view "
+                f"(got ping_known_only={self.ping_known_only}, "
+                f"n_subjects={self.n_subjects}, n_members={self.n_members})"
+            )
         if self.compact_carry:
             if self.max_delay_rounds != 0:
                 raise ValueError(
@@ -1490,7 +1502,9 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         # True wire-message accounting — see _tick_scatter's probes_sent
         # comment: every live member probes its offset target each fd
         # round; ``active`` gates only the tracked-subject bookkeeping.
-        probes_sent = (active if params.full_view
+        # Same predicate as scatter mode (ping_known_only == full_view is
+        # validated for shift delivery in SwimParams.__post_init__).
+        probes_sent = (active if params.ping_known_only
                        else fd_round & alive_here)
         ping_req_n = jnp.sum(
             probes_sent & ~direct_ok, dtype=jnp.int32
@@ -1703,7 +1717,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
 
 
 def node_snapshot(state: SwimState, params: SwimParams, world: SwimWorld,
-                  node_id: int, round_idx: int = 0) -> dict:
+                  node_id: int, round_idx: Optional[int] = None) -> dict:
     """Queryable per-node state dump — the JMX MBean analog for the tick.
 
     Host-side digest of one observer row, mirroring the reference's
@@ -1716,11 +1730,19 @@ def node_snapshot(state: SwimState, params: SwimParams, world: SwimWorld,
     the next round the state would run (e.g. the number of rounds
     executed so far) so a ``compact_carry`` state's relative
     remaining-rounds encodings decode to the same absolute rounds the
-    wide layout reports.
+    wide layout reports.  REQUIRED for ``compact_carry`` states (no
+    correct default exists for a relative encoding); optional for the
+    wide layout, where the state is already absolute.
     """
     import numpy as np
 
     if params.compact_carry:
+        if round_idx is None:
+            raise ValueError(
+                "node_snapshot of a compact_carry state needs round_idx "
+                "(the cursor its relative encodings decode against); "
+                "pass the number of rounds executed so far"
+            )
         state = _carry_decode(state, round_idx)
     status = np.asarray(state.status[node_id])
     inc = np.asarray(state.inc[node_id])

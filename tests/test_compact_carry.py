@@ -235,3 +235,20 @@ def test_compact_validation():
                             suspicion_rounds=40_000)
     with pytest.raises(ValueError, match="spread"):
         dataclasses.replace(base, compact_carry=True, periods_to_spread=200)
+
+
+def test_compact_node_snapshot_requires_round_idx():
+    """A compact state's relative encodings have no correct default cursor
+    — omitting round_idx must raise, not silently decode against 0."""
+    params = dataclasses.replace(
+        swim.SwimParams.from_config(fast_config(), n_members=16),
+        compact_carry=True,
+    )
+    world = swim.SwimWorld.healthy(params)
+    state = swim.initial_state(params, world)
+    with pytest.raises(ValueError, match="round_idx"):
+        swim.node_snapshot(state, params, world, node_id=0)
+    # The wide layout stays optional (its state is absolute).
+    params_w = swim.SwimParams.from_config(fast_config(), n_members=16)
+    state_w = swim.initial_state(params_w, world)
+    swim.node_snapshot(state_w, params_w, world, node_id=0)

@@ -428,3 +428,20 @@ class TestHonestMessageCounters:
         np.testing.assert_array_equal(
             np.asarray(m["messages_ping_sent"]), np.asarray(m["messages_ping"])
         )
+
+
+def test_shift_delivery_requires_ping_known_only_matching_full_view():
+    """Directly-constructed shift params with mismatched flags must fail
+    loudly: shift mode has no known-only probe path at K < N, so a focal
+    SwimParams keeping the dataclass default ping_known_only=True would
+    silently count wire probes differently across delivery modes."""
+    with pytest.raises(ValueError, match="ping_known_only"):
+        swim.SwimParams.from_config(
+            fast_config(), n_members=64, n_subjects=8, delivery="shift",
+            ping_known_only=True,
+        )
+    # from_config derives the flag; both delivery modes accept the result.
+    p = swim.SwimParams.from_config(
+        fast_config(), n_members=64, n_subjects=8, delivery="shift"
+    )
+    assert not p.ping_known_only
