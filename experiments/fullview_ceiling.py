@@ -122,7 +122,10 @@ def attempt(n, layout):
             stdout = stdout.decode("utf-8", "replace")
         for line in reversed(stdout.splitlines()):
             if line.startswith("{"):
-                return json.loads(line)
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    break  # killed mid-write: treat as the timeout it is
         return {"fits": False, "oom": False, "error": "timeout (1200s)"}
     for line in reversed(out.stdout.splitlines()):
         if line.startswith("{"):

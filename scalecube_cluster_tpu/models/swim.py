@@ -173,6 +173,24 @@ class SwimParams:
     # No effect on sharded runs (sharded payloads never double) or
     # scatter mode.
     shift_roll_payloads: bool = False
+    # Per-sender wire counters — the NetworkEmulator measurement substrate
+    # (transport/NetworkEmulator.java:200-222 totalMessageSent/LostCount;
+    # the reference's gossip experiments read exactly these counters,
+    # GossipProtocolTest.java:212-228).  When on, each round's metrics
+    # gain ``sent_by_node``/``lost_by_node`` [N] int32: wire messages
+    # each sender issued, and the subset dropped in flight by the network
+    # model (per-link loss/block rules, default loss, partition walls).
+    # "Lost" counts network drops only — a message toward a crashed
+    # receiver still counts as sent (the reference increments sent before
+    # the connect; a refused connect is an error, not an emulator loss).
+    # FD probe chains are collapsed to one closed-form draw (_chain_ok),
+    # so their in-flight losses are not attributable per hop: pings and
+    # ping-req fan-outs count as sent, and probe-chain loss surfaces in
+    # verdicts rather than lost_by_node (documented deviation; the
+    # reference substrate's tests measure the gossip channel, where this
+    # accounting is exact).  Single-device only (the counters are a
+    # small/medium-N measurement substrate, not a 1M perf path).
+    link_counters: bool = False
 
     def __post_init__(self):
         if self.delivery not in ("scatter", "shift"):
@@ -190,12 +208,6 @@ class SwimParams:
                 f"n_subjects={self.n_subjects}, n_members={self.n_members})"
             )
         if self.compact_carry:
-            if self.max_delay_rounds != 0:
-                raise ValueError(
-                    "compact_carry supports max_delay_rounds=0 only (the "
-                    "delay ring is a small-N validation mode and stays "
-                    "int32)"
-                )
             if self.periods_to_spread + 1 > 127:
                 raise ValueError(
                     f"compact_carry stores remaining spread rounds as int8; "
@@ -639,7 +651,9 @@ def initial_state(params: SwimParams, world: SwimWorld,
             suspect_deadline=jnp.full((n, k), _DEADLINE_NONE16,
                                       dtype=jnp.int16),
             self_inc=jnp.zeros((n,), dtype=jnp.int32),
-            inbox_ring=jnp.full((d_slots, n, k), -1, dtype=jnp.int32),
+            # The ring stores wire-format keys; compact mode's wire is
+            # int16 (records.merge_key16), so its delayed slots are too.
+            inbox_ring=jnp.full((d_slots, n, k), -1, dtype=jnp.int16),
             flag_ring=jnp.zeros((d_slots, n, k), dtype=jnp.int8),
         )
     return SwimState(
@@ -777,7 +791,7 @@ def _ring_open(state: SwimState, params: SwimParams, round_idx):
         return None, None, None, None, None
     slot0 = round_idx % (params.max_delay_rounds + 1)
     inbox_now, ring = ring_ops.open_slot(
-        state.inbox_ring, slot0, delivery.NO_MESSAGE
+        state.inbox_ring, slot0, delivery.no_message(params.compact_carry)
     )
     flags_now, fring = ring_ops.open_slot(
         state.flag_ring, slot0, jnp.int8(0)
@@ -803,6 +817,7 @@ def _route_delayed(ok, delivered, delivered_flags, delay_mean, key, params,
     """
     if params.max_delay_rounds == 0 or delay_mean is None:
         return ok, ring, fring
+    no_msg = delivery.no_message(params.compact_carry)
     q = ring_ops.delay_bins(key, delay_mean, params.round_ms,
                             params.max_delay_rounds, ok.shape)
     d = params.max_delay_rounds + 1
@@ -810,7 +825,7 @@ def _route_delayed(ok, delivered, delivered_flags, delay_mean, key, params,
         m = (ok & (q == j))[:, None]
         ring, fring = _ring_push(
             ring, fring, (slot0 + j) % d,
-            jnp.where(m, delivered, delivery.NO_MESSAGE),
+            jnp.where(m, delivered, no_msg),
             delivered_flags & m,
         )
     return ok & (q == 0), ring, fring
@@ -862,6 +877,11 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     kn = knobs if knobs is not None else Knobs.from_params(params)
     n, k = params.n_members, params.n_subjects
     n_local = state.status.shape[0]
+    if params.link_counters and axis_name is not None:
+        raise NotImplementedError(
+            "link_counters is a single-device measurement substrate "
+            "(per-sender [N] rows don't cross shard_map metric combining)"
+        )
     if params.compact_carry:
         state = _carry_decode(state, round_idx)
     # Fold both the round and the shard offset so draws are independent
@@ -1027,6 +1047,11 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
         messages_ping_req_sent=global_sum(aux["messages_ping_req_sent"]),
         refutations=global_sum(aux["refutations"]),
     )
+    if params.link_counters:
+        # Per-sender NetworkEmulator counters (single-device; validated
+        # above) — [N] rows, stacked by the scan into [rounds, N] traces.
+        metrics["sent_by_node"] = aux["sent_by_node"]
+        metrics["lost_by_node"] = aux["lost_by_node"]
     if params.compact_carry:
         new_state = _carry_encode(new_state, round_idx)
     return new_state, metrics
@@ -1282,18 +1307,26 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     gossip_targets = prng.targets_excluding_self(
         k_gossip_t, n_local, n, params.fanout, sender_offset=offset
     )
-    send_ok = alive_here[:, None] & alive[gossip_targets] \
-        & same_partition(node_ids[:, None], gossip_targets)
-    if gate_contacts:
-        send_ok &= known_live(gossip_targets) | is_seed(gossip_targets)
+    # Named components (vs one fused mask): the link_counters substrate
+    # attributes in-flight drops (wire loss, partition walls) separately
+    # from never-sent (dead sender, contact gate) and not-delivered
+    # (crashed receiver) — the reference's sent/lost split.
+    part_ok_g = same_partition(node_ids[:, None], gossip_targets)
+    contact_ok_g = (known_live(gossip_targets) | is_seed(gossip_targets)
+                    if gate_contacts
+                    else jnp.ones((n_local, params.fanout), dtype=jnp.bool_))
+    send_ok = (alive_here[:, None] & alive[gossip_targets] & part_ok_g
+               & contact_ok_g)
     loss_g, delay_g = link_eval(world.faults, round_idx, node_ids[:, None],
                                 gossip_targets, kn.loss_probability,
                                 params.mean_delay_ms)
-    gossip_drop = (
-        prng.bernoulli_mask(k_gossip_drop, loss_g, (n_local, params.fanout))
-        | ~send_ok
-        | (jnp.arange(params.fanout, dtype=jnp.int32)[None, :] >= kn.fanout)
+    wire_drop_g = prng.bernoulli_mask(
+        k_gossip_drop, loss_g, (n_local, params.fanout)
     )
+    chan_off = (
+        jnp.arange(params.fanout, dtype=jnp.int32)[None, :] >= kn.fanout
+    )
+    gossip_drop = wire_drop_g | ~send_ok | chan_off
 
     # SYNC: full-row push to one random member (doSync,
     # MembershipProtocolImpl.java:298-314).
@@ -1316,11 +1349,9 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     loss_s, delay_s = link_eval(world.faults, round_idx, node_ids,
                                 sync_target[:, 0], kn.loss_probability,
                                 params.mean_delay_ms)
-    sync_ok = (
-        alive[sync_target[:, 0]]
-        & same_partition(node_ids, sync_target[:, 0])
-        & ~prng.bernoulli_mask(k_sync_drop, loss_s, (n_local,))
-    )
+    part_ok_s = same_partition(node_ids, sync_target[:, 0])
+    wire_drop_s = prng.bernoulli_mask(k_sync_drop, loss_s, (n_local,))
+    sync_ok = alive[sync_target[:, 0]] & part_ok_s & ~wire_drop_s
     sync_drop = (~(do_sync & sync_ok))[:, None]
 
     # Accumulate all send channels into one global-height contribution,
@@ -1390,6 +1421,22 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
         ),
         refutations=jnp.sum(refuted & alive_here, dtype=jnp.int32),
     )
+    if params.link_counters:
+        # Per-sender wire accounting (SwimParams.link_counters docstring).
+        # A gossip message exists per active channel when the sender is
+        # live, has hot records, and its peer-list gate admits the target.
+        g_attempt = (alive_here & hot_any)[:, None] & contact_ok_g & ~chan_off
+        g_lost = g_attempt & (wire_drop_g | ~part_ok_g)
+        s_lost = do_sync & (wire_drop_s | ~part_ok_s)
+        aux["sent_by_node"] = (
+            jnp.sum(g_attempt, axis=1, dtype=jnp.int32)
+            + do_sync.astype(jnp.int32)
+            + probes_sent.astype(jnp.int32)
+            + ping_req_launches.astype(jnp.int32) * r_proxies
+        )
+        aux["lost_by_node"] = (
+            jnp.sum(g_lost, axis=1, dtype=jnp.int32) + s_lost.astype(jnp.int32)
+        )
     return new_state, aux
 
 
@@ -1506,14 +1553,14 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         # validated for shift delivery in SwimParams.__post_init__).
         probes_sent = (active if params.ping_known_only
                        else fd_round & alive_here)
-        ping_req_n = jnp.sum(
-            probes_sent & ~direct_ok, dtype=jnp.int32
-        ) * r_proxies
+        ping_req_launches = probes_sent & ~direct_ok
         return (suspect_v, refute_v, active,
-                jnp.maximum(slot, 0), entry_t_inc, probes_sent, ping_req_n)
+                jnp.maximum(slot, 0), entry_t_inc, probes_sent,
+                ping_req_launches)
 
     (verdict_suspect, push_refute, probe_active, slot_safe,
-     entry_t_inc, probes_sent, ping_req_n) = fd_phase(0)
+     entry_t_inc, probes_sent, ping_req_launches) = fd_phase(0)
+    ping_req_n = jnp.sum(ping_req_launches, dtype=jnp.int32) * r_proxies
 
     compact = params.compact_carry
     no_msg = delivery.no_message(compact)
@@ -1573,6 +1620,17 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
 
     drop_u = jax.random.uniform(k_gossip_drop, (n_local, f + 1))
 
+    # Per-sender wire accounting (SwimParams.link_counters docstring):
+    # channel gates evaluate at the receiver in shift mode, so the masks
+    # unshift back to the sender — sender i's channel-s message rides to
+    # receiver (i + s) % n, one doubled-slice per mask.
+    counters_on = params.link_counters
+    sent_acc = jnp.zeros((n_local,), jnp.int32) if counters_on else None
+    lost_acc = jnp.zeros((n_local,), jnp.int32) if counters_on else None
+
+    def unshift(x_local, s):
+        return eng.look_replicated(eng.prep_replicated(x_local), s)
+
     inbox_now, flags_now, ring, fring, slot0 = _ring_open(
         state, params, round_idx
     )
@@ -1595,6 +1653,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             & (drop_u[:, c] >= loss_c)
             & (jnp.int32(c) < kn.fanout)
         )
+        contact_ok_c = None
         if gate_contacts:
             # Sender-side knowledge of the receiver, evaluated at the
             # receiver: sender's record of me (full-view: my id column).
@@ -1602,11 +1661,21 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
                 eng.deliver(h_status, s),
                 node_ids[:, None], axis=1,
             )[:, 0]
-            ok_c &= (
+            contact_ok_c = (
                 (sender_knows == records.ALIVE)
                 | (sender_knows == records.SUSPECT)
                 | is_seed(node_ids)
             )
+            ok_c &= contact_ok_c
+        if counters_on:
+            attempt_c = (sender_alive & eng.deliver(h_hot_any, s)
+                         & (jnp.int32(c) < kn.fanout))
+            if contact_ok_c is not None:
+                attempt_c &= contact_ok_c
+            lost_c = attempt_c & ((drop_u[:, c] < loss_c)
+                                  | (sender_part != part_here))
+            sent_acc += unshift(attempt_c, s).astype(jnp.int32)
+            lost_acc += unshift(lost_c, s).astype(jnp.int32)
         delivered, delivered_flags = deliver_gossip(s)    # [n_local, K]
         ok_now, ring, fring = _route_delayed(
             ok_c, delivered, delivered_flags, delay_c,
@@ -1646,12 +1715,11 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         loss_r, delay_r = link_eval(world.faults, round_idx, sender_ids_r,
                                     node_ids, kn.loss_probability,
                                     params.mean_delay_ms)
-        ok_r = (
-            sender_alive_r & alive_here
-            & (eng.deliver_replicated(d_part, fd_shift) == part_here)
-            & (jax.random.uniform(k_sync_drop, (n_local,)) >= loss_r)
-        )
-        ok_r = ok_r & eng.deliver(h_pushers, fd_shift)
+        part_ok_r = eng.deliver_replicated(d_part, fd_shift) == part_here
+        wire_drop_r = jax.random.uniform(k_sync_drop, (n_local,)) < loss_r
+        pushing_r = eng.deliver(h_pushers, fd_shift)
+        ok_r = (sender_alive_r & alive_here & part_ok_r & ~wire_drop_r
+                & pushing_r)
         delivered_r, flags_r = deliver_sync(fd_shift)
         ok_r_now, ring_, fring_ = _route_delayed(
             ok_r, delivered_r, flags_r, delay_r,
@@ -1660,13 +1728,19 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         )
         contrib = jnp.where(ok_r_now[:, None], delivered_r, no_msg)
         fcontrib = flags_r & ok_r_now[:, None]
+        lost_r_mask = pushing_r & (wire_drop_r | ~part_ok_r)
         return contrib, fcontrib, ring_, fring_, \
-            eng.deliver(h_pushers, sync_shift)
+            eng.deliver(h_pushers, sync_shift), lost_r_mask
 
-    refute_contrib, refute_flags, ring, fring, sender_refuting = \
-        refute_deliver((ring, fring))
+    (refute_contrib, refute_flags, ring, fring, sender_refuting,
+     refute_lost_r) = refute_deliver((ring, fring))
     inbox = jnp.maximum(inbox, refute_contrib)
     inbox_alive |= refute_flags
+    if counters_on:
+        # The refute push is sender-local (the pusher mask IS per sender);
+        # only its in-flight loss needs unshifting back from the receiver.
+        sent_acc += push_refute.astype(jnp.int32)
+        lost_acc += unshift(refute_lost_r, fd_shift).astype(jnp.int32)
 
     # SYNC channel: the periodic anti-entropy push, plus the FD
     # alive-on-suspected refute push (aimed at the probed member = the
@@ -1678,20 +1752,31 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     loss_sy, delay_sy = link_eval(world.faults, round_idx, sender_ids_s,
                                   node_ids, kn.loss_probability,
                                   params.mean_delay_ms)
+    part_ok_sy = sender_part == part_here
+    wire_drop_sy = drop_u[:, f] < loss_sy
     ok_s = (
         sync_round & sender_alive & alive_here & ~sender_refuting
-        & (sender_part == part_here) & (drop_u[:, f] >= loss_sy)
+        & part_ok_sy & ~wire_drop_sy
     )
+    contact_ok_sy = None
     if gate_contacts:
         sender_knows = jnp.take_along_axis(
             eng.deliver(h_status, s),
             node_ids[:, None], axis=1,
         )[:, 0]
-        ok_s &= (
+        contact_ok_sy = (
             (sender_knows == records.ALIVE)
             | (sender_knows == records.SUSPECT)
             | is_seed(node_ids)
         )
+        ok_s &= contact_ok_sy
+    if counters_on:
+        attempt_sy = sync_round & sender_alive & ~sender_refuting
+        if contact_ok_sy is not None:
+            attempt_sy &= contact_ok_sy
+        lost_sy = attempt_sy & (wire_drop_sy | ~part_ok_sy)
+        sent_acc += unshift(attempt_sy, s).astype(jnp.int32)
+        lost_acc += unshift(lost_sy, s).astype(jnp.int32)
     delivered, delivered_flags = deliver_sync(s)
     ok_s_now, ring, fring = _route_delayed(
         ok_s, delivered, delivered_flags, delay_sy,
@@ -1713,6 +1798,12 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         messages_ping_req_sent=ping_req_n,
         refutations=jnp.sum(refuted & alive_here, dtype=jnp.int32),
     )
+    if counters_on:
+        aux["sent_by_node"] = (
+            sent_acc + probes_sent.astype(jnp.int32)
+            + ping_req_launches.astype(jnp.int32) * r_proxies
+        )
+        aux["lost_by_node"] = lost_acc
     return new_state, aux
 
 

@@ -69,6 +69,7 @@ class Cluster:
         self.transport = Transport(
             sim,
             address=None if config.port == 0 else Address("localhost", config.port),
+            max_frame_length=config.max_frame_length,
         )
         member_id = generate_member_id(sim.rng) if alias is None else alias
         # memberHost/memberPort override: the member ADVERTISES a different

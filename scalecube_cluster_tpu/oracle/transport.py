@@ -72,6 +72,16 @@ class NetworkEmulatorError(Exception):
     (reference: transport/NetworkEmulatorException.java)."""
 
 
+class FrameTooLongError(Exception):
+    """Serialized message exceeds the transport's max frame length.
+
+    The analog of netty's TooLongFrameException under the reference's
+    4-byte length-prefix framing: TransportImpl caps frames at
+    ``TransportConfig.maxFrameLength`` (2MB default) on both the encode
+    and decode paths (transport/TransportImpl.java:370-384,
+    TransportConfig.java:9)."""
+
+
 class NetworkEmulator:
     """Outbound fault injection for one node (reference: NetworkEmulator.java:21-273)."""
 
@@ -142,12 +152,25 @@ class Transport:
     """
 
     def __init__(self, sim: Simulator, address: Optional[Address] = None,
-                 enabled_emulator: bool = True, codec="json"):
+                 enabled_emulator: bool = True, codec="json",
+                 max_frame_length: Optional[int] = None):
         """``codec``: "json" (default) routes every send through the
         JsonMessageCodec wire round-trip (the in-process analog of the
         reference's encode -> TCP -> decode, JacksonMessageCodec.java:15-52);
         a MessageCodec instance plugs in a custom codec; None disables
-        serialization (raw object hand-off)."""
+        serialization (raw object hand-off).
+
+        ``max_frame_length``: cap on the serialized frame size in bytes
+        (TransportConfig.maxFrameLength, 2MB default); an oversized send
+        fails its future with :class:`FrameTooLongError` before reaching
+        the emulator, like the reference's length-prefix framing
+        (TransportImpl.java:370-384).  None = the 2MB default; enforced
+        only when a codec is active (no codec = no wire, nothing to
+        frame)."""
+        from scalecube_cluster_tpu.config import DEFAULT_MAX_FRAME_LENGTH
+        self.max_frame_length = (DEFAULT_MAX_FRAME_LENGTH
+                                 if max_frame_length is None
+                                 else max_frame_length)
         self.sim = sim
         self.address = address or Address("localhost", sim.allocate_port())
         if self.address in sim.transports:
@@ -196,7 +219,14 @@ class Transport:
             # delivery — unserializable payloads fail the send future, like
             # a codec error inside TransportImpl.send0 (:257-269).
             try:
-                message = self.codec.deserialize(self.codec.serialize(message))
+                frame = self.codec.serialize(message)
+                if len(frame) > self.max_frame_length:
+                    raise FrameTooLongError(
+                        f"frame of {len(frame)} bytes exceeds "
+                        f"max_frame_length={self.max_frame_length} "
+                        f"({self.address} -> {destination})"
+                    )
+                message = self.codec.deserialize(frame)
             except Exception as e:  # noqa: BLE001 — surfaced on the future
                 future.reject(e)
                 return future

@@ -228,8 +228,9 @@ def test_roll_payload_delivery_is_bit_identical(compact):
 
 def test_compact_validation():
     base = swim.SwimParams.from_config(fast_config(), n_members=16)
-    with pytest.raises(ValueError, match="max_delay_rounds"):
-        dataclasses.replace(base, compact_carry=True, max_delay_rounds=2)
+    # The delay ring is supported under compact_carry (int16 wire slots) —
+    # see test_compact_delay_ring_trace_identical.
+    dataclasses.replace(base, compact_carry=True, max_delay_rounds=2)
     with pytest.raises(ValueError, match="suspicion"):
         dataclasses.replace(base, compact_carry=True,
                             suspicion_rounds=40_000)
@@ -252,3 +253,22 @@ def test_compact_node_snapshot_requires_round_idx():
     params_w = swim.SwimParams.from_config(fast_config(), n_members=16)
     state_w = swim.initial_state(params_w, world)
     swim.node_snapshot(state_w, params_w, world, node_id=0)
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+def test_compact_delay_ring_trace_identical(delivery):
+    """The delayed-delivery ring under compact_carry (int16 wire slots)
+    is bit-identical to the wide layout's int32 ring — same delay bins,
+    same late arrivals, same merges."""
+    (s_w, m_w), (s_c, m_c) = run_pair(
+        24, 100, lambda w: w.with_crash(3, at_round=5),
+        delivery=delivery, loss_probability=0.1,
+        mean_delay_ms=100.0, max_delay_rounds=2,
+    )
+    assert str(s_c.inbox_ring.dtype) == "int16"
+    assert str(s_w.inbox_ring.dtype) == "int32"
+    for name in m_w:
+        np.testing.assert_array_equal(
+            np.asarray(m_w[name]), np.asarray(m_c[name]),
+            err_msg=f"delay/{delivery}: metric {name} diverged",
+        )

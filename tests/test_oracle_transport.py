@@ -204,3 +204,44 @@ def test_member_id_uniqueness():
     rng = random.Random(7)
     ids_ = {generate_member_id(rng) for _ in range(200_000)}
     assert len(ids_) == 200_000
+
+
+def test_max_frame_length_enforced_at_codec_seam():
+    """An oversized serialized frame fails the send future before the
+    emulator hook — the reference's 2MB length-prefix framing
+    (TransportImpl.java:370-384, TransportConfig.java:9)."""
+    from scalecube_cluster_tpu.oracle.transport import FrameTooLongError
+
+    sim = Simulator(seed=1)
+    small = Transport(sim, max_frame_length=256)
+    receiver = Transport(sim)
+    got, errors = [], []
+    receiver.listen(got.append)
+
+    small.send(receiver.address,
+               Message(qualifier="big", data="x" * 1024)).subscribe(
+        None, errors.append)
+    small.send(receiver.address,
+               Message(qualifier="ok", data="tiny")).subscribe(
+        None, errors.append)
+    sim.run_for(10)
+    assert len(got) == 1 and got[0].qualifier == "ok"
+    assert len(errors) == 1 and isinstance(errors[0], FrameTooLongError)
+    # An oversized frame never reached the wire: the emulator's sent
+    # counter saw only the small message (framing sits before tryFail).
+    assert small.network_emulator.total_message_sent_count == 1
+
+
+def test_default_max_frame_length_is_two_megabytes():
+    """Default transports accept payloads the reference would (well under
+    2MB) and the configured default matches TransportConfig.java:9."""
+    from scalecube_cluster_tpu.config import DEFAULT_MAX_FRAME_LENGTH
+
+    assert DEFAULT_MAX_FRAME_LENGTH == 2 * 1024 * 1024
+    sim, client, server = make_pair()
+    assert client.max_frame_length == DEFAULT_MAX_FRAME_LENGTH
+    got = []
+    server.listen(got.append)
+    client.send(server.address, Message(qualifier="q", data="y" * 100_000))
+    sim.run_for(10)
+    assert len(got) == 1
