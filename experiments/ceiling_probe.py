@@ -1,0 +1,141 @@
+"""Bisect the full-view compile-stage ceiling: which program piece fails?
+
+Round 4 established that compact full-view [N, N] fits at 27,648 and
+fails at 28,160 with an opaque remote-compile failure
+(``tpu_compile_helper subprocess exit code 1`` — not a clean
+RESOURCE_EXHAUSTED; artifacts/fullview_ceiling.json).  This probe runs
+one piece of the program per subprocess at a chosen N to localize the
+failing stage:
+
+  piece=scan60   the round-4 shape: 60-round scan (known-fail at 28160)
+  piece=scan1    a single-round scan (is the scan the problem?)
+  piece=tick     the tick body jitted without any scan
+  piece=deliver  just the shift-delivery channels (prep + 5 rotations)
+  piece=merge    just the merge + timers tail on a fake inbox
+  piece=alloc    just allocating the carry + one elementwise pass
+
+Run: ``python experiments/ceiling_probe.py N piece`` in a child, or
+``python experiments/ceiling_probe.py sweep N`` to try all pieces.
+Findings land in RESULTS.md; this script is the reproducer.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PIECES = ["alloc", "deliver", "merge", "tick", "scan1", "scan60"]
+
+
+def child(n: int, piece: str) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.config import ClusterConfig
+    from scalecube_cluster_tpu.ops import shift as shift_ops
+    from scalecube_cluster_tpu.utils.runlog import (
+        completion_barrier, enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+    params = swim.SwimParams.from_config(
+        ClusterConfig.default_local(), n_members=n, delivery="shift",
+        compact_carry=True, suspicion_rounds=6, ping_every=2,
+        sync_every=4, per_subject_metrics=False,
+    )
+    world = swim.SwimWorld.healthy(params).with_crash(3, at_round=2)
+    key = jax.random.key(0)
+    state = swim.initial_state(params, world)
+
+    t0 = time.perf_counter()
+    if piece == "alloc":
+        @jax.jit
+        def f(s):
+            return jnp.sum((s.status == 1).astype(jnp.int32))
+        out = float(f(state))
+    elif piece == "deliver":
+        # The five channel rotations on the doubled payload buffer — the
+        # largest single intermediate ([2N, N] int16).
+        @jax.jit
+        def f(s, k):
+            eng = shift_ops.ShiftEngine(n)
+            keys16 = s.inc  # int16 [N, N] stand-in payload
+            h = eng.prep(keys16)
+            shifts = jax.random.randint(k, (5,), 1, n, dtype=jnp.int32)
+            acc = jnp.zeros_like(keys16)
+            for c in range(5):
+                acc = jnp.maximum(acc, eng.deliver(h, shifts[c]))
+            return jnp.sum(acc.astype(jnp.int32))
+        out = float(f(state, key))
+    elif piece == "merge":
+        from scalecube_cluster_tpu.ops import delivery
+        @jax.jit
+        def f(s, k):
+            inbox = jnp.where(
+                jax.random.bernoulli(k, 0.1, s.status.shape),
+                jnp.int16(2), jnp.int16(-1))
+            st, inc, ch = delivery.merge_inbox(
+                s.status, s.inc.astype(jnp.int32), inbox,
+                inbox >= 0, compact=True)
+            return jnp.sum(ch.astype(jnp.int32))
+        out = float(f(state, key))
+    elif piece == "tick":
+        @jax.jit
+        def f(s, k):
+            s2, m = swim.swim_tick(s, jnp.int32(0), k, params, world)
+            return s2
+        out = completion_barrier(f(state, key).status)
+    elif piece in ("scan1", "scan60"):
+        rounds = 1 if piece == "scan1" else 60
+        step = jax.jit(
+            lambda k, w, s: swim.run(k, params, w, rounds, state=s),
+            static_argnums=(), donate_argnums=(2,))
+        s2, m = step(key, world, state)
+        out = completion_barrier(s2.status)
+    else:
+        raise SystemExit(f"unknown piece {piece}")
+    print(json.dumps({"ok": True, "piece": piece, "n": n,
+                      "wall_s": round(time.perf_counter() - t0, 1),
+                      "out": out}))
+
+
+def probe(n: int, piece: str) -> dict:
+    code = (f"import sys; sys.path.insert(0, {REPO!r}); "
+            f"from experiments.ceiling_probe import child; "
+            f"child({n}, {piece!r})")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=1200,
+                             cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "piece": piece, "n": n, "error": "timeout"}
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                break
+    tail = (out.stderr or "")[-500:]
+    return {"ok": False, "piece": piece, "n": n,
+            "rc": out.returncode, "stderr_tail": tail}
+
+
+def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == "sweep":
+        n = int(sys.argv[2])
+        pieces = sys.argv[3:] or PIECES
+        for piece in pieces:
+            r = probe(n, piece)
+            print(f"[{piece}@{n}] {json.dumps(r)[:400]}", file=sys.stderr)
+    else:
+        child(int(sys.argv[1]), sys.argv[2])
+
+
+if __name__ == "__main__":
+    main()

@@ -39,7 +39,21 @@ LADDERS = {
     # [2N, N] buffers — value-identical, slower, but the doubled copies
     # bind the ceiling; SwimParams.shift_roll_payloads).
     "compact_roll": [26_624, 28_160, 28_672, 30_720, 32_768, 36_864],
+    # compact + K-tiled round body (SwimParams.k_block): per-channel
+    # payload/inbox/merge temps shrink from [N, N] to [N, Kb], leaving
+    # peak HBM ~= one donated carry — the round-5 answer to the round-4
+    # boundary (which reproduced as a clean RESOURCE_EXHAUSTED: 11.8G of
+    # HLO temps at 28,160, six 1.48G per-channel payload buffers;
+    # experiments/ceiling_probe.py).  The remaining frontier is NOT HBM:
+    # above ~38k the axon remote-compile helper dies (exit 1, no
+    # diagnostics) for every probed block width (round-5 bracketing:
+    # 36,864@kb=1024 fits; 36,864@2048, 38,912@{512,1024}, 40,960@{512,
+    # 1024,2048} all exit-1) — an infrastructure boundary below the
+    # ~6 B/cell carry bound (~50k).
+    "compact_blocked": [32_768, 34_816, 36_864, 37_888, 38_912, 40_960],
 }
+BLOCKED_KB = 1_024   # divides every rung above; 2048 trips the helper
+                     # crash earlier (36,864@2048 fails, @1024 fits)
 # Keep probing past the first failure so the boundary gets bracketed
 # (compile-stage failures at rung r don't imply failure at every r' > r a
 # priori); stop only once this many consecutive rungs fail.
@@ -55,12 +69,13 @@ from scalecube_cluster_tpu.utils.runlog import enable_compilation_cache
 
 enable_compilation_cache()
 n, compact, roll, rounds = %(n)d, %(compact)r, %(roll)r, %(rounds)d
+k_block = %(k_block)d
 try:
     params = swim.SwimParams.from_config(
         ClusterConfig.default_local(), n_members=n, delivery="shift",
         compact_carry=compact, shift_roll_payloads=roll,
         suspicion_rounds=6, ping_every=2,
-        sync_every=4, per_subject_metrics=False,
+        sync_every=4, per_subject_metrics=False, k_block=k_block,
     )
     world = swim.SwimWorld.healthy(params).with_crash(3, at_round=2)
     key = jax.random.key(0)
@@ -107,6 +122,8 @@ def attempt(n, layout):
     code = _CHILD % {"repo": REPO, "n": n,
                      "compact": layout.startswith("compact"),
                      "roll": layout.endswith("_roll"),
+                     "k_block": BLOCKED_KB if layout.endswith("_blocked")
+                     else 0,
                      "rounds": ROUNDS}
     try:
         out = subprocess.run([sys.executable, "-c", code],

@@ -191,6 +191,38 @@ class SwimParams:
     # accounting is exact).  Single-device only (the counters are a
     # small/medium-N measurement substrate, not a 1M perf path).
     link_counters: bool = False
+    # K-tiled round body for full-view capacity runs (0 = off).  The
+    # standard shift tick materializes one [N, K] payload temp per send
+    # channel (deliver_channel's masked keys); at the [N, N] single-chip
+    # ceiling those temps — not the carry — bind HBM (measured at
+    # N=28,160: 11.8G of HLO temps, six 1.48G s16[N, N] buffers, vs the
+    # 4.4G donated carry; experiments/ceiling_probe.py).  With
+    # ``k_block = Kb`` the tick runs a fori_loop over K/Kb column blocks:
+    # each block's payloads/inbox/merge are [N, Kb] transients and the
+    # block's new state is written straight into the carry accumulator,
+    # so peak HBM ~= one carry + O(N·Kb) — the per-node O(cluster) table
+    # (MembershipProtocolImpl.java:82) at near carry-bound N.
+    # Bit-identical to the unblocked shift tick (same shifts, same draws
+    # — delivery rotates rows, so column blocks are independent;
+    # tests/test_blocked_tick.py).  Constraints: shift delivery,
+    # full-view, single device, max_delay_rounds=0, no link_counters, no
+    # seed-gated contacts.
+    k_block: int = 0
+    # User-payload gossip co-running with membership in ONE gossip
+    # machinery — the reference's GossipProtocol carries arbitrary user
+    # gossips AND membership piggyback through the same component
+    # (GossipProtocolImpl.java:124-128 spread(), 139-157 doSpreadGossip;
+    # membership piggybacks via spreadMembershipGossip,
+    # MembershipProtocolImpl.java:620-635).  G > 0 adds [N, G] infection
+    # state to the carry: ``SwimWorld.with_spread`` schedules spread()
+    # calls (origin, round), and the bits ride the SAME gossip channels,
+    # loss draws, and delay bins as the membership records — one
+    # GOSSIP_REQ per (sender, target) carries both, exactly the
+    # reference's one-wire-message batching (GossipProtocolImpl.java:
+    # 211-237 sends all selected gossips in one message).  Spread window
+    # = periods_to_spread, the ClusterMath schedule shared with
+    # membership records.  Metrics gain ``user_gossip_infected`` [G].
+    n_user_gossips: int = 0
 
     def __post_init__(self):
         if self.delivery not in ("scatter", "shift"):
@@ -207,6 +239,24 @@ class SwimParams:
                 f"(got ping_known_only={self.ping_known_only}, "
                 f"n_subjects={self.n_subjects}, n_members={self.n_members})"
             )
+        if self.k_block:
+            if self.delivery != "shift" or not self.full_view:
+                raise ValueError(
+                    "k_block is the full-view shift-mode capacity path "
+                    f"(got delivery={self.delivery!r}, "
+                    f"n_subjects={self.n_subjects}, "
+                    f"n_members={self.n_members})"
+                )
+            if self.n_subjects % self.k_block != 0:
+                raise ValueError(
+                    f"k_block ({self.k_block}) must divide n_subjects "
+                    f"({self.n_subjects})"
+                )
+            if self.max_delay_rounds != 0 or self.link_counters:
+                raise ValueError(
+                    "k_block supports max_delay_rounds=0 and "
+                    "link_counters=False only (capacity path)"
+                )
         if self.compact_carry:
             if self.periods_to_spread + 1 > 127:
                 raise ValueError(
@@ -443,6 +493,11 @@ class SwimWorld:
         seed, matching tests that pre-populate full views.
       - ``subject_ids`` [K] int32 / ``slot_of_node`` [N] int32: the focal
         subject mapping (slot -1 = node is not a tracked subject).
+      - ``gossip_origin``/``gossip_spread_at`` [G] int32: the spread()
+        schedule for user gossips (SwimParams.n_user_gossips): gossip g
+        is injected at its origin node in round gossip_spread_at[g]
+        (INT32_MAX = never) — the batched analog of
+        Cluster.spreadGossip(msg) (GossipProtocolImpl.java:124-128).
     """
 
     down_from: jnp.ndarray
@@ -454,11 +509,14 @@ class SwimWorld:
     seed_ids: jnp.ndarray
     subject_ids: jnp.ndarray
     slot_of_node: jnp.ndarray
+    gossip_origin: jnp.ndarray
+    gossip_spread_at: jnp.ndarray
 
     @staticmethod
     def healthy(params: SwimParams,
                 subject_ids: Optional[jnp.ndarray] = None) -> "SwimWorld":
         n, k = params.n_members, params.n_subjects
+        g = params.n_user_gossips
         if subject_ids is None:
             subject_ids = jnp.arange(k, dtype=jnp.int32)
         slot_of_node = (
@@ -476,6 +534,22 @@ class SwimWorld:
             seed_ids=jnp.zeros((0,), dtype=jnp.int32),
             subject_ids=subject_ids,
             slot_of_node=slot_of_node,
+            gossip_origin=jnp.arange(g, dtype=jnp.int32) % max(n, 1),
+            gossip_spread_at=jnp.full((g,), INT32_MAX, dtype=jnp.int32),
+        )
+
+    def with_spread(self, gossip_idx: int, origin, at_round: int) -> "SwimWorld":
+        """Schedule ``spread()`` of user gossip ``gossip_idx`` at ``origin``
+        in round ``at_round`` (Cluster.spreadGossip ->
+        GossipProtocolImpl.spread, :124-128).  The origin must be alive in
+        that round for the injection to happen (a crashed JVM can't call
+        spread)."""
+        return dataclasses.replace(
+            self,
+            gossip_origin=self.gossip_origin.at[gossip_idx].set(
+                jnp.int32(origin)),
+            gossip_spread_at=self.gossip_spread_at.at[gossip_idx].set(
+                jnp.int32(at_round)),
         )
 
     def with_crash(self, node, at_round: int, until_round: int = INT32_MAX):
@@ -553,7 +627,7 @@ jax.tree_util.register_dataclass(
     data_fields=[
         "down_from", "down_until", "leave_at", "partition_of",
         "partition_phase_rounds", "faults", "seed_ids",
-        "subject_ids", "slot_of_node",
+        "subject_ids", "slot_of_node", "gossip_origin", "gossip_spread_at",
     ],
     meta_fields=[],
 )
@@ -590,6 +664,17 @@ class SwimState:
                         or 0 when delay modeling is off — zero-size arrays
                         cost nothing).  Slot (round % D) holds the messages
                         due in that round.
+    ``g_infected``      [N, G] bool: user-gossip possession bits
+                        (params.n_user_gossips; the delivery-dedup bit,
+                        GossipProtocolImpl.java:176-180).
+    ``g_spread_until``  [N, G] int32: per-(member, gossip) retransmission
+                        window (GossipState.infectionPeriod analog).  Kept
+                        int32 absolute in BOTH carry layouts — [N, G] is
+                        small next to [N, K], so compact_carry doesn't
+                        narrow it.
+    ``g_ring``          [D, N, G] bool: delayed user-gossip bits, sharing
+                        the membership payload's delay bins (one wire
+                        message carries both).
     """
 
     status: jnp.ndarray
@@ -599,12 +684,16 @@ class SwimState:
     self_inc: jnp.ndarray
     inbox_ring: jnp.ndarray
     flag_ring: jnp.ndarray
+    g_infected: jnp.ndarray
+    g_spread_until: jnp.ndarray
+    g_ring: jnp.ndarray
 
 
 jax.tree_util.register_dataclass(
     SwimState,
     data_fields=["status", "inc", "spread_until", "suspect_deadline",
-                 "self_inc", "inbox_ring", "flag_ring"],
+                 "self_inc", "inbox_ring", "flag_ring",
+                 "g_infected", "g_spread_until", "g_ring"],
     meta_fields=[],
 )
 
@@ -640,6 +729,13 @@ def initial_state(params: SwimParams, world: SwimWorld,
         # (MembershipProtocolTest seed-chain join, :432-462).
         spread0 = jnp.where(is_self, params.periods_to_spread + 1, spread0)
     d_slots = params.max_delay_rounds + 1 if params.max_delay_rounds > 0 else 0
+    g = params.n_user_gossips
+    gd_slots = d_slots if g > 0 else 0
+    g_fields = dict(
+        g_infected=jnp.zeros((n, g), dtype=jnp.bool_),
+        g_spread_until=jnp.zeros((n, g), dtype=jnp.int32),
+        g_ring=jnp.zeros((gd_slots, n, g), dtype=jnp.bool_),
+    )
     if params.compact_carry:
         # Relative encodings (the carry is re-relativized every tick by
         # _carry_encode): spread_until / suspect_deadline as remaining
@@ -655,6 +751,7 @@ def initial_state(params: SwimParams, world: SwimWorld,
             # int16 (records.merge_key16), so its delayed slots are too.
             inbox_ring=jnp.full((d_slots, n, k), -1, dtype=jnp.int16),
             flag_ring=jnp.zeros((d_slots, n, k), dtype=jnp.int8),
+            **g_fields,
         )
     return SwimState(
         status=status,
@@ -664,6 +761,7 @@ def initial_state(params: SwimParams, world: SwimWorld,
         self_inc=jnp.zeros((n,), dtype=jnp.int32),
         inbox_ring=jnp.full((d_slots, n, k), -1, dtype=jnp.int32),
         flag_ring=jnp.zeros((d_slots, n, k), dtype=jnp.int8),
+        **g_fields,
     )
 
 
@@ -783,12 +881,13 @@ def _chain_ok(key, hop_losses: Sequence[jnp.ndarray],
 def _ring_open(state: SwimState, params: SwimParams, round_idx):
     """Read this round's due slot and clear it for reuse (ops/ring.py).
 
-    Returns (inbox_now, flags_now, ring, fring, slot0) — ``ring``/``fring``
-    already have slot0 reset, ready to accumulate future arrivals.  With
-    delay modeling off (max_delay_rounds == 0) returns Nones.
+    Returns (inbox_now, flags_now, g_now, ring, fring, gring, slot0) —
+    the rings already have slot0 reset, ready to accumulate future
+    arrivals.  With delay modeling off (max_delay_rounds == 0) returns
+    Nones; the user-gossip pair is None when n_user_gossips == 0.
     """
     if params.max_delay_rounds == 0:
-        return None, None, None, None, None
+        return None, None, None, None, None, None, None
     slot0 = round_idx % (params.max_delay_rounds + 1)
     inbox_now, ring = ring_ops.open_slot(
         state.inbox_ring, slot0, delivery.no_message(params.compact_carry)
@@ -796,7 +895,11 @@ def _ring_open(state: SwimState, params: SwimParams, round_idx):
     flags_now, fring = ring_ops.open_slot(
         state.flag_ring, slot0, jnp.int8(0)
     )
-    return inbox_now, flags_now.astype(jnp.bool_), ring, fring, slot0
+    g_now, gring = (None, None)
+    if params.n_user_gossips > 0:
+        g_now, gring = ring_ops.open_slot(state.g_ring, slot0, False)
+    return inbox_now, flags_now.astype(jnp.bool_), g_now, ring, fring, \
+        gring, slot0
 
 
 def _ring_push(ring, fring, slot, keys, flags):
@@ -806,17 +909,20 @@ def _ring_push(ring, fring, slot, keys, flags):
 
 
 def _route_delayed(ok, delivered, delivered_flags, delay_mean, key, params,
-                   ring, fring, slot0):
+                   ring, fring, slot0, g_bits=None, g_ring=None):
     """Split one channel's delivery into now vs future ring slots.
 
-    Returns (ok_now, ring, fring): ``ok_now`` masks the messages arriving
-    this round; later quantized offsets are max/or-merged into the ring.
-    Shared by the gossip, SYNC, and refute channels so the binning and
-    slot arithmetic exist once.  ``delay_mean is None`` (statically zero,
-    link_eval docstring) means everything arrives this round.
+    Returns (ok_now, ring, fring, g_ring): ``ok_now`` masks the messages
+    arriving this round; later quantized offsets are max/or-merged into
+    the ring.  Shared by the gossip, SYNC, and refute channels so the
+    binning and slot arithmetic exist once.  ``delay_mean is None``
+    (statically zero, link_eval docstring) means everything arrives this
+    round.  ``g_bits`` [n, G]: user-gossip bits riding the SAME wire
+    message — they share the channel's delay bins exactly (one message,
+    one delay draw); their future slots go to ``g_ring``.
     """
     if params.max_delay_rounds == 0 or delay_mean is None:
-        return ok, ring, fring
+        return ok, ring, fring, g_ring
     no_msg = delivery.no_message(params.compact_carry)
     q = ring_ops.delay_bins(key, delay_mean, params.round_ms,
                             params.max_delay_rounds, ok.shape)
@@ -828,7 +934,9 @@ def _route_delayed(ok, delivered, delivered_flags, delay_mean, key, params,
             jnp.where(m, delivered, no_msg),
             delivered_flags & m,
         )
-    return ok & (q == 0), ring, fring
+        if g_bits is not None:
+            g_ring = ring_ops.push_or(g_ring, (slot0 + j) % d, g_bits & m)
+    return ok & (q == 0), ring, fring, g_ring
 
 
 def _entry_at_slot(mat, slot, k):
@@ -882,7 +990,11 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
             "link_counters is a single-device measurement substrate "
             "(per-sender [N] rows don't cross shard_map metric combining)"
         )
-    if params.compact_carry:
+    # k_block keeps the carry in its stored layout end-to-end: a global
+    # decode would materialize three wide int32 [N, N] temps (measured
+    # 6x 4G at 32,768 — the decode can't fuse through a fori_loop's
+    # operand boundary); the blocked body decodes/encodes per block.
+    if params.compact_carry and not params.k_block:
         state = _carry_decode(state, round_idx)
     # Fold both the round and the shard offset so draws are independent
     # across rounds AND across devices (ops/prng.py module docstring).
@@ -909,9 +1021,35 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
 
     # Row i's record about itself is pinned (a node always believes itself
     # ALIVE at self_inc — MembershipProtocolImpl drops self-updates and
-    # refutes instead, :488-509).
-    status = jnp.where(is_self, records.ALIVE, state.status)
-    inc = jnp.where(is_self, state.self_inc[:, None], state.inc)
+    # refutes instead, :488-509).  The blocked body pins per block — the
+    # global int32 pin would materialize a wide temp; a well-formed carry
+    # already holds the pinned values (the merge re-asserts them), so the
+    # raw fields the blocked FD pre-pass reads are identical.
+    if params.k_block:
+        status, inc = state.status, state.inc
+    else:
+        status = jnp.where(is_self, records.ALIVE, state.status)
+        inc = jnp.where(is_self, state.self_inc[:, None], state.inc)
+
+    # User-gossip spread() injections (GossipProtocolImpl.createAndPutGossip,
+    # :163-169): gossip g appears at its origin in its scheduled round and
+    # starts spreading the SAME round (doSpreadGossip sends just-created
+    # gossips too, :139-157).  A crashed origin can't call spread().
+    if params.n_user_gossips > 0:
+        inject = (
+            (world.gossip_spread_at[None, :] == round_idx)
+            & (world.gossip_origin[None, :] == node_ids[:, None])
+            & alive_here[:, None]
+        )
+        state = dataclasses.replace(
+            state,
+            g_infected=state.g_infected | inject,
+            g_spread_until=jnp.where(
+                inject & ~state.g_infected,
+                round_idx + 1 + params.periods_to_spread,
+                state.g_spread_until,
+            ),
+        )
 
     # ping_every/sync_every <= 0 disable the phase entirely (a plain
     # modulo sentinel like INT32_MAX would still fire at round 0).
@@ -940,7 +1078,25 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
             target_ids[..., None] == world.seed_ids[None, :], axis=-1
         )
 
-    if params.delivery == "shift":
+    if params.k_block:
+        if axis_name is not None:
+            raise NotImplementedError(
+                "k_block is the single-chip capacity path (shard the rows "
+                "instead for multi-chip full view — parallel/mesh.py)"
+            )
+        if gate_contacts:
+            raise NotImplementedError(
+                "k_block does not support seed-gated contacts (the gate "
+                "reads a full-status column per channel)"
+            )
+        new_state, aux = _tick_shift_blocked(
+            state, status, inc, round_idx, params, kn, world,
+            alive, part, node_ids, alive_here, part_here, is_self,
+            fd_round, sync_round,
+            (k_shifts, k_ping_net, k_proxy, k_proxy_net, k_gossip_t,
+             k_gossip_drop, k_sync_t, k_sync_drop),
+        )
+    elif params.delivery == "shift":
         new_state, aux = _tick_shift(
             state, status, inc, round_idx, params, kn, world,
             alive, part, node_ids, alive_here, part_here, is_self,
@@ -972,25 +1128,46 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     #   - "absent" follows from the histogram identity: each live
     #     observer row contributes exactly one status code per column,
     #     so sum_code hist[code] == live observer count.
-    new_status = new_state.status
-    observer_alive = alive_here[:, None]
-    subject_alive_i = alive[world.subject_ids].astype(jnp.int32)    # [K]
+    if "blocked_metrics" in aux:
+        # Blocked tick: histograms AND the per-column products (the FP
+        # families don't commute with aggregation) were accumulated per
+        # column block inside the fori_loop — same reductions, summed
+        # blockwise, numerically exact.
+        bm = aux.pop("blocked_metrics")
+        hist_alive, hist_suspect, hist_dead = (
+            bm["hist_alive"], bm["hist_suspect"], bm["hist_dead"])
+        still_suspect = bm["still_suspect"]
+        subject_alive_i = bm["subject_alive_i"]
+        live_observers = jnp.sum(alive_here, dtype=jnp.int32)
+        if not params.per_subject_metrics:
+            # Aggregate "absent" is sum_k (live_observers - hists[k]):
+            # the per-column live_observers term appears K times.
+            live_observers = live_observers * k
+        false_suspect_rounds = bm["false_suspect_rounds"]
+        stale_view_rounds = bm["stale_view_rounds"]
+        onsets = bm["onsets"]
+        products_precomputed = True
+    else:
+        products_precomputed = False
+        new_status = new_state.status
+        observer_alive = alive_here[:, None]
+        subject_alive_i = alive[world.subject_ids].astype(jnp.int32)  # [K]
 
-    def col_sum(mask):
-        return jnp.sum(mask, axis=0, dtype=jnp.int32)               # [K]
+        def col_sum(mask):
+            return jnp.sum(mask, axis=0, dtype=jnp.int32)             # [K]
 
-    hist_alive = global_sum(col_sum(
-        (new_status == records.ALIVE) & observer_alive))
-    hist_suspect = global_sum(col_sum(
-        (new_status == records.SUSPECT) & observer_alive))
-    hist_dead = global_sum(col_sum(
-        (new_status == records.DEAD) & observer_alive))
-    # SUSPECT now AND at tick start — subtracted from hist_suspect to
-    # count NEW suspicions (onsets).
-    still_suspect = global_sum(col_sum(
-        (new_status == records.SUSPECT) & (status == records.SUSPECT)
-        & observer_alive))
-    live_observers = global_sum(jnp.sum(alive_here, dtype=jnp.int32))
+        hist_alive = global_sum(col_sum(
+            (new_status == records.ALIVE) & observer_alive))
+        hist_suspect = global_sum(col_sum(
+            (new_status == records.SUSPECT) & observer_alive))
+        hist_dead = global_sum(col_sum(
+            (new_status == records.DEAD) & observer_alive))
+        # SUSPECT now AND at tick start — subtracted from hist_suspect to
+        # count NEW suspicions (onsets).
+        still_suspect = global_sum(col_sum(
+            (new_status == records.SUSPECT) & (status == records.SUSPECT)
+            & observer_alive))
+        live_observers = global_sum(jnp.sum(alive_here, dtype=jnp.int32))
 
     counts = {
         "alive": hist_alive - subject_alive_i,
@@ -1015,9 +1192,10 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     # genuine FD false alarm beginning, the thing the SWIM paper's FP
     # curves count).  ``false_positives`` (observer-rounds) is kept for
     # continuity with round-1/2 artifacts.
-    false_suspect_rounds = hist_suspect * subject_alive_i
-    stale_view_rounds = hist_dead * subject_alive_i
-    onsets = (hist_suspect - still_suspect) * subject_alive_i
+    if not products_precomputed:
+        false_suspect_rounds = hist_suspect * subject_alive_i
+        stale_view_rounds = hist_dead * subject_alive_i
+        onsets = (hist_suspect - still_suspect) * subject_alive_i
     if not params.per_subject_metrics:
         counts = {name: jnp.sum(v) for name, v in counts.items()}
         false_suspect_rounds = jnp.sum(false_suspect_rounds)
@@ -1052,7 +1230,14 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
         # above) — [N] rows, stacked by the scan into [rounds, N] traces.
         metrics["sent_by_node"] = aux["sent_by_node"]
         metrics["lost_by_node"] = aux["lost_by_node"]
-    if params.compact_carry:
+    if params.n_user_gossips > 0:
+        # Per-gossip infection curve — the measured analog of
+        # ClusterMath.gossipConvergencePercent co-running with the full
+        # protocol (GossipProtocolTest.java:178-205's substrate).
+        metrics["user_gossip_infected"] = global_sum(
+            jnp.sum(new_state.g_infected, axis=0, dtype=jnp.int32)
+        )
+    if params.compact_carry and not params.k_block:
         new_state = _carry_encode(new_state, round_idx)
     return new_state, metrics
 
@@ -1064,10 +1249,14 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
 
 def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
                       params, kn, world, node_ids, alive_here, is_self,
-                      inbox_ring=None, flag_ring=None):
+                      inbox_ring=None, flag_ring=None,
+                      g_delivered=None, g_ring=None):
     """Inbox merge, self-refutation, suspicion timers, crash/leave freeze.
 
     Shared tail of both delivery modes; all elementwise on [n_local, K].
+    ``g_delivered`` [n_local, G] bool: user-gossip bits arriving this
+    round (OR-merged; newly infected rows open a fresh spread window —
+    onGossipReq, GossipProtocolImpl.java:171-183).
     Returns (new_state, refuted[n_local] bool).
     """
     new_status, new_inc, changed = delivery.merge_inbox(
@@ -1123,6 +1312,18 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
         changed, round_idx + 1 + params.periods_to_spread, state.spread_until
     )
 
+    g_infected, g_spread_until = state.g_infected, state.g_spread_until
+    if g_delivered is not None:
+        newly_g = g_delivered & ~g_infected
+        g_infected = g_infected | g_delivered
+        g_spread_until = jnp.where(
+            newly_g, round_idx + 1 + params.periods_to_spread, g_spread_until
+        )
+        # Crashed rows are frozen like the rest of the carry.
+        g_infected = jnp.where(frozen[:, :1], state.g_infected, g_infected)
+        g_spread_until = jnp.where(frozen[:, :1], state.g_spread_until,
+                                   g_spread_until)
+
     new_state = SwimState(
         status=new_status.astype(jnp.int8),
         inc=new_inc.astype(jnp.int32),
@@ -1131,6 +1332,9 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
         self_inc=new_self_inc.astype(jnp.int32),
         inbox_ring=state.inbox_ring if inbox_ring is None else inbox_ring,
         flag_ring=state.flag_ring if flag_ring is None else flag_ring,
+        g_infected=g_infected,
+        g_spread_until=g_spread_until,
+        g_ring=state.g_ring if g_ring is None else g_ring,
     )
     return new_state, refuted
 
@@ -1361,7 +1565,7 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     # acceptable — the 1M shift path bins receiver-side instead).
     alive_flags = delivery.is_alive_key(gossip_keys, compact=compact)
     sync_alive_flags = delivery.is_alive_key(sync_keys, compact=compact)
-    inbox_now, flags_now, ring, fring, slot0 = _ring_open(
+    inbox_now, flags_now, g_now, ring, fring, gring, slot0 = _ring_open(
         state, params, round_idx
     )
 
@@ -1405,11 +1609,41 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     # FD local verdicts fold into the same inbox (observer-local, no comm).
     inbox = jnp.maximum(inbox, fd_inbox)
 
+    # User-gossip bits ride the same gossip channels, targets, and drop
+    # masks — one GOSSIP_REQ carries membership records AND user gossips
+    # (GossipProtocolImpl.java:211-237).
+    g_delivered, g_ring_new = None, None
+    if params.n_user_gossips > 0:
+        hot_g = (state.g_infected & alive_here[:, None]
+                 & (round_idx < state.g_spread_until))
+
+        def g_buf(extra_drop):
+            gb = delivery.scatter_or(
+                hot_g, gossip_targets, gossip_drop | extra_drop, n
+            )
+            return combine_max(gb.astype(jnp.int8)).astype(jnp.bool_)
+
+        if params.max_delay_rounds == 0:
+            g_delivered = g_buf(False)
+        else:
+            # Same per-message bins as the membership payload (q_g).
+            g_delivered = g_buf(q_g != 0) | g_now
+            g_ring_new = gring
+            d = params.max_delay_rounds + 1
+            for j in range(1, d):
+                g_ring_new = ring_ops.push_or(
+                    g_ring_new, (slot0 + j) % d, g_buf(q_g != j)
+                )
+
     new_state, refuted = _merge_and_timers(
         state, status, inc, inbox, inbox_alive, round_idx, params, kn, world,
         node_ids, alive_here, is_self, inbox_ring=ring, flag_ring=fring,
+        g_delivered=g_delivered, g_ring=g_ring_new,
     )
     hot_any = jnp.any(gossip_keys >= 0, axis=1)
+    if params.n_user_gossips > 0:
+        # A wire gossip message exists when EITHER family has content.
+        hot_any = hot_any | jnp.any(hot_g, axis=1)
     aux = dict(
         messages_gossip=jnp.sum(
             hot_any[:, None] & ~gossip_drop, dtype=jnp.int32
@@ -1601,7 +1835,16 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     # syncable): halves the doubled-mask writes and lets a channel fetch
     # its mask with one slice.
     h_tx = eng.prep(hot.astype(jnp.int8) | (syncable.astype(jnp.int8) << 1))
-    h_hot_any = eng.prep(jnp.any(hot, axis=1))
+    hot_any_local = jnp.any(hot, axis=1)
+    hot_g, h_g = None, None
+    if params.n_user_gossips > 0:
+        # User gossips ride the same channels; a wire message exists when
+        # either family has content (GossipProtocolImpl.java:211-237).
+        hot_g = (state.g_infected & alive_here[:, None]
+                 & (round_idx < state.g_spread_until))
+        h_g = eng.prep(hot_g)
+        hot_any_local = hot_any_local | jnp.any(hot_g, axis=1)
+    h_hot_any = eng.prep(hot_any_local)
     h_status = eng.prep(status) if gate_contacts else None
 
     def deliver_channel(s, tx_bit):
@@ -1631,14 +1874,21 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     def unshift(x_local, s):
         return eng.look_replicated(eng.prep_replicated(x_local), s)
 
-    inbox_now, flags_now, ring, fring, slot0 = _ring_open(
+    inbox_now, flags_now, g_now, ring, fring, gring, slot0 = _ring_open(
         state, params, round_idx
     )
     inbox = fd_inbox
     inbox_alive = jnp.zeros((n_local, k), dtype=jnp.bool_)
+    g_delivered, g_ring_acc = None, None
+    if params.n_user_gossips > 0:
+        g_delivered = jnp.zeros((n_local, params.n_user_gossips),
+                                dtype=jnp.bool_)
     if params.max_delay_rounds > 0:
         inbox = jnp.maximum(inbox, inbox_now)
         inbox_alive |= flags_now
+        if params.n_user_gossips > 0:
+            g_delivered = g_delivered | g_now
+            g_ring_acc = gring
     n_gossip_sent = jnp.int32(0)
     for c in range(f):
         s = gossip_shifts[c]
@@ -1677,15 +1927,18 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             sent_acc += unshift(attempt_c, s).astype(jnp.int32)
             lost_acc += unshift(lost_c, s).astype(jnp.int32)
         delivered, delivered_flags = deliver_gossip(s)    # [n_local, K]
-        ok_now, ring, fring = _route_delayed(
+        g_bits_c = eng.deliver(h_g, s) if h_g is not None else None
+        ok_now, ring, fring, g_ring_acc = _route_delayed(
             ok_c, delivered, delivered_flags, delay_c,
             jax.random.fold_in(k_gossip_drop, 11 + c), params,
-            ring, fring, slot0,
+            ring, fring, slot0, g_bits=g_bits_c, g_ring=g_ring_acc,
         )
         inbox = jnp.maximum(
             inbox, jnp.where(ok_now[:, None], delivered, no_msg)
         )
         inbox_alive |= delivered_flags & ok_now[:, None]
+        if g_bits_c is not None:
+            g_delivered = g_delivered | (g_bits_c & ok_now[:, None])
         n_gossip_sent += jnp.sum(
             ok_c & eng.deliver(h_hot_any, s), dtype=jnp.int32,
         )
@@ -1721,7 +1974,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         ok_r = (sender_alive_r & alive_here & part_ok_r & ~wire_drop_r
                 & pushing_r)
         delivered_r, flags_r = deliver_sync(fd_shift)
-        ok_r_now, ring_, fring_ = _route_delayed(
+        ok_r_now, ring_, fring_, _ = _route_delayed(
             ok_r, delivered_r, flags_r, delay_r,
             jax.random.fold_in(k_sync_drop, 13), params, ring_, fring_,
             slot0,
@@ -1778,7 +2031,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         sent_acc += unshift(attempt_sy, s).astype(jnp.int32)
         lost_acc += unshift(lost_sy, s).astype(jnp.int32)
     delivered, delivered_flags = deliver_sync(s)
-    ok_s_now, ring, fring = _route_delayed(
+    ok_s_now, ring, fring, _ = _route_delayed(
         ok_s, delivered, delivered_flags, delay_sy,
         jax.random.fold_in(k_sync_drop, 11), params, ring, fring, slot0,
     )
@@ -1790,6 +2043,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     new_state, refuted = _merge_and_timers(
         state, status, inc, inbox, inbox_alive, round_idx, params, kn, world,
         node_ids, alive_here, is_self, inbox_ring=ring, flag_ring=fring,
+        g_delivered=g_delivered, g_ring=g_ring_acc,
     )
     aux = dict(
         messages_gossip=n_gossip_sent,
@@ -1804,6 +2058,354 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             + ping_req_launches.astype(jnp.int32) * r_proxies
         )
         aux["lost_by_node"] = lost_acc
+    return new_state, aux
+
+
+# --------------------------------------------------------------------------
+# K-tiled shift-mode tick body (full-view capacity path)
+# --------------------------------------------------------------------------
+
+
+def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
+                        alive, part, node_ids, alive_here, part_here,
+                        is_self, fd_round, sync_round, keys):
+    """The shift tick restructured as a fori_loop over K column blocks.
+
+    Bit-identical to ``_tick_shift`` (single device, full view, no delay
+    ring): the channel shifts rotate ROWS, so each column block's
+    delivery + merge is independent of the others, and every PRNG draw
+    (shifts, drop uniforms, FD chains) is K-independent — same keys,
+    same values, same order as the unblocked body.  What changes is
+    materialization: payload/inbox/merge temps are [N, Kb] transients
+    and each block's new state is written into the carry accumulator by
+    ``dynamic_update_slice``, so peak HBM ~= one carry instead of carry
+    + six [N, K] channel temps (SwimParams.k_block docstring; the OOM
+    anatomy is in experiments/ceiling_probe.py).
+    """
+    n = params.n_members
+    k = params.n_subjects                           # == n (full view)
+    kb = params.k_block
+    n_blocks = k // kb
+    (k_shifts, k_ping_net, k_proxy, k_proxy_net, k_gossip_t, k_gossip_drop,
+     k_sync_t, k_sync_drop) = keys
+    r_proxies = params.ping_req_members
+    f = params.fanout
+    eng = shift_ops.ShiftEngine(n, roll_payloads=params.shift_roll_payloads)
+    compact = params.compact_carry
+    no_msg = delivery.no_message(compact)
+
+    # ---- Round draws: identical keys/shapes to _tick_shift --------------
+    n_shifts = 1 + r_proxies + f + 1
+    shifts = jax.random.randint(k_shifts, (n_shifts,), 1, n, dtype=jnp.int32)
+    fd_shift = shifts[0]
+    proxy_shifts = shifts[1:1 + r_proxies]
+    gossip_shifts = shifts[1 + r_proxies:1 + r_proxies + f]
+    sync_shift = shifts[-1]
+
+    d_alive = eng.prep_replicated(alive)
+    d_part = eng.prep_replicated(part)
+    d_ids = eng.prep_replicated(jnp.arange(n, dtype=jnp.int32))
+
+    # ---- FD phase (full-view take_along on the whole carry; [N] vectors,
+    # no [N, K] temps) — mirrors _tick_shift.fd_phase's full_view branch.
+    # ``status``/``inc`` are the RAW carry fields (a well-formed carry is
+    # already diagonal-pinned, and t != i for every shift) — in compact
+    # layout the per-entry decode is just the int32 upcast.
+    t = eng.look_replicated(d_ids, fd_shift)
+    alive_t = eng.look_replicated(d_alive, fd_shift)
+    part_t = eng.look_replicated(d_part, fd_shift)
+    entry_t_status = jnp.take_along_axis(status, t[:, None], 1)[:, 0]
+    entry_t_inc = jnp.take_along_axis(inc, t[:, None], 1)[:, 0] \
+        .astype(jnp.int32)
+    has_target = ((entry_t_status == records.ALIVE)
+                  | (entry_t_status == records.SUSPECT))
+    loss_it, delay_it = link_eval(world.faults, round_idx, node_ids, t,
+                                  kn.loss_probability, params.mean_delay_ms)
+    loss_ti, delay_ti = link_eval(world.faults, round_idx, t, node_ids,
+                                  kn.loss_probability, params.mean_delay_ms)
+    direct_ok = (
+        _chain_ok(k_ping_net, [loss_it, loss_ti], [delay_it, delay_ti],
+                  params.ping_timeout_ms, (n,))
+        & alive_t & (part_here == part_t)
+    )
+    ack_ok = direct_ok
+    for r in range(r_proxies):
+        ps = proxy_shifts[r]
+        p_ids = eng.look_replicated(d_ids, ps)
+        p_alive = eng.look_replicated(d_alive, ps)
+        p_part = eng.look_replicated(d_part, ps)
+        hop_pairs = [(node_ids, p_ids), (p_ids, t), (t, p_ids),
+                     (p_ids, node_ids)]
+        hop_losses, hop_delays = [], []
+        for src, dst in hop_pairs:
+            lo, de = link_eval(world.faults, round_idx, src, dst,
+                               kn.loss_probability, params.mean_delay_ms)
+            hop_losses.append(lo)
+            hop_delays.append(de)
+        ok_pr = (
+            _chain_ok(jax.random.fold_in(k_proxy_net, r),
+                      hop_losses, hop_delays,
+                      params.ping_interval_ms - params.ping_timeout_ms, (n,))
+            & p_alive & alive_t
+            & (part_here == p_part) & (p_part == part_t)
+            & (ps != fd_shift)
+        )
+        ack_ok = ack_ok | ok_pr
+    probe_active = fd_round & has_target & alive_here
+    verdict_suspect = probe_active & ~ack_ok
+    push_refute = (probe_active & ack_ok
+                   & (entry_t_status == records.SUSPECT))
+    probes_sent = probe_active                      # full view: same gate
+    ping_req_launches = probes_sent & ~direct_ok
+    ping_req_n = jnp.sum(ping_req_launches, dtype=jnp.int32) * r_proxies
+    slot_safe = t                                    # full view: slot == id
+    fd_suspect_key = delivery.pack_record(
+        jnp.int8(records.SUSPECT), entry_t_inc, compact=compact
+    )
+
+    # ---- Channel sender gates (receiver-indexed [N] vectors) ------------
+    drop_u = jax.random.uniform(k_gossip_drop, (n, f + 1))
+    ok_gossip = []
+    for c in range(f):
+        s = gossip_shifts[c]
+        sender_alive = eng.deliver_replicated(d_alive, s)
+        sender_part = eng.deliver_replicated(d_part, s)
+        loss_c, _ = link_eval(world.faults, round_idx,
+                              eng.deliver_replicated(d_ids, s), node_ids,
+                              kn.loss_probability, params.mean_delay_ms)
+        ok_gossip.append(
+            sender_alive & alive_here & (sender_part == part_here)
+            & (drop_u[:, c] >= loss_c) & (jnp.int32(c) < kn.fanout)
+        )
+    push_refute = push_refute & (kn.sync_every > 0)
+    h_pushers = eng.prep(push_refute)
+    sender_alive_r = eng.deliver_replicated(d_alive, fd_shift)
+    sender_ids_r = eng.deliver_replicated(d_ids, fd_shift)
+    loss_r, _ = link_eval(world.faults, round_idx, sender_ids_r, node_ids,
+                          kn.loss_probability, params.mean_delay_ms)
+    part_ok_r = eng.deliver_replicated(d_part, fd_shift) == part_here
+    wire_drop_r = jax.random.uniform(k_sync_drop, (n,)) < loss_r
+    ok_refute = (sender_alive_r & alive_here & part_ok_r & ~wire_drop_r
+                 & eng.deliver(h_pushers, fd_shift))
+    sender_refuting = eng.deliver(h_pushers, sync_shift)
+    s = sync_shift
+    sender_alive_s = eng.deliver_replicated(d_alive, s)
+    sender_part_s = eng.deliver_replicated(d_part, s)
+    loss_sy, _ = link_eval(world.faults, round_idx,
+                           eng.deliver_replicated(d_ids, s), node_ids,
+                           kn.loss_probability, params.mean_delay_ms)
+    ok_sync = (
+        sync_round & sender_alive_s & alive_here & ~sender_refuting
+        & (sender_part_s == part_here) & (drop_u[:, f] >= loss_sy)
+    )
+
+    # ---- K-independent extras: message counts, user gossip --------------
+    leaving = world.leave_at[node_ids] == round_idx          # [N]
+    # hot_any: streamed reduce over the carry (no [N, K] temp survives).
+    # Compact layout stores spread as remaining rounds: r < r + rel
+    # iff rel > 0, so the condition reads the int8 field directly.
+    in_window = (state.spread_until > 0 if compact
+                 else round_idx < state.spread_until)
+    hot_any = jnp.any(
+        (status != records.ABSENT) & in_window, axis=1,
+    ) | leaving
+    hot_g, g_delivered = None, None
+    if params.n_user_gossips > 0:
+        hot_g = (state.g_infected & alive_here[:, None]
+                 & (round_idx < state.g_spread_until))
+        h_g = eng.prep(hot_g)
+        hot_any = hot_any | jnp.any(hot_g, axis=1)
+        g_delivered = jnp.zeros((n, params.n_user_gossips), dtype=jnp.bool_)
+        for c in range(f):
+            g_delivered = g_delivered | (
+                eng.deliver(h_g, gossip_shifts[c]) & ok_gossip[c][:, None]
+            )
+    h_hot_any = eng.prep(hot_any)
+    n_gossip_sent = jnp.int32(0)
+    for c in range(f):
+        n_gossip_sent += jnp.sum(
+            ok_gossip[c] & eng.deliver(h_hot_any, gossip_shifts[c]),
+            dtype=jnp.int32,
+        )
+
+    # ---- Block loop ------------------------------------------------------
+    per_subject = params.per_subject_metrics
+    hist_shape = (k,) if per_subject else ()
+
+    def hist_init():
+        return jnp.zeros(hist_shape, dtype=jnp.int32)
+
+    zero_g = dict(
+        g_infected=jnp.zeros((n, 0), dtype=jnp.bool_),
+        g_spread_until=jnp.zeros((n, 0), dtype=jnp.int32),
+        g_ring=jnp.zeros((0, n, 0), dtype=jnp.bool_),
+    )
+
+    def body(b, acc):
+        (st_acc, inc_acc, spr_acc, dl_acc, self_inc_acc, refuted_acc,
+         h_alive, h_suspect, h_dead, h_still, fsr, svr, ons) = acc
+        c0 = b * kb
+        cols = c0 + jnp.arange(kb, dtype=jnp.int32)          # global ids
+
+        def blk_of(x):
+            return jax.lax.dynamic_slice_in_dim(x, c0, kb, 1)
+
+        # Raw (stored-layout) block -> decoded block, pinned diagonal.
+        blk_raw = SwimState(
+            status=blk_of(state.status), inc=blk_of(state.inc),
+            spread_until=blk_of(state.spread_until),
+            suspect_deadline=blk_of(state.suspect_deadline),
+            self_inc=state.self_inc,
+            inbox_ring=state.inbox_ring, flag_ring=state.flag_ring,
+            **zero_g,
+        )
+        blk = _carry_decode(blk_raw, round_idx) if compact else blk_raw
+        is_self_b = cols[None, :] == node_ids[:, None]
+        st_b = jnp.where(is_self_b, records.ALIVE, blk.status)
+        inc_b = jnp.where(is_self_b, state.self_inc[:, None], blk.inc)
+
+        record_keys_b, hot_b, syncable_b = _send_components(
+            blk, st_b, inc_b, round_idx, params, world, node_ids, is_self_b
+        )
+
+        h_keys_b = eng.prep(record_keys_b)
+        h_tx_b = eng.prep(
+            hot_b.astype(jnp.int8) | (syncable_b.astype(jnp.int8) << 1)
+        )
+
+        def deliver_channel_b(sft, tx_bit):
+            keys_c = eng.deliver(h_keys_b, sft)
+            tx = (eng.deliver(h_tx_b, sft) & tx_bit) != 0
+            payload = jnp.where(tx, keys_c, no_msg)
+            return payload, delivery.is_alive_key(payload, compact=compact)
+
+        # FD verdict lands on column slot_safe (one cell per row).
+        inbox_b = jnp.where(
+            (cols[None, :] == slot_safe[:, None])
+            & verdict_suspect[:, None],
+            fd_suspect_key[:, None], no_msg,
+        )
+        inbox_alive_b = jnp.zeros((n, kb), dtype=jnp.bool_)
+        for c in range(f):
+            payload, aflags = deliver_channel_b(gossip_shifts[c], 1)
+            okc = ok_gossip[c][:, None]
+            inbox_b = jnp.maximum(inbox_b, jnp.where(okc, payload, no_msg))
+            inbox_alive_b |= aflags & okc
+        payload, aflags = deliver_channel_b(fd_shift, 2)     # refute push
+        okr = ok_refute[:, None]
+        inbox_b = jnp.maximum(inbox_b, jnp.where(okr, payload, no_msg))
+        inbox_alive_b |= aflags & okr
+        payload, aflags = deliver_channel_b(sync_shift, 2)   # SYNC
+        oks = ok_sync[:, None]
+        inbox_b = jnp.maximum(inbox_b, jnp.where(oks, payload, no_msg))
+        inbox_alive_b |= aflags & oks
+
+        new_blk, refuted_b = _merge_and_timers(
+            blk, st_b, inc_b, inbox_b, inbox_alive_b, round_idx,
+            params, kn, world, node_ids, alive_here, is_self_b,
+        )
+        out_blk = (_carry_encode(new_blk, round_idx) if compact
+                   else new_blk)
+
+        st_acc = jax.lax.dynamic_update_slice_in_dim(
+            st_acc, out_blk.status, c0, 1)
+        inc_acc = jax.lax.dynamic_update_slice_in_dim(
+            inc_acc, out_blk.inc, c0, 1)
+        spr_acc = jax.lax.dynamic_update_slice_in_dim(
+            spr_acc, out_blk.spread_until, c0, 1)
+        dl_acc = jax.lax.dynamic_update_slice_in_dim(
+            dl_acc, out_blk.suspect_deadline, c0, 1)
+        # Refutation bumps only happen in the diagonal block of each row;
+        # bumps strictly increase, so max-accumulate is exact.
+        self_inc_acc = jnp.maximum(self_inc_acc, new_blk.self_inc)
+        refuted_acc = refuted_acc | refuted_b
+
+        # Metrics, accumulated blockwise (same reductions as swim_tick).
+        observer_alive = alive_here[:, None]
+        sa_b = alive[cols].astype(jnp.int32)                 # [Kb]
+        ha_b = jnp.sum((new_blk.status == records.ALIVE) & observer_alive,
+                       axis=0, dtype=jnp.int32)
+        hs_b = jnp.sum((new_blk.status == records.SUSPECT) & observer_alive,
+                       axis=0, dtype=jnp.int32)
+        hd_b = jnp.sum((new_blk.status == records.DEAD) & observer_alive,
+                       axis=0, dtype=jnp.int32)
+        hst_b = jnp.sum(
+            (new_blk.status == records.SUSPECT)
+            & (st_b == records.SUSPECT) & observer_alive,
+            axis=0, dtype=jnp.int32)
+        fsr_b = hs_b * sa_b
+        svr_b = hd_b * sa_b
+        ons_b = (hs_b - hst_b) * sa_b
+        if per_subject:
+            upd = partial(jax.lax.dynamic_update_slice_in_dim,
+                          start_index=c0, axis=0)
+            h_alive = upd(h_alive, update=ha_b)
+            h_suspect = upd(h_suspect, update=hs_b)
+            h_dead = upd(h_dead, update=hd_b)
+            h_still = upd(h_still, update=hst_b)
+            fsr = upd(fsr, update=fsr_b)
+            svr = upd(svr, update=svr_b)
+            ons = upd(ons, update=ons_b)
+        else:
+            h_alive += jnp.sum(ha_b)
+            h_suspect += jnp.sum(hs_b)
+            h_dead += jnp.sum(hd_b)
+            h_still += jnp.sum(hst_b)
+            fsr += jnp.sum(fsr_b)
+            svr += jnp.sum(svr_b)
+            ons += jnp.sum(ons_b)
+        return (st_acc, inc_acc, spr_acc, dl_acc, self_inc_acc, refuted_acc,
+                h_alive, h_suspect, h_dead, h_still, fsr, svr, ons)
+
+    # Accumulators stay in the STORED layout (compact dtypes included):
+    # blocks are decoded on read and re-encoded on write, so no wide
+    # [N, K] int32 copy of the carry ever exists.
+    acc0 = (
+        state.status, state.inc,
+        state.spread_until, state.suspect_deadline,
+        state.self_inc, jnp.zeros((n,), dtype=jnp.bool_),
+        hist_init(), hist_init(), hist_init(), hist_init(),
+        hist_init(), hist_init(), hist_init(),
+    )
+    (st_acc, inc_acc, spr_acc, dl_acc, self_inc_acc, refuted,
+     h_alive, h_suspect, h_dead, h_still, fsr, svr, ons) = \
+        jax.lax.fori_loop(0, n_blocks, body, acc0)
+
+    # User-gossip merge (K-independent; mirrors _merge_and_timers's tail).
+    g_infected, g_spread_until = state.g_infected, state.g_spread_until
+    if g_delivered is not None:
+        newly_g = g_delivered & ~g_infected
+        g_infected2 = g_infected | g_delivered
+        g_spread2 = jnp.where(
+            newly_g, round_idx + 1 + params.periods_to_spread,
+            g_spread_until)
+        frozen1 = ~alive_here[:, None]
+        g_infected = jnp.where(frozen1, g_infected, g_infected2)
+        g_spread_until = jnp.where(frozen1, g_spread_until, g_spread2)
+
+    new_state = SwimState(
+        status=st_acc, inc=inc_acc, spread_until=spr_acc,
+        suspect_deadline=dl_acc, self_inc=self_inc_acc,
+        inbox_ring=state.inbox_ring, flag_ring=state.flag_ring,
+        g_infected=g_infected, g_spread_until=g_spread_until,
+        g_ring=state.g_ring,
+    )
+    subject_alive_i = (alive[world.subject_ids].astype(jnp.int32)
+                       if per_subject
+                       else jnp.sum(alive[world.subject_ids],
+                                    dtype=jnp.int32))
+    aux = dict(
+        messages_gossip=n_gossip_sent,
+        messages_ping=jnp.sum(probe_active, dtype=jnp.int32),
+        messages_ping_sent=jnp.sum(probes_sent, dtype=jnp.int32),
+        messages_ping_req_sent=ping_req_n,
+        refutations=jnp.sum(refuted & alive_here, dtype=jnp.int32),
+        blocked_metrics=dict(
+            hist_alive=h_alive, hist_suspect=h_suspect, hist_dead=h_dead,
+            still_suspect=h_still, subject_alive_i=subject_alive_i,
+            false_suspect_rounds=fsr, stale_view_rounds=svr, onsets=ons,
+        ),
+    )
     return new_state, aux
 
 

@@ -78,6 +78,7 @@ def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
         suspect_deadline=P(axis), self_inc=P(axis),
         # Delay rings are [D, rows, K]: receiver rows on axis 1.
         inbox_ring=P(None, axis), flag_ring=P(None, axis),
+        g_infected=P(axis), g_spread_until=P(axis), g_ring=P(None, axis),
     )
     world_specs = jax.tree.map(lambda _: P(), world)
     metric_spec = P()
@@ -94,15 +95,15 @@ def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
         rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
         return jax.lax.scan(body, state, rounds)
 
-    out_metric_specs = {
-        name: metric_spec
-        for name in ("alive", "suspect", "dead", "absent", "false_positives",
-                     "false_suspicion_onsets", "false_suspect_rounds",
-                     "stale_view_rounds",
-                     "messages_gossip", "messages_ping",
-                     "messages_ping_sent", "messages_ping_req_sent",
-                     "refutations")
-    }
+    metric_names = ["alive", "suspect", "dead", "absent", "false_positives",
+                    "false_suspicion_onsets", "false_suspect_rounds",
+                    "stale_view_rounds",
+                    "messages_gossip", "messages_ping",
+                    "messages_ping_sent", "messages_ping_req_sent",
+                    "refutations"]
+    if params.n_user_gossips > 0:
+        metric_names.append("user_gossip_infected")
+    out_metric_specs = {name: metric_spec for name in metric_names}
     return jax.shard_map(
         sharded_body,
         mesh=mesh,

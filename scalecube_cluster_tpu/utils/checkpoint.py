@@ -72,6 +72,26 @@ def load(path: str) -> Tuple[SwimState, int, Optional[jax.Array], dict]:
             name[len("state/"):]: jax.numpy.asarray(z[name])
             for name in z.files if name.startswith("state/")
         }
+        # Checkpoints written before the user-gossip fields existed load
+        # as G=0 (zero-width arrays) — the layout params.n_user_gossips=0
+        # produces, so resume validation stays meaningful.
+        missing = ({f.name for f in dataclasses.fields(SwimState)}
+                   - set(fields))
+        if missing:
+            n = fields["status"].shape[0]
+            g_defaults = {
+                "g_infected": jax.numpy.zeros((n, 0), dtype=bool),
+                "g_spread_until": jax.numpy.zeros(
+                    (n, 0), dtype=jax.numpy.int32),
+                "g_ring": jax.numpy.zeros((0, n, 0), dtype=bool),
+            }
+            unknown = missing - set(g_defaults)
+            if unknown:
+                raise KeyError(
+                    f"checkpoint {path} lacks state fields {sorted(unknown)}"
+                )
+            for name in missing:
+                fields[name] = g_defaults[name]
         state = SwimState(**fields)
         next_round = int(z["next_round"])
         key = None

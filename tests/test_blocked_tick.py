@@ -1,0 +1,132 @@
+"""K-tiled shift tick (SwimParams.k_block) — bit-identity + validation.
+
+The blocked body exists to move the full-view single-chip capacity
+ceiling (its [N, Kb] transients replace the unblocked body's six [N, K]
+channel temps — SwimParams.k_block docstring, measured in
+experiments/fullview_ceiling.py).  Its correctness contract is total:
+same PRNG draws, same delivery, same merges — every metric and every
+state field bit-identical to the unblocked shift tick, in both carry
+layouts, with faults, leaves, link rules, and user gossip co-running.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.models import swim
+
+from tests.test_swim_model import fast_config
+
+
+def run_pair_blocked(n, rounds, kb, world_fn=None, seed=0, **overrides):
+    out = []
+    for k_block in (0, kb):
+        params = swim.SwimParams.from_config(
+            fast_config(), n_members=n, delivery="shift",
+            k_block=k_block, **overrides,
+        )
+        world = swim.SwimWorld.healthy(params)
+        if world_fn is not None:
+            world = world_fn(world)
+        state, metrics = swim.run(jax.random.key(seed), params, world,
+                                  rounds)
+        out.append((state, metrics))
+    return out
+
+
+SCENARIOS = {
+    "crash_revive": lambda w: w.with_crash(3, at_round=5, until_round=60),
+    "leave": lambda w: w.with_leave(7, at_round=12),
+    "link_block": lambda w: w.with_block(1, (0, 48), until_round=50),
+    "partition": lambda w: w.with_partition_schedule(
+        np.r_[np.zeros(24), np.ones(24)].astype(np.int8), phase_rounds=30
+    ),
+}
+
+
+@pytest.mark.parametrize("compact", [False, True])
+@pytest.mark.parametrize("scenario", sorted(SCENARIOS))
+def test_blocked_trace_identical(compact, scenario):
+    (s0, m0), (sb, mb) = run_pair_blocked(
+        48, 100, kb=16, world_fn=SCENARIOS[scenario],
+        loss_probability=0.2, compact_carry=compact, seed=3,
+    )
+    for name in m0:
+        np.testing.assert_array_equal(
+            np.asarray(m0[name]), np.asarray(mb[name]),
+            err_msg=f"{scenario}/compact={compact}: metric {name}",
+        )
+    for fld in ("status", "inc", "spread_until", "suspect_deadline",
+                "self_inc"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s0, fld)), np.asarray(getattr(sb, fld)),
+            err_msg=f"{scenario}/compact={compact}: state {fld}",
+        )
+
+
+@pytest.mark.parametrize("per_subject", [False, True])
+def test_blocked_metrics_both_aggregations(per_subject):
+    (s0, m0), (sb, mb) = run_pair_blocked(
+        32, 80, kb=8, world_fn=lambda w: w.with_crash(5, at_round=4),
+        loss_probability=0.3, per_subject_metrics=per_subject, seed=1,
+    )
+    for name in m0:
+        np.testing.assert_array_equal(
+            np.asarray(m0[name]), np.asarray(mb[name]), err_msg=name
+        )
+
+
+def test_blocked_with_user_gossip_identical():
+    (s0, m0), (sb, mb) = run_pair_blocked(
+        32, 60, kb=8, seed=1, n_user_gossips=2,
+        world_fn=lambda w: (w.with_crash(5, at_round=3)
+                            .with_spread(0, 1, 0).with_spread(1, 20, 10)),
+    )
+    for name in m0:
+        np.testing.assert_array_equal(
+            np.asarray(m0[name]), np.asarray(mb[name]), err_msg=name
+        )
+    np.testing.assert_array_equal(np.asarray(s0.g_infected),
+                                  np.asarray(sb.g_infected))
+
+
+def test_blocked_checkpoint_resume():
+    """Resume mid-run in blocked mode stays bit-exact (the carry never
+    leaves the stored layout)."""
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=32, delivery="shift", k_block=8,
+        compact_carry=True, loss_probability=0.1,
+    )
+    world = swim.SwimWorld.healthy(params).with_crash(3, at_round=5)
+    key = jax.random.key(0)
+    s_full, _ = swim.run(key, params, world, 60)
+    s_half, _ = swim.run(key, params, world, 30)
+    s_res, _ = swim.run(key, params, world, 30, state=s_half,
+                        start_round=30)
+    for fld in ("status", "inc", "suspect_deadline", "self_inc"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s_full, fld)),
+            np.asarray(getattr(s_res, fld)), err_msg=fld,
+        )
+
+
+def test_blocked_validation():
+    base = swim.SwimParams.from_config(fast_config(), n_members=32,
+                                       delivery="shift")
+    with pytest.raises(ValueError, match="divide"):
+        dataclasses.replace(base, k_block=7)
+    with pytest.raises(ValueError, match="full-view"):
+        swim.SwimParams.from_config(fast_config(), n_members=32,
+                                    n_subjects=8, k_block=4)
+    with pytest.raises(ValueError, match="full-view"):
+        dataclasses.replace(base, delivery="scatter", k_block=8)
+    with pytest.raises(ValueError, match="capacity"):
+        dataclasses.replace(base, k_block=8, max_delay_rounds=2)
+    # Seed-gated contacts are rejected at trace time.
+    params = dataclasses.replace(base, k_block=8)
+    world = swim.SwimWorld.healthy(params).with_seeds([0])
+    state = swim.initial_state(params, world)
+    with pytest.raises(NotImplementedError, match="seed-gated"):
+        swim.swim_tick(state, 0, jax.random.key(0), params, world)

@@ -1,0 +1,248 @@
+"""User-payload gossip co-running with membership in the swim tick.
+
+The reference's gossip component carries arbitrary user gossips AND
+membership piggyback through one machinery (GossipProtocolImpl.java:
+124-128 spread(), 139-157 doSpreadGossip; membership piggybacks via
+spreadMembershipGossip, MembershipProtocolImpl.java:620-635).  The tick
+analog: ``SwimParams.n_user_gossips`` + ``SwimWorld.with_spread``.
+
+Contract under test:
+  - user-gossip bits ride the SAME channels/loss draws as membership
+    records (no new PRNG draws: membership traces are bit-identical to a
+    G=0 run);
+  - dissemination follows the ClusterMath O(log n) schedule while crash
+    detection runs concurrently;
+  - crashed origins can't spread; crashed receivers are frozen; delayed
+    delivery shares the membership payload's bins.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu import swim_math
+from scalecube_cluster_tpu.models import swim
+
+from tests.test_swim_model import fast_config
+
+
+def run_gossip(n, rounds, g=1, delivery="shift", world_fn=None, seed=0,
+               **overrides):
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery=delivery, n_user_gossips=g,
+        **overrides,
+    )
+    world = swim.SwimWorld.healthy(params)
+    if world_fn is not None:
+        world = world_fn(world)
+    state, m = swim.run(jax.random.key(seed), params, world, rounds)
+    return params, state, m
+
+
+def first_full_round(m, n, g=0):
+    curve = np.asarray(m["user_gossip_infected"])[:, g]
+    full = np.flatnonzero(curve >= n)
+    return int(full[0]) if full.size else None
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+class TestUserGossip:
+    def test_membership_trace_unchanged_by_user_gossip(self, delivery):
+        """Adding user gossips must not perturb the membership machinery:
+        no new PRNG draws, bit-identical protocol traces."""
+        n, rounds = 48, 60
+        _, _, m_g = run_gossip(
+            n, rounds, g=3, delivery=delivery,
+            world_fn=lambda w: (w.with_crash(5, at_round=8)
+                                .with_spread(0, 1, 0)
+                                .with_spread(1, 20, 10)
+                                .with_spread(2, 40, 25)),
+            loss_probability=0.1,
+        )
+        params0 = swim.SwimParams.from_config(
+            fast_config(), n_members=n, delivery=delivery,
+            loss_probability=0.1,
+        )
+        world0 = swim.SwimWorld.healthy(params0).with_crash(5, at_round=8)
+        _, m_0 = swim.run(jax.random.key(0), params0, world0, rounds)
+        for name in m_0:
+            if name == "messages_gossip":
+                continue  # wire count legitimately includes user gossip
+            np.testing.assert_array_equal(
+                np.asarray(m_0[name]), np.asarray(m_g[name]), err_msg=name
+            )
+
+    def test_dissemination_tracks_cluster_math(self, delivery):
+        """Lossless dissemination completes within the reference's spread
+        schedule: periodsToSpread = repeatMult * ceil(log2(n+1))
+        (ClusterMath.java:111-113) — the gossip stops spreading after
+        that, so full coverage must happen within it."""
+        n = 128
+        params, _, m = run_gossip(
+            n, 60, delivery=delivery,
+            world_fn=lambda w: w.with_spread(0, 7, 0),
+        )
+        full_at = first_full_round(m, n)
+        assert full_at is not None
+        assert full_at <= params.periods_to_spread, (
+            full_at, params.periods_to_spread)
+        # And it takes at least ~log2(n)/log2(1+fanout) rounds (growth
+        # is at most (1+fanout)x per round).
+        lower = int(np.floor(np.log(n) / np.log(1 + params.fanout)))
+        assert full_at >= lower, (full_at, lower)
+
+    def test_spread_windows_close(self, delivery):
+        """After dissemination completes, retransmission windows expire
+        (sweepGossips analog): wire gossip traffic returns to zero."""
+        n = 64
+        params, state, m = run_gossip(
+            n, 120, delivery=delivery,
+            world_fn=lambda w: w.with_spread(0, 3, 0),
+        )
+        msgs = np.asarray(m["messages_gossip"])
+        assert msgs[:3].sum() > 0
+        assert msgs[-20:].sum() == 0  # everyone's window closed
+        assert np.asarray(state.g_infected).all()
+
+    def test_crashed_origin_does_not_spread(self, delivery):
+        n = 32
+        _, _, m = run_gossip(
+            n, 40, delivery=delivery,
+            world_fn=lambda w: (w.with_crash(3, at_round=0)
+                                .with_spread(0, 3, 5)),
+        )
+        assert np.asarray(m["user_gossip_infected"]).sum() == 0
+
+    def test_crashed_receiver_frozen_then_reachable_after_revival(
+            self, delivery):
+        """A node down during dissemination misses the gossip; after
+        revival it can still be infected while senders' windows are open
+        (a fresh infection resets the window at each new member)."""
+        n = 32
+        params, state, m = run_gossip(
+            n, 100, delivery=delivery, seed=2,
+            world_fn=lambda w: (w.with_crash(9, at_round=0, until_round=8)
+                                .with_spread(0, 3, 0)),
+        )
+        curve = np.asarray(m["user_gossip_infected"])[:, 0]
+        infected = np.asarray(state.g_infected)[:, 0]
+        assert curve[7] <= n - 1          # node 9 can't have it while down
+        assert infected[9]                # but gets it after revival
+        assert curve[-1] == n
+
+    def test_co_running_with_crash_detection(self, delivery):
+        """The verdict scenario: infection curves AND crash detection in
+        one run, both completing."""
+        n = 96
+        params, _, m = run_gossip(
+            n, 80, g=2, delivery=delivery,
+            world_fn=lambda w: (w.with_crash(11, at_round=2)
+                                .with_spread(0, 0, 0)
+                                .with_spread(1, 50, 20)),
+        )
+        assert first_full_round(m, n - 1, 0) is not None  # crashed node 11 may miss g0
+        dead_view = np.asarray(m["dead"])[:, 11]
+        assert dead_view[-1] >= n - 2      # everyone declared node 11 dead
+
+    def test_delayed_user_gossip_rides_membership_bins(self, delivery):
+        """With mean delay ~ the round length, dissemination still
+        completes (late bits land via the g_ring) — and determinism
+        holds."""
+        n = 48
+        params, _, m1 = run_gossip(
+            n, 120, delivery=delivery, mean_delay_ms=100.0,
+            max_delay_rounds=2,
+            world_fn=lambda w: w.with_spread(0, 5, 0),
+        )
+        _, _, m2 = run_gossip(
+            n, 120, delivery=delivery, mean_delay_ms=100.0,
+            max_delay_rounds=2,
+            world_fn=lambda w: w.with_spread(0, 5, 0),
+        )
+        assert first_full_round(m1, n) is not None
+        np.testing.assert_array_equal(
+            np.asarray(m1["user_gossip_infected"]),
+            np.asarray(m2["user_gossip_infected"]),
+        )
+
+
+def test_user_gossip_compact_carry_trace_identical():
+    """G fields stay int32/bool in both carry layouts; traces match."""
+    outs = []
+    for compact in (False, True):
+        params = swim.SwimParams.from_config(
+            fast_config(), n_members=32, delivery="shift",
+            n_user_gossips=2, compact_carry=compact, loss_probability=0.1,
+        )
+        world = (swim.SwimWorld.healthy(params)
+                 .with_crash(3, at_round=5)
+                 .with_spread(0, 1, 0).with_spread(1, 30, 12))
+        _, m = swim.run(jax.random.key(4), params, world, 80)
+        outs.append(m)
+    for name in outs[0]:
+        np.testing.assert_array_equal(
+            np.asarray(outs[0][name]), np.asarray(outs[1][name]),
+            err_msg=name,
+        )
+
+
+def test_user_gossip_sharded_matches_semantics():
+    """8-device sharded run: injection lands on the right shard, curves
+    complete, metrics replicate."""
+    from scalecube_cluster_tpu.parallel import mesh as pmesh
+
+    n = 64
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="shift", n_user_gossips=2,
+    )
+    world = (swim.SwimWorld.healthy(params)
+             .with_spread(0, 2, 0)      # shard 0 origin
+             .with_spread(1, 61, 4))    # last-shard origin
+    mesh = pmesh.make_mesh(8)
+    _, m = pmesh.shard_run(jax.random.key(0), params, world, 50, mesh)
+    curve = np.asarray(m["user_gossip_infected"])
+    assert curve[0, 0] >= 1
+    assert (curve[-1] == n).all(), curve[-1]
+
+
+def test_checkpoint_resume_with_user_gossip(tmp_path):
+    """Kill-and-resume carries the G state bit-exactly."""
+    from scalecube_cluster_tpu.utils import checkpoint as ckpt
+
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=32, delivery="shift", n_user_gossips=1,
+    )
+    world = swim.SwimWorld.healthy(params).with_spread(0, 3, 2)
+    key = jax.random.key(0)
+    s_full, m_full = swim.run(key, params, world, 40)
+
+    s_half, _ = swim.run(key, params, world, 20)
+    path = str(tmp_path / "g.npz")
+    ckpt.save(path, s_half, 20, key=key)
+    s_loaded, next_round, key_loaded, _ = ckpt.load(path)
+    s_resumed, _ = swim.run(key_loaded, params, world, 20,
+                            state=s_loaded, start_round=next_round)
+    np.testing.assert_array_equal(np.asarray(s_full.g_infected),
+                                  np.asarray(s_resumed.g_infected))
+    np.testing.assert_array_equal(np.asarray(s_full.status),
+                                  np.asarray(s_resumed.status))
+
+
+def test_old_checkpoint_without_g_fields_loads(tmp_path):
+    """Pre-user-gossip checkpoints load as G=0 layouts."""
+    import numpy as onp
+    from scalecube_cluster_tpu.utils import checkpoint as ckpt
+
+    params = swim.SwimParams.from_config(fast_config(), n_members=16)
+    world = swim.SwimWorld.healthy(params)
+    state = swim.initial_state(params, world)
+    path = str(tmp_path / "old.npz")
+    ckpt.save(path, state, 0)
+    # Strip the g fields to simulate an old file.
+    with onp.load(path) as z:
+        arrays = {k: z[k] for k in z.files if not k.startswith("state/g_")}
+    onp.savez(path, **arrays)
+    loaded, _, _, _ = ckpt.load(path)
+    assert loaded.g_infected.shape == (16, 0)
