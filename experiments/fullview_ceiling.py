@@ -184,12 +184,17 @@ def main():
 
     ratio = (results["compact"]["max_fits"]
              / max(results["wide"]["max_fits"], 1))
+    ratio_b = (results["compact_blocked"]["max_fits"]
+               / max(results["wide"]["max_fits"], 1))
     out = {
         "mode": "full-view [N, N], shift delivery, single real TPU chip",
         "rounds_timed": ROUNDS,
+        "blocked_k_block": BLOCKED_KB,
         "layouts": results,
         "compact_over_wide_members": round(ratio, 3),
         "compact_over_wide_cells": round(ratio ** 2, 2),
+        "blocked_over_wide_members": round(ratio_b, 3),
+        "blocked_over_wide_cells": round(ratio_b ** 2, 2),
     }
     os.makedirs(os.path.join(REPO, "artifacts"), exist_ok=True)
     path = os.path.join(REPO, "artifacts", "fullview_ceiling.json")

@@ -72,7 +72,13 @@ Documented deviations from the reference (all statistical-regime-neutral):
     nodes probe proportionally less often than the reference would —
     use scatter mode to validate cold-start FD behavior;
   - the SYNC exchange is push-only per round (the syncAck pull is replaced
-    by the partner's own future random pushes — symmetric in distribution);
+    by the partner's own future random pushes — symmetric in distribution
+    in the warm steady state); during COLD START, where push-only is far
+    too slow, the reference's join protocol is restored exactly: members
+    holding ABSENT entries run a joiner ⇄ seed SYNC round trip each sync
+    round (``_seed_anti_entropy`` — doSync's seeds ∪ live candidate rule
+    + the syncAck reply, MembershipProtocolImpl.java:298-331,346-367),
+    active whenever seeds are configured and inert once views are full;
     an FD ALIVE-verdict on a suspected member pushes the suspect record to
     the member itself (MembershipProtocolImpl.java:379-391's SYNC), whose
     self-refutation then travels back by gossip;
@@ -1365,6 +1371,100 @@ def _send_components(state, status, inc, round_idx, params, world,
     return record_keys, hot, syncable
 
 
+def _seed_anti_entropy(status, sync_keys, inbox, inbox_alive, sync_round,
+                       round_idx, params, kn, world, node_ids, alive_here,
+                       alive, part, key, axis_name=None):
+    """Joiner ⇄ seed SYNC round trip — the reference's join protocol.
+
+    The reference's doSync picks its target from seeds ∪ live members and
+    the receiver REPLIES with its full table
+    (MembershipProtocolImpl.java:298-314 candidate rule, :320-331,346-367
+    onSync -> merge -> SYNC_ACK; start0's initial sync is the same
+    exchange, :216-251).  The tick's regular SYNC channel is push-only at
+    a uniform target — distribution-symmetric in steady state but far too
+    slow during cold start, where a joiner's uniform draw almost never
+    lands on a known member.  This channel restores the reference's
+    behavior exactly where it differs: on sync rounds, every live member
+    that still has ABSENT entries pushes its row to one random configured
+    seed and receives the seed's row back in the same round (the
+    reference's request/reply both complete well within one gossip
+    period).  Inert in steady state (no ABSENT entries -> no traffic) and
+    when no seeds are configured, so warm-state traces are unchanged.
+
+    Deviations, documented: the ack carries the seed's PRE-merge row
+    (one round staler than the reference's post-merge reply — the pusher
+    already holds everything it pushed); delivery is same-round even
+    under max_delay_rounds (sync_timeout >> link delays in the reference
+    regime).  Sharded: the seed's row and its inbox contribution combine
+    with one [K]-vector pmax per seed over ``axis_name``.
+
+    Returns (inbox, inbox_alive, sent_by_node, lost_by_node) — the
+    counter vectors feed SwimParams.link_counters accounting (pushes at
+    the pushers, acks at the seed).
+    """
+    n_seeds = world.seed_ids.shape[0]
+    compact = params.compact_carry
+    no_msg = delivery.no_message(compact)
+    has_absent = jnp.any(status == records.ABSENT, axis=1)
+    pusher = sync_round & alive_here & has_absent
+    k_sel, k_push, k_ack = jax.random.split(key, 3)
+    sel = jax.random.randint(k_sel, (node_ids.shape[0],), 0, n_seeds)
+    sent_vec = jnp.zeros(node_ids.shape, dtype=jnp.int32)
+    lost_vec = jnp.zeros(node_ids.shape, dtype=jnp.int32)
+
+    def pmax(x):
+        return jax.lax.pmax(x, axis_name) if axis_name is not None else x
+
+    for si in range(n_seeds):                       # S is static and small
+        sid = world.seed_ids[si]
+        mask_i = pusher & (sel == si) & (node_ids != sid)
+        loss_push, _ = link_eval(world.faults, round_idx, node_ids, sid,
+                                 kn.loss_probability, params.mean_delay_ms)
+        part_ok_p = part[node_ids] == part[sid]
+        wire_drop_push = prng.bernoulli_mask(
+            jax.random.fold_in(k_push, si), loss_push, node_ids.shape
+        )
+        ok_push = mask_i & alive[sid] & part_ok_p & ~wire_drop_push
+        # Seed-side merge of all arriving pushes: a one-hot row write of
+        # the columnwise max over pushers (no scatter, no gather).
+        is_seed_row = (node_ids == sid)[:, None]
+        contribution = pmax(jnp.max(
+            jnp.where(ok_push[:, None], sync_keys, no_msg), axis=0
+        ))
+        inbox = jnp.maximum(
+            inbox, jnp.where(is_seed_row, contribution[None, :], no_msg)
+        )
+        inbox_alive |= is_seed_row & delivery.is_alive_key(
+            contribution, compact=compact)[None, :]
+        # The ack: the seed's syncable row back to every successful
+        # pusher, over the reverse link.
+        seed_row = pmax(jnp.max(
+            jnp.where(is_seed_row, sync_keys, no_msg), axis=0
+        ))
+        loss_ack, _ = link_eval(world.faults, round_idx, sid, node_ids,
+                                kn.loss_probability, params.mean_delay_ms)
+        wire_drop_ack = prng.bernoulli_mask(
+            jax.random.fold_in(k_ack, si), loss_ack, node_ids.shape
+        )
+        ok_ack = ok_push & ~wire_drop_ack
+        inbox = jnp.maximum(
+            inbox, jnp.where(ok_ack[:, None], seed_row[None, :], no_msg)
+        )
+        inbox_alive |= ok_ack[:, None] & delivery.is_alive_key(
+            seed_row, compact=compact)[None, :]
+        # Wire accounting (SwimParams.link_counters): pushes at the
+        # pushers, acks at the seed.
+        at_seed = node_ids == sid
+        sent_vec += mask_i.astype(jnp.int32) + jnp.where(
+            at_seed, jnp.sum(ok_push, dtype=jnp.int32), 0
+        )
+        lost_vec += (mask_i & (wire_drop_push | ~part_ok_p)
+                     ).astype(jnp.int32) + jnp.where(
+            at_seed, jnp.sum(ok_push & wire_drop_ack, dtype=jnp.int32), 0
+        )
+    return inbox, inbox_alive, sent_vec, lost_vec
+
+
 def _send_payloads(state, status, inc, round_idx, params, world,
                    node_ids, is_self):
     """(gossip_keys, sync_keys) — the masked per-channel payload matrices
@@ -1609,6 +1709,16 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     # FD local verdicts fold into the same inbox (observer-local, no comm).
     inbox = jnp.maximum(inbox, fd_inbox)
 
+    # Joiner <-> seed SYNC round trip (the reference's join protocol;
+    # inert once no row holds ABSENT entries).
+    ss_sent = ss_lost = jnp.int32(0)
+    if gate_contacts:
+        inbox, inbox_alive, ss_sent, ss_lost = _seed_anti_entropy(
+            status, sync_keys, inbox, inbox_alive, sync_round, round_idx,
+            params, kn, world, node_ids, alive_here, alive, part,
+            jax.random.fold_in(k_sync_drop, 29), axis_name=axis_name,
+        )
+
     # User-gossip bits ride the same gossip channels, targets, and drop
     # masks — one GOSSIP_REQ carries membership records AND user gossips
     # (GossipProtocolImpl.java:211-237).
@@ -1667,9 +1777,11 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
             + do_sync.astype(jnp.int32)
             + probes_sent.astype(jnp.int32)
             + ping_req_launches.astype(jnp.int32) * r_proxies
+            + ss_sent
         )
         aux["lost_by_node"] = (
-            jnp.sum(g_lost, axis=1, dtype=jnp.int32) + s_lost.astype(jnp.int32)
+            jnp.sum(g_lost, axis=1, dtype=jnp.int32)
+            + s_lost.astype(jnp.int32) + ss_lost
         )
     return new_state, aux
 
@@ -2040,6 +2152,18 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     )
     inbox_alive |= delivered_flags & ok_s_now[:, None]
 
+    # Joiner <-> seed SYNC round trip (the reference's join protocol;
+    # inert once no row holds ABSENT entries — the masked key copy only
+    # materializes in seed-configured cold-start scenarios).
+    ss_sent = ss_lost = jnp.int32(0)
+    if gate_contacts:
+        sync_keys_local = jnp.where(syncable, record_keys, no_msg)
+        inbox, inbox_alive, ss_sent, ss_lost = _seed_anti_entropy(
+            status, sync_keys_local, inbox, inbox_alive, sync_round,
+            round_idx, params, kn, world, node_ids, alive_here, alive, part,
+            jax.random.fold_in(k_sync_drop, 29), axis_name=axis_name,
+        )
+
     new_state, refuted = _merge_and_timers(
         state, status, inc, inbox, inbox_alive, round_idx, params, kn, world,
         node_ids, alive_here, is_self, inbox_ring=ring, flag_ring=fring,
@@ -2055,9 +2179,9 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     if counters_on:
         aux["sent_by_node"] = (
             sent_acc + probes_sent.astype(jnp.int32)
-            + ping_req_launches.astype(jnp.int32) * r_proxies
+            + ping_req_launches.astype(jnp.int32) * r_proxies + ss_sent
         )
-        aux["lost_by_node"] = lost_acc
+        aux["lost_by_node"] = lost_acc + ss_lost
     return new_state, aux
 
 

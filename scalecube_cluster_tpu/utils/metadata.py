@@ -1,0 +1,168 @@
+"""Host-side metadata for the dense tick: (id, incarnation)-keyed table.
+
+The reference never gossips metadata content — only the owner's
+incarnation bump travels (through membership gossip), and receivers then
+PULL the metadata from the owner, emitting an UPDATED event
+(metadata/MetadataStoreImpl.java:106-146 updateMetadata -> incarnation
+bump; :149-186 remote fetch; MembershipProtocolImpl.java:572-584 the
+higher-incarnation -> fetchMetadata -> UPDATED path).  SURVEY.md §2.2
+scoped metadata content out of tensor scope for exactly this reason: the
+wire protocol only ever carries (id, incarnation), which the tick already
+disseminates exactly.
+
+This module is the host-side half: a table keyed by (node_id,
+incarnation) plus the three protocol operations —
+
+  - :meth:`TickMetadataStore.update`: the owner's updateMetadata — bumps
+    the node's incarnation in the carry and opens its gossip window so
+    the bump disseminates through the NORMAL membership machinery, and
+    registers the new metadata version under the bumped incarnation;
+  - :meth:`TickMetadataStore.view`: what an observer's fetch would
+    return — resolved against the incarnation THE OBSERVER HAS SEEN
+    (a refutation bump without a metadata change resolves to the prior
+    version, like the reference's fetch returning unchanged content);
+  - :meth:`updated_events`: the UPDATED-event stream — (observer,
+    subject, old_inc, new_inc) tuples diffed between two carries, the
+    batch analog of MembershipProtocolImpl's per-record UPDATED emission.
+
+Scale: all operations are O(rows touched) host-side; the 1M-member
+propagation demo is examples/metadata_at_scale.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from scalecube_cluster_tpu import records
+from scalecube_cluster_tpu.models import swim
+
+
+class TickMetadataStore:
+    """(node_id, incarnation) -> metadata dict, resolved like the
+    reference's pull-on-bump protocol."""
+
+    def __init__(self):
+        # node_id -> sorted list of (incarnation, metadata) versions.
+        self._versions: Dict[int, list] = {}
+
+    # -- owner-side ------------------------------------------------------
+
+    def put(self, node_id: int, incarnation: int, metadata: dict) -> None:
+        """Register ``metadata`` as ``node_id``'s content at
+        ``incarnation`` (initial metadata at incarnation 0 = the
+        reference's config.metadata at join)."""
+        versions = self._versions.setdefault(int(node_id), [])
+        versions.append((int(incarnation), dict(metadata)))
+        versions.sort(key=lambda iv: iv[0])
+
+    def update(self, state: swim.SwimState, params: swim.SwimParams,
+               world: swim.SwimWorld, node_id: int, metadata: dict,
+               current_round: int) -> swim.SwimState:
+        """The owner's ``updateMetadata``: bump incarnation + re-announce.
+
+        Mirrors MetadataStoreImpl.updateMetadata (:106-146) + the
+        membership re-gossip of the bumped record: ``self_inc[node] += 1``
+        (the tick re-pins the node's own record from self_inc every
+        round) and the node's own spread window reopens so the bump
+        disseminates.  The new metadata registers under the bumped
+        incarnation; observers "fetch" it via :meth:`view` once their
+        table shows the new incarnation.
+
+        Returns the updated carry (host-side, between scan chunks — the
+        same seam checkpoint/resume uses).
+        """
+        node_id = int(node_id)
+        slot = int(np.asarray(world.slot_of_node)[node_id])
+        if slot < 0:
+            raise ValueError(
+                f"node {node_id} is not a tracked subject — its record "
+                f"(and so its incarnation bump) is not simulated"
+            )
+        new_inc = int(np.asarray(state.self_inc)[node_id]) + 1
+        self.put(node_id, new_inc, metadata)
+        spread = params.periods_to_spread + 1
+        if params.compact_carry:
+            spread_val = np.int8(min(spread, 127))
+        else:
+            spread_val = np.int32(current_round + spread)
+        return dataclasses.replace(
+            state,
+            self_inc=state.self_inc.at[node_id].add(1),
+            spread_until=state.spread_until.at[node_id, slot].set(
+                jnp.asarray(spread_val, dtype=state.spread_until.dtype)
+            ),
+        )
+
+    # -- observer-side ---------------------------------------------------
+
+    def resolve(self, node_id: int, seen_incarnation: int) -> Optional[dict]:
+        """Metadata at the newest registered version <= what the observer
+        has seen — a refutation bump (no metadata change) resolves to the
+        prior content, exactly like the reference's fetch."""
+        versions = self._versions.get(int(node_id), [])
+        best = None
+        for inc, md in versions:
+            if inc <= seen_incarnation:
+                best = md
+            else:
+                break
+        return best
+
+    def view(self, state: swim.SwimState, params: swim.SwimParams,
+             world: swim.SwimWorld, observer_id: int,
+             subject_id: int, round_idx: Optional[int] = None
+             ) -> Optional[dict]:
+        """What ``observer_id``'s metadata fetch for ``subject_id`` would
+        return right now: None if the observer does not hold a live
+        record of the subject (the reference only fetches for members in
+        its table)."""
+        slot = int(np.asarray(world.slot_of_node)[subject_id])
+        if slot < 0:
+            raise ValueError(f"node {subject_id} is not a tracked subject")
+        snap = swim.node_snapshot(state, params, world, observer_id,
+                                  round_idx=round_idx)
+        if subject_id in snap["alive_members"] + snap["suspected_members"]:
+            seen = snap["record_incarnations"][subject_id]
+        elif subject_id == observer_id:
+            seen = snap["incarnation"]
+        else:
+            return None
+        return self.resolve(subject_id, seen)
+
+
+def updated_events(prev_state: swim.SwimState, state: swim.SwimState,
+                   world: swim.SwimWorld,
+                   max_events: int = 10_000) -> list:
+    """The UPDATED-event stream between two carries.
+
+    (observer_id, subject_id, old_inc, new_inc) wherever an observer's
+    live record of a subject moved to a higher incarnation — the batch
+    analog of the reference's per-record UPDATED emission
+    (MembershipProtocolImpl.java:572-584); each event is the trigger the
+    reference uses to re-fetch metadata.  Capped at ``max_events`` (the
+    [N, K] diff can be huge at scale; the CURVE of bump dissemination is
+    cheaper via the inc matrix directly — see examples/metadata_at_scale).
+    """
+    old_inc = np.asarray(prev_state.inc, dtype=np.int64)
+    new_inc = np.asarray(state.inc, dtype=np.int64)
+    new_status = np.asarray(state.status)
+    live = (new_status == records.ALIVE) | (new_status == records.SUSPECT)
+    bumped = (new_inc > old_inc) & live
+    # A node's record about ITSELF emits no UPDATED — the reference's
+    # about-self path refutes instead of emitting
+    # (MembershipProtocolImpl.java:488-509).
+    subj = np.asarray(world.subject_ids)
+    for sl, s_id in enumerate(subj):
+        bumped[int(s_id), sl] = False
+    obs, slot = np.nonzero(bumped)
+    subjects = np.asarray(world.subject_ids)[slot]
+    events = []
+    for o, s, sl in zip(obs[:max_events], subjects[:max_events],
+                        slot[:max_events]):
+        events.append((int(o), int(s), int(old_inc[o, sl]),
+                       int(new_inc[o, sl])))
+    return events

@@ -105,7 +105,8 @@ def test_blocked_checkpoint_resume():
     s_half, _ = swim.run(key, params, world, 30)
     s_res, _ = swim.run(key, params, world, 30, state=s_half,
                         start_round=30)
-    for fld in ("status", "inc", "suspect_deadline", "self_inc"):
+    for fld in ("status", "inc", "spread_until", "suspect_deadline",
+                "self_inc"):
         np.testing.assert_array_equal(
             np.asarray(getattr(s_full, fld)),
             np.asarray(getattr(s_res, fld)), err_msg=fld,

@@ -1,0 +1,277 @@
+"""Cold-start join + churn cross-validation: oracle ↔ scatter ↔ shift.
+
+Round-4's verdict flagged cold start and churn as the one regime where
+the tick's delivery modes were known to deviate (partially-joined nodes
+probe less in shift mode; push-only SYNC made joins sync-quantized and
+heavy-tailed).  Round 5 fixed the root cause — the joiner ⇄ seed SYNC
+round trip (models/swim._seed_anti_entropy, the reference's
+doSync-seeds-∪-live + syncAck protocol,
+MembershipProtocolImpl.java:298-331,346-367) — and this module pins the
+resulting cross-layer agreement:
+
+  - seed-hub cold-start join time (MembershipProtocolTest.java:432-462's
+    regime): oracle median 3 rounds; both tick modes within one sync
+    cycle;
+  - crash DURING cold start (partial knowledge): DEAD declaration on the
+    oracle timescale in both modes;
+  - freeze/revive churn (the reference's partition+restart scenarios,
+    MembershipProtocolTest.java:368-430): detection AND re-acceptance
+    timescales agree;
+  - the shift-mode probe-rate deviation is bounded: the ramp to full
+    probing completes within two fd cycles of the views filling.
+
+Measured 8-seed medians that set the bands (2026-07-31, N=16):
+  join:    oracle 3 (3..4) | scatter 4 (3..4) | shift 3 (3..4)
+  cs-dead: oracle 34       | scatter 34 (34..44) | shift 34 (32..34)
+  churn:   oracle dead 33, back 10 | ticks dead 30, back 3..4
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.oracle import Cluster, Simulator
+from scalecube_cluster_tpu.records import MemberStatus
+
+N = 16
+ROUND_MS = 100
+CFG = ClusterConfig.default_local().replace(
+    gossip_interval=ROUND_MS,
+    ping_interval=200,
+    ping_timeout=100,
+    sync_interval=1_000,
+    suspicion_mult=3,
+)
+N_SEEDS = 8
+SYNC_CYCLE = CFG.sync_interval // ROUND_MS
+
+
+def median(xs):
+    return float(np.median(xs))
+
+
+def build_oracle(seed, warmup_ms=0):
+    sim = Simulator(seed=seed)
+    clusters = [Cluster.join(sim, config=CFG, alias="m0")]
+    for i in range(1, N):
+        clusters.append(Cluster.join(sim, seeds=[clusters[0].address],
+                                     config=CFG, alias=f"m{i}"))
+    if warmup_ms:
+        sim.run_for(warmup_ms)
+    return sim, clusters
+
+
+def cold_state(params, world):
+    return swim.initial_state(params, world, warm=False)
+
+
+# --------------------------------------------------------------------------
+# (1) Seed-hub cold-start join
+# --------------------------------------------------------------------------
+
+
+def oracle_join_rounds(seed):
+    sim, clusters = build_oracle(seed)
+    t0 = sim.now
+    for _ in range(120):
+        sim.run_for(ROUND_MS)
+        if all(len(c.members()) == N for c in clusters):
+            return (sim.now - t0) / ROUND_MS
+    return float("inf")
+
+
+def tick_join_rounds(seed, delivery):
+    params = swim.SwimParams.from_config(CFG, n_members=N, delivery=delivery)
+    world = swim.SwimWorld.healthy(params).with_seeds(0)
+    _, m = swim.run(jax.random.key(seed), params, world, 120,
+                    state=cold_state(params, world))
+    full = np.all(np.asarray(m["alive"]) == N - 1, axis=1)
+    idx = np.flatnonzero(full)
+    return float(idx[0]) if idx.size else float("inf")
+
+
+@pytest.fixture(scope="module")
+def oracle_join_stats():
+    return [oracle_join_rounds(s) for s in range(N_SEEDS)]
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+def test_cold_start_join_matches_oracle(oracle_join_stats, delivery):
+    o_med = median(oracle_join_stats)
+    t_runs = [tick_join_rounds(s, delivery) for s in range(N_SEEDS)]
+    t_med = median(t_runs)
+    assert np.isfinite(o_med), oracle_join_stats
+    assert np.isfinite(t_med), t_runs
+    # Measured medians 3 vs 3-4; the band is one sync cycle + 2 — before
+    # the seed round trip this was 40 (scatter) / 100-with-inf (shift).
+    assert abs(t_med - o_med) <= SYNC_CYCLE + 2, (delivery, t_med, o_med,
+                                                  t_runs)
+    # And no heavy tail: every seed joins within 3 sync cycles.
+    assert max(t_runs) <= 3 * SYNC_CYCLE, (delivery, t_runs)
+
+
+# --------------------------------------------------------------------------
+# (2) Crash during cold start (partial knowledge)
+# --------------------------------------------------------------------------
+
+CRASH_AT = 2
+
+
+def oracle_coldstart_dead_rounds(seed):
+    """Rounds from cluster start to first observer declaring the victim
+    (which crashed CRASH_AT rounds in) dead."""
+    sim, clusters = build_oracle(seed)
+    sim.run_for(CRASH_AT * ROUND_MS)
+    victim = clusters[5]
+    vid = victim.member().id
+    victim.transport.stop()
+    others = [c for c in clusters if c is not victim]
+    for r in range(300):
+        sim.run_for(ROUND_MS)
+        for c in others:
+            recs = {rr.member.id for rr in c.membership.membership_records()}
+            # Declared dead = once known, now removed (r > a few rounds
+            # guards the window before anyone learned the victim existed).
+            if r > 5 and vid not in recs and len(c.members()) >= N - 1:
+                return float(r + CRASH_AT)
+    return float("inf")
+
+
+def tick_coldstart_dead_rounds(seed, delivery):
+    params = swim.SwimParams.from_config(CFG, n_members=N, delivery=delivery)
+    world = (swim.SwimWorld.healthy(params).with_seeds(0)
+             .with_crash(5, at_round=CRASH_AT))
+    _, m = swim.run(jax.random.key(seed), params, world, 300,
+                    state=cold_state(params, world))
+    idx = np.flatnonzero(np.asarray(m["dead"])[:, 5] > 0)
+    return float(idx[0]) if idx.size else float("inf")
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+def test_cold_start_crash_detection_matches_oracle(delivery):
+    o_runs = [oracle_coldstart_dead_rounds(s) for s in range(6)]
+    t_runs = [tick_coldstart_dead_rounds(s, delivery) for s in range(6)]
+    o_med, t_med = median(o_runs), median(t_runs)
+    assert np.isfinite(o_med), o_runs
+    assert np.isfinite(t_med), t_runs
+    # Measured: oracle 34, ticks 34 (scatter tail to 44 when the victim
+    # dies before some observers learned of it — the same effect delays
+    # the oracle's own declaration on other seeds).  15% + 3.
+    assert abs(t_med - o_med) <= 0.15 * o_med + 3, (delivery, t_med, o_med,
+                                                    t_runs)
+
+
+# --------------------------------------------------------------------------
+# (3) Freeze / revive churn
+# --------------------------------------------------------------------------
+
+FREEZE_ROUNDS = 60
+
+
+def oracle_churn_rounds(seed):
+    """(dead_first, back_all) — detection of a frozen member and
+    re-acceptance after it thaws (block-all is the oracle analog of the
+    tick's frozen-JVM crash window: state intact, no traffic)."""
+    sim, clusters = build_oracle(seed, warmup_ms=2_000)
+    victim = clusters[3]
+    vid = victim.member().id
+    others = [c for c in clusters if c is not victim]
+    victim.network_emulator.block(
+        [c.address for c in clusters if c is not victim])
+    for c in others:
+        c.network_emulator.block(victim.address)
+    t0 = sim.now
+    dead_first = None
+    for _ in range(FREEZE_ROUNDS):
+        sim.run_for(ROUND_MS)
+        if dead_first is None and any(
+                vid not in {m.id for m in c.members()} for c in others):
+            dead_first = (sim.now - t0) / ROUND_MS
+    victim.network_emulator.unblock_all()
+    for c in others:
+        c.network_emulator.unblock(victim.address)
+    t1 = sim.now
+    for _ in range(150):
+        sim.run_for(ROUND_MS)
+        if all(vid in {m.id for m in c.members()} for c in others):
+            return (dead_first or float("inf"),
+                    (sim.now - t1) / ROUND_MS)
+    return dead_first or float("inf"), float("inf")
+
+
+def tick_churn_rounds(seed, delivery):
+    params = swim.SwimParams.from_config(CFG, n_members=N, delivery=delivery)
+    world = swim.SwimWorld.healthy(params).with_crash(
+        3, at_round=0, until_round=FREEZE_ROUNDS)
+    horizon = FREEZE_ROUNDS + 160
+    _, m = swim.run(jax.random.key(seed), params, world, horizon)
+    deads = np.asarray(m["dead"])[:, 3]
+    alive_v = np.asarray(m["alive"])[:, 3]
+    dead_idx = np.flatnonzero(deads > 0)
+    back_idx = np.flatnonzero(
+        (alive_v == N - 1) & (np.arange(horizon) >= FREEZE_ROUNDS))
+    return (float(dead_idx[0]) if dead_idx.size else float("inf"),
+            float(back_idx[0] - FREEZE_ROUNDS) if back_idx.size
+            else float("inf"))
+
+
+@pytest.fixture(scope="module")
+def oracle_churn_stats():
+    return [oracle_churn_rounds(s) for s in range(N_SEEDS)]
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+def test_churn_freeze_revive_matches_oracle(oracle_churn_stats, delivery):
+    o_dead = median([d for d, _ in oracle_churn_stats])
+    o_back = median([b for _, b in oracle_churn_stats])
+    t_runs = [tick_churn_rounds(s, delivery) for s in range(N_SEEDS)]
+    t_dead = median([d for d, _ in t_runs])
+    t_back = median([b for _, b in t_runs])
+    assert np.isfinite([o_dead, o_back, t_dead, t_back]).all(), \
+        (oracle_churn_stats, t_runs)
+    # Detection: measured 33 vs 30 (the within-round verdict offset).
+    assert abs(t_dead - o_dead) <= 0.15 * o_dead + 3, (delivery, t_dead,
+                                                       o_dead, t_runs)
+    # Re-acceptance: the revived member's refutation travels by gossip on
+    # the tick (3-4 rounds) while the oracle's victim must first LEARN it
+    # was declared dead (sync-quantized: 10) — agreement within one sync
+    # cycle + 2.
+    assert abs(t_back - o_back) <= SYNC_CYCLE + 2, (delivery, t_back,
+                                                    o_back, t_runs)
+
+
+# --------------------------------------------------------------------------
+# (4) The shift-mode probe-rate deviation, quantified and bounded
+# --------------------------------------------------------------------------
+
+
+def test_shift_probe_ramp_bounded():
+    """Shift-mode FD probes only when the shared offset lands on a known
+    entry, so during cold start its probe rate tracks the fraction known
+    (module docstring deviation).  With the seed round trip the views
+    fill in ~1 sync cycle, so the deviation is bounded: full probe rate
+    within 2 fd cycles of the join completing.  Scatter mode (known-only
+    uniform draws) probes near-fully from the first fd round — the two
+    modes' counters document the deviation rather than hiding it."""
+    rates = {}
+    for delivery in ("scatter", "shift"):
+        params = swim.SwimParams.from_config(CFG, n_members=N,
+                                             delivery=delivery)
+        world = swim.SwimWorld.healthy(params).with_seeds(0)
+        _, m = swim.run(jax.random.key(0), params, world, 60,
+                        state=cold_state(params, world))
+        ps = np.asarray(m["messages_ping_sent"])
+        alive = np.asarray(m["alive"])
+        full_at = int(np.flatnonzero(np.all(alive == N - 1, axis=1))[0])
+        fd_rounds = np.flatnonzero(ps > 0)
+        rates[delivery] = ps
+        # Full probing (= N pings per fd round) within 2 fd cycles of the
+        # views filling.
+        fd_cycle = params.ping_every
+        late = fd_rounds[fd_rounds >= full_at + 2 * fd_cycle]
+        assert late.size and (ps[late] == N).all(), (delivery, full_at, ps)
+    # The deviation exists and is confined to the cold window: shift's
+    # cumulative probes never exceed scatter's there.
+    assert rates["shift"][:4].sum() <= rates["scatter"][:4].sum()

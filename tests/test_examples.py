@@ -16,6 +16,9 @@ EXAMPLES = [
     "gossip_example",
     "membership_events_example",
     "cluster_metadata_example",
+    # Sizes itself down on CPU (the suite backend); the 1M variant runs
+    # on the accelerator.
+    "metadata_at_scale",
 ]
 
 EXAMPLES_DIR = pathlib.Path(__file__).resolve().parent.parent / "examples"

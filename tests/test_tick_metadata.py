@@ -1,0 +1,104 @@
+"""Host-side metadata on the dense tick (utils/metadata.py).
+
+The reference's metadata protocol: content is never gossiped — the
+owner's incarnation bump travels via membership, and observers pull
+content keyed by the incarnation they saw (MetadataStoreImpl.java:
+106-146, 149-186; MembershipProtocolImpl.java:572-584).  These tests pin
+the tick-side analog end to end at small N; the 1M demonstration is
+examples/metadata_at_scale.py.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.utils import metadata as md
+
+from tests.test_swim_model import fast_config
+
+
+def setup(n=32, delivery="shift", **overrides):
+    params = swim.SwimParams.from_config(fast_config(), n_members=n,
+                                         delivery=delivery, **overrides)
+    world = swim.SwimWorld.healthy(params)
+    store = md.TickMetadataStore()
+    for i in range(n):
+        store.put(i, 0, {"name": f"m{i}", "version": 0})
+    return params, world, store
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+def test_update_propagates_and_is_queryable(delivery):
+    n = 32
+    params, world, store = setup(n, delivery)
+    key = jax.random.key(0)
+    state, _ = swim.run(key, params, world, 20)
+
+    # Owner 3 updates its metadata between scan chunks.
+    state = store.update(state, params, world, 3, {"name": "m3", "version": 1},
+                         current_round=20)
+    # Before dissemination: another observer still resolves version 0.
+    assert store.view(state, params, world, 9, 3, round_idx=20) == {
+        "name": "m3", "version": 0}
+    # The owner immediately sees its own new version.
+    assert store.view(state, params, world, 3, 3, round_idx=20)["version"] == 1
+
+    prev = state
+    state, m = swim.run(key, params, world, 40, state=state, start_round=20)
+    # The bump disseminated: every observer now fetches version 1.
+    for obs in (0, 9, 17, 31):
+        assert store.view(state, params, world, obs, 3,
+                          round_idx=60)["version"] == 1, obs
+    # The UPDATED-event stream carried the wave (observer, subject=3,
+    # 0 -> 1 transitions).
+    events = md.updated_events(prev, state, world)
+    bumps = [(o, s, a, b) for o, s, a, b in events if s == 3]
+    assert len(bumps) == n - 1, len(bumps)
+    assert all(a == 0 and b == 1 for _, _, a, b in bumps)
+
+
+def test_refutation_bump_resolves_to_prior_content():
+    """A refutation bumps incarnation WITHOUT a metadata change — the
+    fetch must return the existing content at the highest registered
+    version <= the seen incarnation (the reference's fetch is content-
+    at-owner, unchanged by the refutation)."""
+    n = 24
+    params, world, store = setup(n)
+    # Crash + revive node 5: its revival refutes its death at a bumped
+    # incarnation nobody registered metadata for.
+    world = world.with_crash(5, at_round=4, until_round=40)
+    state, _ = swim.run(jax.random.key(1), params, world, 120)
+    snap = swim.node_snapshot(state, params, world, 0)
+    assert 5 in snap["alive_members"]
+    seen = snap["record_incarnations"][5]
+    assert seen >= 1                       # the refutation bump traveled
+    assert store.view(state, params, world, 0, 5)["name"] == "m5"
+
+
+def test_update_requires_tracked_subject():
+    params = swim.SwimParams.from_config(fast_config(), n_members=64,
+                                         n_subjects=8)
+    world = swim.SwimWorld.healthy(params)
+    store = md.TickMetadataStore()
+    state = swim.initial_state(params, world)
+    with pytest.raises(ValueError, match="tracked subject"):
+        store.update(state, params, world, 40, {"x": "y"}, current_round=0)
+
+
+def test_update_compact_carry_layout():
+    """The bump + window-reopen writes respect the compact encodings."""
+    import dataclasses
+    params = dataclasses.replace(
+        swim.SwimParams.from_config(fast_config(), n_members=24,
+                                    delivery="shift"),
+        compact_carry=True,
+    )
+    world = swim.SwimWorld.healthy(params)
+    store = md.TickMetadataStore()
+    store.put(3, 0, {"v": 0})
+    key = jax.random.key(0)
+    state, _ = swim.run(key, params, world, 20)
+    state = store.update(state, params, world, 3, {"v": 1}, current_round=20)
+    state, _ = swim.run(key, params, world, 40, state=state, start_round=20)
+    assert store.view(state, params, world, 11, 3, round_idx=60) == {"v": 1}
