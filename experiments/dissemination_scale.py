@@ -94,7 +94,12 @@ def main():
         else:
             bench_json = json.loads(lines[-1])
             rate = bench_json["value"]
-            crash_noticed = "error" not in bench_json
+            # bench returns dissemination_rounds=-1 (no error key) when
+            # the leave was never noticed — require a positive count.
+            crash_noticed = (
+                "error" not in bench_json
+                and bench_json.get("dissemination_rounds", -1) > 0
+            )
             tput_error = bench_json.get("error")
     except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
         tput_error = f"{type(e).__name__}: {e}"

@@ -1,0 +1,86 @@
+"""Fusion-splitting the merge: a measured NEGATIVE result.
+
+The round-4 verdict's second roofline variant: split the select-heavy
+merge fusion (the one kernel below HBM bandwidth — ~1.03 ms/round at 1M,
+artifacts/roofline.json) with ``jax.lax.optimization_barrier`` at the
+delivery->merge and merge->timers boundaries.  Measured on the real
+tick at 1M x 16 (shift, steady state = 3rd+ execution of the loaded
+program; the 1st runs ~3x slow on axon):
+
+    baseline            2.88 ms/round
+    barrier@inbox       4.02 ms/round   (+40%)
+    barrier@merge_out   3.48 ms/round   (+21%)
+    both                4.14 ms/round   (+44%)
+
+Every split is strictly worse: the monolithic fusion's win is exactly
+that the merge intermediates (winner status/inc, accept masks) never
+hit HBM; a barrier forces them to materialize.  The residual-gap
+conclusion stands as a pinned negative alongside the pallas route
+(experiments/merge_kernel_bench.py — full-kernel compositions crash the
+remote-compile helper; experiments/mosaic_probe.py — the individual
+capabilities all work).
+
+Run: ``python experiments/merge_split_bench.py [none|inbox|merge_out|both]``.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+variant = sys.argv[1] if len(sys.argv) > 1 else "none"
+
+import jax
+
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.ops import delivery
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.utils import runlog
+
+runlog.enable_compilation_cache()
+
+if variant == "inbox":
+    orig = swim._merge_and_timers
+
+    def patched(state, status, inc, inbox, inbox_alive, *a, **k):
+        inbox, inbox_alive = jax.lax.optimization_barrier(
+            (inbox, inbox_alive))
+        return orig(state, status, inc, inbox, inbox_alive, *a, **k)
+
+    swim._merge_and_timers = patched
+elif variant == "merge_out":
+    orig_merge = delivery.merge_inbox
+
+    def patched(*args, **kw):
+        return jax.lax.optimization_barrier(orig_merge(*args, **kw))
+
+    delivery.merge_inbox = patched
+elif variant == "both":
+    orig = swim._merge_and_timers
+
+    def patched(state, status, inc, inbox, inbox_alive, *a, **k):
+        inbox, inbox_alive = jax.lax.optimization_barrier(
+            (inbox, inbox_alive))
+        return orig(state, status, inc, inbox, inbox_alive, *a, **k)
+
+    swim._merge_and_timers = patched
+    orig_merge = delivery.merge_inbox
+
+    def patched2(*args, **kw):
+        return jax.lax.optimization_barrier(orig_merge(*args, **kw))
+
+    delivery.merge_inbox = patched2
+
+params = swim.SwimParams.from_config(
+    ClusterConfig.default(), n_members=1_000_000, n_subjects=16,
+    loss_probability=0.02, delivery="shift")
+world = swim.SwimWorld.healthy(params).with_crash(3, at_round=50)
+key = jax.random.key(0)
+s = swim.initial_state(params, world)
+times = []
+for i in range(4):
+    t0 = time.perf_counter()
+    s, _ = swim.run(key, params, world, 500, state=s, start_round=500 * i)
+    runlog.completion_barrier(s.status)
+    times.append((time.perf_counter() - t0) / 500 * 1e3)
+print(f"[{variant}] steady {min(times[2:]):.3f} ms/round (calls: "
+      f"{[round(t, 2) for t in times]})")

@@ -1,0 +1,109 @@
+"""Mosaic capability probes for the merge-kernel plan (run on TPU).
+
+Round 3 established this stack's Mosaic rejects int8 vector compares
+("Target does not support this comparison") and i32->i8 truncating
+stores ("Unsupported target bitwidth for truncation"), killing the int8
+merge-kernel idea (RESULTS.md round-3 log).  The round-4 verdict asks
+for the INT16 variant to be measured: this probe answers, per
+capability, whether a pallas kernel can
+
+  p16_load:   load int16, upcast, compute in int32
+  p16_store:  truncate int32 -> int16 on store
+  p16_cmp:    compare int16 vectors directly
+  p8_load:    load int8 + upcast (reads are fine even if compares are not)
+  merge_core: the actual merge inner loop (i8/i16/i32 planes in, int32
+              compute, i16/i32 out) at a realistic [rows, 128] block
+
+Prints one JSON line per probe.  Run: ``python
+experiments/mosaic_probe.py``.
+"""
+
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def try_probe(name, fn):
+    try:
+        out = fn()
+        print(json.dumps({"probe": name, "ok": True,
+                          "out": float(jnp.asarray(out).sum())}))
+    except Exception as e:  # noqa: BLE001 — capability probe by design
+        msg = str(e).split("\n")[0][:200]
+        print(json.dumps({"probe": name, "ok": False, "error": msg}))
+
+
+ROWS, COLS = 512, 128
+
+
+def k_load16(x_ref, o_ref):
+    o_ref[...] = x_ref[...].astype(jnp.int32) + 1
+
+
+def k_store16(x_ref, o_ref):
+    o_ref[...] = (x_ref[...] + 1).astype(jnp.int16)
+
+
+def k_cmp16(x_ref, y_ref, o_ref):
+    o_ref[...] = jnp.where(x_ref[...] > y_ref[...],
+                           jnp.int16(1), jnp.int16(0)).astype(jnp.int32)
+
+
+def k_load8(x_ref, o_ref):
+    o_ref[...] = x_ref[...].astype(jnp.int32) * 2
+
+
+def k_merge_core(status_ref, inc_ref, inbox_ref, alive_ref,
+                 status_out, inc_out):
+    """The merge inner loop shape: int8-as-i32 status plane + i32 inc +
+    i32 inbox keys + bool-as-i8 gate; i16 status out, i32 inc out."""
+    status = status_ref[...].astype(jnp.int32)
+    inc = inc_ref[...]
+    key = inbox_ref[...]
+    gate = alive_ref[...].astype(jnp.int32)
+    win_inc = jnp.where(key < 0, 0, (key >> 1) & ((1 << 29) - 1))
+    win_dead = (key >> 30) & 1
+    win_status = jnp.where(win_dead == 1, 2,
+                           jnp.where((key & 1) == 1, 1, 0))
+    win_status = jnp.where(key < 0, 3, win_status)
+    accepts = (win_inc > inc) | ((win_inc == inc) & (win_status > status))
+    accepts = accepts & (gate > 0)
+    status_out[...] = jnp.where(accepts, win_status, status).astype(jnp.int16)
+    inc_out[...] = jnp.where(accepts, win_inc, inc)
+
+
+def main():
+    key = jax.random.key(0)
+    x16 = jax.random.randint(key, (ROWS, COLS), 0, 100, dtype=jnp.int16)
+    y16 = jax.random.randint(key, (ROWS, COLS), 0, 100, dtype=jnp.int16)
+    x8 = jax.random.randint(key, (ROWS, COLS), 0, 100, dtype=jnp.int8)
+    xi = jax.random.randint(key, (ROWS, COLS), -1, 1 << 20, dtype=jnp.int32)
+
+    try_probe("p16_load", lambda: pl.pallas_call(
+        k_load16, out_shape=jax.ShapeDtypeStruct((ROWS, COLS), jnp.int32)
+    )(x16))
+    try_probe("p16_store", lambda: pl.pallas_call(
+        k_store16, out_shape=jax.ShapeDtypeStruct((ROWS, COLS), jnp.int16)
+    )(jnp.abs(xi) % 1000))
+    try_probe("p16_cmp", lambda: pl.pallas_call(
+        k_cmp16, out_shape=jax.ShapeDtypeStruct((ROWS, COLS), jnp.int32)
+    )(x16, y16))
+    try_probe("p8_load", lambda: pl.pallas_call(
+        k_load8, out_shape=jax.ShapeDtypeStruct((ROWS, COLS), jnp.int32)
+    )(x8))
+    try_probe("merge_core", lambda: pl.pallas_call(
+        k_merge_core,
+        out_shape=(jax.ShapeDtypeStruct((ROWS, COLS), jnp.int16),
+                   jax.ShapeDtypeStruct((ROWS, COLS), jnp.int32)),
+    )(x8, jnp.abs(xi), xi, x8))
+
+
+if __name__ == "__main__":
+    main()

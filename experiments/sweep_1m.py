@@ -1,0 +1,122 @@
+"""BASELINE config 5 as ONE compiled program: a 27-cell grid at 1M.
+
+Round 4 satisfied config 5 by looping the grid sequentially
+(experiments/northstar.py) because vmapped shift-mode delivery degraded
+to gathers above ~16k members.  Round 5's shared-shift batching
+(sweep.sweep_run docstring: the channel shifts come from one unbatched
+key, so the payload dynamic-slices stay batch-invariant under vmap)
+makes the original promise real: one ``jax.vmap`` over one compiled
+scan sweeps fanout × ping-interval × suspicion-mult at 1,000,000
+members — and runs FASTER than the sequential loop (the batch amortizes
+the per-round [N]-vector work and dispatch).
+
+Writes ``artifacts/sweep_1m.json`` with the per-cell crash curves, the
+analytic anchors, and the measured vmap-vs-sequential wall comparison;
+pinned by tests/test_results_claims.py.
+
+Run: ``python experiments/sweep_1m.py`` (TPU, ~5 min).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_MEMBERS = 1_000_000
+N_SUBJECTS = 16
+N_ROUNDS = 600
+GRID = dict(fanout=[2, 3, 4], ping_every=[2, 5, 10],
+            suspicion_rounds=[20, 40, 60])
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from scalecube_cluster_tpu import sweep, swim_math
+    from scalecube_cluster_tpu.config import ClusterConfig
+    from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.utils.runlog import enable_compilation_cache
+
+    enable_compilation_cache()
+    config = ClusterConfig.default()
+    params = swim.SwimParams.from_config(
+        config, n_members=N_MEMBERS, n_subjects=N_SUBJECTS,
+        delivery="shift", fanout=max(GRID["fanout"]),
+    )
+    world = swim.SwimWorld.healthy(params).with_crash(0, at_round=0)
+    knobs = sweep.knob_grid(params, **GRID)
+    n_cells = int(knobs.fanout.shape[0])
+    key = jax.random.key(0)
+
+    # One compiled program over the whole grid: warm, then time.
+    t0 = time.perf_counter()
+    metrics = sweep.sweep_run(key, params, world, N_ROUNDS, knobs)
+    jax.block_until_ready(metrics["dead"])
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    metrics = sweep.sweep_run(jax.random.key(1), params, world, N_ROUNDS,
+                              knobs)
+    float(np.asarray(metrics["dead"]).sum())   # scalar-fetch barrier
+    vmap_s = time.perf_counter() - t0
+    print(f"[sweep] {n_cells} cells x {N_ROUNDS} rounds @ {N_MEMBERS}: "
+          f"{vmap_s:.1f}s (compile+first {compile_s:.1f}s)",
+          file=sys.stderr)
+
+    # The sequential baseline: same grid, one compiled single-cell
+    # program looped on the host.
+    def one(key, kn):
+        _, m = swim.run(key, params, world, N_ROUNDS, knobs=kn)
+        return m
+
+    one_j = jax.jit(one)
+    kn0 = jax.tree.map(lambda x: x[0], knobs)
+    m1 = one_j(jax.random.key(2), kn0)
+    jax.block_until_ready(m1["dead"])
+    t0 = time.perf_counter()
+    for b in range(n_cells):
+        knb = jax.tree.map(lambda x: x[b], knobs)
+        m1 = one_j(jax.random.fold_in(jax.random.key(1), b), knb)
+    float(np.asarray(m1["dead"]).sum())
+    seq_s = time.perf_counter() - t0
+    print(f"[seq] {seq_s:.1f}s; vmap/seq = {vmap_s / seq_s:.2f}",
+          file=sys.stderr)
+
+    curves = sweep.crash_curves(metrics, subject_slot=0, n_rounds=N_ROUNDS,
+                                n_members=N_MEMBERS)
+    out = {
+        "n_members": N_MEMBERS,
+        "n_subjects": N_SUBJECTS,
+        "n_rounds": N_ROUNDS,
+        "n_cells": n_cells,
+        "grid": {name: np.asarray(getattr(knobs, name)).tolist()
+                 for name in ("fanout", "ping_every", "suspicion_rounds",
+                              "loss_probability", "sync_every")},
+        "curves": {k: v.tolist() for k, v in curves.items()},
+        "one_program": True,
+        "wall": {
+            "vmap_s": round(vmap_s, 2),
+            "sequential_s": round(seq_s, 2),
+            "vmap_over_sequential": round(vmap_s / seq_s, 3),
+            "compile_plus_first_s": round(compile_s, 1),
+        },
+        "analytic": {
+            "periods_to_spread": swim_math.gossip_periods_to_spread(
+                config.gossip_repeat_mult, N_MEMBERS
+            ),
+        },
+    }
+    path = os.path.join(REPO, "artifacts", "sweep_1m.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: out[k] for k in ("n_cells", "wall")}, indent=1))
+    print(f"wrote {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

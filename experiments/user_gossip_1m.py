@@ -1,0 +1,94 @@
+"""User-gossip infection curves at 1M, co-running with crash detection.
+
+The round-4 verdict's item 5, measured: four user gossips spread()
+from different origins at staggered rounds while the full SWIM tick
+detects a crash — one gossip machinery carrying user payloads AND
+membership records (SwimParams.n_user_gossips; GossipProtocolImpl.java:
+124-128's spread() through the same component that piggybacks
+membership).  Expected law: fanout-3 infection grows ~(1+fanout)x per
+round, so full dissemination at 1M in ~log4(1M) ~= 10-12 rounds.
+
+Writes ``artifacts/user_gossip_1m.json``; pinned by
+tests/test_results_claims.py.  Run: ``python
+experiments/user_gossip_1m.py`` (TPU, ~1 min).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N = 1_000_000
+G = 4
+ROUNDS = 120
+SPREADS = [(0, 17, 0), (1, 250_017, 5), (2, 500_017, 10), (3, 750_017, 15)]
+CRASH_NODE, CRASH_AT = 3, 10
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from scalecube_cluster_tpu.config import ClusterConfig
+    from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.utils import runlog
+
+    runlog.enable_compilation_cache()
+    params = swim.SwimParams.from_config(
+        ClusterConfig.default_local(), n_members=N, n_subjects=16,
+        delivery="shift", n_user_gossips=G,
+        suspicion_rounds=6, ping_every=2, sync_every=4,
+    )
+    world = swim.SwimWorld.healthy(params).with_crash(CRASH_NODE,
+                                                      at_round=CRASH_AT)
+    for g, origin, at in SPREADS:
+        world = world.with_spread(g, origin=origin, at_round=at)
+
+    t0 = time.perf_counter()
+    state, m = swim.run(jax.random.key(0), params, world, ROUNDS)
+    runlog.completion_barrier(state.status)
+    wall = time.perf_counter() - t0
+
+    curves = np.asarray(m["user_gossip_infected"])          # [rounds, G]
+    dead = np.asarray(m["dead"])[:, CRASH_NODE]
+    gossips = []
+    for g, origin, at in SPREADS:
+        full = np.flatnonzero(curves[:, g] >= N - 1)
+        gossips.append({
+            "gossip": g, "origin": origin, "spread_at_round": at,
+            "full_dissemination_round": int(full[0]) if full.size else None,
+            "dissemination_rounds": (int(full[0]) - at) if full.size
+            else None,
+            "final_infected": int(curves[-1, g]),
+        })
+    detected = np.flatnonzero(dead >= N - 1)
+    out = {
+        "n_members": N,
+        "n_user_gossips": G,
+        "rounds": ROUNDS,
+        "delivery": "shift",
+        "log4_n": round(float(np.log(N) / np.log(4)), 2),
+        "gossips": gossips,
+        "crash": {
+            "node": CRASH_NODE, "at_round": CRASH_AT,
+            "dead_known_by_all_round": (int(detected[0]) if detected.size
+                                        else None),
+        },
+        "wall_s": round(wall, 1),
+        "curve_heads": {str(g): curves[:20, g].tolist() for g in range(G)},
+    }
+    path = os.path.join(REPO, "artifacts", "user_gossip_1m.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: out[k] for k in ("gossips", "crash", "wall_s")},
+                     indent=1))
+    print(f"wrote {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -78,7 +78,9 @@ Documented deviations from the reference (all statistical-regime-neutral):
     holding ABSENT entries run a joiner ⇄ seed SYNC round trip each sync
     round (``_seed_anti_entropy`` — doSync's seeds ∪ live candidate rule
     + the syncAck reply, MembershipProtocolImpl.java:298-331,346-367),
-    active whenever seeds are configured and inert once views are full;
+    active in FULL-VIEW mode with seeds configured (join semantics are a
+    full-view concern; focal mode's cold start remains statistical) and
+    inert once views are full;
     an FD ALIVE-verdict on a suspected member pushes the suspect record to
     the member itself (MembershipProtocolImpl.java:379-391's SYNC), whose
     self-refutation then travels back by gossip;
@@ -959,7 +961,8 @@ def _entry_at_slot(mat, slot, k):
 
 def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
               world: SwimWorld, offset=0, axis_name: Optional[str] = None,
-              knobs: Optional[Knobs] = None, n_devices: int = 1):
+              knobs: Optional[Knobs] = None, n_devices: int = 1,
+              shift_key=None):
     """One protocol round.  Pure: (state, r, key) -> (state', metrics).
 
     Phases (matching the reference's periodic loops, SURVEY.md §3.2-3.4):
@@ -1010,7 +1013,20 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     key = prng.round_key(key_global, offset)
     (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t, k_gossip_drop,
      k_sync_t, k_sync_drop) = jax.random.split(key, 8)
-    k_shifts = jax.random.fold_in(key_global, 0x5317)
+    # ``shift_key`` (default: the base key) sources ONLY the per-round
+    # channel shifts.  Under a vmapped knob sweep, passing one UNBATCHED
+    # shift key makes the round's shifts batch-invariant, so the payload
+    # dynamic-slices stay slices instead of lowering to gathers — the
+    # shared-shift batching that makes 1M-member vmap sweeps run at the
+    # shift path's contiguous rate (sweep.sweep_run).  Within an
+    # instance the draws are identical in distribution; across instances
+    # the shared offsets act as common random numbers for the channel
+    # topology while drop/chain draws stay per-instance.
+    k_shifts = jax.random.fold_in(
+        prng.round_key(base_key if shift_key is None else shift_key,
+                       round_idx),
+        0x5317,
+    )
 
     def global_sum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
@@ -1388,8 +1404,11 @@ def _seed_anti_entropy(status, sync_keys, inbox, inbox_alive, sync_round,
     that still has ABSENT entries pushes its row to one random configured
     seed and receives the seed's row back in the same round (the
     reference's request/reply both complete well within one gossip
-    period).  Inert in steady state (no ABSENT entries -> no traffic) and
-    when no seeds are configured, so warm-state traces are unchanged.
+    period).  Runs in FULL-VIEW mode with seeds configured (the same
+    gate as every contact rule — join semantics are a full-view concern;
+    focal cold starts stay on the statistical push-only path); inert in
+    steady state (no ABSENT entries -> no traffic), so warm-state traces
+    are unchanged.
 
     Deviations, documented: the ack carries the seed's PRE-merge row
     (one round staler than the reference's post-merge reply — the pusher
@@ -2593,19 +2612,21 @@ def node_snapshot(state: SwimState, params: SwimParams, world: SwimWorld,
 @partial(jax.jit, static_argnames=("params", "n_rounds"))
 def run(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
         state: Optional[SwimState] = None, start_round: int = 0,
-        knobs: Optional[Knobs] = None):
+        knobs: Optional[Knobs] = None, shift_key=None):
     """Scan the SWIM tick over ``n_rounds`` rounds from ``start_round``.
 
     Returns (final_state, metrics-dict of [n_rounds, ...] traces).
     ``start_round``/``state`` support checkpoint-resume: re-enter the scan
-    at round r with a restored carry (SURVEY.md §5.4).
+    at round r with a restored carry (SURVEY.md §5.4).  ``shift_key``:
+    optional separate key for the shift-channel draws (swim_tick
+    docstring — the shared-shift batching hook for vmapped sweeps).
     """
     if state is None:
         state = initial_state(params, world)
 
     def body(carry, round_idx):
         return swim_tick(carry, round_idx, base_key, params, world,
-                         knobs=knobs)
+                         knobs=knobs, shift_key=shift_key)
 
     rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
     return jax.lax.scan(body, state, rounds)

@@ -20,12 +20,13 @@ anchors from swim_math (the ClusterMath port): measured dissemination must
 sit within the spread window `repeat_mult*ceil(log2(n+1))` and detection
 must straddle the configured suspicion timeout.
 
-Performance note: under vmap, shift-mode delivery's per-instance
-dynamic-slices lower to gathers (each grid point draws different shifts),
-which runs at the slow random-access rate on TPU.  The vmapped sweep is
-therefore best at small/medium N; for 1M-scale sweeps loop the grid
-sequentially over one compiled program with traced knobs instead
-(experiments/northstar.py does exactly this), or use delivery="scatter".
+Performance note: shift-mode sweeps default to SHARED-SHIFT BATCHING —
+the per-round channel shifts come from one unbatched key, so under vmap
+the payload dynamic-slices stay batch-invariant slices and the whole
+grid runs at the contiguous-slice rate at any N (one compiled program
+sweeps a 27-cell grid at 1M members; experiments/sweep_1m.py).  With
+per-instance shifts (share_shifts=False) the slices lower to gathers and
+degrade ~3 orders of magnitude above ~16k members.
 """
 
 from __future__ import annotations
@@ -81,19 +82,36 @@ def knob_grid(params: swim.SwimParams, *,
 
 
 def sweep_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
-              n_rounds: int, knobs: swim.Knobs):
+              n_rounds: int, knobs: swim.Knobs,
+              share_shifts: Optional[bool] = None):
     """Run the scenario once per grid point: vmap over the knob batch.
 
     Returns metrics with a leading grid axis [B, n_rounds, ...].  Each grid
     point gets an independent PRNG stream (fold_in of its index).
+
+    ``share_shifts`` (default: on for shift delivery): source the
+    per-round channel shifts from ONE unbatched key shared by every grid
+    point, so the payload dynamic-slices stay batch-invariant slices
+    under vmap instead of lowering to gathers — this is what makes the
+    1M-member 27-cell grid ONE compiled program at the contiguous-slice
+    rate (measured in experiments/sweep_1m.py; without it the vmapped
+    shift sweep degraded ~3 orders of magnitude above ~16k members).
+    Within each instance the draw distribution is unchanged; across
+    instances the shared offsets act as common random numbers for the
+    channel topology, while loss/chain/verdict draws remain independent
+    per instance (swim.swim_tick docstring).
     """
+    if share_shifts is None:
+        share_shifts = params.delivery == "shift"
     batch = knobs.fanout.shape[0]
     keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
         jnp.arange(batch, dtype=jnp.int32)
     )
+    shift_key = base_key if share_shifts else None
 
     def one(key, kn):
-        _, metrics = swim.run(key, params, world, n_rounds, knobs=kn)
+        _, metrics = swim.run(key, params, world, n_rounds, knobs=kn,
+                              shift_key=shift_key)
         return metrics
 
     return jax.vmap(one)(keys, knobs)
@@ -123,33 +141,35 @@ def crash_curves(metrics: Dict[str, np.ndarray], subject_slot: int,
     }
 
 
-# Above this N, a vmapped shift-mode sweep degrades to gathers (module
-# docstring performance note) and silently runs orders of magnitude below
-# the un-vmapped shift path.  16k is comfortably inside the regime where
-# the degradation is still minor on one chip.
+# Above this N, a vmapped shift-mode sweep with PER-INSTANCE shifts
+# (share_shifts=False) degrades to gathers and silently runs orders of
+# magnitude below the un-vmapped shift path.  The default shared-shift
+# batching (sweep_run docstring) removes the degradation — this constant
+# and the warning below only guard the explicit opt-out.
 SHIFT_VMAP_N_WARN = 16_384
 
 
 def run_crash_sweep(n_members: int, n_rounds: int, config=None, seed: int = 0,
                     delivery: str = "shift",
                     n_subjects: Optional[int] = None,
+                    share_shifts: Optional[bool] = None,
                     **grid_axes) -> Dict[str, object]:
     """One-call sweep: crash-at-0 scenario across the knob grid.
 
-    Warns when invoked with ``delivery="shift"`` above
-    ``SHIFT_VMAP_N_WARN`` members — the vmapped grid turns shift mode's
-    dynamic-slices into gathers (the docstring trap made operational): for
-    large-N sweeps loop the grid sequentially over one compiled program
-    instead (experiments/northstar.py does exactly this) or use
-    ``delivery="scatter"``.
+    Shift delivery defaults to shared-shift batching (sweep_run
+    docstring), which keeps the vmapped grid at the contiguous-slice
+    rate at any N; opting out (``share_shifts=False``) above
+    ``SHIFT_VMAP_N_WARN`` members warns, because per-instance shifts
+    lower to gathers under vmap.
     """
-    if delivery == "shift" and n_members > SHIFT_VMAP_N_WARN:
+    if (delivery == "shift" and share_shifts is False
+            and n_members > SHIFT_VMAP_N_WARN):
         warnings.warn(
-            f"vmapped shift-mode sweep at n_members={n_members} > "
-            f"{SHIFT_VMAP_N_WARN}: per-instance dynamic-slices lower to "
-            f"gathers under vmap and run at the slow random-access rate. "
-            f"Loop the grid sequentially over one compiled program "
-            f"(see experiments/northstar.py) or pass delivery='scatter'.",
+            f"vmapped shift-mode sweep with share_shifts=False at "
+            f"n_members={n_members} > {SHIFT_VMAP_N_WARN}: per-instance "
+            f"dynamic-slices lower to gathers under vmap and run at the "
+            f"slow random-access rate.  Use the default shared-shift "
+            f"batching or delivery='scatter'.",
             stacklevel=2,
         )
     config = config or ClusterConfig.default()
@@ -162,7 +182,8 @@ def run_crash_sweep(n_members: int, n_rounds: int, config=None, seed: int = 0,
     )
     world = swim.SwimWorld.healthy(params).with_crash(0, at_round=0)
     knobs = knob_grid(params, **grid_axes)
-    metrics = sweep_run(jax.random.key(seed), params, world, n_rounds, knobs)
+    metrics = sweep_run(jax.random.key(seed), params, world, n_rounds, knobs,
+                        share_shifts=share_shifts)
     curves = crash_curves(metrics, subject_slot=0, n_rounds=n_rounds,
                           n_members=n_members)
     grid_cols = {

@@ -150,8 +150,14 @@ def updated_events(prev_state: swim.SwimState, state: swim.SwimState,
     old_inc = np.asarray(prev_state.inc, dtype=np.int64)
     new_inc = np.asarray(state.inc, dtype=np.int64)
     new_status = np.asarray(state.status)
+    old_status = np.asarray(prev_state.status)
     live = (new_status == records.ALIVE) | (new_status == records.SUSPECT)
-    bumped = (new_inc > old_inc) & live
+    # A record the observer just LEARNED is the reference's ADDED, not
+    # UPDATED (MembershipProtocolImpl.java:558-570 vs :572-584) — require
+    # the prior record to have been live too.
+    was_live = ((old_status == records.ALIVE)
+                | (old_status == records.SUSPECT))
+    bumped = (new_inc > old_inc) & live & was_live
     # A node's record about ITSELF emits no UPDATED — the reference's
     # about-self path refutes instead of emitting
     # (MembershipProtocolImpl.java:488-509).

@@ -353,30 +353,121 @@ def test_fullview_ceiling_table(results_text, ceiling):
         for a in lay["attempts"]:
             if a["fits"]:
                 assert a["crash_noticed"], a
-    (new_ceiling,) = claim(
-        results_text, r"The ceiling moved 16,384 → ([\d,]+) members"
-    )
-    assert new_ceiling == ceiling["layouts"]["compact"]["max_fits"]
-    (cells_x,) = claim(results_text, r"\*\*(\d\.\d\d)× the table cells\*\*")
-    assert cells_x == rounded((new_ceiling / 16_384) ** 2, 2)
-    (wide_reach,) = claim(
-        results_text, r"wide alone now reaches ([\d,]+)"
-    )
-    assert wide_reach == ceiling["layouts"]["wide"]["max_fits"]
 
-    # The roll-probe claims: same boundary, ~equal ms at the ceiling.
-    roll = ceiling["layouts"]["compact_roll"]
-    compact = ceiling["layouts"]["compact"]
-    (roll_fail,) = claim(
-        results_text, r"still fails at ([\d,]+) in the same\s+way"
-    )
-    assert roll_fail == roll["first_oom"] == compact["first_oom"]
-    roll_ms, compact_ms = claim(
+    # The round-5 blocked row.
+    blk = ceiling["layouts"]["compact_blocked"]
+    kb = ceiling["blocked_k_block"]
+    fits, fail, ms_max = claim(
         results_text,
-        r"costing nothing at 26,624 \((\d+\.\d) vs (\d+\.\d) ms/round\)",
+        rf"\| compact \+ `k_block={kb}` \| \*\*([\d,]+)\*\* \| "
+        rf"([\d,]+) \| (\d+\.\d) \| — \|",
     )
-    assert roll_ms == rounded(at("compact_roll", 26_624)["ms_per_round"], 1)
-    assert compact_ms == rounded(at("compact", 26_624)["ms_per_round"], 1)
+    assert fits == blk["max_fits"]
+    assert fail == blk["first_oom"]
+    assert ms_max == rounded(at("compact_blocked", blk["max_fits"])
+                             ["ms_per_round"], 1)
+    for a in blk["attempts"]:
+        if a["fits"]:
+            assert a["crash_noticed"], a
+    # "2.25x the round-4 wide cells" in the index table.
+    (cells_x,) = claim(
+        results_text,
+        r"\*\*27,648 → 36,864\*\* \((\d\.\d\d)× the round-4 wide cells\)",
+    )
+    assert cells_x == rounded(
+        (blk["max_fits"] / ceiling["layouts"]["wide"]["max_fits"]) ** 2, 2)
+    assert blk["max_fits"] == 36_864
+    assert ceiling["layouts"]["compact"]["max_fits"] == 27_648
+    (ratio,) = claim(results_text, r"is\s+\*\*(\d+)×\*\* the largest cluster")
+    assert ratio == rounded(blk["max_fits"] / 50)
+
+
+# ---------------------------------------------------------------------------
+# Round-5 artifacts: 1M sweep, user gossip, dissemination law
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def sweep_1m():
+    return _load("sweep_1m.json")
+
+
+@pytest.fixture(scope="module")
+def user_gossip_1m():
+    return _load("user_gossip_1m.json")
+
+
+@pytest.fixture(scope="module")
+def dissemination_scale():
+    return _load("dissemination_scale.json")
+
+
+def test_sweep_1m_claims(results_text, sweep_1m):
+    assert sweep_1m["one_program"] is True
+    assert sweep_1m["n_members"] == 1_000_000
+    cells, rounds_, vmap_s, seq_s = claim(
+        results_text,
+        r"\*\*(\d+) cells × (\d+) rounds at 1M members in\s+"
+        r"(\d+\.\d) s — 2\.9× faster than the sequential loop\*\* "
+        r"\((\d+\.\d) s;",
+    )
+    assert cells == sweep_1m["n_cells"] >= 27
+    assert rounds_ == sweep_1m["n_rounds"]
+    assert vmap_s == rounded(sweep_1m["wall"]["vmap_s"], 1)
+    assert seq_s == rounded(sweep_1m["wall"]["sequential_s"], 1)
+    (ratio,) = claim(results_text, r"ratio (0\.\d+)\), *\n?in one program")
+    assert ratio == sweep_1m["wall"]["vmap_over_sequential"] <= 2.0
+    det_lo, det_hi = claim(results_text,
+                           r"\((\d+)\.\.(\d+) rounds across the grid\)")
+    det = sweep_1m["curves"]["detection_rounds"]
+    assert (det_lo, det_hi) == (min(det), max(det))
+    dis_lo, dis_hi = claim(results_text,
+                           r"dissemination spans (\d+)\.\.(\d+) rounds")
+    dis = sweep_1m["curves"]["dissemination_rounds"]
+    assert (dis_lo, dis_hi) == (min(dis), max(dis))
+    assert max(dis) <= 2 * sweep_1m["analytic"]["periods_to_spread"]
+
+
+def test_user_gossip_1m_claims(results_text, user_gossip_1m):
+    gossips = user_gossip_1m["gossips"]
+    assert len(gossips) == user_gossip_1m["n_user_gossips"] == 4
+    (diss,) = claim(
+        results_text,
+        r"each reaches all 999,999 live members in\s+exactly (\d+) rounds",
+    )
+    for g in gossips:
+        assert g["dissemination_rounds"] == diss
+        assert g["final_infected"] == user_gossip_1m["n_members"] - 1
+    (crash_round,) = claim(
+        results_text, r"the crash is known cluster-wide by round (\d+),")
+    assert crash_round == user_gossip_1m["crash"]["dead_known_by_all_round"]
+
+
+def test_dissemination_scale_claims(results_text, dissemination_scale):
+    rows = {r["n_members"]: r["dissemination_rounds"]
+            for r in dissemination_scale["rows"]}
+    r16k, r65k, r262k, r1m, r4m, r16m = claim(
+        results_text,
+        r"takes (\d+) rounds at 16k, (\d+) at 65k, (\d+) at 262k, "
+        r"(\d+) at 1M, (\d+) at 4\.2M, and\s+(\d+) at 16\.7M",
+    )
+    assert (r16k, r65k, r262k, r1m, r4m, r16m) == (
+        rows[16_384], rows[65_536], rows[262_144], rows[1_048_576],
+        rows[4_194_304], rows[16_777_216],
+    )
+    fit = dissemination_scale["fit"]
+    (b,) = claim(results_text, r"with\s+b = (0\.\d\d) \(ideal fanout-3")
+    assert b == rounded(fit["b"], 2)
+    (resid,) = claim(results_text, r"max residual (0\.\d\d)\s+rounds")
+    assert resid == rounded(fit["max_abs_residual_rounds"], 2)
+    tput = dissemination_scale["throughput_16m"]
+    (rate,) = claim(
+        results_text,
+        r"\*\*16,777,216 members on the same single chip sustain "
+        r"(\d\.\d+)e8\s+member-rounds/sec\*\*",
+    )
+    assert rate == rounded(tput["member_rounds_per_sec"] / 1e8, 2)
+    assert tput["crash_noticed"] is True
 
 
 def test_stated_suite_size_matches_collection(results_text):

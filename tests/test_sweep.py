@@ -38,8 +38,8 @@ def test_default_knobs_are_identity(delivery):
 
 
 def test_grid_point_matches_single_run():
-    """Grid point b of a sweep == a standalone run with that knob set and
-    the grid-point key."""
+    """Grid point b of a sweep == a standalone run with that knob set,
+    the grid-point key, and (shift delivery) the shared shift key."""
     params, world = make(16)
     base_key = jax.random.key(3)
     knobs = sweep.knob_grid(params, ping_every=[2, 4])
@@ -47,11 +47,32 @@ def test_grid_point_matches_single_run():
 
     kn1 = jax.tree.map(lambda a: a[1], knobs)
     _, single = swim.run(jax.random.fold_in(base_key, 1), params, world, 50,
-                         knobs=kn1)
+                         knobs=kn1, shift_key=base_key)
     for name in single:
         np.testing.assert_array_equal(
             np.asarray(metrics[name])[1], np.asarray(single[name])
         )
+
+
+def test_shared_shifts_preserve_per_instance_independence():
+    """Shared-shift batching must change ONLY the channel topology
+    source: a scatter-mode sweep (no shifts) is bit-identical with and
+    without it, and shift grid points still differ from each other."""
+    params, world = make(16, delivery="scatter")
+    key = jax.random.key(5)
+    knobs = sweep.knob_grid(params, ping_every=[2, 4])
+    m_a = sweep.sweep_run(key, params, world, 40, knobs, share_shifts=False)
+    m_b = sweep.sweep_run(key, params, world, 40, knobs, share_shifts=True)
+    for name in m_a:
+        np.testing.assert_array_equal(np.asarray(m_a[name]),
+                                      np.asarray(m_b[name]))
+    params_s, world_s = make(16, delivery="shift")
+    m_s = sweep.sweep_run(key, params_s, world_s, 40,
+                          sweep.knob_grid(params_s, loss_probability=[0.3,
+                                                                      0.3]))
+    # Same knobs, different instance keys: loss draws stay independent.
+    assert not np.array_equal(np.asarray(m_s["false_positives"])[0],
+                              np.asarray(m_s["false_positives"])[1])
 
 
 def test_suspicion_knob_moves_detection_time():
@@ -113,20 +134,22 @@ def test_cli_writes_curve_artifact(tmp_path):
 
 
 def test_shift_vmap_guard_warns_above_threshold(monkeypatch):
-    """The documented vmap-gather trap (sweep.py performance note) is
-    operational: a large-N shift-mode sweep warns; scatter and small-N
-    shift do not."""
+    """The vmap-gather trap now only applies to the explicit
+    share_shifts=False opt-out (sweep.py performance note): that path
+    warns at large N; the default shared-shift batching does not."""
     # Shrink the threshold so the test doesn't need a big compile.
     monkeypatch.setattr(sweep, "SHIFT_VMAP_N_WARN", 32)
     with pytest.warns(UserWarning, match="vmapped shift-mode sweep"):
         sweep.run_crash_sweep(64, 30, config=fast_config(),
-                              fanout=[2, 3])
+                              fanout=[2, 3], share_shifts=False)
     import warnings as _w
     with _w.catch_warnings():
         # Only the guard's own message is promoted to an error, so an
         # unrelated upstream warning can't fail this test spuriously.
         _w.filterwarnings("error", message=".*vmapped shift-mode sweep.*")
-        sweep.run_crash_sweep(16, 30, config=fast_config(),
+        sweep.run_crash_sweep(64, 30, config=fast_config(),
                               fanout=[2, 3])
+        sweep.run_crash_sweep(16, 30, config=fast_config(),
+                              fanout=[2, 3], share_shifts=False)
         sweep.run_crash_sweep(64, 30, config=fast_config(),
                               delivery="scatter", fanout=[2, 3])
