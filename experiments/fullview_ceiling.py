@@ -45,11 +45,11 @@ LADDERS = {
     # boundary (which reproduced as a clean RESOURCE_EXHAUSTED: 11.8G of
     # HLO temps at 28,160, six 1.48G per-channel payload buffers;
     # experiments/ceiling_probe.py).  The remaining frontier is NOT HBM:
-    # above ~38k the axon remote-compile helper dies (exit 1, no
+    # above 36,864 the axon remote-compile helper dies (exit 1, no
     # diagnostics) for every probed block width (round-5 bracketing:
-    # 36,864@kb=1024 fits; 36,864@2048, 38,912@{512,1024}, 40,960@{512,
-    # 1024,2048} all exit-1) — an infrastructure boundary below the
-    # ~6 B/cell carry bound (~50k).
+    # 36,864@kb=1024 fits; 36,864@2048, 37,376@512, 37,888@{256,512,
+    # 1024}, 38,912@{512,1024}, 40,960@{512,1024,2048} all exit-1) — an
+    # infrastructure boundary below the ~6 B/cell carry bound (~50k).
     "compact_blocked": [32_768, 34_816, 36_864, 37_888, 38_912, 40_960],
 }
 BLOCKED_KB = 1_024   # divides every rung above; 2048 trips the helper
