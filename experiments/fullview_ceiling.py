@@ -54,6 +54,19 @@ LADDERS = {
 }
 BLOCKED_KB = 1_024   # divides every rung above; 2048 trips the helper
                      # crash earlier (36,864@2048 fails, @1024 fits)
+
+# The (N, k_block) bracketing matrix for the helper-crash frontier —
+# recorded into the artifact so the RESULTS.md bracket claims are
+# checkable data, not prose.  ``python experiments/fullview_ceiling.py
+# bracket`` re-probes just this matrix into the existing artifact.
+BRACKETING = [
+    (36_864, 1_024),   # fits — the ceiling
+    (36_864, 2_048),
+    (37_376, 512),
+    (37_888, 256), (37_888, 512), (37_888, 1_024),
+    (38_912, 512), (38_912, 1_024),
+    (40_960, 512), (40_960, 1_024), (40_960, 2_048),
+]
 # Keep probing past the first failure so the boundary gets bracketed
 # (compile-stage failures at rung r don't imply failure at every r' > r a
 # priori); stop only once this many consecutive rungs fail.
@@ -152,6 +165,31 @@ def attempt(n, layout):
                      f"stderr tail: {out.stderr[-300:]}"}
 
 
+def run_bracketing():
+    """Probe the (N, k_block) frontier matrix; returns artifact rows."""
+    global BLOCKED_KB
+    rows = []
+    saved = BLOCKED_KB
+    for n, kb in BRACKETING:
+        BLOCKED_KB = kb
+        r = attempt(n, "compact_blocked")
+        rows.append({"n_members": n, "k_block": kb, "fits": r["fits"]})
+        print(f"[bracket] N={n} kb={kb}: fits={r['fits']}", file=sys.stderr)
+    BLOCKED_KB = saved
+    return rows
+
+
+def bracket_only():
+    """Update just the kb_bracketing section of the existing artifact."""
+    path = os.path.join(REPO, "artifacts", "fullview_ceiling.json")
+    with open(path) as f:
+        out = json.load(f)
+    out["kb_bracketing"] = run_bracketing()
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"updated kb_bracketing in {path}", file=sys.stderr)
+
+
 def main():
     results = {}
     for layout, ladder in LADDERS.items():
@@ -190,6 +228,7 @@ def main():
         "mode": "full-view [N, N], shift delivery, single real TPU chip",
         "rounds_timed": ROUNDS,
         "blocked_k_block": BLOCKED_KB,
+        "kb_bracketing": run_bracketing(),
         "layouts": results,
         "compact_over_wide_members": round(ratio, 3),
         "compact_over_wide_cells": round(ratio ** 2, 2),
@@ -205,4 +244,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "bracket":
+        bracket_only()
+    else:
+        main()

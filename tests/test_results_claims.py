@@ -381,6 +381,22 @@ def test_fullview_ceiling_table(results_text, ceiling):
     (ratio,) = claim(results_text, r"is\s+\*\*(\d+)×\*\* the largest cluster")
     assert ratio == rounded(blk["max_fits"] / 50)
 
+    # The helper-crash frontier bracket: the prose's probe list must be
+    # exactly the artifact's kb_bracketing matrix.
+    matrix = {(r["n_members"], r["k_block"]): r["fits"]
+              for r in ceiling["kb_bracketing"]}
+    assert matrix[(36_864, 1_024)] is True
+    expect_fail = [(36_864, 2_048), (37_376, 512), (37_888, 256),
+                   (37_888, 512), (37_888, 1_024), (38_912, 512),
+                   (38_912, 1_024), (40_960, 512), (40_960, 1_024),
+                   (40_960, 2_048)]
+    for pair in expect_fail:
+        assert matrix[pair] is False, pair
+    claim(results_text,
+          r"36,864@kb=1024 fits while\s*\n36,864@2048, 37,376@512, "
+          r"37,888@\{256,512,1024\}, 38,912@\{512,1024\} and\s*\n"
+          r"40,960@\{512,1024,2048\} all exit-(1)")
+
 
 # ---------------------------------------------------------------------------
 # Round-5 artifacts: 1M sweep, user gossip, dissemination law
