@@ -131,12 +131,13 @@ except Exception as e:  # noqa: BLE001 — OOM classification by message
 """
 
 
-def attempt(n, layout):
+def attempt(n, layout, k_block=None):
+    if k_block is None:
+        k_block = BLOCKED_KB if layout.endswith("_blocked") else 0
     code = _CHILD % {"repo": REPO, "n": n,
                      "compact": layout.startswith("compact"),
                      "roll": layout.endswith("_roll"),
-                     "k_block": BLOCKED_KB if layout.endswith("_blocked")
-                     else 0,
+                     "k_block": k_block,
                      "rounds": ROUNDS}
     try:
         out = subprocess.run([sys.executable, "-c", code],
@@ -167,15 +168,11 @@ def attempt(n, layout):
 
 def run_bracketing():
     """Probe the (N, k_block) frontier matrix; returns artifact rows."""
-    global BLOCKED_KB
     rows = []
-    saved = BLOCKED_KB
     for n, kb in BRACKETING:
-        BLOCKED_KB = kb
-        r = attempt(n, "compact_blocked")
+        r = attempt(n, "compact_blocked", k_block=kb)
         rows.append({"n_members": n, "k_block": kb, "fits": r["fits"]})
         print(f"[bracket] N={n} kb={kb}: fits={r['fits']}", file=sys.stderr)
-    BLOCKED_KB = saved
     return rows
 
 

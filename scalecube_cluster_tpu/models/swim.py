@@ -172,6 +172,25 @@ class SwimParams:
     # saved bandwidth) — it exists to raise the [N, N] single-chip
     # CEILING, where the regime is capacity-, not compute-bound.
     compact_carry: bool = False
+    # int16 WIRE keys with the WIDE carry — the hybrid the round-3
+    # narrow-int negative did not cover: that experiment narrowed the
+    # carry lanes (which made the merge fusion slower than the saved
+    # bandwidth); this knob narrows only the wire-format buffers
+    # (payloads, channel delivers, inbox, delay-ring slots) to the int16
+    # records.merge_key16 format while every carry field stays at the
+    # wide dtypes.  The merge upcasts the inbox on load (i16 load + i32
+    # compute).  Trace-identical to the wide wire while incarnations stay
+    # below merge_key16's 8191 saturation (same cap as compact_carry;
+    # tests/test_wire16.py).  Implied by compact_carry (whose wire is
+    # already int16); see ``compact_wire`` for the derived predicate.
+    # MEASURED NEGATIVE for speed (round 5): 3.76 vs 3.05 ms/round at
+    # 1M x 16 and 5.76 vs 4.99 at 131k x 256 — the halved key bytes do
+    # not pay for the narrow-lane loads/compares inside the merge
+    # fusion, even at a 256-wide minor dim.  The knob stays as the
+    # wire-format seam (and because the sharded traffic model's ICI
+    # bytes DO halve — parallel/traffic._key_bytes — a multi-chip,
+    # ICI-bound regime may price it differently than single-chip HBM).
+    int16_wire: bool = False
     # Single-device shift delivery: replace the persistent doubled
     # [2N, K] payload buffers with a jnp.roll per channel (transient
     # two-slice concats) — value-identical (ops/shift.ShiftEngine
@@ -277,6 +296,15 @@ class SwimParams:
                     f"int16; suspicion_rounds={self.suspicion_rounds} "
                     f"exceeds 32765 (also applies to Knobs overrides)"
                 )
+
+    @property
+    def compact_wire(self) -> bool:
+        """True when the wire format is int16 (records.merge_key16):
+        chosen directly by ``int16_wire`` or implied by ``compact_carry``.
+        Gates every wire-format decision (pack/unpack, no-message
+        sentinel, alive-key bits, ring-slot dtype); carry-layout
+        decisions gate on ``compact_carry`` alone."""
+        return self.compact_carry or self.int16_wire
 
     @staticmethod
     def from_config(config, n_members: int, n_subjects: Optional[int] = None,
@@ -552,6 +580,11 @@ class SwimWorld:
         GossipProtocolImpl.spread, :124-128).  The origin must be alive in
         that round for the injection to happen (a crashed JVM can't call
         spread)."""
+        if not 0 <= gossip_idx < self.gossip_origin.shape[0]:
+            raise ValueError(
+                f"gossip_idx {gossip_idx} out of range for n_user_gossips="
+                f"{self.gossip_origin.shape[0]} (jnp would silently drop the"
+                f" out-of-bounds update)")
         return dataclasses.replace(
             self,
             gossip_origin=self.gossip_origin.at[gossip_idx].set(
@@ -744,6 +777,9 @@ def initial_state(params: SwimParams, world: SwimWorld,
         g_spread_until=jnp.zeros((n, g), dtype=jnp.int32),
         g_ring=jnp.zeros((gd_slots, n, g), dtype=jnp.bool_),
     )
+    # The ring stores wire-format keys; the int16 wire (compact_carry or
+    # int16_wire) makes its delayed slots int16 (records.merge_key16).
+    ring_dtype = jnp.int16 if params.compact_wire else jnp.int32
     if params.compact_carry:
         # Relative encodings (the carry is re-relativized every tick by
         # _carry_encode): spread_until / suspect_deadline as remaining
@@ -755,9 +791,7 @@ def initial_state(params: SwimParams, world: SwimWorld,
             suspect_deadline=jnp.full((n, k), _DEADLINE_NONE16,
                                       dtype=jnp.int16),
             self_inc=jnp.zeros((n,), dtype=jnp.int32),
-            # The ring stores wire-format keys; compact mode's wire is
-            # int16 (records.merge_key16), so its delayed slots are too.
-            inbox_ring=jnp.full((d_slots, n, k), -1, dtype=jnp.int16),
+            inbox_ring=jnp.full((d_slots, n, k), -1, dtype=ring_dtype),
             flag_ring=jnp.zeros((d_slots, n, k), dtype=jnp.int8),
             **g_fields,
         )
@@ -767,7 +801,7 @@ def initial_state(params: SwimParams, world: SwimWorld,
         spread_until=spread0,
         suspect_deadline=jnp.full((n, k), INT32_MAX, dtype=jnp.int32),
         self_inc=jnp.zeros((n,), dtype=jnp.int32),
-        inbox_ring=jnp.full((d_slots, n, k), -1, dtype=jnp.int32),
+        inbox_ring=jnp.full((d_slots, n, k), -1, dtype=ring_dtype),
         flag_ring=jnp.zeros((d_slots, n, k), dtype=jnp.int8),
         **g_fields,
     )
@@ -898,7 +932,7 @@ def _ring_open(state: SwimState, params: SwimParams, round_idx):
         return None, None, None, None, None, None, None
     slot0 = round_idx % (params.max_delay_rounds + 1)
     inbox_now, ring = ring_ops.open_slot(
-        state.inbox_ring, slot0, delivery.no_message(params.compact_carry)
+        state.inbox_ring, slot0, delivery.no_message(params.compact_wire)
     )
     flags_now, fring = ring_ops.open_slot(
         state.flag_ring, slot0, jnp.int8(0)
@@ -931,7 +965,7 @@ def _route_delayed(ok, delivered, delivered_flags, delay_mean, key, params,
     """
     if params.max_delay_rounds == 0 or delay_mean is None:
         return ok, ring, fring, g_ring
-    no_msg = delivery.no_message(params.compact_carry)
+    no_msg = delivery.no_message(params.compact_wire)
     q = ring_ops.delay_bins(key, delay_mean, params.round_ms,
                             params.max_delay_rounds, ok.shape)
     d = params.max_delay_rounds + 1
@@ -1282,14 +1316,14 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
     Returns (new_state, refuted[n_local] bool).
     """
     new_status, new_inc, changed = delivery.merge_inbox(
-        status, inc, inbox, inbox_alive, compact=params.compact_carry
+        status, inc, inbox, inbox_alive, compact=params.compact_wire
     )
 
     # Self-refutation (updateMembership about-self branch, :488-509): if the
     # inbound winner about ME overrides my ALIVE@self_inc record, bump to
     # max(inc)+1 and gossip the refutation (spread reset via `changed`).
     win_status, win_inc = delivery.unpack_record(
-        inbox, compact=params.compact_carry
+        inbox, compact=params.compact_wire
     )
     self_overridden = is_self & records.is_overrides_array(
         win_status, win_inc, records.ALIVE, state.self_inc[:, None]
@@ -1377,7 +1411,7 @@ def _send_components(state, status, inc, round_idx, params, world,
     leaving_now = (world.leave_at[node_ids] == round_idx)[:, None] & is_self
     hot = (status != records.ABSENT) & (round_idx < state.spread_until)
     hot = hot | leaving_now
-    compact = params.compact_carry
+    compact = params.compact_wire
     record_keys = delivery.pack_record(status, inc, compact=compact)
     leave_key = delivery.pack_record(
         jnp.int8(records.DEAD), state.self_inc[:, None] + 1, compact=compact
@@ -1422,7 +1456,7 @@ def _seed_anti_entropy(status, sync_keys, inbox, inbox_alive, sync_round,
     the pushers, acks at the seed).
     """
     n_seeds = world.seed_ids.shape[0]
-    compact = params.compact_carry
+    compact = params.compact_wire
     no_msg = delivery.no_message(compact)
     has_absent = jnp.any(status == records.ABSENT, axis=1)
     pusher = sync_round & alive_here & has_absent
@@ -1492,7 +1526,7 @@ def _send_payloads(state, status, inc, round_idx, params, world,
     record_keys, hot, syncable = _send_components(
         state, status, inc, round_idx, params, world, node_ids, is_self
     )
-    no_msg = delivery.no_message(params.compact_carry)
+    no_msg = delivery.no_message(params.compact_wire)
     gossip_keys = jnp.where(hot, record_keys, no_msg)
     sync_keys = jnp.where(syncable, record_keys, no_msg)
     return gossip_keys, sync_keys
@@ -1603,7 +1637,7 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     fd_slot_onehot = (
         jnp.arange(k, dtype=jnp.int32)[None, :] == slot_safe[:, None]
     )
-    compact = params.compact_carry
+    compact = params.compact_wire
     no_msg = delivery.no_message(compact)
     fd_suspect_key = delivery.pack_record(
         jnp.int8(records.SUSPECT),
@@ -1810,6 +1844,78 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
 # --------------------------------------------------------------------------
 
 
+def _shift_fd_chains(eng, d_ids, d_alive, d_part, fd_shift, proxy_shifts,
+                     k_ping_net, k_proxy_net, params, kn, world, round_idx,
+                     node_ids, part_here, out_shape):
+    """Shift-mode FD network outcomes as [n_local] vectors: the direct
+    ping round trip and the ping-req proxy chains
+    (FailureDetectorImpl.java:128-213), collapsed per _chain_ok.
+
+    Shared by ``_tick_shift.fd_phase`` and ``_tick_shift_blocked`` so a
+    protocol fix lands in one place; both callers pass the same keys in
+    the same order, which is what keeps the blocked tick bit-identical.
+
+    Returns ``(t, alive_t, part_t, direct_ok, ack_ok)`` where ``t`` is
+    each prober's target id and ``ack_ok`` includes the proxy rescues.
+    """
+    t = eng.look_replicated(d_ids, fd_shift)
+    alive_t = eng.look_replicated(d_alive, fd_shift)
+    part_t = eng.look_replicated(d_part, fd_shift)
+    loss_it, delay_it = link_eval(world.faults, round_idx, node_ids, t,
+                                  kn.loss_probability, params.mean_delay_ms)
+    loss_ti, delay_ti = link_eval(world.faults, round_idx, t, node_ids,
+                                  kn.loss_probability, params.mean_delay_ms)
+    direct_ok = (
+        _chain_ok(k_ping_net, [loss_it, loss_ti], [delay_it, delay_ti],
+                  params.ping_timeout_ms, out_shape)
+        & alive_t & (part_here == part_t)
+    )
+    # Ping-req via proxy shifts; proxy r for node i is (i + ps_r) % n.
+    ack_ok = direct_ok
+    for r in range(params.ping_req_members):
+        ps = proxy_shifts[r]
+        p_ids = eng.look_replicated(d_ids, ps)
+        p_alive = eng.look_replicated(d_alive, ps)
+        p_part = eng.look_replicated(d_part, ps)
+        hop_pairs = [(node_ids, p_ids), (p_ids, t), (t, p_ids),
+                     (p_ids, node_ids)]
+        hop_losses, hop_delays = [], []
+        for src, dst in hop_pairs:
+            lo, de = link_eval(world.faults, round_idx, src, dst,
+                               kn.loss_probability, params.mean_delay_ms)
+            hop_losses.append(lo)
+            hop_delays.append(de)
+        ok_pr = (
+            _chain_ok(jax.random.fold_in(k_proxy_net, r),
+                      hop_losses, hop_delays,
+                      params.ping_interval_ms - params.ping_timeout_ms,
+                      out_shape)
+            & p_alive & alive_t
+            & (part_here == p_part) & (p_part == part_t)
+            & (ps != fd_shift)                           # proxy != target
+        )
+        ack_ok = ack_ok | ok_pr
+    return t, alive_t, part_t, direct_ok, ack_ok
+
+
+def _shift_sender_gate(eng, d_ids, d_alive, d_part, s, world, round_idx,
+                       node_ids, kn, params):
+    """Receiver-evaluated ingredients of a shift channel's sender-side
+    gate: the sender's id/alive/partition views through shift ``s`` plus
+    the per-link loss/delay of the sender->receiver hop.  Shared by both
+    shift tick bodies; callers compose the channel-specific gate (wire
+    drop draw, fanout cap, sync round, refute suppression) from these.
+
+    Returns ``(sender, sender_alive, sender_part, loss, delay)``.
+    """
+    sender = eng.deliver_replicated(d_ids, s)
+    sender_alive = eng.deliver_replicated(d_alive, s)
+    sender_part = eng.deliver_replicated(d_part, s)
+    loss, delay = link_eval(world.faults, round_idx, sender, node_ids,
+                            kn.loss_probability, params.mean_delay_ms)
+    return sender, sender_alive, sender_part, loss, delay
+
+
 def _tick_shift(state, status, inc, round_idx, params, kn, world,
                 alive, part, node_ids, alive_here, part_here, is_self,
                 fd_round, sync_round, gate_contacts, known_live, is_seed,
@@ -1849,9 +1955,11 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     # draws + [N]-vector chains) is ~0.3 ms — and under vmap sweeps a cond
     # lowers to select-both-branches anyway.
     def fd_phase(_):
-        t = eng.look_replicated(d_ids, fd_shift)        # [n_local] targets
-        alive_t = eng.look_replicated(d_alive, fd_shift)
-        part_t = eng.look_replicated(d_part, fd_shift)
+        t, _alive_t, _part_t, direct_ok, ack_ok = _shift_fd_chains(
+            eng, d_ids, d_alive, d_part, fd_shift, proxy_shifts,
+            k_ping_net, k_proxy_net, params, kn, world, round_idx,
+            node_ids, part_here, (n_local,),
+        )
         if params.full_view:
             slot = t
             entry_t_status = jnp.take_along_axis(status, t[:, None], 1)[:, 0]
@@ -1870,44 +1978,6 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
                 (entry_t_status == records.ALIVE)
                 | (entry_t_status == records.SUSPECT)
             )
-
-        loss_it, delay_it = link_eval(world.faults, round_idx, node_ids, t,
-                                      kn.loss_probability,
-                                      params.mean_delay_ms)
-        loss_ti, delay_ti = link_eval(world.faults, round_idx, t, node_ids,
-                                      kn.loss_probability,
-                                      params.mean_delay_ms)
-        direct_ok = (
-            _chain_ok(k_ping_net, [loss_it, loss_ti], [delay_it, delay_ti],
-                      params.ping_timeout_ms, (n_local,))
-            & alive_t & (part_here == part_t)
-        )
-        # Ping-req via proxy shifts; proxy r for node i is (i + ps_r) % n.
-        ack_ok = direct_ok
-        for r in range(r_proxies):
-            ps = proxy_shifts[r]
-            p_ids = eng.look_replicated(d_ids, ps)
-            p_alive = eng.look_replicated(d_alive, ps)
-            p_part = eng.look_replicated(d_part, ps)
-            hop_pairs = [(node_ids, p_ids), (p_ids, t), (t, p_ids),
-                         (p_ids, node_ids)]
-            hop_losses, hop_delays = [], []
-            for src, dst in hop_pairs:
-                lo, de = link_eval(world.faults, round_idx, src, dst,
-                                   kn.loss_probability,
-                                   params.mean_delay_ms)
-                hop_losses.append(lo)
-                hop_delays.append(de)
-            ok_pr = (
-                _chain_ok(jax.random.fold_in(k_proxy_net, r),
-                          hop_losses, hop_delays,
-                          params.ping_interval_ms - params.ping_timeout_ms,
-                          (n_local,))
-                & p_alive & alive_t
-                & (part_here == p_part) & (p_part == part_t)
-                & (ps != fd_shift)                           # proxy != target
-            )
-            ack_ok = ack_ok | ok_pr
         active = fd_round & has_target & alive_here
         suspect_v = active & ~ack_ok
         refute_v = active & ack_ok & (entry_t_status == records.SUSPECT)
@@ -1927,7 +1997,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
      entry_t_inc, probes_sent, ping_req_launches) = fd_phase(0)
     ping_req_n = jnp.sum(ping_req_launches, dtype=jnp.int32) * r_proxies
 
-    compact = params.compact_carry
+    compact = params.compact_wire
     no_msg = delivery.no_message(compact)
     fd_slot_onehot = (
         jnp.arange(k, dtype=jnp.int32)[None, :] == slot_safe[:, None]
@@ -2023,12 +2093,10 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     n_gossip_sent = jnp.int32(0)
     for c in range(f):
         s = gossip_shifts[c]
-        sender = eng.deliver_replicated(d_ids, s)
-        sender_alive = eng.deliver_replicated(d_alive, s)
-        sender_part = eng.deliver_replicated(d_part, s)
-        loss_c, delay_c = link_eval(world.faults, round_idx, sender,
-                                    node_ids, kn.loss_probability,
-                                    params.mean_delay_ms)
+        _, sender_alive, sender_part, loss_c, delay_c = _shift_sender_gate(
+            eng, d_ids, d_alive, d_part, s, world, round_idx, node_ids,
+            kn, params,
+        )
         ok_c = (
             sender_alive & alive_here & (sender_part == part_here)
             & (drop_u[:, c] >= loss_c)
@@ -2091,15 +2159,13 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     def refute_deliver(rf):
         ring_, fring_ = rf
         h_pushers = eng.prep(push_refute)
-        sender_alive_r = eng.deliver_replicated(d_alive, fd_shift)
         # Loss/delay for the refute push (issuer -> target hop); it rides
         # the same delayed-delivery ring as the other channels so both
         # delivery modes agree under max_delay_rounds > 0.
-        sender_ids_r = eng.deliver_replicated(d_ids, fd_shift)
-        loss_r, delay_r = link_eval(world.faults, round_idx, sender_ids_r,
-                                    node_ids, kn.loss_probability,
-                                    params.mean_delay_ms)
-        part_ok_r = eng.deliver_replicated(d_part, fd_shift) == part_here
+        _, sender_alive_r, sender_part_r, loss_r, delay_r = \
+            _shift_sender_gate(eng, d_ids, d_alive, d_part, fd_shift,
+                               world, round_idx, node_ids, kn, params)
+        part_ok_r = sender_part_r == part_here
         wire_drop_r = jax.random.uniform(k_sync_drop, (n_local,)) < loss_r
         pushing_r = eng.deliver(h_pushers, fd_shift)
         ok_r = (sender_alive_r & alive_here & part_ok_r & ~wire_drop_r
@@ -2130,12 +2196,10 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     # alive-on-suspected refute push (aimed at the probed member = the
     # fd_shift channel, delivered above).
     s = sync_shift
-    sender_alive = eng.deliver_replicated(d_alive, s)
-    sender_part = eng.deliver_replicated(d_part, s)
-    sender_ids_s = eng.deliver_replicated(d_ids, s)
-    loss_sy, delay_sy = link_eval(world.faults, round_idx, sender_ids_s,
-                                  node_ids, kn.loss_probability,
-                                  params.mean_delay_ms)
+    _, sender_alive, sender_part, loss_sy, delay_sy = _shift_sender_gate(
+        eng, d_ids, d_alive, d_part, s, world, round_idx, node_ids,
+        kn, params,
+    )
     part_ok_sy = sender_part == part_here
     wire_drop_sy = drop_u[:, f] < loss_sy
     ok_s = (
@@ -2234,8 +2298,9 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
     r_proxies = params.ping_req_members
     f = params.fanout
     eng = shift_ops.ShiftEngine(n, roll_payloads=params.shift_roll_payloads)
-    compact = params.compact_carry
-    no_msg = delivery.no_message(compact)
+    compact = params.compact_carry          # carry layout
+    wire = params.compact_wire              # wire-key format
+    no_msg = delivery.no_message(wire)
 
     # ---- Round draws: identical keys/shapes to _tick_shift --------------
     n_shifts = 1 + r_proxies + f + 1
@@ -2250,50 +2315,21 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
     d_ids = eng.prep_replicated(jnp.arange(n, dtype=jnp.int32))
 
     # ---- FD phase (full-view take_along on the whole carry; [N] vectors,
-    # no [N, K] temps) — mirrors _tick_shift.fd_phase's full_view branch.
-    # ``status``/``inc`` are the RAW carry fields (a well-formed carry is
-    # already diagonal-pinned, and t != i for every shift) — in compact
-    # layout the per-entry decode is just the int32 upcast.
-    t = eng.look_replicated(d_ids, fd_shift)
-    alive_t = eng.look_replicated(d_alive, fd_shift)
-    part_t = eng.look_replicated(d_part, fd_shift)
+    # no [N, K] temps) — the chain math is _shift_fd_chains, shared with
+    # _tick_shift.fd_phase.  ``status``/``inc`` are the RAW carry fields
+    # (a well-formed carry is already diagonal-pinned, and t != i for
+    # every shift) — in compact layout the per-entry decode is just the
+    # int32 upcast.
+    t, _alive_t, _part_t, direct_ok, ack_ok = _shift_fd_chains(
+        eng, d_ids, d_alive, d_part, fd_shift, proxy_shifts,
+        k_ping_net, k_proxy_net, params, kn, world, round_idx,
+        node_ids, part_here, (n,),
+    )
     entry_t_status = jnp.take_along_axis(status, t[:, None], 1)[:, 0]
     entry_t_inc = jnp.take_along_axis(inc, t[:, None], 1)[:, 0] \
         .astype(jnp.int32)
     has_target = ((entry_t_status == records.ALIVE)
                   | (entry_t_status == records.SUSPECT))
-    loss_it, delay_it = link_eval(world.faults, round_idx, node_ids, t,
-                                  kn.loss_probability, params.mean_delay_ms)
-    loss_ti, delay_ti = link_eval(world.faults, round_idx, t, node_ids,
-                                  kn.loss_probability, params.mean_delay_ms)
-    direct_ok = (
-        _chain_ok(k_ping_net, [loss_it, loss_ti], [delay_it, delay_ti],
-                  params.ping_timeout_ms, (n,))
-        & alive_t & (part_here == part_t)
-    )
-    ack_ok = direct_ok
-    for r in range(r_proxies):
-        ps = proxy_shifts[r]
-        p_ids = eng.look_replicated(d_ids, ps)
-        p_alive = eng.look_replicated(d_alive, ps)
-        p_part = eng.look_replicated(d_part, ps)
-        hop_pairs = [(node_ids, p_ids), (p_ids, t), (t, p_ids),
-                     (p_ids, node_ids)]
-        hop_losses, hop_delays = [], []
-        for src, dst in hop_pairs:
-            lo, de = link_eval(world.faults, round_idx, src, dst,
-                               kn.loss_probability, params.mean_delay_ms)
-            hop_losses.append(lo)
-            hop_delays.append(de)
-        ok_pr = (
-            _chain_ok(jax.random.fold_in(k_proxy_net, r),
-                      hop_losses, hop_delays,
-                      params.ping_interval_ms - params.ping_timeout_ms, (n,))
-            & p_alive & alive_t
-            & (part_here == p_part) & (p_part == part_t)
-            & (ps != fd_shift)
-        )
-        ack_ok = ack_ok | ok_pr
     probe_active = fd_round & has_target & alive_here
     verdict_suspect = probe_active & ~ack_ok
     push_refute = (probe_active & ack_ok
@@ -2303,40 +2339,35 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
     ping_req_n = jnp.sum(ping_req_launches, dtype=jnp.int32) * r_proxies
     slot_safe = t                                    # full view: slot == id
     fd_suspect_key = delivery.pack_record(
-        jnp.int8(records.SUSPECT), entry_t_inc, compact=compact
+        jnp.int8(records.SUSPECT), entry_t_inc, compact=wire
     )
 
     # ---- Channel sender gates (receiver-indexed [N] vectors) ------------
     drop_u = jax.random.uniform(k_gossip_drop, (n, f + 1))
     ok_gossip = []
     for c in range(f):
-        s = gossip_shifts[c]
-        sender_alive = eng.deliver_replicated(d_alive, s)
-        sender_part = eng.deliver_replicated(d_part, s)
-        loss_c, _ = link_eval(world.faults, round_idx,
-                              eng.deliver_replicated(d_ids, s), node_ids,
-                              kn.loss_probability, params.mean_delay_ms)
+        _, sender_alive, sender_part, loss_c, _ = _shift_sender_gate(
+            eng, d_ids, d_alive, d_part, gossip_shifts[c], world,
+            round_idx, node_ids, kn, params,
+        )
         ok_gossip.append(
             sender_alive & alive_here & (sender_part == part_here)
             & (drop_u[:, c] >= loss_c) & (jnp.int32(c) < kn.fanout)
         )
     push_refute = push_refute & (kn.sync_every > 0)
     h_pushers = eng.prep(push_refute)
-    sender_alive_r = eng.deliver_replicated(d_alive, fd_shift)
-    sender_ids_r = eng.deliver_replicated(d_ids, fd_shift)
-    loss_r, _ = link_eval(world.faults, round_idx, sender_ids_r, node_ids,
-                          kn.loss_probability, params.mean_delay_ms)
-    part_ok_r = eng.deliver_replicated(d_part, fd_shift) == part_here
+    _, sender_alive_r, sender_part_r, loss_r, _ = _shift_sender_gate(
+        eng, d_ids, d_alive, d_part, fd_shift, world, round_idx,
+        node_ids, kn, params,
+    )
     wire_drop_r = jax.random.uniform(k_sync_drop, (n,)) < loss_r
-    ok_refute = (sender_alive_r & alive_here & part_ok_r & ~wire_drop_r
-                 & eng.deliver(h_pushers, fd_shift))
+    ok_refute = (sender_alive_r & alive_here & (sender_part_r == part_here)
+                 & ~wire_drop_r & eng.deliver(h_pushers, fd_shift))
     sender_refuting = eng.deliver(h_pushers, sync_shift)
-    s = sync_shift
-    sender_alive_s = eng.deliver_replicated(d_alive, s)
-    sender_part_s = eng.deliver_replicated(d_part, s)
-    loss_sy, _ = link_eval(world.faults, round_idx,
-                           eng.deliver_replicated(d_ids, s), node_ids,
-                           kn.loss_probability, params.mean_delay_ms)
+    _, sender_alive_s, sender_part_s, loss_sy, _ = _shift_sender_gate(
+        eng, d_ids, d_alive, d_part, sync_shift, world, round_idx,
+        node_ids, kn, params,
+    )
     ok_sync = (
         sync_round & sender_alive_s & alive_here & ~sender_refuting
         & (sender_part_s == part_here) & (drop_u[:, f] >= loss_sy)
@@ -2420,7 +2451,7 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
             keys_c = eng.deliver(h_keys_b, sft)
             tx = (eng.deliver(h_tx_b, sft) & tx_bit) != 0
             payload = jnp.where(tx, keys_c, no_msg)
-            return payload, delivery.is_alive_key(payload, compact=compact)
+            return payload, delivery.is_alive_key(payload, compact=wire)
 
         # FD verdict lands on column slot_safe (one cell per row).
         inbox_b = jnp.where(

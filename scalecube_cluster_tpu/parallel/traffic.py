@@ -58,11 +58,12 @@ INT8 = 1
 def _key_bytes(params) -> int:
     """Wire bytes per packed record key.
 
-    ``compact_carry`` ships int16 keys (records.merge_key16), halving
-    every key exchange's ICI bytes — the sharded full-view capacity
-    layout is also the cheaper one to scale out.
+    The int16 wire (``compact_carry`` or ``int16_wire``) ships
+    records.merge_key16 keys, halving every key exchange's ICI bytes —
+    the sharded full-view capacity layout is also the cheaper one to
+    scale out.
     """
-    return INT16 if params.compact_carry else INT32
+    return INT16 if params.compact_wire else INT32
 
 
 def shift_exchanges_per_round(params, gate_contacts: bool = False):

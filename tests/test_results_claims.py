@@ -451,9 +451,13 @@ def test_user_gossip_1m_claims(results_text, user_gossip_1m):
         results_text,
         r"each reaches all 999,999 live members in\s+exactly (\d+) rounds",
     )
+    n = user_gossip_1m["n_members"]
     for g in gossips:
         assert g["dissemination_rounds"] == diss
-        assert g["final_infected"] == user_gossip_1m["n_members"] - 1
+        # >= n-1, not == n-1: the crashed node counts as infected if a
+        # gossip reached it before its crash round (seed-dependent), so
+        # pinning the exact value would make regeneration flaky.
+        assert n - 1 <= g["final_infected"] <= n
     (crash_round,) = claim(
         results_text, r"the crash is known cluster-wide by round (\d+),")
     assert crash_round == user_gossip_1m["crash"]["dead_known_by_all_round"]

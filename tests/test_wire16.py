@@ -1,0 +1,127 @@
+"""int16_wire (int16 wire keys, WIDE carry) is protocol-trace-identical
+to the int32 wire.
+
+The hybrid exists as a bandwidth lever for the 1M focal headline: the
+round-3 narrow-int negative narrowed the CARRY lanes (slower merge); this
+knob narrows only the wire-format buffers — payloads, channel delivers,
+inbox, delay-ring slots — to records.merge_key16 while the carry keeps
+its wide dtypes (SwimParams.int16_wire docstring).  Contract: below the
+8191 incarnation saturation every protocol outcome is bit-identical —
+same PRNG draws, same merge winners, same timers — because merge_key16
+preserves the merge lattice order and the merge upcasts on load.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.models import swim
+
+from tests.test_swim_model import fast_config
+
+
+def run_pair(n, rounds, world_fn=None, seed=0, spread=None, **overrides):
+    """(wide-wire metrics+state, int16-wire metrics+state), same scenario."""
+    out = []
+    for wire16 in (False, True):
+        params = swim.SwimParams.from_config(
+            fast_config(), n_members=n, int16_wire=wire16, **overrides
+        )
+        world = swim.SwimWorld.healthy(params)
+        if world_fn is not None:
+            world = world_fn(world)
+        if spread is not None:
+            for idx, origin, at_round in spread:
+                world = world.with_spread(idx, origin, at_round)
+        state, metrics = swim.run(jax.random.key(seed), params, world, rounds)
+        out.append((state, metrics))
+    return out
+
+
+def assert_identical(pair, rounds, msg):
+    (s_w, m_w), (s_16, m_16) = pair
+    for name in m_w:
+        np.testing.assert_array_equal(
+            np.asarray(m_w[name]), np.asarray(m_16[name]),
+            err_msg=f"{msg}: metric {name} diverged",
+        )
+    # The carry is wide in BOTH modes: compare fields directly.
+    for field in ("status", "inc", "spread_until", "suspect_deadline",
+                  "self_inc", "g_infected", "g_spread_until"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s_w, field)),
+            np.asarray(getattr(s_16, field)),
+            err_msg=f"{msg}: state.{field} diverged",
+        )
+
+
+SCENARIOS = {
+    "crash_revive": lambda w: w.with_crash(3, at_round=5, until_round=60),
+    "leave": lambda w: w.with_leave(2, at_round=10),
+    "asym_link": lambda w: w.with_link_fault(1, 4, loss=0.9),
+}
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+@pytest.mark.parametrize("scenario", sorted(SCENARIOS))
+def test_wire16_trace_identical(delivery, scenario):
+    pair = run_pair(32, 120, SCENARIOS[scenario], delivery=delivery,
+                    loss_probability=0.1)
+    assert_identical(pair, 120, f"{scenario}/{delivery}")
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+def test_wire16_delay_ring_trace_identical(delivery):
+    # The ring slots hold wire keys, so int16_wire narrows them too; the
+    # cross-round delivery must still merge identically.
+    pair = run_pair(
+        32, 120, SCENARIOS["crash_revive"], delivery=delivery,
+        loss_probability=0.1, mean_delay_ms=150.0, max_delay_rounds=2,
+    )
+    assert_identical(pair, 120, f"delay-ring/{delivery}")
+    # And the ring dtype actually narrowed.
+    assert pair[1][0].inbox_ring.dtype == jnp.int16
+    assert pair[0][0].inbox_ring.dtype == jnp.int32
+
+
+def test_wire16_user_gossip_trace_identical():
+    pair = run_pair(
+        32, 80, SCENARIOS["crash_revive"], delivery="shift",
+        loss_probability=0.05, n_user_gossips=2,
+        spread=[(0, 1, 0), (1, 7, 4)],
+    )
+    assert_identical(pair, 80, "user-gossip/shift")
+
+
+def test_wire16_blocked_tick_trace_identical():
+    # k_block + int16_wire without compact_carry: the block bodies pack
+    # and deliver int16 keys while decoding a WIDE carry.
+    outs = []
+    for wire16 in (False, True):
+        params = swim.SwimParams.from_config(
+            fast_config(), n_members=64, delivery="shift",
+            int16_wire=wire16, k_block=16, per_subject_metrics=False,
+        )
+        world = swim.SwimWorld.healthy(params).with_crash(5, at_round=4)
+        state, metrics = swim.run(jax.random.key(1), params, world, 60)
+        outs.append((state, metrics))
+    assert_identical(outs, 60, "blocked/shift")
+
+
+def test_wire16_carry_stays_wide():
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=16, delivery="shift", int16_wire=True
+    )
+    state = swim.initial_state(params, swim.SwimWorld.healthy(params))
+    assert state.inc.dtype == jnp.int32
+    assert state.spread_until.dtype == jnp.int32
+    assert state.suspect_deadline.dtype == jnp.int32
+    assert params.compact_wire and not params.compact_carry
+
+
+def test_compact_carry_implies_compact_wire():
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=16, delivery="shift", compact_carry=True
+    )
+    assert params.compact_wire
