@@ -1,4 +1,4 @@
-"""Pin the O(log n) dissemination law to 16.7M members on one chip.
+"""Pin the O(log n) dissemination law to 33.5M members on one chip.
 
 BASELINE.md's north star reproduces SWIM's O(log n) dissemination; round
 4 fitted it to N=16,384 and stated the 16,777,216-member headroom run in
@@ -7,15 +7,17 @@ prose only.  This experiment makes both an artifact:
   - leave-dissemination rounds (one graceful leave, rounds until every
     live observer dropped the leaver — pure infection spread, no
     suspicion wait; bench.py's dissemination_at_scale) measured at
-    N = 16k .. 16.7M (2 decades past the old fit ceiling);
+    N = 16k .. 33.5M (the 33,554,432 rung uses the compact carry —
+    trace-identical, tests/test_compact_carry.py — because the wide
+    focal carry RESOURCE_EXHAUSTs at that N);
   - a linear fit rounds = a + b*log2(N): fanout-3 gossip grows the
     infected set ~(1+fanout)x per round, so b ~= 1/log2(4) = 0.5;
-  - the 16.7M throughput pin (member-rounds/sec over a 100-round
-    window, the round-4 prose claim).
+  - throughput pins at 16.7M (wide) and 33.5M (compact)
+    (member-rounds/sec over a 100-round window, fresh subprocesses).
 
 Writes ``artifacts/dissemination_scale.json``; pinned by
 tests/test_results_claims.py.  Run: ``python
-experiments/dissemination_scale.py`` (TPU, ~6 min).
+experiments/dissemination_scale.py`` (TPU, ~10 min).
 """
 
 import json
@@ -27,13 +29,72 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-LADDER = [16_384, 65_536, 262_144, 1_048_576, 4_194_304, 16_777_216]
+LADDER = [16_384, 65_536, 262_144, 1_048_576, 4_194_304, 16_777_216,
+          33_554_432]
+# Above this N the wide focal carry RESOURCE_EXHAUSTs; the rung runs on
+# the trace-identical compact layout instead.
+COMPACT_ABOVE = 16_777_216
 N_SUBJECTS = 16
-THROUGHPUT_N = 16_777_216
+THROUGHPUT_PINS = [(16_777_216, False), (33_554_432, True)]
 THROUGHPUT_ROUNDS = 100
 
 
+def throughput_pin(n, compact):
+    """The documented bench command at N, in a FRESH subprocess.
+
+    Fresh for two reasons: an in-process pin after the ladder measured
+    ~20% low (residue from prior compiled programs skews the window),
+    and the 33.5M rung needs the whole chip — it RESOURCE_EXHAUSTs if
+    the parent still holds the ladder's buffers.  main() therefore runs
+    the pins BEFORE the parent touches the device.
+    """
+    import subprocess
+    env = dict(os.environ,
+               SCALECUBE_BENCH_N=str(n),
+               SCALECUBE_BENCH_ROUNDS=str(THROUGHPUT_ROUNDS),
+               SCALECUBE_BENCH_SKIP_CANARY="1",
+               **({"SCALECUBE_BENCH_COMPACT": "1"} if compact else {}))
+    rate, crash_noticed, tput_error = None, None, None
+    try:
+        bench = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=1200, env=env,
+            cwd=REPO,
+        )
+        lines = bench.stdout.strip().splitlines()
+        if bench.returncode != 0 or not lines:
+            tput_error = (f"bench rc={bench.returncode}; stderr tail: "
+                          f"{(bench.stderr or '')[-300:]}")
+        else:
+            bench_json = json.loads(lines[-1])
+            rate = bench_json["value"]
+            # bench returns dissemination_rounds=-1 (no error key)
+            # when the leave was never noticed — require a positive
+            # count.
+            crash_noticed = (
+                "error" not in bench_json
+                and bench_json.get("dissemination_rounds", -1) > 0
+            )
+            tput_error = bench_json.get("error")
+    except Exception as e:  # noqa: BLE001 — record, keep the artifact
+        tput_error = f"{type(e).__name__}: {e}"
+    print(f"[tput] {rate and f'{rate:.3e}'} member-rounds/s @ {n} "
+          f"compact={compact} (error={tput_error})", file=sys.stderr)
+    return {
+        "n_members": n,
+        "rounds_timed": THROUGHPUT_ROUNDS,
+        "compact_carry": compact,
+        "member_rounds_per_sec": rate and round(rate, 1),
+        "crash_noticed": crash_noticed,
+        **({"error": tput_error} if tput_error else {}),
+    }
+
+
 def main():
+    # Pins first: the parent must not have touched the chip yet (see
+    # throughput_pin docstring).
+    pins = [throughput_pin(n, compact) for n, compact in THROUGHPUT_PINS]
+
     import jax
     import numpy as np
 
@@ -46,7 +107,7 @@ def main():
     def dissemination_rounds(n, seed=1):
         params = swim.SwimParams.from_config(
             ClusterConfig.default(), n_members=n, n_subjects=N_SUBJECTS,
-            delivery="shift",
+            delivery="shift", compact_carry=n > COMPACT_ABOVE,
         )
         world = swim.SwimWorld.healthy(params).with_leave(3, at_round=10)
         _, m = swim.run(jax.random.key(seed), params, world, 60)
@@ -64,6 +125,7 @@ def main():
             "n_members": n,
             "dissemination_rounds": sorted(vals)[1],
             "seed_values": vals,
+            "compact_carry": n > COMPACT_ABOVE,
             "wall_s": round(time.perf_counter() - t0, 1),
         })
         print(f"[diss] N={n}: {rows[-1]}", file=sys.stderr, flush=True)
@@ -72,39 +134,6 @@ def main():
     y = np.asarray([r["dissemination_rounds"] for r in rows], dtype=float)
     b, a = np.polyfit(x, y, 1)
     resid = y - (a + b * x)
-
-    # Throughput pin at 16.7M — the exact documented command, in a FRESH
-    # subprocess (an in-process pin after the ladder measured ~20% low:
-    # residue from six prior compiled programs skews the window).
-    import subprocess
-    env = dict(os.environ,
-               SCALECUBE_BENCH_N=str(THROUGHPUT_N),
-               SCALECUBE_BENCH_ROUNDS=str(THROUGHPUT_ROUNDS),
-               SCALECUBE_BENCH_SKIP_CANARY="1")
-    rate, crash_noticed, tput_error = None, None, None
-    try:
-        bench = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py")],
-            capture_output=True, text=True, timeout=1200, env=env, cwd=REPO,
-        )
-        lines = bench.stdout.strip().splitlines()
-        if bench.returncode != 0 or not lines:
-            tput_error = (f"bench rc={bench.returncode}; stderr tail: "
-                          f"{(bench.stderr or '')[-300:]}")
-        else:
-            bench_json = json.loads(lines[-1])
-            rate = bench_json["value"]
-            # bench returns dissemination_rounds=-1 (no error key) when
-            # the leave was never noticed — require a positive count.
-            crash_noticed = (
-                "error" not in bench_json
-                and bench_json.get("dissemination_rounds", -1) > 0
-            )
-            tput_error = bench_json.get("error")
-    except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
-        tput_error = f"{type(e).__name__}: {e}"
-    print(f"[tput] {rate and f'{rate:.3e}'} member-rounds/s @ "
-          f"{THROUGHPUT_N} (error={tput_error})", file=sys.stderr)
 
     out = {
         "mode": "focal shift, K=16, graceful-leave dissemination",
@@ -116,13 +145,8 @@ def main():
             "b_ideal_log4": 0.5,
             "max_abs_residual_rounds": round(float(np.abs(resid).max()), 3),
         },
-        "throughput_16m": {
-            "n_members": THROUGHPUT_N,
-            "rounds_timed": THROUGHPUT_ROUNDS,
-            "member_rounds_per_sec": rate and round(rate, 1),
-            "crash_noticed": crash_noticed,
-            **({"error": tput_error} if tput_error else {}),
-        },
+        "throughput_16m": pins[0],
+        "throughput_33m": pins[1],
     }
     os.makedirs(os.path.join(REPO, "artifacts"), exist_ok=True)
     path = os.path.join(REPO, "artifacts", "dissemination_scale.json")

@@ -173,10 +173,21 @@ def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
     # Stored DEAD gates like ABSENT (record was deleted in the reference).
     gate_status = jnp.where(entry_status == records.DEAD, records.ABSENT, entry_status)
 
-    accepts = records.is_overrides_array(win_status, win_inc, gate_status, entry_inc)
-    # The ABSENT gate: only an ALIVE opener admits the winner.
+    # The live-entry is_overrides gate IS the packed-key order (the same
+    # monotonicity the inbox max-fold already relies on — records.merge_key
+    # docstring): new DEAD's bit dominates any live key, higher incarnation
+    # dominates the suspect bit, SUSPECT beats ALIVE at equal incarnation
+    # via bit 0, and equal keys (no strict >) never override.  One compare
+    # replaces the five-rule select chain in the hottest fusion; exact
+    # below the key's incarnation saturation, where the fold itself
+    # already lives.
+    entry_key = pack_record(gate_status, entry_inc, compact=compact)
+    # The ABSENT gate: only an ALIVE opener admits the winner (any
+    # non-absent winner, i.e. key >= 0, once open).
     absent = gate_status == records.ABSENT
-    accepts = jnp.where(absent, inbox_any_alive & (win_status != records.ABSENT), accepts)
+    accepts = jnp.where(
+        absent, inbox_any_alive & (inbox_key >= 0), inbox_key > entry_key
+    )
 
     new_status = jnp.where(accepts, win_status, entry_status).astype(jnp.int8)
     new_inc = jnp.where(accepts, win_inc, entry_inc).astype(jnp.int32)

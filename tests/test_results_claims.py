@@ -466,28 +466,43 @@ def test_user_gossip_1m_claims(results_text, user_gossip_1m):
 def test_dissemination_scale_claims(results_text, dissemination_scale):
     rows = {r["n_members"]: r["dissemination_rounds"]
             for r in dissemination_scale["rows"]}
-    r16k, r65k, r262k, r1m, r4m, r16m = claim(
+    r16k, r65k, r262k, r1m, r4m, r16m, r33m = claim(
         results_text,
         r"takes (\d+) rounds at 16k, (\d+) at 65k, (\d+) at 262k, "
-        r"(\d+) at 1M, (\d+) at 4\.2M, and\s+(\d+) at 16\.7M",
+        r"(\d+) at 1M, (\d+) at 4\.2M,\s+(\d+) at 16\.7M, and (\d+) "
+        r"at 33\.5M",
     )
-    assert (r16k, r65k, r262k, r1m, r4m, r16m) == (
+    assert (r16k, r65k, r262k, r1m, r4m, r16m, r33m) == (
         rows[16_384], rows[65_536], rows[262_144], rows[1_048_576],
-        rows[4_194_304], rows[16_777_216],
+        rows[4_194_304], rows[16_777_216], rows[33_554_432],
     )
     fit = dissemination_scale["fit"]
-    (b,) = claim(results_text, r"with\s+b = (0\.\d\d) \(ideal fanout-3")
+    (b,) = claim(results_text, r"with b = (0\.\d\d) \(ideal fanout-3")
     assert b == rounded(fit["b"], 2)
-    (resid,) = claim(results_text, r"max residual (0\.\d\d)\s+rounds")
+    (resid,) = claim(results_text, r"max residual (0\.\d\d) rounds")
     assert resid == rounded(fit["max_abs_residual_rounds"], 2)
     tput = dissemination_scale["throughput_16m"]
     (rate,) = claim(
         results_text,
-        r"\*\*16,777,216 members on the same single chip sustain "
-        r"(\d\.\d+)e8\s+member-rounds/sec\*\*",
+        r"\*\*16,777,216 members on the same\s+single chip sustain "
+        r"(\d\.\d+)e8 member-rounds/sec\*\*",
     )
     assert rate == rounded(tput["member_rounds_per_sec"] / 1e8, 2)
     assert tput["crash_noticed"] is True
+    tput33 = dissemination_scale["throughput_33m"]
+    (rate33,) = claim(
+        results_text,
+        r"\*\*33,554,432 members — 32×\s+the north-star count — sustain "
+        r"(\d\.\d+)e8 member-rounds/sec\*\*",
+    )
+    assert rate33 == rounded(tput33["member_rounds_per_sec"] / 1e8, 2)
+    assert tput33["crash_noticed"] is True
+    assert tput33["compact_carry"] is True
+    # The 33.5M ladder rung runs on the trace-identical compact layout
+    # (the wide carry RESOURCE_EXHAUSTs there) — recorded per row.
+    by_n = {r["n_members"]: r for r in dissemination_scale["rows"]}
+    assert by_n[33_554_432]["compact_carry"] is True
+    assert by_n[16_777_216]["compact_carry"] is False
 
 
 def test_stated_suite_size_matches_collection(results_text):
