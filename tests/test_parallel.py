@@ -172,3 +172,30 @@ class TestShardedShiftMode:
         # The revival is a refutation (incarnation bump), not a
         # false-positive: no live member was ever wrongly suspected.
         assert np.asarray(metrics["false_suspicion_onsets"]).sum() == 0
+
+
+class TestShardedLayouts:
+    """Narrow-wire layouts through the sharded shift path: the block-
+    rotation ppermutes carry int16 payloads (compact_wire), and the
+    compact carry additionally re-relativizes its encodings every tick.
+    Both must trace-match the wide layout exactly — the single-device
+    contracts of tests/test_wire16.py / test_compact_carry.py lifted to
+    the 8-device mesh.
+    """
+
+    @pytest.mark.parametrize("layout", ["int16_wire", "compact_carry"])
+    def test_sharded_layout_trace_identical(self, mesh8, layout):
+        out = []
+        for on in (False, True):
+            params, world = make(64, loss=0.1, delivery="shift",
+                                 **{layout: on})
+            world = world.with_crash(5, at_round=4, until_round=80)
+            _, m = pmesh.shard_run(
+                jax.random.key(12), params, world, 120, mesh8
+            )
+            out.append(m)
+        for name in out[0]:
+            np.testing.assert_array_equal(
+                np.asarray(out[0][name]), np.asarray(out[1][name]),
+                err_msg=f"sharded {layout}: metric {name} diverged",
+            )
