@@ -1,0 +1,205 @@
+"""Single-chip FOCAL-mode (K=16) member-count ceiling, by carry layout.
+
+Focal mode is the bench/headline configuration: each node tracks K=16
+subjects, so capacity scales with N rather than N² — this is where the
+33.5M-member dissemination rung lives (artifacts/dissemination_scale.json).
+This experiment brackets the focal ceiling the way
+experiments/fullview_ceiling.py brackets the full-view one, with each
+(layout, N) attempt in a subprocess so a RESOURCE_EXHAUSTED cannot
+poison later attempts (experiments/ladder_util.py):
+
+  - wide layout: the standard 13 B/cell carry + int32 wire;
+  - compact: 6 B/cell + int16 wire (trace-identical,
+    tests/test_compact_carry.py) — the layout the 33.5M rung uses;
+  - compact_roll: compact + ``shift_roll_payloads`` (no persistent
+    doubled payload buffers) — probes whether dropping the doubled
+    buffers moves the boundary, as it could not for full view.
+
+The artifact records the measured bracket per layout plus an
+``anatomy_probe``: one deliberate over-ceiling attempt (67M compact,
+retried a few times) that preserves the raw failure text, because the
+failure MODE at a given over-ceiling rung is nondeterministic — the
+same rung reports a clean RESOURCE_EXHAUSTED with an allocation dump
+on one run and an axon compile-helper exit-1 on the next (the helper
+itself dying on the too-big program).  The BRACKET (max_fits /
+first_fail N) is stable across regenerations; consumers should pin
+those, not the oom/helper_crash flags.  When the clean dump surfaces
+it shows the [N, 16] per-channel payload/metric temps — the full-view
+boundary's anatomy at K=16, where ``k_block`` has nothing to tile; and
+roll payloads fail at every probed rung >= 46.1M, so dropping the
+doubled buffers cannot be tested past the compact ceiling.
+
+Writes ``artifacts/focal_ceiling.json``; pinned by
+tests/test_results_claims.py.  Run: ``python
+experiments/focal_ceiling.py`` (TPU, ~30 min), or ``... anatomy`` to
+refresh only the anatomy probe in the existing artifact.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from experiments.ladder_util import bracket, salvage_run  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROUNDS = 50
+K = 16
+
+# Layouts suffixed ``_ps`` run with per-subject metrics on — the
+# bench/dissemination configuration.  Measured: the metric mode does
+# NOT move the boundary (wide_ps fits 33.5M like wide; the bench's
+# 33.5M wide OOM comes from its TWO-program pipeline — throughput
+# window plus the separate dissemination program — holding buffers
+# concurrently, which is why the dissemination rung runs compact).
+LADDERS = {
+    "wide": [16_777_216, 25_165_824, 33_554_432, 41_943_040],
+    "wide_ps": [25_165_824, 33_554_432],
+    "compact": [33_554_432, 41_943_040, 46_137_344, 50_331_648],
+    "compact_ps": [33_554_432, 41_943_040],
+    "compact_roll": [46_137_344, 50_331_648, 67_108_864],
+}
+CONSECUTIVE_FAILURES_TO_STOP = 2
+ANATOMY_N = 67_108_864          # deliberate over-ceiling probe (compact)
+ANATOMY_RETRIES = 3             # until a clean RESOURCE_EXHAUSTED dump
+
+_CHILD = r"""
+import json, sys, time
+sys.path.insert(0, %(repo)r)
+import jax
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.utils.runlog import enable_compilation_cache
+
+enable_compilation_cache()
+n, compact, roll, rounds = %(n)d, %(compact)r, %(roll)r, %(rounds)d
+per_subject = %(per_subject)r
+try:
+    params = swim.SwimParams.from_config(
+        ClusterConfig.default_lan(), n_members=n, n_subjects=%(k)d,
+        delivery="shift", compact_carry=compact,
+        shift_roll_payloads=roll, loss_probability=0.02,
+        per_subject_metrics=per_subject,
+    )
+    world = swim.SwimWorld.healthy(params).with_crash(3, at_round=5)
+    step = jax.jit(
+        lambda k_, w, s, r0: swim.run(k_, params, w, rounds, state=s,
+                                      start_round=r0),
+        donate_argnums=(2,))
+    key = jax.random.key(0)
+
+    from scalecube_cluster_tpu.utils import runlog
+
+    def force(s):
+        # Scalar-fetch completion barrier: on the tunnelled axon link,
+        # block_until_ready returns before device completion and the
+        # window timing lies (utils/runlog.completion_barrier docstring).
+        return runlog.completion_barrier(s.status)
+
+    state = swim.initial_state(params, world)
+    t0 = time.perf_counter()
+    state, _ = step(key, world, state, 0)
+    force(state)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    state, m = step(key, world, state, rounds)
+    force(state)
+    elapsed = time.perf_counter() - t0
+    print(json.dumps({
+        "fits": True,
+        "ms_per_round": round(elapsed / rounds * 1e3, 2),
+        "member_rounds_per_sec": round(n * rounds / elapsed, 1),
+        "compile_plus_first_window_s": round(compile_s, 1),
+    }))
+except Exception as e:  # noqa: BLE001 — boundary classification by message
+    msg = str(e)
+    helper = "compile_helper subprocess exit code" in msg
+    oom = not helper and ("RESOURCE_EXHAUSTED" in msg
+                          or "Ran out of memory" in msg)
+    print(json.dumps({"fits": False, "oom": oom, "helper_crash": helper,
+                      "error": f"{type(e).__name__}: {msg[:%(err_chars)d]}"}))
+"""
+
+_FALLBACK = {"fits": False, "oom": False, "helper_crash": False}
+
+
+def attempt(n, layout, err_chars=300):
+    code = _CHILD % {"repo": REPO, "n": n, "k": K,
+                     "compact": layout.startswith("compact"),
+                     "roll": "_roll" in layout,
+                     "per_subject": layout.endswith("_ps"),
+                     "rounds": ROUNDS,
+                     "err_chars": err_chars}
+    return salvage_run(code, cwd=REPO, fallback=dict(_FALLBACK))
+
+
+def run_anatomy_probe():
+    """One over-ceiling attempt preserving the raw failure text.
+
+    Retries until the failure surfaces as a clean RESOURCE_EXHAUSTED
+    (whose text carries the allocation dump's "Used X of Y hbm" line)
+    or retries run out — the helper-crash mode carries no diagnostics.
+    """
+    last = None
+    for i in range(ANATOMY_RETRIES):
+        r = attempt(ANATOMY_N, "compact", err_chars=4000)
+        r.update(n_members=ANATOMY_N, layout="compact", try_idx=i)
+        print(f"[focal:anatomy] try {i}: fits={r['fits']} "
+              f"oom={r.get('oom')} helper={r.get('helper_crash')}",
+              file=sys.stderr, flush=True)
+        last = r
+        if r.get("oom"):
+            break
+    return last
+
+
+def main(anatomy_only=False):
+    path = os.path.join(REPO, "artifacts", "focal_ceiling.json")
+    if anatomy_only:
+        with open(path) as f:
+            out = json.load(f)
+        out["anatomy_probe"] = run_anatomy_probe()
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"updated anatomy_probe in {path}", file=sys.stderr)
+        return
+
+    results = {}
+    for layout, ladder in LADDERS.items():
+        rows, consecutive_failures = [], 0
+        for n in ladder:
+            t0 = time.perf_counter()
+            r = attempt(n, layout)
+            r.update(n_members=n,
+                     attempt_wall_s=round(time.perf_counter() - t0, 1))
+            rows.append(r)
+            print(f"[focal:{layout}] N={n}: fits={r['fits']} "
+                  f"{r.get('ms_per_round', r.get('error', ''))}",
+                  file=sys.stderr, flush=True)
+            consecutive_failures = 0 if r["fits"] else \
+                consecutive_failures + 1
+            if consecutive_failures >= CONSECUTIVE_FAILURES_TO_STOP:
+                break
+        max_fits, first_fail = bracket(rows)
+        results[layout] = {
+            "rows": rows,
+            "max_fits": max_fits,
+            "first_fail_above_max_fits": first_fail,
+        }
+    out = {
+        "mode": f"focal shift, K={K}, {ROUNDS}-round windows, "
+                "crash at round 5",
+        "layouts": results,
+        "anatomy_probe": run_anatomy_probe(),
+    }
+    os.makedirs(os.path.join(REPO, "artifacts"), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+    print(f"wrote {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main(anatomy_only=len(sys.argv) > 1 and sys.argv[1] == "anatomy")

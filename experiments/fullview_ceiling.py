@@ -18,11 +18,12 @@ Run: ``python experiments/fullview_ceiling.py`` (TPU, ~10 min).
 
 import json
 import os
-import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from experiments.ladder_util import bracket, salvage_run  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ROUNDS = 60          # timed window per fitting attempt (plus 1 warmup run)
@@ -139,31 +140,10 @@ def attempt(n, layout, k_block=None):
                      "roll": layout.endswith("_roll"),
                      "k_block": k_block,
                      "rounds": ROUNDS}
-    try:
-        out = subprocess.run([sys.executable, "-c", code],
-                             capture_output=True, text=True, timeout=1200,
-                             cwd=REPO)
-    except subprocess.TimeoutExpired as e:
-        # A hung child is a non-fitting rung, not a lost ladder: record it
-        # and keep probing so the partial results still reach the artifact.
-        # But first salvage any result the child already printed — a
-        # completed measurement followed by a teardown hang is a fit.
-        stdout = e.stdout or b""
-        if isinstance(stdout, bytes):
-            stdout = stdout.decode("utf-8", "replace")
-        for line in reversed(stdout.splitlines()):
-            if line.startswith("{"):
-                try:
-                    return json.loads(line)
-                except json.JSONDecodeError:
-                    break  # killed mid-write: treat as the timeout it is
-        return {"fits": False, "oom": False, "error": "timeout (1200s)"}
-    for line in reversed(out.stdout.splitlines()):
-        if line.startswith("{"):
-            return json.loads(line)
-    return {"fits": False, "oom": False,
-            "error": f"no output; rc={out.returncode}; "
-                     f"stderr tail: {out.stderr[-300:]}"}
+    # Subprocess + timeout-salvage machinery shared with
+    # experiments/focal_ceiling.py (experiments/ladder_util.py).
+    return salvage_run(code, cwd=REPO,
+                       fallback={"fits": False, "oom": False})
 
 
 def run_bracketing():
@@ -202,19 +182,16 @@ def main():
             consecutive_failures = 0 if r["fits"] else consecutive_failures + 1
             if consecutive_failures >= CONSECUTIVE_FAILURES_TO_STOP:
                 break
-        fitting = [r for r in rows if r["fits"]]
-        max_fits = max((r["n_members"] for r in fitting), default=0)
+        # The capacity boundary: smallest non-fitting rung ABOVE every
+        # fitting rung (ladder_util.bracket; bracketing may probe past a
+        # transient failure that a later rung contradicts, so "first
+        # failure in probe order" is not the boundary).
+        max_fits, first_fail = bracket(rows)
         results[layout] = {
             "bytes_per_cell_carry": 13 if layout == "wide" else 6,
             "attempts": rows,
-            "max_fits": max_fits,
-            # The capacity boundary: smallest non-fitting rung ABOVE every
-            # fitting rung (bracketing may probe past a transient failure
-            # that a later rung contradicts, so "first failure in probe
-            # order" is not the boundary).
-            "first_oom": next((r["n_members"] for r in rows
-                               if not r["fits"]
-                               and r["n_members"] > max_fits), None),
+            "max_fits": max_fits or 0,     # artifact schema: 0, not None
+            "first_oom": first_fail,
         }
 
     ratio = (results["compact"]["max_fits"]

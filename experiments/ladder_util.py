@@ -1,0 +1,62 @@
+"""Shared machinery for subprocess capacity-ladder experiments.
+
+Used by experiments/fullview_ceiling.py and experiments/focal_ceiling.py:
+each (layout, N) attempt runs in a child process so a RESOURCE_EXHAUSTED
+(or a compile-helper crash) cannot poison the parent for later rungs,
+and a hung child is salvaged rather than losing the ladder.
+"""
+
+import json
+import subprocess
+import sys
+
+
+def salvage_run(code, cwd, timeout=1200, fallback=None):
+    """Run ``python -c code``; return its last JSON line as a dict.
+
+    A hung child is a non-fitting rung, not a lost ladder: on timeout,
+    salvage any result the child already printed (a completed
+    measurement followed by a teardown hang is a fit), else return
+    ``fallback`` annotated with the timeout.  A child that produced no
+    JSON at all returns ``fallback`` with rc/stderr context.
+    """
+    fallback = dict(fallback or {"fits": False, "oom": False})
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout, cwd=cwd)
+    except subprocess.TimeoutExpired as e:
+        stdout = e.stdout or b""
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode("utf-8", "replace")
+        for line in reversed(stdout.splitlines()):
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    break  # killed mid-write: treat as the timeout it is
+        return {**fallback, "error": f"timeout ({timeout}s)"}
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                break  # child died mid-print: fall through to context
+    return {**fallback,
+            "error": f"no parseable output; rc={out.returncode}; "
+                     f"stderr tail: {out.stderr[-300:]}"}
+
+
+def bracket(rows):
+    """(max_fits, first_fail_above_max_fits) from ladder rows.
+
+    ``first_fail`` is the smallest failing N above the largest fitting
+    one (bracketing may probe past a transient failure), or the smallest
+    failing N when nothing fits.
+    """
+    fits = [r["n_members"] for r in rows if r["fits"]]
+    fails = [r["n_members"] for r in rows if not r["fits"]]
+    max_fits = max(fits) if fits else None
+    first_fail = min([n for n in fails if max_fits is None or n > max_fits],
+                     default=None)
+    return max_fits, first_fail

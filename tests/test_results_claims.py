@@ -418,6 +418,11 @@ def dissemination_scale():
     return _load("dissemination_scale.json")
 
 
+@pytest.fixture(scope="module")
+def focal_ceiling():
+    return _load("focal_ceiling.json")
+
+
 def test_sweep_1m_claims(results_text, sweep_1m):
     assert sweep_1m["one_program"] is True
     assert sweep_1m["n_members"] == 1_000_000
@@ -503,6 +508,41 @@ def test_dissemination_scale_claims(results_text, dissemination_scale):
     by_n = {r["n_members"]: r for r in dissemination_scale["rows"]}
     assert by_n[33_554_432]["compact_carry"] is True
     assert by_n[16_777_216]["compact_carry"] is False
+
+
+def test_focal_ceiling_claims(results_text, focal_ceiling):
+    lay = focal_ceiling["layouts"]
+    w_fit, w_fail = claim(
+        results_text,
+        r"the wide layout fits ([\d,]+)\s+members and fails at ([\d,]+)",
+    )
+    assert (w_fit, w_fail) == (lay["wide"]["max_fits"],
+                               lay["wide"]["first_fail_above_max_fits"])
+    c_fit, c_fail = claim(
+        results_text,
+        r"the compact layout fits ([\d,]+)\s+and fails at ([\d,]+)",
+    )
+    assert (c_fit, c_fail) == (lay["compact"]["max_fits"],
+                               lay["compact"]["first_fail_above_max_fits"])
+    (rate_m,) = claim(
+        results_text,
+        r"focal ceiling is 41\.9M members on one\s+chip\*\* "
+        r"\((\d+\.\d)M member-rounds/s at the ceiling rung\)",
+    )
+    ceiling_row = next(r for r in lay["compact"]["rows"]
+                       if r["n_members"] == lay["compact"]["max_fits"])
+    assert rate_m == rounded(ceiling_row["member_rounds_per_sec"] / 1e6, 1)
+    # The metric mode must not move the bracket (stated negative).
+    assert lay["wide_ps"]["max_fits"] == lay["wide"]["max_fits"]
+    assert lay["compact_ps"]["max_fits"] == lay["compact"]["max_fits"]
+    # Roll payloads fail at every probed rung (stated negative).
+    assert lay["compact_roll"]["max_fits"] is None
+    # The over-ceiling anatomy probe is recorded with its raw failure
+    # text (the stated mode nondeterminism: pin bracket, not flags).
+    probe = focal_ceiling["anatomy_probe"]
+    assert probe["n_members"] == 67_108_864 and not probe["fits"]
+    assert probe.get("oom") or probe.get("helper_crash")
+    assert probe["error"]
 
 
 def test_stated_suite_size_matches_collection(results_text):
