@@ -56,18 +56,23 @@ def _op_operand_bytes(hlo_text, op_name):
     return out
 
 
-@pytest.mark.parametrize("n,k,gate,compact", [
-    (256, 16, False, False),
-    (128, 128, True, False),
+@pytest.mark.parametrize("n,k,gate,layout", [
+    (256, 16, False, "wide"),
+    (128, 128, True, "wide"),
     # compact layout: int16 keys must halve the key exchanges' ICI bytes
     # in the compiled program too — full-view and focal (the no_message
     # dtype discipline is what keeps int16 buffers from silently
     # promoting back to int32; a promotion doubles the compiled bytes
     # and fails here).
-    (128, 128, False, True),
-    (256, 16, False, True),
+    (128, 128, False, "compact"),
+    (256, 16, False, "compact"),
+    # int16_wire: the wire narrows while the carry stays wide — the
+    # compiled ppermute bytes must match _key_bytes' compact_wire
+    # accounting (the "sharded ICI bytes DO halve" claim in RESULTS.md's
+    # int16-wire negative is a compiled-program fact, not just a model).
+    (256, 16, False, "wire16"),
 ])
-def test_shift_hlo_collectives_match_traffic_model(n, k, gate, compact):
+def test_shift_hlo_collectives_match_traffic_model(n, k, gate, layout):
     """The compiled sharded shift program's collective-permutes ARE the
     model: count == exchanges x 2 rotations x D branches (one ppermute
     per lax.switch branch; exactly 2 execute per exchange), and total
@@ -75,7 +80,8 @@ def test_shift_hlo_collectives_match_traffic_model(n, k, gate, compact):
     params = swim.SwimParams.from_config(
         fast_config(), n_members=n,
         n_subjects=(None if k == n else k), delivery="shift",
-        compact_carry=compact,
+        compact_carry=layout == "compact",
+        int16_wire=layout == "wire16",
     )
     world = swim.SwimWorld.healthy(params)
     if gate:
