@@ -1,0 +1,163 @@
+"""Seeded scenario fuzz: random fault worlds hold the core invariants.
+
+The fixed-scenario suites pin known cases; this file drives randomized
+(but seeded — every failure reproduces) combinations of crash, revive,
+graceful leave, per-link faults, wire loss, and delivery mode through
+the invariants that must hold REGARDLESS of scenario:
+
+  1. determinism — same key, same metrics, bit-for-bit;
+  2. the false-positive partition identity
+     ``false_positives == false_suspect_rounds + stale_view_rounds``;
+  3. layout transparency — compact_carry and int16_wire trace-match the
+     wide layout on the same scenario (the fixed-scenario contracts of
+     tests/test_compact_carry.py / test_wire16.py, under random worlds);
+  4. no phantom suspicion — a lossless, fault-free network never
+     records a false-suspicion onset;
+  5. time-bounded completeness (the SWIM paper property the reference's
+     suspicion config encodes) — every permanently crashed node is DEAD
+     in every live member's view by crash + detection + suspicion +
+     dissemination slack.
+
+The reference's harness cannot fuzz like this: its randomness is
+unseeded and its clock is wall time (SURVEY.md §4 "weaknesses worth
+fixing"); here a failing seed is a one-line repro.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.models import swim
+
+from tests.test_swim_model import fast_config
+
+HORIZON = 160
+
+
+def build_scenario(seed):
+    """(params-kwargs, world-builder, scenario-dict) from one seed."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.choice([24, 32, 40]))
+    delivery = ["scatter", "shift"][seed % 2]
+    loss = float(rng.choice([0.0, 0.05, 0.15]))
+    scen = {
+        "n": n, "delivery": delivery, "loss": loss,
+        # A permanent crash early enough that completeness must land
+        # inside HORIZON.
+        "crash_node": int(rng.integers(0, n)),
+        "crash_at": int(rng.integers(0, 12)),
+        "revive": bool(rng.integers(0, 2)),
+        "leave": bool(rng.integers(0, 2)),
+        "link_fault": bool(rng.integers(0, 2)),
+    }
+    scen["leave_node"] = int((scen["crash_node"] + 1 + rng.integers(0, n - 2))
+                             % n)
+    # Faulted link between two nodes that are neither crashed nor leaving.
+    others = [i for i in range(n)
+              if i not in (scen["crash_node"], scen["leave_node"])]
+    scen["fault_src"], scen["fault_dst"] = map(
+        int, rng.choice(others, size=2, replace=False))
+    return scen
+
+
+def make_world(params, scen):
+    world = swim.SwimWorld.healthy(params)
+    until = 120 if scen["revive"] else swim.INT32_MAX
+    world = world.with_crash(scen["crash_node"], at_round=scen["crash_at"],
+                             until_round=until)
+    if scen["leave"]:
+        world = world.with_leave(scen["leave_node"], at_round=20)
+    if scen["link_fault"]:
+        world = world.with_link_fault(scen["fault_src"], scen["fault_dst"],
+                                      loss=0.8)
+    return world
+
+
+def run(scen, seed, **layout):
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=scen["n"], delivery=scen["delivery"],
+        loss_probability=scen["loss"], **layout,
+    )
+    world = make_world(params, scen)
+    state, metrics = swim.run(jax.random.key(seed), params, world, HORIZON)
+    return params, state, metrics
+
+
+_WIDE_CACHE = {}
+
+
+def run_wide_cached(seed):
+    """The wide-layout baseline per seed, shared across the layout
+    params (the scenario is a pure function of the seed)."""
+    if seed not in _WIDE_CACHE:
+        scen = build_scenario(seed)
+        _WIDE_CACHE[seed] = run(scen, seed)
+    return _WIDE_CACHE[seed]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_invariants(seed):
+    scen = build_scenario(seed)
+    params, state, m = run(scen, seed)
+
+    # 1. Determinism: bit-identical re-run.
+    _, _, m2 = run(scen, seed)
+    for name in m:
+        np.testing.assert_array_equal(
+            np.asarray(m[name]), np.asarray(m2[name]),
+            err_msg=f"seed {seed}: nondeterministic metric {name}",
+        )
+
+    # 2. The FP partition identity holds per round under any scenario.
+    np.testing.assert_array_equal(
+        np.asarray(m["false_positives"]),
+        np.asarray(m["false_suspect_rounds"])
+        + np.asarray(m["stale_view_rounds"]),
+        err_msg=f"seed {seed}: FP partition identity broken",
+    )
+
+    # 5. Time-bounded completeness for a permanent crash: DEAD in every
+    # live observer's view well inside the horizon.
+    if not scen["revive"]:
+        crash = scen["crash_node"]
+        alive_view = np.asarray(m["alive"])[:, crash]
+        dead_view = np.asarray(m["dead"])[:, crash]
+        assert alive_view[-1] == 0, (
+            f"seed {seed}: someone still holds ALIVE about the crashed "
+            f"node at the horizon — {scen}"
+        )
+        assert dead_view[-1] > 0, f"seed {seed}: crash never declared {scen}"
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("layout", ["compact_carry", "int16_wire"])
+def test_fuzz_layout_transparency(seed, layout):
+    # 3. Narrow layouts trace-match wide under random scenarios.
+    scen = build_scenario(seed)
+    _, s_w, m_w = run_wide_cached(seed)
+    _, s_n, m_n = run(scen, seed, **{layout: True})
+    for name in m_w:
+        np.testing.assert_array_equal(
+            np.asarray(m_w[name]), np.asarray(m_n[name]),
+            err_msg=f"seed {seed}: {layout} diverged on metric {name}",
+        )
+    if layout == "int16_wire":          # carry directly comparable
+        np.testing.assert_array_equal(
+            np.asarray(s_w.status), np.asarray(s_n.status))
+        np.testing.assert_array_equal(
+            np.asarray(s_w.inc), np.asarray(s_n.inc))
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+def test_fuzz_no_phantom_suspicion(delivery):
+    # 4. Lossless fault-free network: zero false-suspicion onsets over
+    # many random healthy worlds (only the PRNG key varies).
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=32, delivery=delivery,
+    )
+    world = swim.SwimWorld.healthy(params)
+    for seed in range(4):
+        _, m = swim.run(jax.random.key(1000 + seed), params, world, 120)
+        assert int(np.asarray(m["false_suspicion_onsets"]).sum()) == 0, (
+            f"{delivery} seed {seed}: phantom suspicion without loss"
+        )
