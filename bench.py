@@ -20,12 +20,25 @@ Robustness contract (this file must never ship an empty round):
   - exactly ONE JSON line is printed to stdout no matter what — on any
     failure it carries the best measurement achieved plus the error.
 
+Telemetry: every invocation writes a JSONL run manifest (run id, config
+digest, device info, counter rows, detection/removal latency histogram
+buckets from a traced crash scenario, the event stream itself) under
+``SCALECUBE_TPU_TELEMETRY_DIR`` (default ``artifacts/telemetry``) —
+telemetry/sink.py; a TensorBoard export of the same data activates when
+``SCALECUBE_TPU_PROFILE_DIR`` is set.
+
+``--smoke``: a fast CPU-safe pass (small N, few rounds, no canary) that
+exercises the full pipeline — timed run, dissemination probe, traced
+telemetry scenario, JSONL manifest — so the wiring can't silently rot;
+pinned by tests/test_bench_smoke.py.
+
 Env overrides for debugging: SCALECUBE_BENCH_N, SCALECUBE_BENCH_ROUNDS,
 SCALECUBE_BENCH_DELIVERY, SCALECUBE_BENCH_SKIP_CANARY,
 SCALECUBE_BENCH_COMPACT (=1: the capacity-oriented compact carry layout,
 SwimParams.compact_carry).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -33,6 +46,8 @@ import time
 import traceback
 
 NORTH_STAR_RATE = 1e6 * 1e4 / (3600.0 * 8)  # member-rounds/sec/chip
+
+SMOKE = False  # set by main() from --smoke; rescales the module knobs
 
 N_MEMBERS = int(os.environ.get("SCALECUBE_BENCH_N", 1_000_000))
 # "full" = full-view mode (K == N, exact reference semantics, O(N^2) state).
@@ -47,6 +62,22 @@ BENCH_ROUNDS = int(os.environ.get("SCALECUBE_BENCH_ROUNDS", 1000))
 DELIVERY = os.environ.get("SCALECUBE_BENCH_DELIVERY", "shift")
 COMPACT = os.environ.get("SCALECUBE_BENCH_COMPACT", "") == "1"
 CANARY_N = 4096
+# Traced telemetry scenario size cap (events scale ~2N; trace capacity is
+# telemetry.trace.DEFAULT_CAPACITY = 65536, so 4096 leaves >8x headroom —
+# the "zero drops at default capacity" contract).
+TELEMETRY_N = 4096
+TELEMETRY_CRASH_AT = 10
+
+
+def apply_smoke_preset():
+    """CPU-safe fast path: small N, short windows, no canary.  Explicit
+    env overrides still win (same precedence as the full bench)."""
+    global SMOKE, N_MEMBERS, BENCH_ROUNDS, TELEMETRY_N
+    SMOKE = True
+    N_MEMBERS = int(os.environ.get("SCALECUBE_BENCH_N", 256))
+    BENCH_ROUNDS = int(os.environ.get("SCALECUBE_BENCH_ROUNDS", 40))
+    TELEMETRY_N = min(TELEMETRY_N, 256)
+    os.environ.setdefault("SCALECUBE_BENCH_SKIP_CANARY", "1")
 
 
 def log(msg):
@@ -84,7 +115,8 @@ def init_backend():
 
 
 def timed_run(jax, n_members, rounds, label):
-    """Compile + steady-state-time a run; returns member-rounds/sec.
+    """Compile + steady-state-time a run; returns (member-rounds/sec,
+    metrics traces of the timed window).
 
     The timed region is wrapped in ``runlog.profiled`` — a no-op unless
     ``SCALECUBE_TPU_PROFILE_DIR`` is set, in which case a ``jax.profiler``
@@ -136,7 +168,7 @@ def timed_run(jax, n_members, rounds, label):
     # Sanity: the crash at round 50 must eventually be noticed.
     dead_total = int(jax.numpy.asarray(metrics["dead"]).sum())
     log(f"{label}: dead-view observer-rounds in window: {dead_total}")
-    return rate
+    return rate, metrics
 
 
 def dissemination_at_scale(jax, n_members):
@@ -168,13 +200,173 @@ def dissemination_at_scale(jax, n_members):
     return rounds
 
 
+def telemetry_scenario(jax):
+    """The traced crash scenario: a crash at round k observed through the
+    on-device event trace (models/swim.run_traced) and digested into
+    detection/removal latency histograms — distribution-level
+    observability where the bench prints could only report means.
+
+    Runs at min(N_MEMBERS, TELEMETRY_N) so the ~2N SUSPECTED+REMOVED
+    events sit far below the default trace capacity (zero drops is part
+    of the contract, asserted in the manifest summary).
+    """
+    import numpy as np
+
+    from scalecube_cluster_tpu.config import ClusterConfig
+    from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.telemetry import trace as ttrace
+
+    n = min(N_MEMBERS, TELEMETRY_N)
+    # The sped-up protocol timing (the test preset): the suspicion
+    # timeout resolves in tens of rounds, so the scenario stays cheap.
+    cfg = ClusterConfig.default().replace(
+        gossip_interval=100, ping_interval=200, ping_timeout=100,
+        sync_interval=1_000, suspicion_mult=3,
+    )
+    params = swim.SwimParams.from_config(
+        cfg, n_members=n, n_subjects=min(16, n), delivery=DELIVERY,
+    )
+    crash_node = 3
+    world = swim.SwimWorld.healthy(params).with_crash(
+        crash_node, at_round=TELEMETRY_CRASH_AT
+    )
+    rounds = params.suspicion_rounds + 80
+    _, tel, metrics = swim.run_traced(
+        jax.random.key(7), params, world, rounds
+    )
+    hists = ttrace.latency_histograms(tel, world)
+    events = ttrace.decode_events(tel)
+    log(f"telemetry@{n}: {int(tel.trace.count)} events recorded, "
+        f"{int(tel.trace.dropped)} dropped "
+        f"(capacity {tel.trace.capacity})")
+    return {
+        "params": params,
+        "metrics": metrics,
+        "events": events,
+        "recorded": int(tel.trace.count),
+        "dropped": int(tel.trace.dropped),
+        "capacity": int(tel.trace.capacity),
+        "edges": np.asarray(hists["edges"]).tolist(),
+        "detection_buckets": np.asarray(hists["detection"])[crash_node].tolist(),
+        "removal_buckets": np.asarray(hists["removal"])[crash_node].tolist(),
+        "detection_undetected": int(
+            np.asarray(hists["detection_undetected"])[crash_node]
+        ),
+        "crash_node": crash_node,
+        "crash_at": TELEMETRY_CRASH_AT,
+        "n_members": n,
+        "rounds": rounds,
+    }
+
+
+def write_telemetry(scenario, main_metrics):
+    """JSONL run manifest + (gated) TensorBoard export; returns the
+    manifest path."""
+    import numpy as np
+
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+
+    out_dir = (os.environ.get(tsink.TELEMETRY_DIR_ENV)
+               or os.path.join("artifacts", "telemetry"))
+    sink = tsink.TelemetrySink(
+        out_dir, prefix="bench-smoke" if SMOKE else "bench"
+    )
+    sink.write_manifest(
+        params=scenario["params"],
+        workload={
+            "bench_n_members": N_MEMBERS,
+            "bench_rounds": BENCH_ROUNDS,
+            "delivery": DELIVERY,
+            "compact_carry": COMPACT,
+            "smoke": SMOKE,
+        },
+        scenario={
+            "kind": "crash",
+            "n_members": scenario["n_members"],
+            "crash_node": scenario["crash_node"],
+            "crash_round": scenario["crash_at"],
+            "rounds": scenario["rounds"],
+        },
+    )
+    if main_metrics is not None:
+        sink.write_counters(main_metrics, round_offset=BENCH_ROUNDS,
+                            label="main_timed_window")
+    sink.write_counters(scenario["metrics"], label="telemetry_scenario")
+    hist_meta = dict(subject=scenario["crash_node"],
+                     fault_round=scenario["crash_at"])
+    sink.write_histogram("detection_latency_rounds", scenario["edges"],
+                         scenario["detection_buckets"],
+                         undetected=scenario["detection_undetected"],
+                         **hist_meta)
+    sink.write_histogram("removal_latency_rounds", scenario["edges"],
+                         scenario["removal_buckets"], **hist_meta)
+    # Fraction-informed-by-round: the dissemination curve of the death
+    # notice, from the scenario's per-subject dead counts.
+    dead = np.asarray(scenario["metrics"]["dead"])[:, scenario["crash_node"]]
+    sink.write_curve(
+        "fraction_informed",
+        tsink.fraction_informed_curve(dead, scenario["n_members"] - 1),
+        subject=scenario["crash_node"],
+    )
+    sink.write_events(scenario["events"], dropped=scenario["dropped"])
+    sink.write_summary(
+        events_recorded=scenario["recorded"],
+        event_drops=scenario["dropped"],
+        trace_capacity=scenario["capacity"],
+    )
+    sink.close()
+    tsink.maybe_export_tensorboard(
+        sink.run_id,
+        scalars={
+            "telemetry/dead_views": scenario["metrics"]["dead"],
+            "telemetry/messages_gossip":
+                scenario["metrics"]["messages_gossip"],
+            "telemetry/false_positives":
+                scenario["metrics"]["false_positives"],
+        },
+        histograms={
+            "telemetry/detection_latency_rounds":
+                (scenario["edges"], scenario["detection_buckets"]),
+            "telemetry/removal_latency_rounds":
+                (scenario["edges"], scenario["removal_buckets"]),
+        },
+    )
+    log(f"telemetry manifest written to {sink.path}")
+    return sink.path
+
+
 def main():
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="fast CPU-safe pass (small N, few rounds, no canary) that "
+             "still exercises the full pipeline incl. telemetry",
+    )
+    try:
+        args = parser.parse_args()
+    except SystemExit as e:
+        # The one-JSON-line contract holds even for a bad argv: argparse
+        # already printed its usage message to stderr; ship the error
+        # line before propagating its exit code (--help's clean exit
+        # stays JSON-free — it is not a measurement attempt).
+        if e.code not in (0, None):
+            print(json.dumps({
+                "metric": "swim_member_rounds_per_sec_per_chip",
+                "value": None,
+                "error": f"ArgumentError: bad argv {sys.argv[1:]}",
+            }), flush=True)
+        raise
+    if args.smoke:
+        apply_smoke_preset()
+
     result = {
         "metric": "swim_member_rounds_per_sec_per_chip",
         "value": None,
         "unit": "member-rounds/sec/chip",
         "vs_baseline": None,
+        "smoke": SMOKE,
     }
+    main_metrics = None
     try:
         jax, platform = init_backend()
         result["platform"] = platform
@@ -184,14 +376,16 @@ def main():
             # dispatch overhead (~0.1 s/invocation through the tunnelled
             # TPU link), NOT throughput at 4k.  It exists to diagnose
             # failures cheaply before the 1M run; label it accordingly.
-            canary_rate = timed_run(jax, CANARY_N, 100, f"canary@{CANARY_N}")
+            canary_rate, _ = timed_run(jax, CANARY_N, 100,
+                                       f"canary@{CANARY_N}")
             result["canary_smoke_member_rounds_per_sec"] = round(canary_rate, 1)
             result["canary_note"] = (
                 "smoke check only — 100-round window is dispatch-dominated, "
                 "do not read as throughput"
             )
 
-        rate = timed_run(jax, N_MEMBERS, BENCH_ROUNDS, f"main@{N_MEMBERS}")
+        rate, main_metrics = timed_run(jax, N_MEMBERS, BENCH_ROUNDS,
+                                       f"main@{N_MEMBERS}")
         result["value"] = round(rate, 1)
         result["vs_baseline"] = round(rate / NORTH_STAR_RATE, 3)
         result["n_members"] = N_MEMBERS
@@ -207,6 +401,29 @@ def main():
             result["value"] = result["canary_smoke_member_rounds_per_sec"]
             result["vs_baseline"] = round(result["value"] / NORTH_STAR_RATE, 3)
             result["n_members"] = CANARY_N
+
+    # Telemetry stage: the traced scenario + JSONL manifest.  Same
+    # never-ship-empty contract — a telemetry failure is recorded in the
+    # result, it does not void the throughput measurement.
+    try:
+        import jax  # may already be initialized above; cheap re-import
+
+        scenario = telemetry_scenario(jax)
+        manifest = write_telemetry(scenario, main_metrics)
+        result["telemetry"] = {
+            "manifest": manifest,
+            "events_recorded": scenario["recorded"],
+            "event_drops": scenario["dropped"],
+            "detection_latency_hist": {
+                "edges": scenario["edges"],
+                "counts": scenario["detection_buckets"],
+                "undetected": scenario["detection_undetected"],
+            },
+        }
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["telemetry_error"] = f"{type(e).__name__}: {e}"
+
     print(json.dumps(result), flush=True)
 
 

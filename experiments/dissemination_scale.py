@@ -155,6 +155,31 @@ def main():
     print(json.dumps(out, indent=1))
     print(f"wrote {path}", file=sys.stderr)
 
+    # Telemetry manifest: the ladder as counter rows + the fit summary
+    # (telemetry/sink.py; dir from SCALECUBE_TPU_TELEMETRY_DIR, default
+    # artifacts/telemetry).
+    from scalecube_cluster_tpu.telemetry import sink as telemetry_sink
+
+    sink = telemetry_sink.TelemetrySink.from_env(
+        default_dir=os.path.join(REPO, "artifacts", "telemetry"),
+        prefix="dissemination-scale",
+    )
+    if sink is not None:
+        sink.write_manifest(
+            params={"mode": out["mode"], "ladder": LADDER,
+                    "n_subjects": N_SUBJECTS},
+        )
+        sink.write_curve(
+            "dissemination_rounds_vs_log2n",
+            [r["dissemination_rounds"] for r in rows],
+            ladder=[r["n_members"] for r in rows],
+            seed_values=[r["seed_values"] for r in rows],
+        )
+        sink.write_summary(fit=out["fit"],
+                           throughput_16m=pins[0], throughput_33m=pins[1])
+        sink.close()
+        print(f"telemetry manifest at {sink.path}", file=sys.stderr)
+
 
 if __name__ == "__main__":
     main()

@@ -23,6 +23,7 @@ import numpy as np
 
 from scalecube_cluster_tpu.config import ClusterConfig
 from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.telemetry import sink as telemetry_sink
 from scalecube_cluster_tpu.utils import checkpoint, get_logger
 from scalecube_cluster_tpu.utils.runlog import enable_compilation_cache
 
@@ -93,6 +94,39 @@ def main():
     log.info("10k rounds in %.1fs (%.2e member-rounds/s incl. compile + io)",
              elapsed, N * ROUNDS / elapsed)
 
+    # Telemetry manifest: run id + config digest + device info, one
+    # counter row per checkpoint chunk, and the crash-dissemination
+    # curve (telemetry/sink.py; dir from SCALECUBE_TPU_TELEMETRY_DIR,
+    # default artifacts/telemetry).
+    sink = telemetry_sink.TelemetrySink.from_env(
+        default_dir="artifacts/telemetry", prefix="northstar"
+    )
+    if sink is not None:
+        sink.write_manifest(
+            params=params,
+            workload={"n_members": N, "rounds": ROUNDS, "chunk": 2_500,
+                      "loss": 0.02, "delivery": "shift"},
+        )
+        for i, c in enumerate(chunks):
+            sink.write_counters(c, round_offset=i * 2_500,
+                                label=f"chunk_{i}")
+        sink.write_curve(
+            "fraction_informed",
+            telemetry_sink.fraction_informed_curve(
+                np.asarray(metrics["dead"])[:, CRASH_NODE], N - 1
+            ),
+            subject=CRASH_NODE, fault_round=CRASH_AT,
+        )
+        telemetry_sink.maybe_export_tensorboard(
+            sink.run_id,
+            scalars={
+                "northstar/dead_views": metrics["dead"],
+                "northstar/false_positives": metrics["false_positives"],
+                "northstar/messages_gossip": metrics["messages_gossip"],
+            },
+            log=log,
+        )
+
     suspicion = params.suspicion_rounds
     result = {
         "workload": f"{N} members x {ROUNDS} rounds, 2% loss, shift delivery",
@@ -134,6 +168,19 @@ def main():
             np.asarray(metrics["stale_view_rounds"]).sum()
         ),
     }
+
+    # Close the manifest BEFORE the sweep: the headline run's records are
+    # durable even if a sweep point dies (the riskiest section at 1M).
+    if sink is not None:
+        sink.write_summary(
+            wall_seconds=result["wall_seconds"],
+            events=result["events"],
+            total_refutations=result["total_refutations"],
+            false_positive_observer_rounds=result[
+                "false_positive_observer_rounds"],
+        )
+        sink.close()
+        log.info("telemetry manifest at %s", sink.path)
 
     # ---- BASELINE config 5: the 1M parameter sweep -----------------------
     # One compiled program (knobs are traced), looped over the grid points

@@ -105,6 +105,7 @@ import jax.numpy as jnp
 from scalecube_cluster_tpu import records
 from scalecube_cluster_tpu.ops import delivery, prng, ring as ring_ops, \
     shift as shift_ops
+from scalecube_cluster_tpu.telemetry import trace as telemetry_trace
 
 INT32_MAX = jnp.iinfo(jnp.int32).max
 
@@ -2661,3 +2662,49 @@ def run(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
 
     rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
     return jax.lax.scan(body, state, rounds)
+
+
+@partial(jax.jit, static_argnames=("params", "n_rounds", "trace_capacity"))
+def run_traced(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
+               trace_capacity: int = telemetry_trace.DEFAULT_CAPACITY,
+               state: Optional[SwimState] = None, start_round: int = 0,
+               knobs: Optional[Knobs] = None, shift_key=None,
+               telemetry: Optional["telemetry_trace.TelemetryState"] = None):
+    """``run`` with the membership event trace carried through the scan.
+
+    The round step additionally derives each cell's net status
+    transition (telemetry/trace.derive_event_codes — the dense analog of
+    the reference's listener emissions, MembershipProtocolImpl.java:
+    543-588), compacts the events into the jit-carried fixed-capacity
+    buffer (overflow counted, never silent), and advances the
+    first-suspect/first-removed round matrices the in-jit latency
+    histograms reduce over (telemetry/trace.latency_histograms).
+
+    Returns (final_state, telemetry_state, metrics).  ``telemetry``
+    resumes an existing trace across chunked/checkpointed scans (pass
+    the previous chunk's result).  Single-device (like ``run``); the
+    traced tick costs one extra [N, K] pass per round, so the untraced
+    ``run`` stays the benchmark hot path.
+    """
+    if state is None:
+        state = initial_state(params, world)
+    if telemetry is None:
+        telemetry = telemetry_trace.TelemetryState.init(
+            params.n_members, params.n_subjects, trace_capacity
+        )
+
+    def body(carry, round_idx):
+        st, tel = carry
+        prev_status, prev_inc = st.status, st.inc
+        new_st, metrics = swim_tick(st, round_idx, base_key, params, world,
+                                    knobs=knobs, shift_key=shift_key)
+        tel = telemetry_trace.observe_round(
+            tel, round_idx, prev_status, prev_inc, new_st, world
+        )
+        return (new_st, tel), metrics
+
+    rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
+    (final_state, telemetry), metrics = jax.lax.scan(
+        body, (state, telemetry), rounds
+    )
+    return final_state, telemetry, metrics

@@ -252,6 +252,14 @@ class Cluster:
             handler(MembershipEvent.added(member, self.metadata(member)))
         self.membership.listen(handler)
 
+    def listen_trace(self, handler: Callable) -> None:
+        """Raw membership-table transition stream (the numeric schema
+        shared with the dense tick's event trace —
+        ``MembershipProtocol.listen_trace``; adapt with
+        ``telemetry.events.OracleTraceCollector``).  No synthetic
+        prefix: the trace starts at subscription time."""
+        self.membership.listen_trace(handler)
+
     # -- shutdown (ClusterImpl.java:297-347) -------------------------------
 
     def shutdown(self) -> SimFuture:

@@ -26,6 +26,7 @@ from scalecube_cluster_tpu.oracle.fdetector import FailureDetector, FailureDetec
 from scalecube_cluster_tpu.oracle.gossip import GossipProtocol
 from scalecube_cluster_tpu.oracle.transport import Address, Message, Transport
 from scalecube_cluster_tpu.records import MemberStatus, is_overrides
+from scalecube_cluster_tpu.telemetry.events import TraceEventType
 
 # Qualifiers (MembershipProtocolImpl.java:64-66).
 SYNC = "sc/membership/sync"
@@ -146,6 +147,7 @@ class MembershipProtocol:
 
         self.suspicion_timeout_tasks: Dict[str, Timer] = {}
         self._listeners: List[Callable[[MembershipEvent], None]] = []
+        self._trace_listeners: List[Callable] = []
         self._stopped = False
         self._periodic_sync: Optional[Timer] = None
 
@@ -196,9 +198,26 @@ class MembershipProtocol:
         self.suspicion_timeout_tasks.clear()
         self._unsubscribe()
         self._listeners.clear()
+        self._trace_listeners.clear()
 
     def listen(self, handler: Callable[[MembershipEvent], None]) -> None:
         self._listeners.append(handler)
+
+    def listen_trace(self, handler: Callable) -> None:
+        """Subscribe to raw membership-table transitions — the numeric
+        event stream shared with the dense tick's trace
+        (telemetry/events.py schema; telemetry.events.OracleTraceCollector
+        adapts this into ``MembershipTraceEvent`` records).
+
+        ``handler(event_type: TraceEventType, member: Member,
+        incarnation: int)`` is called synchronously at the transition,
+        BEFORE any metadata fetch — unlike :meth:`listen`'s
+        ``MembershipEvent``s, whose ADDED/UPDATED are deferred (and
+        possibly suppressed) by the metadata round trip.  The trace is
+        the table's transition log; the event stream is the
+        application-facing view.
+        """
+        self._trace_listeners.append(handler)
 
     # -- views -------------------------------------------------------------
 
@@ -240,6 +259,7 @@ class MembershipProtocol:
         cur = self.membership_table[self.local_member.id]
         new = MembershipRecord(self.local_member, DEAD, cur.incarnation + 1)
         self.membership_table[self.local_member.id] = new
+        self._trace(TraceEventType.LEAVING, self.local_member, new.incarnation)
         return self._spread_membership_gossip(new)
 
     # -- periodic sync (MembershipProtocolImpl.java:298-314,410-421) -------
@@ -355,6 +375,22 @@ class MembershipProtocol:
         else:
             self.membership_table[r1.member.id] = r1
 
+        # Trace stream: the table transition, in the shared numeric
+        # schema (telemetry/events.py).  ALIVE-over-ALIVE incarnation
+        # bumps are not transitions (the tick emits nothing for them
+        # either); the metadata-facing UPDATED surface stays on listen().
+        if r1.status == DEAD:
+            self._trace(TraceEventType.REMOVED, r1.member, r1.incarnation)
+        elif r1.status == SUSPECT and (r0 is None or r0.status != SUSPECT):
+            # SUSPECT-over-SUSPECT incarnation bumps are not transitions
+            # (the tick's transition trace emits nothing for them either).
+            self._trace(TraceEventType.SUSPECTED, r1.member, r1.incarnation)
+        elif r1.status == ALIVE and r0 is None:
+            self._trace(TraceEventType.ADDED, r1.member, r1.incarnation)
+        elif r1.status == ALIVE and r0.status == SUSPECT:
+            self._trace(TraceEventType.ALIVE_REFUTED, r1.member,
+                        r1.incarnation)
+
         # Schedule/cancel suspicion timeout (:518-523).
         if r1.status == SUSPECT:
             self._schedule_suspicion_timeout(r1)
@@ -409,6 +445,11 @@ class MembershipProtocol:
     def _emit(self, event: MembershipEvent) -> None:
         for handler in list(self._listeners):
             handler(event)
+
+    def _trace(self, event_type: TraceEventType, member: Member,
+               incarnation: int) -> None:
+        for handler in list(self._trace_listeners):
+            handler(event_type, member, incarnation)
 
     # -- suspicion timeouts (MembershipProtocolImpl.java:590-618) ----------
 

@@ -1,0 +1,42 @@
+"""On-device membership event trace + telemetry pipeline.
+
+The reference exposes its protocol life through observable surfaces —
+``MembershipProtocol.listen()`` emits a typed event stream, SLF4J logs
+per-period counters, and JMX MBeans answer point queries (SURVEY.md
+§5.1).  A jit'd 10k-round scan can't call a listener per event; this
+package is the dense-equivalent observability stack:
+
+  - ``events``  the typed event schema (``MembershipTraceEvent``) shared
+                by BOTH layers: the oracle emits it from its merge funnel
+                (``MembershipProtocol.listen_trace``) and the TPU tick's
+                decoded trace produces the same records, so model-vs-
+                oracle event streams are directly diffable — observability
+                doubling as a correctness surface.  Pure Python, no jax.
+  - ``trace``   the jit side: a fixed-capacity event buffer carried
+                through ``jax.lax.scan`` (int32 lanes, overflow counted,
+                never silently truncated), per-(observer, subject)
+                first-suspect/first-removal round tracking, and in-jit
+                detection/removal latency histograms.
+  - ``sink``    host sinks: a JSONL run manifest (run id, config digest,
+                device info, counter rows, histograms, event batches)
+                and a TensorBoard exporter gated behind
+                ``SCALECUBE_TPU_PROFILE_DIR``.
+"""
+
+from scalecube_cluster_tpu.telemetry import events, sink, trace
+from scalecube_cluster_tpu.telemetry.events import (
+    MembershipTraceEvent,
+    OracleTraceCollector,
+    TraceEventType,
+    event_key_set,
+)
+
+__all__ = [
+    "events",
+    "sink",
+    "trace",
+    "MembershipTraceEvent",
+    "OracleTraceCollector",
+    "TraceEventType",
+    "event_key_set",
+]

@@ -1,0 +1,179 @@
+"""The typed membership event schema shared by the oracle and the tick.
+
+The reference's observable protocol surface is ``MembershipEvent``
+(membership/MembershipEvent.java:1-123: ADDED/REMOVED/UPDATED per
+observer) plus the internal transitions its tests reach into
+(suspicion, refutation).  The dense tick can't call a listener per
+event, so both layers speak ONE numeric schema instead:
+
+    (round, observer, subject, event_type, incarnation)
+
+  - ``round``       protocol round of the transition (the tick's scan
+                    cursor; the oracle quantizes ``sim.now`` by the
+                    gossip interval — the same base-round mapping as
+                    config.ClusterConfig.to_sim).
+  - ``observer``    node index whose membership table transitioned (the
+                    reference's "local member" of the listener).
+  - ``subject``     node index the record is about.
+  - ``event_type``  :class:`TraceEventType` — the five table transitions
+                    that cover the reference's event surface.
+  - ``incarnation`` incarnation of the accepted record.
+
+Event types vs the reference surface:
+
+  - ``ADDED``          null/tombstone entry accepted an ALIVE record
+                       (MembershipProtocolImpl.java:553-570; re-adding a
+                       restarted member is the delete-then-re-add path,
+                       :512-516).
+  - ``SUSPECTED``      entry turned SUSPECT (FD verdict or gossip,
+                       :392-397) — the transition the suspicion timer
+                       starts from.
+  - ``ALIVE_REFUTED``  a SUSPECT entry was overridden by a
+                       higher-incarnation ALIVE (the refutation
+                       arriving, :488-509).
+  - ``REMOVED``        entry accepted DEAD (suspicion timeout, leave
+                       notice, or gossiped tombstone; the reference
+                       emits MembershipEvent.REMOVED here, :543-552).
+  - ``LEAVING``        the observer announced its own graceful leave
+                       (leaveCluster's DEAD@inc+1 self-gossip,
+                       :197-206); observer == subject.
+
+Timing caveat for cross-layer diffs: rounds are stochastic (probe draws,
+gossip spread), so exact-match comparisons should be made on the
+timing-free :meth:`MembershipTraceEvent.key` = (observer, subject, type,
+incarnation) — see :func:`event_key_set`.  Per-round transition
+collapse: the tick emits the NET transition of a (observer, subject)
+cell per round, so an ABSENT->SUSPECT round (possible when the ALIVE
+gate opener and a SUSPECT winner arrive together) is one SUSPECTED
+event where the oracle's serialized merges would emit ADDED then
+SUSPECTED.  Warm-state scenarios (the parity tests) never hit this.
+
+This module is pure Python (no jax) so the event-driven oracle can
+import it without touching the accelerator stack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, Iterable, List, Optional, Sequence, Set, Tuple
+
+
+class TraceEventType(enum.IntEnum):
+    """Membership-table transition kinds (int codes are the wire/lane
+    values — stable, do not renumber)."""
+
+    ADDED = 0
+    SUSPECTED = 1
+    ALIVE_REFUTED = 2
+    REMOVED = 3
+    LEAVING = 4
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class MembershipTraceEvent:
+    """One observed membership-table transition (module docstring)."""
+
+    round: int
+    observer: int
+    subject: int
+    event_type: TraceEventType
+    incarnation: int
+
+    def key(self) -> Tuple[int, int, int, int]:
+        """Timing-free identity for cross-layer diffs: (observer,
+        subject, type, incarnation)."""
+        return (self.observer, self.subject, int(self.event_type),
+                self.incarnation)
+
+    def to_json(self) -> dict:
+        return {
+            "round": self.round,
+            "observer": self.observer,
+            "subject": self.subject,
+            "event_type": self.event_type.name,
+            "incarnation": self.incarnation,
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "MembershipTraceEvent":
+        return MembershipTraceEvent(
+            round=int(obj["round"]),
+            observer=int(obj["observer"]),
+            subject=int(obj["subject"]),
+            event_type=TraceEventType[obj["event_type"]],
+            incarnation=int(obj["incarnation"]),
+        )
+
+
+def event_key_set(
+    events: Iterable[MembershipTraceEvent],
+    types: Optional[Sequence[TraceEventType]] = None,
+    subjects: Optional[Sequence[int]] = None,
+    observers: Optional[Sequence[int]] = None,
+    min_round: Optional[int] = None,
+) -> Set[Tuple[int, int, int, int]]:
+    """Timing-free key set of a filtered event stream — the diffable form.
+
+    Two layers running the same scenario agree on WHICH transitions
+    happened (the key set) even though the rounds they happen in are
+    stochastic; ``set_a == set_b`` is the parity assertion
+    (tests/test_telemetry_trace.py).
+    """
+    types_s = None if types is None else {TraceEventType(t) for t in types}
+    subj_s = None if subjects is None else set(subjects)
+    obs_s = None if observers is None else set(observers)
+    out = set()
+    for e in events:
+        if types_s is not None and e.event_type not in types_s:
+            continue
+        if subj_s is not None and e.subject not in subj_s:
+            continue
+        if obs_s is not None and e.observer not in obs_s:
+            continue
+        if min_round is not None and e.round < min_round:
+            continue
+        out.add(e.key())
+    return out
+
+
+def diff_event_streams(a, b, **filters):
+    """(only_in_a, only_in_b) timing-free key sets — the two sides of a
+    model-vs-oracle trace diff.  Empty/empty means parity."""
+    ka, kb = event_key_set(a, **filters), event_key_set(b, **filters)
+    return ka - kb, kb - ka
+
+
+class OracleTraceCollector:
+    """Collects the oracle's trace stream into the shared numeric schema.
+
+    The oracle emits (event_type, subject Member, incarnation) per
+    observer through ``MembershipProtocol.listen_trace``; this adapter
+    maps members to integer node indices and quantizes virtual time to
+    protocol rounds (``sim.now // round_ms`` — the same base-round rule
+    as ClusterConfig.to_sim), producing the exact record layout the
+    tick's decoded trace yields (telemetry/trace.decode_events).
+    """
+
+    def __init__(self, sim, round_ms: int,
+                 index_of: Callable[[object], int]):
+        self.sim = sim
+        self.round_ms = round_ms
+        self.index_of = index_of
+        self.events: List[MembershipTraceEvent] = []
+
+    def watch(self, cluster, observer_index: Optional[int] = None) -> None:
+        """Subscribe to one oracle cluster's trace stream."""
+        obs = (self.index_of(cluster.member())
+               if observer_index is None else observer_index)
+
+        def on_trace(event_type, member, incarnation):
+            self.events.append(MembershipTraceEvent(
+                round=int(self.sim.now // self.round_ms),
+                observer=obs,
+                subject=self.index_of(member),
+                event_type=TraceEventType(event_type),
+                incarnation=int(incarnation),
+            ))
+
+        cluster.listen_trace(on_trace)

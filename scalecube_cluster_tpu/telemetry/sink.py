@@ -1,0 +1,321 @@
+"""Host-side telemetry sinks: JSONL run manifests + TensorBoard export.
+
+The reference's runs are observed through SLF4J logs and JMX monitors;
+the dense runs' equivalent durable surface is one JSONL file per run:
+
+    line 1: {"kind": "manifest", run id, schema, config digest, device
+             info, caller metadata}
+    then:   {"kind": "counters", ...}   per-chunk digested counter rows
+            {"kind": "histogram", ...}  named bucket histograms
+            {"kind": "events", ...}     batches of typed trace events
+            {"kind": "curve", ...}      per-round series (downsampled)
+            {"kind": "summary", ...}    closing totals
+
+Everything is line-delimited JSON so a run is greppable, appendable and
+stream-parseable; :func:`read_records` / :func:`read_events` round-trip
+it (pinned by tests/test_telemetry_sink.py).
+
+Sink directory resolution: explicit argument, else the
+``SCALECUBE_TPU_TELEMETRY_DIR`` env var, else the caller's default
+(bench.py uses ``artifacts/telemetry``).  The TensorBoard exporter
+follows the repo's existing profiling convention: it activates only
+when ``SCALECUBE_TPU_PROFILE_DIR`` is set (utils/runlog.profiled uses
+the same gate) and degrades to a no-op if no TensorBoard writer package
+is importable — never a hard dependency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from scalecube_cluster_tpu.telemetry.events import MembershipTraceEvent
+
+SCHEMA_VERSION = 1
+TELEMETRY_DIR_ENV = "SCALECUBE_TPU_TELEMETRY_DIR"
+PROFILE_DIR_ENV = "SCALECUBE_TPU_PROFILE_DIR"
+
+# Counter names digested into a counters row (the same families
+# utils/runlog.log_metrics_summary prints; per-subject [rounds, K]
+# traces sum over subjects).
+_COUNTER_NAMES = (
+    "messages_gossip", "messages_ping", "messages_ping_sent",
+    "messages_ping_req_sent", "refutations", "false_positives",
+    "false_suspicion_onsets", "false_suspect_rounds", "stale_view_rounds",
+)
+
+
+def new_run_id(prefix: str = "run") -> str:
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    return f"{prefix}-{stamp}-{os.urandom(3).hex()}"
+
+
+def config_digest(params) -> str:
+    """Stable 12-hex digest of a run configuration.
+
+    Accepts a dataclass (SwimParams, ClusterConfig, ...) or a plain
+    dict; same knobs -> same digest across processes, so manifests from
+    different runs of one configuration are groupable.
+    """
+    if dataclasses.is_dataclass(params) and not isinstance(params, type):
+        obj = dataclasses.asdict(params)
+    elif isinstance(params, dict):
+        obj = params
+    else:
+        obj = {"repr": repr(params)}
+    blob = json.dumps(obj, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:12]
+
+
+def device_info() -> dict:
+    """Backend + device census, robust to an uninitializable backend."""
+    try:
+        import jax
+
+        devs = jax.devices()
+        return {
+            "backend": jax.default_backend(),
+            "device_count": len(devs),
+            "device_kind": devs[0].device_kind if devs else None,
+        }
+    except Exception as e:  # noqa: BLE001 — telemetry must not kill a run
+        return {"backend": "unavailable", "error": f"{type(e).__name__}: {e}"}
+
+
+def counters_row(metrics: dict, round_offset: int = 0,
+                 label: Optional[str] = None) -> dict:
+    """Digest one chunk of per-round metric traces into a counters row.
+
+    Same input contract as runlog.log_metrics_summary: a dict of
+    [n_rounds, ...] traces from models/swim.run.  Scalar-trace counters
+    are summed over the chunk; per-subject traces sum over subjects too.
+    An empty metrics dict produces an empty (but valid) row.
+    """
+    row: dict = {"label": label, "round_offset": round_offset}
+    n_rounds = 0
+    for v in metrics.values():
+        n_rounds = int(np.asarray(v).shape[0])
+        break
+    row["n_rounds"] = n_rounds
+    for name in _COUNTER_NAMES:
+        if name in metrics:
+            row[name] = int(np.asarray(metrics[name]).sum())
+    return row
+
+
+class TelemetrySink:
+    """One JSONL run manifest under a sink directory (module docstring)."""
+
+    def __init__(self, out_dir: str, run_id: Optional[str] = None,
+                 prefix: str = "run"):
+        self.run_id = run_id or new_run_id(prefix)
+        os.makedirs(out_dir, exist_ok=True)
+        self.path = os.path.join(out_dir, f"{self.run_id}.jsonl")
+        self._f = open(self.path, "w")
+        self._closed = False
+
+    @staticmethod
+    def from_env(default_dir: Optional[str] = None,
+                 prefix: str = "run") -> Optional["TelemetrySink"]:
+        """Sink in $SCALECUBE_TPU_TELEMETRY_DIR, else ``default_dir``,
+        else None (telemetry off)."""
+        out_dir = os.environ.get(TELEMETRY_DIR_ENV) or default_dir
+        if not out_dir:
+            return None
+        return TelemetrySink(out_dir, prefix=prefix)
+
+    # -- record writers ----------------------------------------------------
+
+    def _write(self, kind: str, payload: dict) -> None:
+        rec = {"kind": kind, "run_id": self.run_id}
+        rec.update(payload)
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def write_manifest(self, params=None, **extra) -> None:
+        self._write("manifest", {
+            "schema_version": SCHEMA_VERSION,
+            "wall_time": time.time(),
+            "config_digest": config_digest(params) if params is not None
+            else None,
+            "device": device_info(),
+            **extra,
+        })
+
+    def write_counters(self, metrics: dict, round_offset: int = 0,
+                       label: Optional[str] = None) -> None:
+        self._write("counters", counters_row(metrics, round_offset, label))
+
+    def write_events(self, events: Iterable[MembershipTraceEvent],
+                     dropped: int = 0, batch: int = 1000) -> None:
+        """Event batches (chunked so single lines stay parseable-sized);
+        ``dropped`` reports the trace buffer's overflow count so a
+        truncated trace is never mistaken for a complete one."""
+        events = list(events)
+        for i in range(0, len(events), batch):
+            self._write("events", {
+                "offset": i,
+                "events": [e.to_json() for e in events[i:i + batch]],
+            })
+        self._write("events_footer",
+                    {"recorded": len(events), "dropped": int(dropped)})
+
+    def write_histogram(self, name: str, edges: Sequence[int],
+                        counts: Sequence[int], **meta) -> None:
+        self._write("histogram", {
+            "name": name,
+            "edges": np.asarray(edges).tolist(),
+            "counts": np.asarray(counts).tolist(),
+            **meta,
+        })
+
+    def write_curve(self, name: str, values, round_offset: int = 0,
+                    max_points: int = 2048, **meta) -> None:
+        """A per-round series (e.g. fraction-informed-by-round),
+        stride-downsampled to ``max_points``."""
+        v = np.asarray(values)
+        stride = max(1, int(np.ceil(v.shape[0] / max_points)))
+        idx = list(range(0, v.shape[0], stride))
+        # Always keep the terminal sample (a dissemination curve's
+        # converged value) even when the stride would skip it.
+        if idx and idx[-1] != v.shape[0] - 1:
+            idx.append(v.shape[0] - 1)
+        self._write("curve", {
+            "name": name,
+            "round_offset": round_offset,
+            "stride": stride,
+            "values": v[idx].tolist(),
+            **meta,
+        })
+
+    def write_summary(self, **fields) -> None:
+        self._write("summary", fields)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._f.close()
+            self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# --------------------------------------------------------------------------
+# Readers (the round-trip half of the contract)
+# --------------------------------------------------------------------------
+
+
+def read_records(path: str, kind: Optional[str] = None) -> List[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if kind is None or rec.get("kind") == kind:
+                out.append(rec)
+    return out
+
+
+def read_events(path: str) -> List[MembershipTraceEvent]:
+    events: List[MembershipTraceEvent] = []
+    for rec in read_records(path, kind="events"):
+        events.extend(
+            MembershipTraceEvent.from_json(e) for e in rec["events"]
+        )
+    return events
+
+
+def fraction_informed_curve(dead_counts, n_live_observers: int):
+    """[rounds] fraction of live observers holding the death notice —
+    the dissemination curve, from the tick's per-round ``dead`` counts
+    for one subject column."""
+    v = np.asarray(dead_counts, dtype=np.float64)
+    return v / max(1, int(n_live_observers))
+
+
+# --------------------------------------------------------------------------
+# TensorBoard export (gated; never a hard dependency)
+# --------------------------------------------------------------------------
+
+
+def _summary_writer(logdir: str):
+    try:
+        from tensorboardX import SummaryWriter
+    except Exception:  # noqa: BLE001 — optional dependency
+        return None
+    return SummaryWriter(logdir=logdir)
+
+
+def export_tensorboard(logdir: str, run_id: str,
+                       scalars: Optional[Dict[str, Sequence]] = None,
+                       histograms: Optional[dict] = None,
+                       max_points: int = 1024) -> Optional[str]:
+    """Write scalar traces + bucket histograms as TensorBoard summaries.
+
+    ``scalars``: name -> per-round series (downsampled to max_points).
+    ``histograms``: name -> (edges, counts) bucket pairs.  Returns the
+    event-file directory, or None when no writer package is available.
+    """
+    path = os.path.join(logdir, run_id)
+    w = _summary_writer(path)
+    if w is None:
+        return None
+    try:
+        for name, series in (scalars or {}).items():
+            v = np.asarray(series)
+            if v.ndim > 1:
+                v = v.sum(axis=tuple(range(1, v.ndim)))
+            stride = max(1, int(np.ceil(v.shape[0] / max_points)))
+            for step in range(0, v.shape[0], stride):
+                w.add_scalar(name, float(v[step]), global_step=step)
+        for name, (edges, counts) in (histograms or {}).items():
+            e = np.asarray(edges, dtype=np.float64)
+            c = np.asarray(counts, dtype=np.float64)
+            if c.sum() <= 0:
+                continue
+            # Bucket i covers [e[i], e[i+1]); the open last bucket gets a
+            # synthetic right edge so TB has a finite limit.
+            limits = np.append(e[1:], e[-1] * 2 + 1)
+            mids = (limits + e) / 2.0
+            w.add_histogram_raw(
+                name,
+                min=float(e[0]), max=float(limits[-1]),
+                num=int(c.sum()),
+                sum=float((mids * c).sum()),
+                sum_squares=float((mids * mids * c).sum()),
+                bucket_limits=limits.tolist(),
+                bucket_counts=c.tolist(),
+                global_step=0,
+            )
+    finally:
+        w.close()
+    return path
+
+
+def maybe_export_tensorboard(run_id: str,
+                             scalars: Optional[Dict[str, Sequence]] = None,
+                             histograms: Optional[dict] = None,
+                             log=None) -> Optional[str]:
+    """TensorBoard export gated behind SCALECUBE_TPU_PROFILE_DIR (the
+    repo's existing profiling-surface convention — runlog.profiled)."""
+    logdir = os.environ.get(PROFILE_DIR_ENV)
+    if not logdir:
+        return None
+    path = export_tensorboard(logdir, run_id, scalars, histograms)
+    if log is not None:
+        if path:
+            log.info("tensorboard telemetry written to %s", path)
+        else:
+            log.info("tensorboard export skipped (no writer package)")
+    return path

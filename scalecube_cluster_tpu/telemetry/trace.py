@@ -1,0 +1,349 @@
+"""The jit-carried membership event trace (the dense listener analog).
+
+The reference observes its protocol through per-event listener calls
+(MembershipProtocolImpl._emit, :543-588).  Inside a ``lax.scan`` no host
+call can run per event, so the trace is a fixed-capacity device buffer
+carried through the scan:
+
+  - :class:`EventTrace` — ``lanes [capacity, 5]`` int32:
+    (round, observer, subject, event_type, incarnation) per recorded
+    event, plus ``count`` (events written) and ``dropped`` (events that
+    arrived after the buffer filled).  Overflow is ALWAYS counted —
+    the decoded trace is an exact prefix of the event stream and
+    ``dropped`` says precisely how many events are missing; nothing is
+    silently truncated.
+  - :class:`TelemetryState` — the trace plus per-(observer, subject)
+    ``first_suspect`` / ``first_removed`` round matrices, the inputs of
+    the in-jit detection/removal latency histograms
+    (:func:`latency_histograms` — no per-round host round trips).
+
+Event detection is transition-based: :func:`derive_event_codes` compares
+the carry's (status, incarnation) before and after one ``swim_tick``
+(models/swim.py) and emits the NET transition per cell — the same five
+types the oracle's merge funnel emits through ``listen_trace``
+(telemetry/events.py has the schema + the per-round collapse caveat).
+A crashed observer's rows are frozen by the tick, so a stopped node
+emits nothing — exactly a stopped JVM.
+
+Cost: recording flattens one ``[N, K]`` int8 code matrix per round
+(a cumsum + one scatter).  It is OFF unless requested
+(``models/swim.run_traced``); the untraced hot path is untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalecube_cluster_tpu import records
+from scalecube_cluster_tpu.telemetry.events import (
+    MembershipTraceEvent,
+    TraceEventType,
+)
+
+INT32_MAX = jnp.iinfo(jnp.int32).max
+
+# Default event-buffer capacity: comfortably above the 2·N SUSPECTED +
+# REMOVED events of a crash scenario at the telemetry-scenario scales
+# (bench.py caps its traced scenario well below this), small enough
+# (65536 × 5 lanes × 4 B = 1.3 MB) to be free next to any carry.
+DEFAULT_CAPACITY = 1 << 16
+
+# Latency histogram bucket edges, in protocol rounds.  Bucket i covers
+# [edges[i], edges[i+1]); the last bucket is open-ended.  Roughly
+# geometric: detection latencies cluster at a few probe cycles, removal
+# adds the suspicion timeout, so the range spans both regimes.
+DEFAULT_LATENCY_EDGES = (0, 1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64,
+                         96, 128, 192, 256, 384, 512)
+
+_N_LANES = 5  # (round, observer, subject, event_type, incarnation)
+
+
+# --------------------------------------------------------------------------
+# Carried state
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class EventTrace:
+    """Fixed-capacity event buffer (module docstring).
+
+    ``lanes[i] = (round, observer, subject, event_type, incarnation)``
+    for i < ``count``, in (round, observer-major cell) order — the
+    deterministic serialization of each round's transitions.
+    """
+
+    lanes: jnp.ndarray      # [capacity, 5] int32
+    count: jnp.ndarray      # int32 scalar: events recorded (<= capacity)
+    dropped: jnp.ndarray    # int32 scalar: events lost to overflow
+
+    @property
+    def capacity(self) -> int:
+        return self.lanes.shape[0]
+
+    @staticmethod
+    def empty(capacity: int = DEFAULT_CAPACITY) -> "EventTrace":
+        return EventTrace(
+            lanes=jnp.full((capacity, _N_LANES), -1, dtype=jnp.int32),
+            count=jnp.int32(0),
+            dropped=jnp.int32(0),
+        )
+
+
+jax.tree_util.register_dataclass(
+    EventTrace, data_fields=["lanes", "count", "dropped"], meta_fields=[]
+)
+
+
+@dataclasses.dataclass
+class TelemetryState:
+    """Scan-carried telemetry: the event buffer + first-transition rounds.
+
+    ``first_suspect``/``first_removed`` [N, K] int32: the first round
+    observer i turned subject-slot k SUSPECT / DEAD (INT32_MAX = never)
+    — the per-observer detection/removal samples the latency histograms
+    reduce over.
+    """
+
+    trace: EventTrace
+    first_suspect: jnp.ndarray
+    first_removed: jnp.ndarray
+
+    @staticmethod
+    def init(n_members: int, n_subjects: int,
+             capacity: int = DEFAULT_CAPACITY) -> "TelemetryState":
+        full = jnp.full((n_members, n_subjects), INT32_MAX, dtype=jnp.int32)
+        return TelemetryState(
+            trace=EventTrace.empty(capacity),
+            first_suspect=full,
+            first_removed=full,
+        )
+
+
+jax.tree_util.register_dataclass(
+    TelemetryState,
+    data_fields=["trace", "first_suspect", "first_removed"],
+    meta_fields=[],
+)
+
+
+# --------------------------------------------------------------------------
+# Per-round recording (called inside the scan body)
+# --------------------------------------------------------------------------
+
+
+def derive_event_codes(prev_status, prev_inc, new_status, new_inc,
+                       is_self, leaving_now, self_inc):
+    """(codes, incarnations) of this round's net cell transitions.
+
+    ``codes`` [N, K] int8: 0 = no event, else TraceEventType + 1.  The
+    (prev, new) status pair determines at most one transition per cell
+    (events.py maps each to its reference merge-funnel line):
+
+      ABSENT/DEAD -> ALIVE   ADDED        (tombstone re-add included —
+                                           delete-then-re-add, :512-516)
+      !SUSPECT    -> SUSPECT SUSPECTED
+      SUSPECT     -> ALIVE   ALIVE_REFUTED
+      !DEAD       -> DEAD    REMOVED
+
+    Self cells are pinned by the tick (never transition); the one self
+    event is LEAVING, injected from the world's leave schedule with the
+    announced incarnation self_inc + 1 (leaveCluster's DEAD@inc+1).
+    """
+    prev = prev_status
+    new = new_status
+    added = ((prev == records.ABSENT) | (prev == records.DEAD)) \
+        & (new == records.ALIVE)
+    suspected = (new == records.SUSPECT) & (prev != records.SUSPECT)
+    refuted = (prev == records.SUSPECT) & (new == records.ALIVE)
+    removed = (new == records.DEAD) & (prev != records.DEAD)
+
+    code = jnp.zeros(prev.shape, dtype=jnp.int8)
+    code = jnp.where(added, jnp.int8(TraceEventType.ADDED + 1), code)
+    code = jnp.where(suspected, jnp.int8(TraceEventType.SUSPECTED + 1), code)
+    code = jnp.where(refuted, jnp.int8(TraceEventType.ALIVE_REFUTED + 1),
+                     code)
+    code = jnp.where(removed, jnp.int8(TraceEventType.REMOVED + 1), code)
+    code = jnp.where(is_self, jnp.int8(0), code)
+    code = jnp.where(leaving_now, jnp.int8(TraceEventType.LEAVING + 1), code)
+
+    inc = jnp.asarray(new_inc, jnp.int32)
+    inc = jnp.where(leaving_now,
+                    jnp.asarray(self_inc, jnp.int32)[:, None] + 1, inc)
+    return code, inc
+
+
+def record_events(trace: EventTrace, round_idx, codes, incarnations,
+                  subject_ids, observer_offset: int = 0) -> EventTrace:
+    """Compact this round's coded cells into the event buffer.
+
+    A prefix-sum assigns each event its slot (row-major cell order —
+    deterministic); slots past capacity are dropped by the scatter's
+    out-of-bounds mode and counted in ``dropped``.  One cumsum + one
+    scatter; no host round trip.
+    """
+    n, k = codes.shape
+    cap = trace.capacity
+    flat_code = codes.reshape(-1)
+    has = flat_code > 0
+    slot = trace.count + jnp.cumsum(has.astype(jnp.int32)) - 1
+    idx = jnp.where(has & (slot < cap), slot, cap)   # cap = OOB -> dropped
+
+    observer = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32)[:, None] + observer_offset, (n, k)
+    ).reshape(-1)
+    subject = jnp.broadcast_to(
+        jnp.asarray(subject_ids, jnp.int32)[None, :], (n, k)
+    ).reshape(-1)
+    rows = jnp.stack([
+        jnp.full((n * k,), round_idx, dtype=jnp.int32),
+        observer,
+        subject,
+        flat_code.astype(jnp.int32) - 1,
+        incarnations.reshape(-1),
+    ], axis=1)
+
+    lanes = trace.lanes.at[idx].set(rows, mode="drop")
+    total = jnp.sum(has, dtype=jnp.int32)
+    new_count = jnp.minimum(trace.count + total, cap)
+    new_dropped = trace.dropped + total - (new_count - trace.count)
+    return EventTrace(lanes=lanes, count=new_count, dropped=new_dropped)
+
+
+def observe_round(tel: TelemetryState, round_idx, prev_status, prev_inc,
+                  new_state, world, observer_offset: int = 0
+                  ) -> TelemetryState:
+    """One round's telemetry update: derive transitions, record them,
+    advance the first-suspect/first-removed matrices.
+
+    ``prev_status``/``prev_inc`` are the carry fields BEFORE the tick,
+    ``new_state`` the SwimState after; both in their stored layout (the
+    int16 compact-carry incarnation upcasts losslessly below its
+    saturation point).  Called from models/swim.run_traced inside the
+    scan body.
+    """
+    n = prev_status.shape[0]
+    node_ids = jnp.arange(n, dtype=jnp.int32) + observer_offset
+    is_self = jnp.asarray(world.subject_ids, jnp.int32)[None, :] \
+        == node_ids[:, None]
+    leaving_now = (world.leave_at[node_ids] == round_idx)[:, None] & is_self
+
+    codes, ev_inc = derive_event_codes(
+        prev_status, prev_inc, new_state.status, new_state.inc,
+        is_self, leaving_now, new_state.self_inc,
+    )
+    trace = record_events(tel.trace, round_idx, codes, ev_inc,
+                          world.subject_ids, observer_offset)
+
+    suspected = codes == jnp.int8(TraceEventType.SUSPECTED + 1)
+    removed = codes == jnp.int8(TraceEventType.REMOVED + 1)
+    first_suspect = jnp.where(
+        suspected & (tel.first_suspect == INT32_MAX), round_idx,
+        tel.first_suspect,
+    )
+    first_removed = jnp.where(
+        removed & (tel.first_removed == INT32_MAX), round_idx,
+        tel.first_removed,
+    )
+    return TelemetryState(trace=trace, first_suspect=first_suspect,
+                          first_removed=first_removed)
+
+
+# --------------------------------------------------------------------------
+# In-jit derived metrics
+# --------------------------------------------------------------------------
+
+
+def _bucketize(values, edges):
+    e = jnp.asarray(edges, jnp.int32)
+    idx = jnp.searchsorted(e, values, side="right") - 1
+    return jnp.clip(idx, 0, len(edges) - 1)
+
+
+def latency_histograms(tel: TelemetryState, world,
+                       edges: Sequence[int] = DEFAULT_LATENCY_EDGES,
+                       ref_rounds=None) -> dict:
+    """Detection/removal latency histograms per subject, on device.
+
+    Latency of observer i for subject slot k = first transition round
+    minus the subject's fault round (``ref_rounds`` [K]; default: the
+    earlier of the subject's crash and leave rounds from the world
+    schedule).  Subjects with no scheduled fault (or transitions that
+    precede it — false positives) are excluded; ``*_undetected`` counts
+    observers that never transitioned for a faulted subject.
+
+    Returns {"edges": [B], "detection": [K, B], "removal": [K, B],
+    "detection_undetected": [K], "removal_undetected": [K]} of device
+    arrays — pure jnp, callable under jit (no host round trips).
+    """
+    subject_ids = jnp.asarray(world.subject_ids, jnp.int32)
+    if ref_rounds is None:
+        ref_rounds = jnp.minimum(world.down_from[subject_ids],
+                                 world.leave_at[subject_ids])
+    ref = jnp.asarray(ref_rounds, jnp.int32)
+    n = tel.first_suspect.shape[0]
+    k = subject_ids.shape[0]
+    b = len(edges)
+    is_self = subject_ids[None, :] == jnp.arange(n, dtype=jnp.int32)[:, None]
+    faulted = (ref != INT32_MAX)[None, :]
+
+    out = {"edges": jnp.asarray(edges, jnp.int32)}
+    for name, first in (("detection", tel.first_suspect),
+                        ("removal", tel.first_removed)):
+        lat = first - ref[None, :]
+        valid = (first != INT32_MAX) & faulted & (lat >= 0) & ~is_self
+        bucket = _bucketize(lat, edges)
+        flat = jnp.where(
+            valid,
+            jnp.arange(k, dtype=jnp.int32)[None, :] * b + bucket,
+            k * b,
+        ).reshape(-1)
+        counts = jnp.zeros((k * b,), jnp.int32).at[flat].add(
+            1, mode="drop"
+        ).reshape(k, b)
+        out[name] = counts
+        out[name + "_undetected"] = jnp.sum(
+            (first == INT32_MAX) & faulted & ~is_self, axis=0,
+            dtype=jnp.int32,
+        )
+    return out
+
+
+# --------------------------------------------------------------------------
+# Host-side decoding
+# --------------------------------------------------------------------------
+
+
+def decode_events(trace_or_tel) -> list:
+    """Device buffer -> typed ``MembershipTraceEvent`` list (host side).
+
+    Accepts an :class:`EventTrace` or a :class:`TelemetryState`.  The
+    result is the exact recorded prefix of the event stream, in
+    (round, observer-major cell) order; ``trace.dropped`` says how many
+    later events the capacity cut off.
+    """
+    trace = getattr(trace_or_tel, "trace", trace_or_tel)
+    lanes = np.asarray(trace.lanes)
+    count = int(trace.count)
+    return [
+        MembershipTraceEvent(
+            round=int(lanes[i, 0]),
+            observer=int(lanes[i, 1]),
+            subject=int(lanes[i, 2]),
+            event_type=TraceEventType(int(lanes[i, 3])),
+            incarnation=int(lanes[i, 4]),
+        )
+        for i in range(count)
+    ]
+
+
+def histograms_to_json(hists: dict) -> dict:
+    """Device histogram dict -> plain-python JSONL-ready form."""
+    out = {}
+    for name, v in hists.items():
+        out[name] = np.asarray(v).tolist()
+    return out

@@ -28,14 +28,23 @@ _FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
 
 
 def get_logger(name: str = "scalecube_tpu", level=None) -> logging.Logger:
-    """Package logger; level from SCALECUBE_TPU_LOGLEVEL (default INFO)."""
+    """Package logger; level from SCALECUBE_TPU_LOGLEVEL (default INFO).
+
+    The resolved level is applied on EVERY call (an explicit ``level``
+    argument wins over the env var), so repeat calls with a new level
+    take effect regardless of whether the handler already exists.
+    ``level`` may be a logging constant (including 0 == NOTSET) or a
+    name like ``"DEBUG"``.
+    """
     logger = logging.getLogger(name)
     if not logger.handlers:
         handler = logging.StreamHandler(sys.stderr)
         handler.setFormatter(logging.Formatter(_FORMAT))
         logger.addHandler(handler)
         logger.propagate = False
-    logger.setLevel(level or os.environ.get("SCALECUBE_TPU_LOGLEVEL", "INFO"))
+    if level is None:
+        level = os.environ.get("SCALECUBE_TPU_LOGLEVEL", "INFO")
+    logger.setLevel(level)
     return logger
 
 
@@ -45,8 +54,14 @@ def log_metrics_summary(log: logging.Logger, metrics: dict,
 
     ``metrics`` is the dict of [n_rounds, ...] traces returned by
     models/swim.run: status counts, false_positives, messages_*,
-    refutations.
+    refutations.  An empty dict logs a "no metrics" line instead of
+    crashing (a zero-round chunk or a filtered-out trace is a valid
+    input at a chunk boundary).
     """
+    if not metrics:
+        log.info("rounds starting at %d: no metrics to summarize",
+                 round_offset)
+        return
     n_rounds = len(np.asarray(next(iter(metrics.values()))))
     last = round_offset + n_rounds - 1
 

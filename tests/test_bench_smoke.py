@@ -1,0 +1,77 @@
+"""bench.py --smoke: the fast CPU-safe pass that keeps the telemetry
+wiring honest.
+
+The bench is the one entry point every round's measurements flow
+through; its telemetry stage (traced crash scenario -> JSONL manifest
+with latency histogram buckets) must not silently rot, so this tier-1
+test runs the real script in a subprocess and asserts the published
+contract: one JSON line on stdout, a parseable manifest with
+detection-latency BUCKETS (a distribution, not a mean), and zero event
+drops at the default trace capacity.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_bench_smoke_emits_result_and_manifest(tmp_path):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_TPU_TELEMETRY_DIR=str(tmp_path),
+        SCALECUBE_XLA_CACHE_DIR="",           # no cache writes from tests
+    )
+    env.pop("SCALECUBE_TPU_PROFILE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=540, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout      # exactly ONE JSON line
+    result = json.loads(lines[0])
+
+    assert "error" not in result, result
+    assert "telemetry_error" not in result, result
+    assert result["smoke"] is True
+    assert result["value"] and result["value"] > 0
+    assert result["dissemination_rounds"] > 0
+
+    # The telemetry contract: manifest path, zero drops, real buckets.
+    tele = result["telemetry"]
+    assert tele["event_drops"] == 0
+    assert tele["events_recorded"] > 0
+    hist = tele["detection_latency_hist"]
+    assert len(hist["counts"]) == len(hist["edges"]) > 1
+    assert sum(hist["counts"]) > 0
+
+    # And the manifest itself round-trips through the sink reader.
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+
+    path = tele["manifest"]
+    assert os.path.dirname(path) == str(tmp_path)
+    kinds = {r["kind"] for r in tsink.read_records(path)}
+    assert {"manifest", "counters", "histogram", "curve", "events",
+            "summary"} <= kinds
+    (manifest,) = tsink.read_records(path, kind="manifest")
+    assert manifest["config_digest"]
+    assert manifest["workload"]["smoke"] is True
+    (summary,) = tsink.read_records(path, kind="summary")
+    assert summary["event_drops"] == 0
+    events = tsink.read_events(path)
+    assert len(events) == tele["events_recorded"]
+    # The crash victim's SUSPECTED/REMOVED stream is what filled the
+    # histogram: every live observer contributes one detection sample.
+    n = manifest["scenario"]["n_members"]
+    victim = manifest["scenario"]["crash_node"]
+    suspected = {e.observer for e in events
+                 if e.event_type.name == "SUSPECTED"
+                 and e.subject == victim}
+    assert len(suspected) == n - 1
+    assert sum(hist["counts"]) == n - 1

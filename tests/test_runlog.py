@@ -39,6 +39,41 @@ def test_log_metrics_summary_digests_counters(caplog):
     assert f"tracked-subject probe verdicts {verdicts}" in msg
 
 
+def test_log_metrics_summary_empty_metrics_logs_no_metrics_line(caplog):
+    """An empty metrics dict (a zero-round chunk at a checkpoint
+    boundary) must log a 'no metrics' line, not raise StopIteration."""
+    logger = runlog.get_logger("test_runlog_empty")
+    logger.propagate = True
+    with caplog.at_level(logging.INFO, logger="test_runlog_empty"):
+        runlog.log_metrics_summary(logger, {}, round_offset=500)
+    assert len(caplog.records) == 1
+    msg = caplog.records[0].getMessage()
+    assert "no metrics" in msg and "500" in msg
+
+
+def test_get_logger_reapplies_level_on_repeat_calls():
+    """The resolved level applies on EVERY call — a later explicit
+    ``level`` must take effect even though the handler already exists."""
+    name = "test_runlog_levels"
+    logger = runlog.get_logger(name, level=logging.WARNING)
+    assert logger.level == logging.WARNING
+    assert len(logger.handlers) == 1
+    logger = runlog.get_logger(name, level=logging.DEBUG)
+    assert logger.level == logging.DEBUG
+    assert len(logger.handlers) == 1          # no handler duplication
+    logger = runlog.get_logger(name, level="ERROR")
+    assert logger.level == logging.ERROR
+
+
+def test_get_logger_level_from_env(monkeypatch):
+    monkeypatch.setenv("SCALECUBE_TPU_LOGLEVEL", "WARNING")
+    logger = runlog.get_logger("test_runlog_env_level")
+    assert logger.level == logging.WARNING
+    # Explicit argument beats the env var.
+    logger = runlog.get_logger("test_runlog_env_level", level="DEBUG")
+    assert logger.level == logging.DEBUG
+
+
 def test_profiled_noop_without_env(monkeypatch):
     monkeypatch.delenv("SCALECUBE_TPU_PROFILE_DIR", raising=False)
     with runlog.profiled():

@@ -1,0 +1,177 @@
+"""JSONL run-manifest sink + TensorBoard exporter (telemetry/sink.py).
+
+Pins the round-trip contract (write -> parse -> same typed events), the
+manifest invariants (run id, schema version, stable config digest,
+device info), counter-row digestion (incl. the empty-metrics edge), and
+the exporter's env gating.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.telemetry import sink as tsink
+from scalecube_cluster_tpu.telemetry.events import (
+    MembershipTraceEvent,
+    TraceEventType,
+)
+
+
+def sample_events(n=7):
+    return [
+        MembershipTraceEvent(
+            round=10 + i, observer=i, subject=3,
+            event_type=TraceEventType(i % 5), incarnation=i % 3,
+        )
+        for i in range(n)
+    ]
+
+
+def test_events_roundtrip(tmp_path):
+    """write -> parse -> the same typed event list, with the drop count
+    carried alongside so a truncated trace is never silently complete."""
+    events = sample_events(2500)          # spans multiple batches
+    with tsink.TelemetrySink(str(tmp_path), prefix="t") as sink:
+        sink.write_events(events, dropped=4)
+        path = sink.path
+    assert tsink.read_events(path) == events
+    footer = tsink.read_records(path, kind="events_footer")
+    assert footer == [{"kind": "events_footer", "run_id": sink.run_id,
+                       "recorded": 2500, "dropped": 4}]
+
+
+def test_manifest_fields_and_digest_stability(tmp_path):
+    cfg = ClusterConfig.default()
+    params = swim.SwimParams.from_config(cfg, n_members=64, n_subjects=16)
+    with tsink.TelemetrySink(str(tmp_path)) as sink:
+        sink.write_manifest(params=params, workload={"n": 64})
+    (manifest,) = tsink.read_records(sink.path, kind="manifest")
+    assert manifest["schema_version"] == tsink.SCHEMA_VERSION
+    assert manifest["run_id"] == sink.run_id
+    assert manifest["workload"] == {"n": 64}
+    assert "backend" in manifest["device"]
+    # Digest is a pure function of the knobs: same params -> same digest,
+    # any knob change -> different digest.
+    assert manifest["config_digest"] == tsink.config_digest(params)
+    same = swim.SwimParams.from_config(cfg, n_members=64, n_subjects=16)
+    other = swim.SwimParams.from_config(cfg, n_members=64, n_subjects=16,
+                                        loss_probability=0.1)
+    assert tsink.config_digest(same) == manifest["config_digest"]
+    assert tsink.config_digest(other) != manifest["config_digest"]
+
+
+def test_counters_histogram_curve_records(tmp_path):
+    metrics = {
+        "messages_gossip": np.arange(10, dtype=np.int32),
+        "false_positives": np.ones((10, 4), dtype=np.int32),
+        "dead": np.zeros((10, 4), dtype=np.int32),
+    }
+    with tsink.TelemetrySink(str(tmp_path)) as sink:
+        sink.write_counters(metrics, round_offset=100, label="chunk_0")
+        sink.write_counters({}, label="empty_chunk")   # must not crash
+        sink.write_histogram("detection_latency_rounds",
+                             edges=[0, 1, 2, 4], counts=[5, 0, 3, 1],
+                             subject=3)
+        sink.write_curve("fraction_informed", np.linspace(0, 1, 5000),
+                         subject=3)
+        sink.write_summary(event_drops=0)
+
+    rows = tsink.read_records(sink.path, kind="counters")
+    assert rows[0]["label"] == "chunk_0"
+    assert rows[0]["round_offset"] == 100
+    assert rows[0]["n_rounds"] == 10
+    assert rows[0]["messages_gossip"] == 45
+    assert rows[0]["false_positives"] == 40     # per-subject trace summed
+    assert rows[1] == {"kind": "counters", "run_id": sink.run_id,
+                       "label": "empty_chunk", "round_offset": 0,
+                       "n_rounds": 0}
+
+    (hist,) = tsink.read_records(sink.path, kind="histogram")
+    assert hist["name"] == "detection_latency_rounds"
+    assert hist["edges"] == [0, 1, 2, 4]
+    assert hist["counts"] == [5, 0, 3, 1]
+    assert hist["subject"] == 3
+
+    (curve,) = tsink.read_records(sink.path, kind="curve")
+    assert len(curve["values"]) <= 2048           # downsampled
+    assert curve["values"][0] == 0.0
+
+    (summary,) = tsink.read_records(sink.path, kind="summary")
+    assert summary["event_drops"] == 0
+
+
+def test_from_env_resolution(tmp_path, monkeypatch):
+    monkeypatch.delenv(tsink.TELEMETRY_DIR_ENV, raising=False)
+    assert tsink.TelemetrySink.from_env() is None
+    sink = tsink.TelemetrySink.from_env(default_dir=str(tmp_path / "a"))
+    assert sink is not None and sink.path.startswith(str(tmp_path / "a"))
+    sink.close()
+    monkeypatch.setenv(tsink.TELEMETRY_DIR_ENV, str(tmp_path / "b"))
+    sink = tsink.TelemetrySink.from_env(default_dir=str(tmp_path / "a"))
+    assert sink is not None and sink.path.startswith(str(tmp_path / "b"))
+    sink.close()
+
+
+def test_tensorboard_export_gated_off_without_env(monkeypatch):
+    monkeypatch.delenv(tsink.PROFILE_DIR_ENV, raising=False)
+    assert tsink.maybe_export_tensorboard("run-x",
+                                          scalars={"a": [1, 2]}) is None
+
+
+def test_tensorboard_export_writes_event_files(tmp_path, monkeypatch):
+    pytest.importorskip("tensorboardX")
+    monkeypatch.setenv(tsink.PROFILE_DIR_ENV, str(tmp_path))
+    path = tsink.maybe_export_tensorboard(
+        "run-y",
+        scalars={"telemetry/dead_views": np.arange(50)},
+        histograms={"telemetry/detection":
+                    ([0, 1, 2, 4], [3, 2, 1, 0])},
+    )
+    assert path is not None
+    produced = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(path) for f in files
+    ]
+    assert produced, "exporter wrote no event files"
+
+
+def test_bench_manifest_shape_end_to_end(tmp_path):
+    """The full pipeline at test scale: a traced crash run digested
+    through the sink exactly the way bench.py writes it, then read back
+    — the manifest carries histogram BUCKETS (distributions, not means)
+    and a zero drop count."""
+    import jax
+
+    from scalecube_cluster_tpu.telemetry import trace as ttrace
+
+    cfg = ClusterConfig.default_local().replace(
+        gossip_interval=100, ping_interval=200, ping_timeout=100,
+        sync_interval=1_000, suspicion_mult=3,
+    )
+    params = swim.SwimParams.from_config(cfg, n_members=16,
+                                         delivery="shift")
+    world = swim.SwimWorld.healthy(params).with_crash(3, at_round=10)
+    _, tel, metrics = swim.run_traced(jax.random.key(0), params, world, 90)
+    hists = ttrace.latency_histograms(tel, world)
+
+    with tsink.TelemetrySink(str(tmp_path), prefix="bench") as sink:
+        sink.write_manifest(params=params)
+        sink.write_counters(metrics, label="scenario")
+        sink.write_histogram(
+            "detection_latency_rounds",
+            np.asarray(hists["edges"]), np.asarray(hists["detection"])[3],
+            subject=3,
+        )
+        sink.write_events(ttrace.decode_events(tel),
+                          dropped=int(tel.trace.dropped))
+        sink.write_summary(event_drops=int(tel.trace.dropped))
+
+    (hist,) = tsink.read_records(sink.path, kind="histogram")
+    assert sum(hist["counts"]) == 15 and len(hist["counts"]) > 1
+    (summary,) = tsink.read_records(sink.path, kind="summary")
+    assert summary["event_drops"] == 0
+    assert tsink.read_events(sink.path) == ttrace.decode_events(tel)

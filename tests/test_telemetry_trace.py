@@ -1,0 +1,366 @@
+"""The on-device membership event trace (telemetry/trace.py + run_traced).
+
+The trace is observability doubling as a correctness surface: the tick's
+decoded event stream and the oracle's merge-funnel trace
+(``MembershipProtocol.listen_trace``) speak one schema
+(telemetry/events.py), so a fault scenario's event streams are directly
+diffable across layers.  Rounds are stochastic, so parity compares the
+timing-free key sets (observer, subject, type, incarnation) — which ARE
+deterministic for scenarios that run to quiescence.
+
+Also pinned here: ring-buffer overflow accounting (drops counted, the
+recorded prefix exact — never silent truncation), record-order
+determinism, the in-jit latency histograms against a host-side
+recomputation from the decoded events, and the graceful-leave LEAVING
+event.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.oracle import Cluster, Simulator
+from scalecube_cluster_tpu.telemetry import trace as ttrace
+from scalecube_cluster_tpu.telemetry.events import (
+    MembershipTraceEvent,
+    OracleTraceCollector,
+    TraceEventType,
+    diff_event_streams,
+    event_key_set,
+)
+
+N = 16
+ROUND_MS = 100
+VICTIM = 3
+
+# The sped-up two-layer config of tests/test_cross_validation.py:
+# suspicion resolves in 30 rounds, so scenarios quiesce fast.
+CFG = ClusterConfig.default_local().replace(
+    gossip_interval=ROUND_MS,
+    ping_interval=200,
+    ping_timeout=100,
+    sync_interval=1_000,
+    suspicion_mult=3,
+)
+
+SUSPECTED = TraceEventType.SUSPECTED
+REMOVED = TraceEventType.REMOVED
+ADDED = TraceEventType.ADDED
+ALIVE_REFUTED = TraceEventType.ALIVE_REFUTED
+LEAVING = TraceEventType.LEAVING
+
+
+def make_params(**overrides):
+    return swim.SwimParams.from_config(CFG, n_members=N, **overrides)
+
+
+def build_oracle(seed: int):
+    """N warmed-up oracle clusters with integer-aliased members and one
+    attached trace collector."""
+    sim = Simulator(seed=seed)
+    clusters = [Cluster.join(sim, config=CFG, alias="m0")]
+    for i in range(1, N):
+        clusters.append(
+            Cluster.join(sim, seeds=[clusters[0].address], config=CFG,
+                         alias=f"m{i}")
+        )
+    sim.run_for(4_000)
+    assert all(len(c.members()) == N for c in clusters), "warmup incomplete"
+    collector = OracleTraceCollector(
+        sim, ROUND_MS, index_of=lambda m: int(m.id[1:])
+    )
+    for i, c in enumerate(clusters):
+        collector.watch(c, observer_index=i)
+    return sim, clusters, collector
+
+
+def observers():
+    return [i for i in range(N) if i != VICTIM]
+
+
+# --------------------------------------------------------------------------
+# Model-vs-oracle event-stream parity
+# --------------------------------------------------------------------------
+
+
+class TestCrashParity:
+    """A crash-at-round-k scenario: the decoded model trace's
+    SUSPECTED/REMOVED events must match the oracle's event stream
+    exactly (the acceptance criterion).  The comparison excludes the
+    victim-as-observer: the oracle's stopped transport leaves the
+    victim's scheduler running (it falsely suspects everyone), while
+    the dense crash freezes the whole row — the documented crash-model
+    difference; every LIVE observer's stream must agree."""
+
+    def oracle_keys(self, seed=0):
+        sim, clusters, collector = build_oracle(seed)
+        clusters[VICTIM].transport.stop()
+        sim.run_for(120 * ROUND_MS)
+        return event_key_set(
+            collector.events, types=[SUSPECTED, REMOVED],
+            subjects=[VICTIM], observers=observers(),
+        )
+
+    @pytest.mark.parametrize("delivery", ["scatter", "shift"])
+    def test_crash_suspected_removed_match_oracle(self, delivery):
+        oracle_keys = self.oracle_keys()
+        params = make_params(delivery=delivery)
+        world = swim.SwimWorld.healthy(params).with_crash(
+            VICTIM, at_round=0
+        )
+        _, tel, _ = swim.run_traced(jax.random.key(0), params, world, 120)
+        assert int(tel.trace.dropped) == 0
+        model_keys = event_key_set(
+            ttrace.decode_events(tel), types=[SUSPECTED, REMOVED],
+            subjects=[VICTIM],
+        )
+        only_model, only_oracle = (model_keys - oracle_keys,
+                                   oracle_keys - model_keys)
+        assert not only_model and not only_oracle, (only_model, only_oracle)
+        # And both equal the closed-form expectation: every live observer
+        # suspects, then removes, the victim at incarnation 0.
+        expected = {
+            (o, VICTIM, int(t), 0)
+            for o in observers() for t in (SUSPECTED, REMOVED)
+        }
+        assert model_keys == expected
+
+    def test_crash_revive_readd_matches_oracle(self):
+        """Crash long enough for full removal, then revive: every live
+        observer re-accepts the victim (ADDED at the old incarnation —
+        the delete-then-re-add path) on BOTH layers.  The oracle's
+        'crash' is a full link blockade (its transport has no restart);
+        the blockade and the frozen dense crash agree on everything a
+        live observer can see."""
+        down_at, up_at, horizon = 0, 70, 160
+
+        sim, clusters, collector = build_oracle(seed=1)
+        victim = clusters[VICTIM]
+        rest = [c for c in clusters if c is not victim]
+        victim.network_emulator.block([c.address for c in rest])
+        for c in rest:
+            c.network_emulator.block(victim.address)
+        sim.run_for((up_at - down_at) * ROUND_MS)
+        assert all(len(c.members()) == N - 1 for c in rest), \
+            "oracle removal incomplete before revival"
+        for c in clusters:
+            c.network_emulator.unblock_all()
+        sim.run_for((horizon - up_at) * ROUND_MS)
+
+        oracle_crash = event_key_set(
+            collector.events, types=[SUSPECTED, REMOVED],
+            subjects=[VICTIM], observers=observers(),
+        )
+        oracle_readd = event_key_set(
+            collector.events, types=[ADDED], subjects=[VICTIM],
+            observers=observers(), min_round=up_at,
+        )
+
+        params = make_params(delivery="shift")
+        world = swim.SwimWorld.healthy(params).with_crash(
+            VICTIM, at_round=down_at, until_round=up_at
+        )
+        _, tel, _ = swim.run_traced(jax.random.key(1), params, world,
+                                    horizon)
+        assert int(tel.trace.dropped) == 0
+        events = ttrace.decode_events(tel)
+        model_crash = event_key_set(
+            events, types=[SUSPECTED, REMOVED], subjects=[VICTIM],
+        )
+        model_readd = event_key_set(
+            events, types=[ADDED], subjects=[VICTIM], min_round=up_at,
+        )
+        assert model_crash == oracle_crash, \
+            diff_event_streams(events, collector.events,
+                               types=[SUSPECTED, REMOVED],
+                               subjects=[VICTIM], observers=observers())
+        assert model_readd == oracle_readd
+        assert model_readd == {(o, VICTIM, int(ADDED), 0)
+                               for o in observers()}
+
+
+def test_short_crash_refutation_events():
+    """A crash shorter than the suspicion timeout: the revived node
+    refutes (incarnation bump) and observers' SUSPECT entries resolve by
+    ALIVE_REFUTED — nobody ever emits REMOVED.  (Which observers
+    suspected before the revival is seed-dependent, so this asserts the
+    model's event semantics rather than cross-layer set equality.)"""
+    params = make_params(delivery="shift")
+    world = swim.SwimWorld.healthy(params).with_crash(
+        VICTIM, at_round=5, until_round=15
+    )
+    state, tel, _ = swim.run_traced(jax.random.key(2), params, world, 120)
+    events = ttrace.decode_events(tel)
+    refuted = [e for e in events
+               if e.event_type == ALIVE_REFUTED and e.subject == VICTIM]
+    suspected = [e for e in events
+                 if e.event_type == SUSPECTED and e.subject == VICTIM]
+    assert suspected, "nobody suspected the briefly-crashed node"
+    assert refuted, "no refutation event reached any observer"
+    assert all(e.incarnation >= 1 for e in refuted)
+    assert int(np.asarray(state.self_inc)[VICTIM]) >= 1
+    assert not [e for e in events
+                if e.event_type == REMOVED and e.subject == VICTIM]
+
+
+def test_graceful_leave_events():
+    """with_leave: the leaver announces LEAVING@inc+1 in its final round
+    (one event, observer == subject) and every live observer REMOVEs it
+    at the announced incarnation — the oracle's leaveCluster surface."""
+    leaver, leave_at = 5, 10
+    params = make_params(delivery="shift")
+    world = swim.SwimWorld.healthy(params).with_leave(leaver, at_round=leave_at)
+    _, tel, _ = swim.run_traced(jax.random.key(3), params, world, 60)
+    events = ttrace.decode_events(tel)
+    leaving = [e for e in events if e.event_type == LEAVING]
+    assert leaving == [MembershipTraceEvent(
+        round=leave_at, observer=leaver, subject=leaver,
+        event_type=LEAVING, incarnation=1,
+    )]
+    removed = event_key_set(events, types=[REMOVED], subjects=[leaver])
+    assert removed == {(o, leaver, int(REMOVED), 1)
+                      for o in range(N) if o != leaver}
+
+
+def test_oracle_leave_emits_leaving_trace():
+    """The oracle side of the LEAVING surface: leave_cluster emits one
+    LEAVING trace record at incarnation + 1, and the leaver's death
+    disseminates as REMOVED@1 at the observers."""
+    sim, clusters, collector = build_oracle(seed=4)
+    clusters[VICTIM].shutdown()
+    sim.run_for(60 * ROUND_MS)
+    leaving = [e for e in collector.events if e.event_type == LEAVING]
+    assert [(e.observer, e.subject, e.incarnation) for e in leaving] == \
+        [(VICTIM, VICTIM, 1)]
+    removed = event_key_set(collector.events, types=[REMOVED],
+                            subjects=[VICTIM], observers=observers())
+    assert removed == {(o, VICTIM, int(REMOVED), 1) for o in observers()}
+
+
+# --------------------------------------------------------------------------
+# Buffer mechanics
+# --------------------------------------------------------------------------
+
+
+def run_crash(capacity=ttrace.DEFAULT_CAPACITY, seed=0, rounds=120):
+    params = make_params(delivery="shift")
+    world = swim.SwimWorld.healthy(params).with_crash(VICTIM, at_round=0)
+    return swim.run_traced(jax.random.key(seed), params, world, rounds,
+                           trace_capacity=capacity)
+
+
+def test_overflow_counts_drops_exactly():
+    """A too-small buffer records an exact prefix and counts every
+    dropped event — count + dropped equals the untruncated stream's
+    length, and the recorded events are its prefix (never silent
+    truncation, never corruption)."""
+    _, tel_full, _ = run_crash()
+    full_events = ttrace.decode_events(tel_full)
+    assert int(tel_full.trace.dropped) == 0
+
+    cap = 7
+    _, tel_small, _ = run_crash(capacity=cap)
+    small_events = ttrace.decode_events(tel_small)
+    assert int(tel_small.trace.count) == cap
+    assert len(small_events) == cap
+    assert int(tel_small.trace.count) + int(tel_small.trace.dropped) \
+        == len(full_events)
+    assert small_events == full_events[:cap]
+
+
+def test_trace_is_deterministic():
+    _, tel_a, _ = run_crash(seed=9)
+    _, tel_b, _ = run_crash(seed=9)
+    assert np.array_equal(np.asarray(tel_a.trace.lanes),
+                          np.asarray(tel_b.trace.lanes))
+    assert int(tel_a.trace.count) == int(tel_b.trace.count)
+
+
+def test_trace_resumes_across_chunks():
+    """Chunked scans (the checkpointing pattern) thread the telemetry
+    carry through: two 60-round chunks equal one 120-round trace."""
+    params = make_params(delivery="shift")
+    world = swim.SwimWorld.healthy(params).with_crash(VICTIM, at_round=0)
+    key = jax.random.key(5)
+    _, tel_once, _ = swim.run_traced(key, params, world, 120)
+
+    state = swim.initial_state(params, world)
+    tel = None
+    for chunk_start in (0, 60):
+        state, tel, _ = swim.run_traced(
+            key, params, world, 60, state=state, start_round=chunk_start,
+            telemetry=tel,
+        )
+    assert ttrace.decode_events(tel) == ttrace.decode_events(tel_once)
+
+
+def test_healthy_run_is_silent():
+    """No faults, warm start: the trace records nothing (every event is
+    a real transition, not noise)."""
+    params = make_params(delivery="shift")
+    world = swim.SwimWorld.healthy(params)
+    _, tel, _ = swim.run_traced(jax.random.key(6), params, world, 80)
+    assert int(tel.trace.count) == 0
+    assert int(tel.trace.dropped) == 0
+
+
+# --------------------------------------------------------------------------
+# In-jit latency histograms
+# --------------------------------------------------------------------------
+
+
+def test_latency_histograms_match_decoded_events():
+    """The on-device histograms equal a host-side recomputation from the
+    decoded event stream — same buckets, same counts, and distribution
+    (not just mean) granularity."""
+    crash_at = 10
+    params = make_params(delivery="shift")
+    world = swim.SwimWorld.healthy(params).with_crash(
+        VICTIM, at_round=crash_at
+    )
+    _, tel, _ = swim.run_traced(jax.random.key(8), params, world, 120)
+    hists = ttrace.latency_histograms(tel, world)
+    edges = np.asarray(hists["edges"])
+    events = ttrace.decode_events(tel)
+
+    for name, etype in (("detection", SUSPECTED), ("removal", REMOVED)):
+        firsts = {}
+        for e in events:
+            if e.event_type == etype and e.subject == VICTIM:
+                firsts.setdefault(e.observer, e.round)
+        lat = np.asarray(sorted(r - crash_at for r in firsts.values()))
+        expected = np.zeros(len(edges), dtype=np.int64)
+        for v in lat:
+            expected[np.searchsorted(edges, v, side="right") - 1] += 1
+        got = np.asarray(hists[name])[VICTIM]
+        assert np.array_equal(got, expected), (name, got, expected)
+        assert got.sum() == N - 1          # every live observer sampled
+        assert int(np.asarray(hists[name + "_undetected"])[VICTIM]) == 0
+
+    # Healthy subjects contribute no latency samples (false-positive
+    # transitions would be pre-fault and are excluded by construction).
+    other = [k for k in range(N) if k != VICTIM]
+    assert np.asarray(hists["detection"])[other].sum() == 0
+
+
+def test_latency_histograms_undetected_accounting():
+    """Observers that never see the fault land in the undetected count:
+    truncate the run before the suspicion timeout fires — detection
+    samples exist, removal samples don't."""
+    crash_at = 5
+    params = make_params(delivery="shift")
+    world = swim.SwimWorld.healthy(params).with_crash(
+        VICTIM, at_round=crash_at
+    )
+    # Long enough to suspect (a probe cycle or two), far short of the
+    # 30-round suspicion timeout.
+    _, tel, _ = swim.run_traced(jax.random.key(10), params, world,
+                                crash_at + 10)
+    hists = ttrace.latency_histograms(tel, world)
+    det = np.asarray(hists["detection"])[VICTIM]
+    assert det.sum() + int(np.asarray(hists["detection_undetected"])[VICTIM]) \
+        == N - 1
+    assert np.asarray(hists["removal"])[VICTIM].sum() == 0
+    assert int(np.asarray(hists["removal_undetected"])[VICTIM]) == N - 1
