@@ -27,15 +27,30 @@ buckets from a traced crash scenario, the event stream itself) under
 telemetry/sink.py; a TensorBoard export of the same data activates when
 ``SCALECUBE_TPU_PROFILE_DIR`` is set.
 
+Traced-vs-untraced: the timed window is measured BOTH ways by default —
+the untraced ``swim.run`` hot path and the traced path through
+``telemetry.sink.stream_traced_run`` (round-fused scan, donated carry,
+device→host trace offload overlapped with the next segment).  The JSON
+line carries ``untraced_member_rounds_per_sec``,
+``traced_member_rounds_per_sec`` and ``traced_overhead_ratio``
+(untraced/traced; 1.0 = telemetry is free).  ``--untraced``/``--traced``
+restrict to one path for debugging; ``--gap-artifact [PATH]``
+additionally writes a BENCH_*-style artifact pinning the measured gap.
+
 ``--smoke``: a fast CPU-safe pass (small N, few rounds, no canary) that
-exercises the full pipeline — timed run, dissemination probe, traced
-telemetry scenario, JSONL manifest — so the wiring can't silently rot;
-pinned by tests/test_bench_smoke.py.
+exercises the full pipeline — both timed paths (fused + traced +
+overlapped offload included), dissemination probe, traced telemetry
+scenario, JSONL manifest — so the wiring can't silently rot; pinned by
+tests/test_bench_smoke.py.
 
 Env overrides for debugging: SCALECUBE_BENCH_N, SCALECUBE_BENCH_ROUNDS,
 SCALECUBE_BENCH_DELIVERY, SCALECUBE_BENCH_SKIP_CANARY,
 SCALECUBE_BENCH_COMPACT (=1: the capacity-oriented compact carry layout,
-SwimParams.compact_carry).
+SwimParams.compact_carry), SCALECUBE_BENCH_ROUNDS_PER_STEP (scan round
+fusion, SwimParams.rounds_per_step; default resolves per backend — 4
+off-CPU, 1 on XLA:CPU where unrolling measured slower),
+SCALECUBE_TPU_TRACE_SEGMENT_ROUNDS (overlapped-offload segment length;
+default: a quarter of the timed window).
 """
 
 import argparse
@@ -61,6 +76,28 @@ N_SUBJECTS = None if _subj == "full" else int(_subj)
 BENCH_ROUNDS = int(os.environ.get("SCALECUBE_BENCH_ROUNDS", 1000))
 DELIVERY = os.environ.get("SCALECUBE_BENCH_DELIVERY", "shift")
 COMPACT = os.environ.get("SCALECUBE_BENCH_COMPACT", "") == "1"
+# Scan round fusion (SwimParams.rounds_per_step): K ticks per scan step,
+# bit-identical outputs — applied to BOTH timed paths.  Unset = chosen
+# per backend by measurement: 4 off-CPU (amortizes per-step scan
+# dispatch/carry fix-ups), 1 on XLA:CPU, where BOTH the native
+# ``lax.scan(..., unroll=K)`` and the manual K-unrolled body measured
+# SLOWER than the plain scan (untraced ~1.3x, traced ~3x at K=4,
+# N=256..4096) — the same backend-priced-differently pattern as
+# compact_carry/int16_wire.
+_RPS_ENV = os.environ.get("SCALECUBE_BENCH_ROUNDS_PER_STEP")
+ROUNDS_PER_STEP = int(_RPS_ENV) if _RPS_ENV else None
+
+
+def resolve_rounds_per_step():
+    """Backend-dependent default (module comment); call after init."""
+    global ROUNDS_PER_STEP
+    if ROUNDS_PER_STEP is None:
+        import jax
+
+        ROUNDS_PER_STEP = 1 if jax.default_backend() == "cpu" else 4
+    return ROUNDS_PER_STEP
+
+
 CANARY_N = 4096
 # Traced telemetry scenario size cap (events scale ~2N; trace capacity is
 # telemetry.trace.DEFAULT_CAPACITY = 65536, so 4096 leaves >8x headroom —
@@ -74,8 +111,8 @@ def apply_smoke_preset():
     env overrides still win (same precedence as the full bench)."""
     global SMOKE, N_MEMBERS, BENCH_ROUNDS, TELEMETRY_N
     SMOKE = True
-    N_MEMBERS = int(os.environ.get("SCALECUBE_BENCH_N", 256))
-    BENCH_ROUNDS = int(os.environ.get("SCALECUBE_BENCH_ROUNDS", 40))
+    N_MEMBERS = int(os.environ.get("SCALECUBE_BENCH_N", 1024))
+    BENCH_ROUNDS = int(os.environ.get("SCALECUBE_BENCH_ROUNDS", 80))
     TELEMETRY_N = min(TELEMETRY_N, 256)
     os.environ.setdefault("SCALECUBE_BENCH_SKIP_CANARY", "1")
 
@@ -114,25 +151,15 @@ def init_backend():
     return jax, "cpu(fallback)"
 
 
-def timed_run(jax, n_members, rounds, label):
-    """Compile + steady-state-time a run; returns (member-rounds/sec,
-    metrics traces of the timed window).
+def bench_workload(n_members):
+    """The shared (params, world, key) of every timed path — traced and
+    untraced must measure the SAME program modulo the trace, or the
+    overhead ratio is meaningless."""
+    import jax
 
-    The timed region is wrapped in ``runlog.profiled`` — a no-op unless
-    ``SCALECUBE_TPU_PROFILE_DIR`` is set, in which case a ``jax.profiler``
-    step trace lands there (the input to experiments/profile_roofline.py's
-    kernel table), and the run's protocol counters are digested through
-    ``runlog.log_metrics_summary`` (the reference-style per-period logs,
-    SURVEY.md §5.1).
-    """
     from scalecube_cluster_tpu.config import ClusterConfig
     from scalecube_cluster_tpu.models import swim
-    from scalecube_cluster_tpu.utils import runlog
 
-    def force(state):
-        return runlog.completion_barrier(state.status)
-
-    rlog = runlog.get_logger("bench")
     params = swim.SwimParams.from_config(
         ClusterConfig.default(),
         n_members=n_members,
@@ -141,9 +168,35 @@ def timed_run(jax, n_members, rounds, label):
         per_subject_metrics=True,
         delivery=DELIVERY,
         compact_carry=COMPACT,
+        rounds_per_step=resolve_rounds_per_step(),
     )
-    world = swim.SwimWorld.healthy(params).with_crash(3, at_round=50)
-    key = jax.random.key(0)
+    # Crash early enough that the SUSPECTED wave completes inside the
+    # warmup window even on the 80-round smoke config: the timed window
+    # then measures the representative telemetry-on steady state (the
+    # wave itself is timed at full scale, where warmup spans it anyway).
+    world = swim.SwimWorld.healthy(params).with_crash(3, at_round=10)
+    return params, world, jax.random.key(0)
+
+
+def timed_run(jax, n_members, rounds, label):
+    """Compile + steady-state-time an untraced run; returns
+    (member-rounds/sec, metrics traces of the timed window).
+
+    The timed region is wrapped in ``runlog.profiled`` — a no-op unless
+    ``SCALECUBE_TPU_PROFILE_DIR`` is set, in which case a ``jax.profiler``
+    step trace lands there (the input to experiments/profile_roofline.py's
+    kernel table), and the run's protocol counters are digested through
+    ``runlog.log_metrics_summary`` (the reference-style per-period logs,
+    SURVEY.md §5.1).
+    """
+    from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.utils import runlog
+
+    def force(state):
+        return runlog.completion_barrier(state.status)
+
+    rlog = runlog.get_logger("bench")
+    params, world, key = bench_workload(n_members)
 
     t0 = time.perf_counter()
     state = swim.initial_state(params, world)
@@ -154,21 +207,186 @@ def timed_run(jax, n_members, rounds, label):
     force(state)
     log(f"{label}: compile+first-run took {time.perf_counter() - t0:.1f}s")
 
-    t0 = time.perf_counter()
-    with runlog.profiled(rlog):
-        state, metrics = swim.run(
-            key, params, world, rounds, state=state, start_round=rounds
-        )
-        force(state)
-    elapsed = time.perf_counter() - t0
+    # Short smoke windows are host-noise-sensitive (±40% per-window
+    # swings measured on a shared box): time several consecutive
+    # steady-state windows and keep the best (the full bench's
+    # 1000-round window stays a single measurement, comparable with the
+    # round-1..5 artifacts).
+    reps = 6 if SMOKE else 1
+    elapsed, metrics = None, None
+    for rep in range(reps):
+        t0 = time.perf_counter()
+        with runlog.profiled(rlog):
+            state, metrics = swim.run(
+                key, params, world, rounds, state=state,
+                start_round=rounds * (1 + rep),
+            )
+            force(state)
+        elapsed = (time.perf_counter() - t0 if elapsed is None
+                   else min(elapsed, time.perf_counter() - t0))
     rate = n_members * rounds / elapsed
-    log(f"{label}: {rounds} rounds in {elapsed:.3f}s -> {rate:.3e} "
-        f"member-rounds/sec")
-    runlog.log_metrics_summary(rlog, metrics, round_offset=rounds)
-    # Sanity: the crash at round 50 must eventually be noticed.
+    log(f"{label}: {rounds} rounds in {elapsed:.3f}s (best of {reps}) -> "
+        f"{rate:.3e} member-rounds/sec")
+    # The logged/returned metrics are the LAST rep's window, which
+    # started at rounds * reps.
+    runlog.log_metrics_summary(rlog, metrics, round_offset=rounds * reps)
+    # Sanity: the crash at round 10 must eventually be noticed (DEAD
+    # views need the ~suspicion_rounds timeout, so expect 0 on short
+    # smoke windows where only the SUSPECT wave fits).
     dead_total = int(jax.numpy.asarray(metrics["dead"]).sum())
     log(f"{label}: dead-view observer-rounds in window: {dead_total}")
     return rate, metrics
+
+
+def traced_window_policy(n_members, rounds):
+    """(segment_rounds, trace_capacity) of a timed traced window —
+    shared by timed_traced_run and timed_both so --traced measures the
+    SAME program as the default both-paths mode.  Segment default: a
+    quarter of the window (>= 4 overlap segments even on smoke); env
+    override wins.  Per-SEGMENT capacity scales with the workload: the
+    scan carries (and functionally updates) the whole lane buffer every
+    event round, so at small N an oversized buffer IS the traced
+    overhead (65536 slots are ~20x the entire N=256 carry)."""
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+    from scalecube_cluster_tpu.telemetry import trace as ttrace
+
+    seg_env = os.environ.get(tsink.TRACE_SEGMENT_ENV)
+    seg = int(seg_env) if seg_env else max(1, rounds // 4)
+    cap = min(ttrace.DEFAULT_CAPACITY, max(4 * n_members, 4096))
+    return seg, cap
+
+
+def timed_traced_run(jax, n_members, rounds, label):
+    """The SAME timed window with telemetry ON, through the segmented
+    overlapped-offload driver (telemetry.sink.stream_traced_run).
+
+    The measured time INCLUDES the device→host trace offload (that cost
+    is the point of the overlap) but not host-side event decoding
+    (``decode=False`` — python-object construction is a consumer cost,
+    not a device-pipeline one).  Returns member-rounds/sec.
+    """
+    from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+    from scalecube_cluster_tpu.utils import runlog
+
+    def force(state):
+        return runlog.completion_barrier(state.status)
+
+    params, world, key = bench_workload(n_members)
+    seg, cap = traced_window_policy(n_members, rounds)
+
+    t0 = time.perf_counter()
+    state = swim.initial_state(params, world)
+    state, _ = tsink.stream_traced_run(
+        key, params, world, rounds, state=state, segment_rounds=seg,
+        trace_capacity=cap, decode=False,
+    )
+    force(state)
+    log(f"{label}: compile+first-run took {time.perf_counter() - t0:.1f}s")
+
+    reps = 6 if SMOKE else 1          # best-of policy mirrors timed_run
+    elapsed, res = None, None
+    for rep in range(reps):
+        t0 = time.perf_counter()
+        state, res = tsink.stream_traced_run(
+            key, params, world, rounds, state=state,
+            start_round=rounds * (1 + rep),
+            segment_rounds=seg, trace_capacity=cap, decode=False,
+        )
+        force(state)
+        elapsed = (time.perf_counter() - t0 if elapsed is None
+                   else min(elapsed, time.perf_counter() - t0))
+    rate = n_members * rounds / elapsed
+    log(f"{label}: {rounds} rounds in {elapsed:.3f}s (best of {reps}) -> "
+        f"{rate:.3e} member-rounds/sec traced ({res.n_segments} segments "
+        f"of {seg}, {res.recorded} events, {res.dropped} dropped)")
+    return rate
+
+
+def timed_both(jax, n_members, rounds, label):
+    """Both timed paths with their windows INTERLEAVED (untraced window,
+    traced window, repeat): host-speed drift — frequency scaling, a
+    noisy neighbor calming down — then biases both rates equally
+    instead of whichever path happened to run second, which a
+    back-to-back measurement mis-read as a (negative!) trace overhead.
+    Returns (untraced_rate, untraced_metrics, traced_rate).
+    """
+    from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+    from scalecube_cluster_tpu.telemetry import trace as ttrace
+    from scalecube_cluster_tpu.utils import runlog
+
+    def force(state):
+        return runlog.completion_barrier(state.status)
+
+    rlog = runlog.get_logger("bench")
+    params, world, key = bench_workload(n_members)
+    seg, cap = traced_window_policy(n_members, rounds)
+
+    t0 = time.perf_counter()
+    u_state = swim.initial_state(params, world)
+    u_state, _ = swim.run(key, params, world, rounds, state=u_state,
+                          start_round=0)
+    force(u_state)
+    t_state = swim.initial_state(params, world)
+    t_state, _ = tsink.stream_traced_run(
+        key, params, world, rounds, state=t_state, segment_rounds=seg,
+        trace_capacity=cap, decode=False,
+    )
+    force(t_state)
+    log(f"{label}: compile+first-run (both paths) took "
+        f"{time.perf_counter() - t0:.1f}s")
+
+    reps = 6 if SMOKE else 1
+    u_best = t_best = None
+    u_metrics, res = None, None
+    for rep in range(reps):
+        start = rounds * (1 + rep)
+
+        def run_untraced():
+            nonlocal u_state, u_metrics, u_best
+            t0 = time.perf_counter()
+            with runlog.profiled(rlog):
+                u_state, u_metrics = swim.run(
+                    key, params, world, rounds, state=u_state,
+                    start_round=start,
+                )
+                force(u_state)
+            dt = time.perf_counter() - t0
+            u_best = dt if u_best is None else min(u_best, dt)
+
+        def run_traced_seg():
+            nonlocal t_state, res, t_best
+            t0 = time.perf_counter()
+            t_state, res = tsink.stream_traced_run(
+                key, params, world, rounds, state=t_state,
+                start_round=start, segment_rounds=seg,
+                trace_capacity=cap, decode=False,
+            )
+            force(t_state)
+            dt = time.perf_counter() - t0
+            t_best = dt if t_best is None else min(t_best, dt)
+
+        # Alternate which path goes first each rep: interleaving cancels
+        # slow host-speed drift, alternation cancels the residual
+        # whoever-runs-second-is-warmer bias within a rep pair.
+        pair = ((run_untraced, run_traced_seg) if rep % 2 == 0
+                else (run_traced_seg, run_untraced))
+        for f in pair:
+            f()
+    u_rate = n_members * rounds / u_best
+    t_rate = n_members * rounds / t_best
+    log(f"{label}: untraced {u_best:.3f}s vs traced {t_best:.3f}s per "
+        f"{rounds}-round window (best of {reps}, interleaved) -> "
+        f"{u_rate:.3e} / {t_rate:.3e} member-rounds/sec "
+        f"({res.n_segments} offload segments of {seg}, {res.recorded} "
+        f"events, {res.dropped} dropped)")
+    # The logged/returned metrics are the LAST rep's window, which
+    # started at rounds * reps.
+    runlog.log_metrics_summary(rlog, u_metrics, round_offset=rounds * reps)
+    dead_total = int(jax.numpy.asarray(u_metrics["dead"]).sum())
+    log(f"{label}: dead-view observer-rounds in window: {dead_total}")
+    return u_rate, u_metrics, t_rate
 
 
 def dissemination_at_scale(jax, n_members):
@@ -189,6 +407,7 @@ def dissemination_at_scale(jax, n_members):
         n_members=n_members,
         n_subjects=N_SUBJECTS,
         delivery=DELIVERY,
+        rounds_per_step=resolve_rounds_per_step(),
     )
     world = swim.SwimWorld.healthy(params).with_leave(3, at_round=10)
     _, metrics = swim.run(jax.random.key(1), params, world, 60)
@@ -208,12 +427,16 @@ def telemetry_scenario(jax):
 
     Runs at min(N_MEMBERS, TELEMETRY_N) so the ~2N SUSPECTED+REMOVED
     events sit far below the default trace capacity (zero drops is part
-    of the contract, asserted in the manifest summary).
+    of the contract, asserted in the manifest summary).  Driven through
+    the segmented overlapped-offload path (stream_traced_run) so every
+    bench invocation — including --smoke on CPU — exercises the fused +
+    traced + overlapped pipeline end to end.
     """
     import numpy as np
 
     from scalecube_cluster_tpu.config import ClusterConfig
     from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.telemetry import sink as tsink
     from scalecube_cluster_tpu.telemetry import trace as ttrace
 
     n = min(N_MEMBERS, TELEMETRY_N)
@@ -225,27 +448,32 @@ def telemetry_scenario(jax):
     )
     params = swim.SwimParams.from_config(
         cfg, n_members=n, n_subjects=min(16, n), delivery=DELIVERY,
+        rounds_per_step=resolve_rounds_per_step(),
     )
     crash_node = 3
     world = swim.SwimWorld.healthy(params).with_crash(
         crash_node, at_round=TELEMETRY_CRASH_AT
     )
     rounds = params.suspicion_rounds + 80
-    _, tel, metrics = swim.run_traced(
-        jax.random.key(7), params, world, rounds
+    # >= 3 segments so the dispatch-ahead/harvest-behind overlap really
+    # cycles (env still overrides through stream_traced_run's default).
+    _, res = tsink.stream_traced_run(
+        jax.random.key(7), params, world, rounds,
+        segment_rounds=max(1, rounds // 3),
     )
-    hists = ttrace.latency_histograms(tel, world)
-    events = ttrace.decode_events(tel)
-    log(f"telemetry@{n}: {int(tel.trace.count)} events recorded, "
-        f"{int(tel.trace.dropped)} dropped "
-        f"(capacity {tel.trace.capacity})")
+    hists = ttrace.latency_histograms(res.telemetry, world)
+    events = res.events
+    metrics = res.metrics
+    log(f"telemetry@{n}: {res.recorded} events recorded, "
+        f"{res.dropped} dropped (capacity {res.capacity}, "
+        f"{res.n_segments} offload segments)")
     return {
         "params": params,
         "metrics": metrics,
         "events": events,
-        "recorded": int(tel.trace.count),
-        "dropped": int(tel.trace.dropped),
-        "capacity": int(tel.trace.capacity),
+        "recorded": res.recorded,
+        "dropped": res.dropped,
+        "capacity": res.capacity,
         "edges": np.asarray(hists["edges"]).tolist(),
         "detection_buckets": np.asarray(hists["detection"])[crash_node].tolist(),
         "removal_buckets": np.asarray(hists["removal"])[crash_node].tolist(),
@@ -278,6 +506,7 @@ def write_telemetry(scenario, main_metrics):
             "bench_rounds": BENCH_ROUNDS,
             "delivery": DELIVERY,
             "compact_carry": COMPACT,
+            "rounds_per_step": resolve_rounds_per_step(),
             "smoke": SMOKE,
         },
         scenario={
@@ -289,7 +518,10 @@ def write_telemetry(scenario, main_metrics):
         },
     )
     if main_metrics is not None:
-        sink.write_counters(main_metrics, round_offset=BENCH_ROUNDS,
+        # The metrics are the last best-of rep's window (timed_run /
+        # timed_both): it started at BENCH_ROUNDS * reps.
+        reps = 6 if SMOKE else 1
+        sink.write_counters(main_metrics, round_offset=BENCH_ROUNDS * reps,
                             label="main_timed_window")
     sink.write_counters(scenario["metrics"], label="telemetry_scenario")
     hist_meta = dict(subject=scenario["crash_node"],
@@ -342,8 +574,29 @@ def main():
         help="fast CPU-safe pass (small N, few rounds, no canary) that "
              "still exercises the full pipeline incl. telemetry",
     )
+    mode = parser.add_mutually_exclusive_group()
+    mode.add_argument(
+        "--untraced", action="store_true",
+        help="time only the untraced hot path (default: both, plus the "
+             "traced_overhead_ratio)",
+    )
+    mode.add_argument(
+        "--traced", action="store_true",
+        help="time only the traced path (overlapped trace offload)",
+    )
+    parser.add_argument(
+        "--gap-artifact", nargs="?", const="BENCH_traced_overhead.json",
+        default=None, metavar="PATH",
+        help="also write a BENCH_*-style JSON artifact pinning the "
+             "traced-vs-untraced gap (default path when bare: "
+             "BENCH_traced_overhead.json)",
+    )
     try:
         args = parser.parse_args()
+        if args.gap_artifact and (args.traced or args.untraced):
+            parser.error(
+                "--gap-artifact pins the traced-vs-untraced gap and needs "
+                "BOTH paths measured; drop --traced/--untraced")
     except SystemExit as e:
         # The one-JSON-line contract holds even for a bad argv: argparse
         # already printed its usage message to stderr; ship the error
@@ -384,13 +637,52 @@ def main():
                 "do not read as throughput"
             )
 
-        rate, main_metrics = timed_run(jax, N_MEMBERS, BENCH_ROUNDS,
-                                       f"main@{N_MEMBERS}")
+        rate = None
+        if args.untraced:
+            rate, main_metrics = timed_run(jax, N_MEMBERS, BENCH_ROUNDS,
+                                           f"main@{N_MEMBERS}")
+            result["untraced_member_rounds_per_sec"] = round(rate, 1)
+        elif args.traced:
+            rate = timed_traced_run(jax, N_MEMBERS, BENCH_ROUNDS,
+                                    f"traced@{N_MEMBERS}")
+            result["traced_member_rounds_per_sec"] = round(rate, 1)
+        else:
+            rate, main_metrics, traced_rate = timed_both(
+                jax, N_MEMBERS, BENCH_ROUNDS, f"main@{N_MEMBERS}"
+            )
+            result["untraced_member_rounds_per_sec"] = round(rate, 1)
+            result["traced_member_rounds_per_sec"] = round(traced_rate, 1)
+        if ("untraced_member_rounds_per_sec" in result
+                and "traced_member_rounds_per_sec" in result):
+            # > 1.0 = telemetry still costs device time; 1.0 = free.
+            result["traced_overhead_ratio"] = round(
+                result["untraced_member_rounds_per_sec"]
+                / result["traced_member_rounds_per_sec"], 4)
+        # The headline ``value`` stays the untraced hot-path rate (the
+        # round-1..5 artifact series); --traced makes it the traced rate.
         result["value"] = round(rate, 1)
         result["vs_baseline"] = round(rate / NORTH_STAR_RATE, 3)
         result["n_members"] = N_MEMBERS
         result["rounds_timed"] = BENCH_ROUNDS
         result["delivery"] = DELIVERY
+        result["rounds_per_step"] = resolve_rounds_per_step()
+        if args.gap_artifact and "traced_overhead_ratio" in result:
+            gap = {
+                "metric": "traced_vs_untraced_member_rounds_per_sec",
+                "untraced": result["untraced_member_rounds_per_sec"],
+                "traced": result["traced_member_rounds_per_sec"],
+                "traced_overhead_ratio": result["traced_overhead_ratio"],
+                "n_members": N_MEMBERS,
+                "rounds_timed": BENCH_ROUNDS,
+                "rounds_per_step": resolve_rounds_per_step(),
+                "delivery": DELIVERY,
+                "smoke": SMOKE,
+                "platform": platform,
+            }
+            with open(args.gap_artifact, "w") as f:
+                json.dump(gap, f, indent=1)
+                f.write("\n")
+            log(f"traced-overhead artifact written to {args.gap_artifact}")
         result["dissemination_rounds"] = dissemination_at_scale(jax, N_MEMBERS)
     except BaseException as e:  # noqa: BLE001 — partial result by contract
         log(traceback.format_exc())

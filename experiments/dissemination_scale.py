@@ -104,10 +104,16 @@ def main():
 
     runlog.enable_compilation_cache()
 
+    # Round fusion: bit-identical scan outputs, less per-step dispatch
+    # (SwimParams.rounds_per_step) — 4 on device, 1 on the CPU fallback
+    # where unrolling measured slower (bench.resolve_rounds_per_step).
+    rounds_per_step = 1 if jax.default_backend() == "cpu" else 4
+
     def dissemination_rounds(n, seed=1):
         params = swim.SwimParams.from_config(
             ClusterConfig.default(), n_members=n, n_subjects=N_SUBJECTS,
             delivery="shift", compact_carry=n > COMPACT_ABOVE,
+            rounds_per_step=rounds_per_step,
         )
         world = swim.SwimWorld.healthy(params).with_leave(3, at_round=10)
         _, m = swim.run(jax.random.key(seed), params, world, 60)

@@ -115,7 +115,13 @@ try:
     }))
 except Exception as e:  # noqa: BLE001 — boundary classification by message
     msg = str(e)
-    helper = "compile_helper subprocess exit code" in msg
+    # Case-insensitive substring, not the exact phrase "compile_helper
+    # subprocess exit code": the helper's message wording has already
+    # drifted across toolchain builds, and a missed match silently
+    # reclassified helper deaths as generic (non-oom) failures.  The
+    # raw message is ALWAYS recorded alongside the flags, so even a
+    # misclassification stays diagnosable from the artifact.
+    helper = "compile_helper" in msg.lower()
     oom = not helper and ("RESOURCE_EXHAUSTED" in msg
                           or "Ran out of memory" in msg)
     print(json.dumps({"fits": False, "oom": oom, "helper_crash": helper,

@@ -30,6 +30,11 @@ from scalecube_cluster_tpu.utils.runlog import enable_compilation_cache
 N = 1_000_000
 K = 16
 ROUNDS = 10_000
+# Scan round fusion (SwimParams.rounds_per_step): bit-identical to the
+# unfused scan, amortizes per-step scan dispatch/carry fix-ups on
+# device; 1 on the CPU fallback, where unrolling measured slower
+# (bench.resolve_rounds_per_step has the numbers).
+ROUNDS_PER_STEP = 1 if jax.default_backend() == "cpu" else 4
 CRASH_NODE, CRASH_AT = 3, 500
 LEAVE_NODE, LEAVE_AT = 5, 2_000
 REVIVE_NODE, REVIVE_DOWN, REVIVE_UP = 7, 4_000, 7_000
@@ -61,6 +66,7 @@ def main():
     params = swim.SwimParams.from_config(
         ClusterConfig.default(), n_members=N, n_subjects=K,
         loss_probability=0.02, delivery="shift",
+        rounds_per_step=ROUNDS_PER_STEP,
     )
     world = (
         swim.SwimWorld.healthy(params)
@@ -193,6 +199,7 @@ def main():
     sweep_params = swim.SwimParams.from_config(
         ClusterConfig.default(), n_members=N, n_subjects=K,
         loss_probability=0.02, delivery="shift", fanout=3,
+        rounds_per_step=ROUNDS_PER_STEP,
     )
     sweep_world = swim.SwimWorld.healthy(sweep_params).with_crash(
         0, at_round=0

@@ -251,10 +251,28 @@ class SwimParams:
     # = periods_to_spread, the ClusterMath schedule shared with
     # membership records.  Metrics gain ``user_gossip_infected`` [G].
     n_user_gossips: int = 0
+    # Round fusion: ``run``/``run_traced`` scan a body that unrolls this
+    # many protocol ticks per scan step, amortising the scan's per-step
+    # carry layout fix-ups and dispatch over K rounds (an explicit
+    # K-unrolled body rather than ``lax.scan(..., unroll=K)``, so the
+    # stacked per-round metric rows stay inside one fused step instead
+    # of round-tripping the scan output buffers each round).  Outputs
+    # are BIT-IDENTICAL to the unfused path for any K: each tick's PRNG
+    # stream is a pure function of (base_key, round_idx) — not of scan
+    # position — and per-round counter rows / trace lanes are stacked
+    # [steps, K, ...] then reshaped back to [rounds, ...] in round
+    # order (tests/test_round_fusion.py).  A trailing n_rounds % K
+    # remainder runs through an unfused tail scan, so any (n_rounds, K)
+    # pair is legal.  1 = the classic one-tick-per-step scan.
+    rounds_per_step: int = 1
 
     def __post_init__(self):
         if self.delivery not in ("scatter", "shift"):
             raise ValueError(f"unknown delivery mode {self.delivery!r}")
+        if self.rounds_per_step < 1:
+            raise ValueError(
+                f"rounds_per_step must be >= 1 (got {self.rounds_per_step})"
+            )
         if self.delivery == "shift" and self.ping_known_only != self.full_view:
             # Shift mode has no known-only probe path at K < N (its FD
             # target is the shared offset; eligibility is evaluated at the
@@ -816,6 +834,24 @@ def initial_state(params: SwimParams, world: SwimWorld,
 # remaining-rounds encoding (decodes to INT32_MAX).
 _DEADLINE_NONE16 = 32767
 _INC_SAT16 = (1 << 13) - 1      # matches the int16 wire format's inc field
+_INC_SAT32 = (1 << 29) - 1      # records.merge_key's int32 inc field
+
+
+def _wire_inc_sat(params: "SwimParams") -> int:
+    """Largest incarnation the active wire-key format carries exactly
+    (records.merge_key16's 8191 / merge_key's 2^29-1 saturation point).
+
+    The carry must never hold an incarnation ABOVE this cap: past it the
+    packed keys of distinct incarnations collide, so the merge gate
+    (ops/delivery.merge_inbox's ``inbox_key > entry_key``) stops
+    distinguishing records the carry still could — wire and table would
+    silently disagree.  Incarnations only grow at the self-refutation
+    bump, which is clamped to this cap (_merge_and_timers); at the cap a
+    node can no longer refute (ALIVE@cap does not override SUSPECT@cap)
+    — a loud, pinned degradation (tests/test_wire16.py boundary tests)
+    instead of a silent wire/table divergence.
+    """
+    return _INC_SAT16 if params.compact_wire else _INC_SAT32
 
 
 def _carry_decode(state: SwimState, round_idx) -> SwimState:
@@ -1330,10 +1366,18 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
         win_status, win_inc, records.ALIVE, state.self_inc[:, None]
     )
     refuted = jnp.any(self_overridden, axis=1)
-    bumped_inc = jnp.maximum(
-        state.self_inc,
-        jnp.max(jnp.where(self_overridden, win_inc, 0), axis=1),
-    ) + 1
+    # The bump saturates at the wire key's incarnation cap (8191 on the
+    # int16 wire): the carry must never hold an incarnation the wire
+    # cannot express, or table and wire silently diverge at the merge
+    # gate (_wire_inc_sat docstring; the advisor finding at
+    # ops/delivery.py:189).
+    bumped_inc = jnp.minimum(
+        jnp.maximum(
+            state.self_inc,
+            jnp.max(jnp.where(self_overridden, win_inc, 0), axis=1),
+        ) + 1,
+        _wire_inc_sat(params),
+    )
     new_self_inc = jnp.where(refuted & alive_here, bumped_inc, state.self_inc)
     new_status = jnp.where(is_self, records.ALIVE, new_status)
     new_inc = jnp.where(is_self, new_self_inc[:, None], new_inc)
@@ -2641,7 +2685,57 @@ def node_snapshot(state: SwimState, params: SwimParams, world: SwimWorld,
     }
 
 
-@partial(jax.jit, static_argnames=("params", "n_rounds"))
+def _fused_scan(tick, carry, n_rounds: int, start_round, k: int,
+                fused_body=None):
+    """Scan ``tick`` over ``n_rounds`` rounds, K ticks per scan step.
+
+    ``tick(carry, round_idx) -> (carry, metrics)``.  The fused body
+    unrolls K ticks and stacks their per-round metric rows, so the
+    scan's output buffers (and its carry layout fix-ups) are touched
+    once per K rounds instead of every round; the stacked
+    [steps, K, ...] traces reshape back to [rounds, ...] in row-major
+    (= round) order.  A trailing ``n_rounds % K`` remainder runs
+    through an unfused tail scan on the same ``tick``, so the result is
+    bit-identical to ``k == 1`` for any (n_rounds, K) pair — every
+    tick's draws depend only on (base_key, round_idx), never on scan
+    position (SwimParams.rounds_per_step docstring).
+
+    ``fused_body(carry, rounds_k) -> (carry, [K, ...]-stacked metrics)``
+    overrides the default K-times-``tick`` body — the hook run_traced
+    uses to amortize per-step work (one event-record scatter per step
+    instead of per round) without changing per-round semantics; it MUST
+    stay bit-identical to K sequential ``tick`` applications.
+    """
+    rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
+    steps, rem = divmod(n_rounds, k)
+    if k == 1 or steps == 0:
+        return jax.lax.scan(tick, carry, rounds)
+
+    if fused_body is None:
+        def fused_body(c, rounds_k):
+            ms = []
+            for j in range(k):
+                c, m = tick(c, rounds_k[j])
+                ms.append(m)
+            return c, jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ms)
+
+    carry, head = jax.lax.scan(
+        fused_body, carry, rounds[:steps * k].reshape(steps, k)
+    )
+    head = jax.tree_util.tree_map(
+        lambda x: x.reshape((steps * k,) + x.shape[2:]), head
+    )
+    if rem == 0:
+        return carry, head
+    carry, tail = jax.lax.scan(tick, carry, rounds[steps * k:])
+    metrics = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b], axis=0), head, tail
+    )
+    return carry, metrics
+
+
+@partial(jax.jit, static_argnames=("params", "n_rounds"),
+         donate_argnames=("state",))
 def run(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
         state: Optional[SwimState] = None, start_round: int = 0,
         knobs: Optional[Knobs] = None, shift_key=None):
@@ -2652,19 +2746,28 @@ def run(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
     at round r with a restored carry (SURVEY.md §5.4).  ``shift_key``:
     optional separate key for the shift-channel draws (swim_tick
     docstring — the shared-shift batching hook for vmapped sweeps).
+
+    ``params.rounds_per_step`` fuses K ticks per scan step (bit-identical
+    outputs — _fused_scan docstring).  The ``state`` argument is DONATED:
+    the carry's HBM buffers are reused for the result instead of
+    double-buffering the membership matrices, so never reuse a state
+    object after passing it here — current XLA donates on CPU too, and
+    the input buffers really are gone.  Need the previous carry?  Take
+    a host snapshot first (``jax.device_get(state)``).
     """
     if state is None:
         state = initial_state(params, world)
 
-    def body(carry, round_idx):
+    def tick(carry, round_idx):
         return swim_tick(carry, round_idx, base_key, params, world,
                          knobs=knobs, shift_key=shift_key)
 
-    rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
-    return jax.lax.scan(body, state, rounds)
+    return _fused_scan(tick, state, n_rounds, start_round,
+                       params.rounds_per_step)
 
 
-@partial(jax.jit, static_argnames=("params", "n_rounds", "trace_capacity"))
+@partial(jax.jit, static_argnames=("params", "n_rounds", "trace_capacity"),
+         donate_argnames=("state", "telemetry"))
 def run_traced(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
                trace_capacity: int = telemetry_trace.DEFAULT_CAPACITY,
                state: Optional[SwimState] = None, start_round: int = 0,
@@ -2682,9 +2785,15 @@ def run_traced(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
 
     Returns (final_state, telemetry_state, metrics).  ``telemetry``
     resumes an existing trace across chunked/checkpointed scans (pass
-    the previous chunk's result).  Single-device (like ``run``); the
-    traced tick costs one extra [N, K] pass per round, so the untraced
-    ``run`` stays the benchmark hot path.
+    the previous chunk's result).  Single-device (like ``run``).
+
+    Rounds fuse per ``params.rounds_per_step`` exactly like ``run`` (the
+    trace lanes stay per-round — recording order is round order in both
+    layouts), and ``state``/``telemetry`` are DONATED like ``run``'s
+    carry — don't reuse either after the call.  For long traced runs,
+    ``telemetry.sink.stream_traced_run`` drives this in segments with
+    the device→host trace offload overlapped against the next segment's
+    compute.
     """
     if state is None:
         state = initial_state(params, world)
@@ -2693,7 +2802,7 @@ def run_traced(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
             params.n_members, params.n_subjects, trace_capacity
         )
 
-    def body(carry, round_idx):
+    def tick(carry, round_idx):
         st, tel = carry
         prev_status, prev_inc = st.status, st.inc
         new_st, metrics = swim_tick(st, round_idx, base_key, params, world,
@@ -2703,8 +2812,38 @@ def run_traced(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
         )
         return (new_st, tel), metrics
 
-    rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
-    (final_state, telemetry), metrics = jax.lax.scan(
-        body, (state, telemetry), rounds
+    def fused_body(carry, rounds_k):
+        # K ticks, per-round code derivation + first-round updates, but
+        # ONE batched event record (cumsum + scatter) for the whole
+        # step — flattened round-major, so lanes/count/dropped are
+        # bit-identical to K sequential observe_round calls
+        # (telemetry_trace.record_events_batch docstring).
+        st, tel = carry
+        ms, codes_l, inc_l = [], [], []
+        for j in range(params.rounds_per_step):
+            prev_status, prev_inc = st.status, st.inc
+            st, m = swim_tick(st, rounds_k[j], base_key, params, world,
+                              knobs=knobs, shift_key=shift_key)
+            tel, codes, ev_inc = telemetry_trace.observe_round_codes(
+                tel, rounds_k[j], prev_status, prev_inc, st, world
+            )
+            ms.append(m)
+            codes_l.append(codes)
+            inc_l.append(ev_inc)
+        trace = telemetry_trace.record_events_batch(
+            tel.trace, rounds_k, jnp.stack(codes_l), jnp.stack(inc_l),
+            world.subject_ids,
+        )
+        tel = telemetry_trace.TelemetryState(
+            trace=trace, first_suspect=tel.first_suspect,
+            first_removed=tel.first_removed,
+        )
+        return (st, tel), jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *ms
+        )
+
+    (final_state, telemetry), metrics = _fused_scan(
+        tick, (state, telemetry), n_rounds, start_round,
+        params.rounds_per_step, fused_body=fused_body,
     )
     return final_state, telemetry, metrics

@@ -184,6 +184,15 @@ def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
     entry_key = pack_record(gate_status, entry_inc, compact=compact)
     # The ABSENT gate: only an ALIVE opener admits the winner (any
     # non-absent winner, i.e. key >= 0, once open).
+    #
+    # The strict > gate is exact only while incarnations stay at or
+    # below the wire key's saturation point (8191 compact / 2^29-1
+    # wide): above it, distinct incarnations pack to colliding keys and
+    # the gate stops distinguishing records the int32 table still
+    # could.  The invariant is enforced at the ONLY place incarnations
+    # grow — the self-refutation bump clamps to the active wire's cap
+    # (models/swim._wire_inc_sat) — and the at-the-cap merge behavior
+    # is pinned by tests/test_wire16.py's saturation-boundary tests.
     absent = gate_status == records.ABSENT
     accepts = jnp.where(
         absent, inbox_any_alive & (inbox_key >= 0), inbox_key > entry_key

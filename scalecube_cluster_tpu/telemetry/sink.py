@@ -35,11 +35,18 @@ from typing import Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
-from scalecube_cluster_tpu.telemetry.events import MembershipTraceEvent
+from scalecube_cluster_tpu.telemetry.events import (
+    MembershipTraceEvent,
+    TraceEventType,
+)
 
 SCHEMA_VERSION = 1
 TELEMETRY_DIR_ENV = "SCALECUBE_TPU_TELEMETRY_DIR"
 PROFILE_DIR_ENV = "SCALECUBE_TPU_PROFILE_DIR"
+# Segment length (in protocol rounds) of the overlapped trace offload
+# (stream_traced_run); override with this env var.
+TRACE_SEGMENT_ENV = "SCALECUBE_TPU_TRACE_SEGMENT_ROUNDS"
+DEFAULT_SEGMENT_ROUNDS = 256
 
 # Counter names digested into a counters row (the same families
 # utils/runlog.log_metrics_summary prints; per-subject [rounds, K]
@@ -242,6 +249,154 @@ def fraction_informed_curve(dead_counts, n_live_observers: int):
     for one subject column."""
     v = np.asarray(dead_counts, dtype=np.float64)
     return v / max(1, int(n_live_observers))
+
+
+# --------------------------------------------------------------------------
+# Overlapped trace offload: the segmented traced-run driver
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TracedRunResult:
+    """What :func:`stream_traced_run` hands back, host-side.
+
+    ``events`` is the decoded stream in round order (empty when
+    ``decode=False``); ``recorded``/``dropped`` total the per-segment
+    buffers.  ``telemetry`` carries the final first-suspect /
+    first-removed matrices (feed it to
+    ``telemetry.trace.latency_histograms``); its trace buffer is a
+    placeholder — the event stream lives in ``events``.  ``metrics`` is
+    the concatenated [n_rounds, ...] trace dict as numpy arrays.
+    """
+
+    events: List[MembershipTraceEvent]
+    recorded: int
+    dropped: int
+    capacity: int
+    segment_rounds: int
+    n_segments: int
+    metrics: dict
+    telemetry: object
+
+
+def stream_traced_run(base_key, params, world, n_rounds: int, *,
+                      state=None, knobs=None, shift_key=None,
+                      start_round: int = 0,
+                      segment_rounds: Optional[int] = None,
+                      trace_capacity: Optional[int] = None,
+                      decode: bool = True):
+    """Drive ``models/swim.run_traced`` in segments with the trace
+    offload overlapped against the next segment's compute.
+
+    A monolithic traced run fetches its whole event buffer in one
+    blocking ``device_get`` at the end; this driver instead scans
+    ``segment_rounds``-round segments and, thanks to JAX's async
+    dispatch, ENQUEUES segment k+1 before fetching segment k's trace
+    slab + metric rows — the device chews on the next segment while the
+    host drains the previous one, so the device→host copy costs no
+    device time (the ISSUE-2 overlapped-offload shape; segment length
+    from ``SCALECUBE_TPU_TRACE_SEGMENT_ROUNDS``, default
+    ``DEFAULT_SEGMENT_ROUNDS``).
+
+    Each segment gets a FRESH event buffer of ``trace_capacity`` while
+    the first-suspect/first-removed matrices thread through (they are
+    donated segment-to-segment along with the carry —
+    swim.run_traced's donation contract).  With zero drops the
+    concatenated stream is exactly the monolithic run's; under
+    overflow, drops are counted per segment (a segmented run can only
+    drop FEWER events than one shared buffer, never more, and the
+    count is still exact).
+
+    Returns ``(final_state, TracedRunResult)``.  ``decode=False`` skips
+    building host-side event objects (the offload still happens) — use
+    it when timing, where python-object construction would pollute the
+    measurement.
+    """
+    import jax
+
+    from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.telemetry import trace as ttrace
+
+    if segment_rounds is None:
+        env = os.environ.get(TRACE_SEGMENT_ENV)
+        segment_rounds = int(env) if env else DEFAULT_SEGMENT_ROUNDS
+    segment_rounds = max(1, segment_rounds)
+    cap = trace_capacity or ttrace.DEFAULT_CAPACITY
+
+    if state is None:
+        state = swim.initial_state(params, world)
+    tel0 = ttrace.TelemetryState.init(
+        params.n_members, params.n_subjects, capacity=1
+    )
+    fs, fr = tel0.first_suspect, tel0.first_removed
+
+    pending = None          # (trace pytree, metrics) of the previous segment
+    slabs, metric_parts = [], []
+
+    def harvest(p):
+        # ONE transfer per segment: a per-leaf device_get (separate
+        # syncs per array) measurably dominates small-segment offload.
+        (lanes, count, seg_dropped), metrics = jax.device_get(p)
+        slabs.append((np.asarray(lanes), int(count), int(seg_dropped)))
+        metric_parts.append(metrics)
+
+    r, n_segments = 0, 0
+    while r < n_rounds:
+        step = min(segment_rounds, n_rounds - r)
+        tel_in = ttrace.TelemetryState(
+            trace=ttrace.EventTrace.empty(cap),
+            first_suspect=fs, first_removed=fr,
+        )
+        state, tel_out, metrics = swim.run_traced(
+            base_key, params, world, step, trace_capacity=cap,
+            state=state, start_round=start_round + r, knobs=knobs,
+            shift_key=shift_key, telemetry=tel_in,
+        )
+        # tel_in (including fs/fr) is donated into the call just made;
+        # tel_out's buffers are fresh outputs — safe to read any time.
+        fs, fr = tel_out.first_suspect, tel_out.first_removed
+        r += step
+        n_segments += 1
+        if pending is not None:     # overlapped: next segment is enqueued
+            harvest(pending)
+        pending = ((tel_out.trace.lanes, tel_out.trace.count,
+                    tel_out.trace.dropped), metrics)
+    if pending is not None:
+        harvest(pending)
+
+    events: List[MembershipTraceEvent] = []
+    recorded = dropped = 0
+    for lanes, count, seg_dropped in slabs:
+        recorded += count
+        dropped += seg_dropped
+        if decode:
+            events.extend(
+                MembershipTraceEvent(
+                    round=int(lanes[i, 0]),
+                    observer=int(lanes[i, 1]),
+                    subject=int(lanes[i, 2]),
+                    event_type=TraceEventType(int(lanes[i, 3])),
+                    incarnation=int(lanes[i, 4]),
+                )
+                for i in range(count)
+            )
+    metrics_np = {}
+    if metric_parts:
+        metrics_np = {
+            name: np.concatenate(
+                [np.asarray(p[name]) for p in metric_parts], axis=0
+            )
+            for name in metric_parts[0]
+        }
+    final_tel = ttrace.TelemetryState(
+        trace=ttrace.EventTrace.empty(1), first_suspect=fs,
+        first_removed=fr,
+    )
+    return state, TracedRunResult(
+        events=events, recorded=recorded, dropped=dropped, capacity=cap,
+        segment_rounds=segment_rounds, n_segments=n_segments,
+        metrics=metrics_np, telemetry=final_tel,
+    )
 
 
 # --------------------------------------------------------------------------

@@ -25,9 +25,15 @@ types the oracle's merge funnel emits through ``listen_trace``
 A crashed observer's rows are frozen by the tick, so a stopped node
 emits nothing — exactly a stopped JVM.
 
-Cost: recording flattens one ``[N, K]`` int8 code matrix per round
-(a cumsum + one scatter).  It is OFF unless requested
-(``models/swim.run_traced``); the untraced hot path is untouched.
+Cost: recording flattens one ``[N, K]`` int8 code matrix per round —
+one fused elementwise pass to derive the net-transition codes, a cumsum
+to assign slots, ONE scatter into the lane buffer (no per-event-type
+passes), and one fused count/overflow bookkeeping update.  It is OFF
+unless requested (``models/swim.run_traced``); the untraced hot path is
+untouched.  For long runs, ``telemetry/sink.stream_traced_run``
+overlaps the device→host offload of each segment's trace slab with the
+next segment's compute, so traced throughput tracks untraced
+(bench.py's ``traced_overhead_ratio``).
 """
 
 from __future__ import annotations
@@ -116,11 +122,17 @@ class TelemetryState:
     @staticmethod
     def init(n_members: int, n_subjects: int,
              capacity: int = DEFAULT_CAPACITY) -> "TelemetryState":
-        full = jnp.full((n_members, n_subjects), INT32_MAX, dtype=jnp.int32)
+        def full():
+            # Two SEPARATE buffers: run_traced donates its telemetry
+            # argument, and donating one aliased array through two tree
+            # leaves is an XLA error ("donate the same buffer twice").
+            return jnp.full((n_members, n_subjects), INT32_MAX,
+                            dtype=jnp.int32)
+
         return TelemetryState(
             trace=EventTrace.empty(capacity),
-            first_suspect=full,
-            first_removed=full,
+            first_suspect=full(),
+            first_removed=full(),
         )
 
 
@@ -153,6 +165,14 @@ def derive_event_codes(prev_status, prev_inc, new_status, new_inc,
     Self cells are pinned by the tick (never transition); the one self
     event is LEAVING, injected from the world's leave schedule with the
     announced incarnation self_inc + 1 (leaveCluster's DEAD@inc+1).
+
+    The four transition masks are mutually exclusive by construction
+    (they partition on the NEW status: ALIVE splits on the previous
+    status, SUSPECT and DEAD each gate on not-already-there), so the
+    code matrix is ONE weighted sum of disjoint masks — a single fused
+    elementwise pass over the [N, K] pair, not a per-type select chain.
+    This is the traced tick's whole per-round overhead next to the
+    untraced path, so it stays one pass.
     """
     prev = prev_status
     new = new_status
@@ -162,12 +182,13 @@ def derive_event_codes(prev_status, prev_inc, new_status, new_inc,
     refuted = (prev == records.SUSPECT) & (new == records.ALIVE)
     removed = (new == records.DEAD) & (prev != records.DEAD)
 
-    code = jnp.zeros(prev.shape, dtype=jnp.int8)
-    code = jnp.where(added, jnp.int8(TraceEventType.ADDED + 1), code)
-    code = jnp.where(suspected, jnp.int8(TraceEventType.SUSPECTED + 1), code)
-    code = jnp.where(refuted, jnp.int8(TraceEventType.ALIVE_REFUTED + 1),
-                     code)
-    code = jnp.where(removed, jnp.int8(TraceEventType.REMOVED + 1), code)
+    code = (
+        added.astype(jnp.int8) * jnp.int8(TraceEventType.ADDED + 1)
+        + suspected.astype(jnp.int8) * jnp.int8(TraceEventType.SUSPECTED + 1)
+        + refuted.astype(jnp.int8)
+        * jnp.int8(TraceEventType.ALIVE_REFUTED + 1)
+        + removed.astype(jnp.int8) * jnp.int8(TraceEventType.REMOVED + 1)
+    )
     code = jnp.where(is_self, jnp.int8(0), code)
     code = jnp.where(leaving_now, jnp.int8(TraceEventType.LEAVING + 1), code)
 
@@ -179,39 +200,132 @@ def derive_event_codes(prev_status, prev_inc, new_status, new_inc,
 
 def record_events(trace: EventTrace, round_idx, codes, incarnations,
                   subject_ids, observer_offset: int = 0) -> EventTrace:
-    """Compact this round's coded cells into the event buffer.
+    """Compact this round's coded cells into the event buffer
+    (single-round form of :func:`record_events_batch`)."""
+    return record_events_batch(
+        trace, jnp.asarray(round_idx, jnp.int32)[None],
+        codes[None], incarnations[None], subject_ids,
+        observer_offset=observer_offset,
+    )
 
-    A prefix-sum assigns each event its slot (row-major cell order —
-    deterministic); slots past capacity are dropped by the scatter's
-    out-of-bounds mode and counted in ``dropped``.  One cumsum + one
-    scatter; no host round trip.
+
+def record_events_batch(trace: EventTrace, round_ids, codes, incarnations,
+                        subject_ids, observer_offset: int = 0) -> EventTrace:
+    """Compact a BATCH of rounds' coded cells into the event buffer.
+
+    ``round_ids`` [R], ``codes``/``incarnations`` [R, N, K]: the stacked
+    per-round transition codes of one fused scan step
+    (models/swim.run_traced with rounds_per_step > 1).  Flattening is
+    round-major then row-major — exactly the order R sequential
+    single-round records would produce — so the resulting (lanes, count,
+    dropped) are bit-identical to the per-round path while paying the
+    cumsum + scatter ONCE per step.  The whole record runs under a
+    ``lax.cond`` and is skipped exactly when the batch holds no events
+    (the identity on the buffer), so silent steady-state steps cost one
+    reduction, not a scatter.
     """
-    n, k = codes.shape
+    r, n, k = codes.shape
     cap = trace.capacity
     flat_code = codes.reshape(-1)
     has = flat_code > 0
-    slot = trace.count + jnp.cumsum(has.astype(jnp.int32)) - 1
-    idx = jnp.where(has & (slot < cap), slot, cap)   # cap = OOB -> dropped
-
+    flat_round = jnp.broadcast_to(
+        jnp.asarray(round_ids, jnp.int32)[:, None, None], (r, n, k)
+    ).reshape(-1)
+    flat_inc = incarnations.reshape(-1)
     observer = jnp.broadcast_to(
-        jnp.arange(n, dtype=jnp.int32)[:, None] + observer_offset, (n, k)
+        jnp.arange(n, dtype=jnp.int32)[None, :, None] + observer_offset,
+        (r, n, k),
     ).reshape(-1)
     subject = jnp.broadcast_to(
-        jnp.asarray(subject_ids, jnp.int32)[None, :], (n, k)
+        jnp.asarray(subject_ids, jnp.int32)[None, None, :], (r, n, k)
     ).reshape(-1)
-    rows = jnp.stack([
-        jnp.full((n * k,), round_idx, dtype=jnp.int32),
-        observer,
-        subject,
-        flat_code.astype(jnp.int32) - 1,
-        incarnations.reshape(-1),
-    ], axis=1)
 
-    lanes = trace.lanes.at[idx].set(rows, mode="drop")
-    total = jnp.sum(has, dtype=jnp.int32)
-    new_count = jnp.minimum(trace.count + total, cap)
-    new_dropped = trace.dropped + total - (new_count - trace.count)
-    return EventTrace(lanes=lanes, count=new_count, dropped=new_dropped)
+    def record(tr: EventTrace) -> EventTrace:
+        slot = tr.count + jnp.cumsum(has.astype(jnp.int32)) - 1
+        idx = jnp.where(has & (slot < cap), slot, cap)  # cap = OOB -> drop
+        rows = jnp.stack([
+            flat_round,
+            observer,
+            subject,
+            flat_code.astype(jnp.int32) - 1,
+            flat_inc,
+        ], axis=1)
+        lanes = tr.lanes.at[idx].set(rows, mode="drop")
+        total = jnp.sum(has, dtype=jnp.int32)
+        new_count = jnp.minimum(tr.count + total, cap)
+        new_dropped = tr.dropped + total - (new_count - tr.count)
+        return EventTrace(lanes=lanes, count=new_count, dropped=new_dropped)
+
+    return jax.lax.cond(jnp.any(has), record, lambda tr: tr, trace)
+
+
+def round_transition_codes(round_idx, prev_status, prev_inc, new_state,
+                           world, observer_offset: int = 0):
+    """(codes, ev_inc) of one tick's net transitions (the derive half of
+    :func:`observe_round` — split out so the fused scan can batch the
+    record half across rounds_per_step ticks)."""
+    n = prev_status.shape[0]
+    node_ids = jnp.arange(n, dtype=jnp.int32) + observer_offset
+    is_self = jnp.asarray(world.subject_ids, jnp.int32)[None, :] \
+        == node_ids[:, None]
+    leaving_now = (world.leave_at[node_ids] == round_idx)[:, None] & is_self
+    return derive_event_codes(
+        prev_status, prev_inc, new_state.status, new_state.inc,
+        is_self, leaving_now, new_state.self_inc,
+    )
+
+
+def update_first_rounds(tel: TelemetryState, codes,
+                        round_idx) -> TelemetryState:
+    """Advance the first-suspect/first-removed matrices for one round's
+    codes (trace buffer untouched — pair with record_events[_batch])."""
+    suspected = codes == jnp.int8(TraceEventType.SUSPECTED + 1)
+    removed = codes == jnp.int8(TraceEventType.REMOVED + 1)
+    first_suspect = jnp.where(
+        suspected & (tel.first_suspect == INT32_MAX), round_idx,
+        tel.first_suspect,
+    )
+    first_removed = jnp.where(
+        removed & (tel.first_removed == INT32_MAX), round_idx,
+        tel.first_removed,
+    )
+    return TelemetryState(trace=tel.trace, first_suspect=first_suspect,
+                          first_removed=first_removed)
+
+
+def observe_round_codes(tel: TelemetryState, round_idx, prev_status,
+                        prev_inc, new_state, world,
+                        observer_offset: int = 0):
+    """(tel', codes, ev_inc) for one tick, with the WHOLE derivation +
+    first-round update gated on a two-reduction predicate.
+
+    Every event type requires a status transition (incarnation-only
+    changes emit nothing) except LEAVING, which fires off the world's
+    leave schedule — so ``any(status changed) | any(leaving now)`` is an
+    exact emptiness test, and steady-state rounds (the overwhelming
+    majority) cost one [N, K] compare + one [N] compare instead of the
+    full derivation.  The silent branch returns all-zero codes, which
+    every consumer (record scatter, first-round updates) treats as the
+    identity — bit-identical to the ungated path.
+    """
+    n = prev_status.shape[0]
+    node_ids = jnp.arange(n, dtype=jnp.int32) + observer_offset
+    pred = jnp.any(prev_status != new_state.status) | jnp.any(
+        world.leave_at[node_ids] == round_idx
+    )
+
+    def active(t):
+        codes, ev_inc = round_transition_codes(
+            round_idx, prev_status, prev_inc, new_state, world,
+            observer_offset,
+        )
+        return update_first_rounds(t, codes, round_idx), codes, ev_inc
+
+    def silent(t):
+        return (t, jnp.zeros(prev_status.shape, dtype=jnp.int8),
+                jnp.zeros(prev_status.shape, dtype=jnp.int32))
+
+    return jax.lax.cond(pred, active, silent, tel)
 
 
 def observe_round(tel: TelemetryState, round_idx, prev_status, prev_inc,
@@ -224,33 +338,18 @@ def observe_round(tel: TelemetryState, round_idx, prev_status, prev_inc,
     ``new_state`` the SwimState after; both in their stored layout (the
     int16 compact-carry incarnation upcasts losslessly below its
     saturation point).  Called from models/swim.run_traced inside the
-    scan body.
+    scan body (the fused body batches the record half per scan step —
+    record_events_batch).  Event-free rounds reduce to two cheap
+    predicates (observe_round_codes + record's own cond).
     """
-    n = prev_status.shape[0]
-    node_ids = jnp.arange(n, dtype=jnp.int32) + observer_offset
-    is_self = jnp.asarray(world.subject_ids, jnp.int32)[None, :] \
-        == node_ids[:, None]
-    leaving_now = (world.leave_at[node_ids] == round_idx)[:, None] & is_self
-
-    codes, ev_inc = derive_event_codes(
-        prev_status, prev_inc, new_state.status, new_state.inc,
-        is_self, leaving_now, new_state.self_inc,
+    tel, codes, ev_inc = observe_round_codes(
+        tel, round_idx, prev_status, prev_inc, new_state, world,
+        observer_offset,
     )
     trace = record_events(tel.trace, round_idx, codes, ev_inc,
                           world.subject_ids, observer_offset)
-
-    suspected = codes == jnp.int8(TraceEventType.SUSPECTED + 1)
-    removed = codes == jnp.int8(TraceEventType.REMOVED + 1)
-    first_suspect = jnp.where(
-        suspected & (tel.first_suspect == INT32_MAX), round_idx,
-        tel.first_suspect,
-    )
-    first_removed = jnp.where(
-        removed & (tel.first_removed == INT32_MAX), round_idx,
-        tel.first_removed,
-    )
-    return TelemetryState(trace=trace, first_suspect=first_suspect,
-                          first_removed=first_removed)
+    return TelemetryState(trace=trace, first_suspect=tel.first_suspect,
+                          first_removed=tel.first_removed)
 
 
 # --------------------------------------------------------------------------

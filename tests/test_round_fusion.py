@@ -1,0 +1,156 @@
+"""Round fusion (SwimParams.rounds_per_step) is bit-identical to the
+classic one-tick-per-step scan.
+
+The fused scan unrolls K ticks per scan step and reshapes the stacked
+[steps, K, ...] metric rows back to [rounds, ...]; a n_rounds % K
+remainder runs through an unfused tail (models/swim._fused_scan).  The
+contract is exact equality — every PRNG draw is a function of
+(base_key, round_idx), never of scan position — for:
+
+  - every per-round counter trace,
+  - the final carry (all SwimState fields),
+  - the FULL event trace of run_traced (lanes, count, overflow drops),
+
+across rounds_per_step in {1, 2, 4}, both delivery modes, and a
+crash/revive world (the scenario with the densest event stream: the
+revival path exercises SUSPECTED, REMOVED, ADDED and ALIVE_REFUTED).
+Also pinned here: the overlapped-offload driver
+(telemetry.sink.stream_traced_run) reproduces the monolithic traced
+run's event stream, metrics, and latency inputs segment-for-segment.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.telemetry import sink as tsink
+from scalecube_cluster_tpu.telemetry import trace as ttrace
+
+from tests.test_swim_model import fast_config
+
+N = 16
+# 4 does NOT divide 90: the {4} case exercises the fused head + unfused
+# remainder-tail concatenation too.
+ROUNDS = 90
+
+
+def make_params(delivery, rounds_per_step):
+    return swim.SwimParams.from_config(
+        fast_config(), n_members=N, delivery=delivery,
+        rounds_per_step=rounds_per_step,
+    )
+
+
+def crash_revive_world(params):
+    # Crash long enough to be removed, then revive: the densest event
+    # mix (SUSPECTED -> REMOVED -> ADDED, plus refutations on the short
+    # second dip).
+    return (
+        swim.SwimWorld.healthy(params)
+        .with_crash(3, at_round=5, until_round=55)
+        .with_crash(7, at_round=20, until_round=26)
+    )
+
+
+def state_fields(state):
+    return {f.name: np.asarray(getattr(state, f.name))
+            for f in dataclasses.fields(state)}
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+@pytest.mark.parametrize("k", [2, 4])
+def test_fused_run_bit_identical(delivery, k):
+    params_1 = make_params(delivery, 1)
+    params_k = make_params(delivery, k)
+    world = crash_revive_world(params_1)
+    state_1, m_1 = swim.run(jax.random.key(0), params_1, world, ROUNDS)
+    state_k, m_k = swim.run(jax.random.key(0), params_k, world, ROUNDS)
+    assert set(m_1) == set(m_k)
+    for name in m_1:
+        np.testing.assert_array_equal(
+            np.asarray(m_1[name]), np.asarray(m_k[name]),
+            err_msg=f"{delivery}, K={k}: metric {name} diverged",
+        )
+    for name, v in state_fields(state_1).items():
+        np.testing.assert_array_equal(
+            v, state_fields(state_k)[name],
+            err_msg=f"{delivery}, K={k}: state.{name} diverged",
+        )
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+@pytest.mark.parametrize("k", [2, 4])
+def test_fused_run_traced_trace_identical(delivery, k):
+    """The full event trace — lane buffer contents, count, AND the
+    overflow drop count under a deliberately too-small buffer — is
+    identical under fusion: trace lanes stay per-round."""
+    for capacity in (ttrace.DEFAULT_CAPACITY, 11):
+        outs = []
+        for rps in (1, k):
+            params = make_params(delivery, rps)
+            world = crash_revive_world(params)
+            _, tel, metrics = swim.run_traced(
+                jax.random.key(1), params, world, ROUNDS,
+                trace_capacity=capacity,
+            )
+            outs.append((tel, metrics))
+        tel_1, m_1 = outs[0]
+        tel_k, m_k = outs[1]
+        np.testing.assert_array_equal(
+            np.asarray(tel_1.trace.lanes), np.asarray(tel_k.trace.lanes),
+            err_msg=f"{delivery}, K={k}, cap={capacity}: lanes diverged",
+        )
+        assert int(tel_1.trace.count) == int(tel_k.trace.count)
+        assert int(tel_1.trace.dropped) == int(tel_k.trace.dropped)
+        if capacity == 11:
+            assert int(tel_k.trace.dropped) > 0, \
+                "scenario must overflow an 11-slot buffer"
+        np.testing.assert_array_equal(
+            np.asarray(tel_1.first_suspect), np.asarray(tel_k.first_suspect))
+        np.testing.assert_array_equal(
+            np.asarray(tel_1.first_removed), np.asarray(tel_k.first_removed))
+        for name in m_1:
+            np.testing.assert_array_equal(
+                np.asarray(m_1[name]), np.asarray(m_k[name]))
+
+
+def test_rounds_per_step_validation():
+    with pytest.raises(ValueError, match="rounds_per_step"):
+        make_params("shift", 0)
+
+
+def test_stream_traced_run_matches_monolithic():
+    """The segmented overlapped-offload driver reproduces the monolithic
+    run_traced exactly: same decoded event stream (order included), same
+    metrics, same first-suspect/first-removed matrices — with zero drops
+    at default capacity."""
+    params = make_params("shift", 4)
+    world = crash_revive_world(params)
+    key = jax.random.key(2)
+    _, tel_mono, m_mono = swim.run_traced(key, params, world, ROUNDS)
+    assert int(tel_mono.trace.dropped) == 0
+
+    # 40-round segments: exercises segment remainder (90 = 40 + 40 + 10)
+    # AND the fused head + tail inside each segment (40 % 4 == 0 but the
+    # trailing 10-round segment has a fused head of 8 + tail of 2).
+    _, res = tsink.stream_traced_run(
+        key, params, world, ROUNDS, segment_rounds=40,
+    )
+    assert res.n_segments == 3
+    assert res.dropped == 0
+    assert res.events == ttrace.decode_events(tel_mono)
+    assert res.recorded == int(tel_mono.trace.count)
+    np.testing.assert_array_equal(
+        np.asarray(tel_mono.first_suspect),
+        np.asarray(res.telemetry.first_suspect))
+    np.testing.assert_array_equal(
+        np.asarray(tel_mono.first_removed),
+        np.asarray(res.telemetry.first_removed))
+    for name in m_mono:
+        np.testing.assert_array_equal(
+            np.asarray(m_mono[name]), res.metrics[name],
+            err_msg=f"metric {name} diverged across segmentation",
+        )

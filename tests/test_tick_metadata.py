@@ -44,7 +44,11 @@ def test_update_propagates_and_is_queryable(delivery):
     # The owner immediately sees its own new version.
     assert store.view(state, params, world, 3, 3, round_idx=20)["version"] == 1
 
-    prev = state
+    # Host snapshot BEFORE the run: swim.run donates its state argument
+    # (the carry buffers are reused in place), so the device arrays may
+    # be gone afterwards — the documented don't-reuse-a-donated-state
+    # caveat (README Telemetry > Performance).
+    prev = jax.device_get(state)
     state, m = swim.run(key, params, world, 40, state=state, start_round=20)
     # The bump disseminated: every observer now fetches version 1.
     for obs in (0, 9, 17, 31):

@@ -125,3 +125,95 @@ def test_compact_carry_implies_compact_wire():
         fast_config(), n_members=16, delivery="shift", compact_carry=True
     )
     assert params.compact_wire
+
+
+# --------------------------------------------------------------------------
+# The 8191 saturation boundary (the int16 wire key's incarnation cap)
+# --------------------------------------------------------------------------
+
+
+WIRE16_INC_CAP = (1 << 13) - 1      # records.merge_key16 saturation
+
+
+def test_merge_gate_at_wire16_saturation_boundary():
+    """Merge behavior exactly AT the int16 wire's incarnation cap
+    (ops/delivery.merge_inbox's ``inbox_key > entry_key`` gate):
+
+      - one below the cap, a refutation still lands (ALIVE@8191 beats
+        SUSPECT@8190);
+      - at the cap, incarnations stop distinguishing: ALIVE@8191 does
+        NOT override SUSPECT@8191 (the suspect bit wins a key tie), and
+        any incarnation above the cap packs to the same key as 8191;
+      - DEAD still absorbs everything at the cap (the dead bit sits
+        above the incarnation field, so saturation never corrupts
+        rule 3).
+    """
+    from scalecube_cluster_tpu import records
+    from scalecube_cluster_tpu.ops import delivery
+
+    cap = WIRE16_INC_CAP
+
+    def merge_one(entry_status, entry_inc, in_status, in_inc):
+        key = delivery.pack_record(
+            jnp.int8(in_status), jnp.int32(in_inc), compact=True
+        )
+        status, inc, changed = delivery.merge_inbox(
+            jnp.int8(entry_status), jnp.int32(entry_inc),
+            key, jnp.asarray(in_status == records.ALIVE), compact=True,
+        )
+        return int(status), int(inc), bool(changed)
+
+    # Below the cap: higher incarnation refutes a suspicion.
+    assert merge_one(records.SUSPECT, cap - 1, records.ALIVE, cap) == \
+        (records.ALIVE, cap, True)
+    # At the cap: the refutation no longer lands (key tie, suspect bit
+    # wins) — the documented degradation, loud in the protocol (the
+    # suspicion matures) rather than a silent wire/table divergence.
+    status, inc, changed = merge_one(records.SUSPECT, cap,
+                                     records.ALIVE, cap)
+    assert (status, changed) == (records.SUSPECT, False)
+    # Above the cap the wire saturates: 8192 packs like 8191.
+    status, _, changed = merge_one(records.SUSPECT, cap,
+                                   records.ALIVE, cap + 1)
+    assert (status, changed) == (records.SUSPECT, False)
+    # DEAD absorbs at the cap (dead bit above the inc field).
+    status, _, changed = merge_one(records.SUSPECT, cap,
+                                   records.DEAD, cap)
+    assert (status, changed) == (records.DEAD, True)
+
+
+@pytest.mark.parametrize("wire16,expected_cap", [
+    (True, WIRE16_INC_CAP),          # int16 wire: bump clamps at 8191
+    (False, WIRE16_INC_CAP + 1),     # wide wire: 8191 is an ordinary inc
+])
+def test_refutation_bump_saturates_at_wire_cap(wire16, expected_cap):
+    """The self-refutation bump is clamped to the ACTIVE wire format's
+    incarnation saturation (models/swim._wire_inc_sat): the carry never
+    holds an incarnation the wire cannot express, so table and wire
+    cannot silently disagree at the merge gate.  A brief crash/revive
+    with every incarnation pre-seeded AT the int16 cap pins it: under
+    the int16 wire the revived node's bump saturates at 8191; under the
+    wide wire the same scenario bumps to 8192 (its cap is 2^29-1)."""
+    import dataclasses
+
+    victim = 3
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=8, delivery="shift", int16_wire=wire16,
+    )
+    world = swim.SwimWorld.healthy(params).with_crash(
+        victim, at_round=5, until_round=15
+    )
+    state = swim.initial_state(params, world)
+    state = dataclasses.replace(
+        state,
+        inc=jnp.full_like(state.inc, WIRE16_INC_CAP),
+        self_inc=jnp.full_like(state.self_inc, WIRE16_INC_CAP),
+    )
+    final, _ = swim.run(jax.random.key(0), params, world, 60, state=state)
+    max_self = int(np.asarray(final.self_inc).max())
+    assert max_self == expected_cap, \
+        f"self_inc bump should saturate at {expected_cap}, got {max_self}"
+    # The invariant the clamp enforces: no carried incarnation exceeds
+    # what the wire key can pack exactly.
+    if wire16:
+        assert int(np.asarray(final.inc).max()) <= WIRE16_INC_CAP
