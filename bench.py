@@ -43,6 +43,17 @@ overlapped offload included), dissemination probe, traced telemetry
 scenario, JSONL manifest — so the wiring can't silently rot; pinned by
 tests/test_bench_smoke.py.
 
+``--chaos``: the robustness workload instead of the throughput one — a
+seeded severity-tiered campaign of generated fault scenarios (churn
+storms, flapping links, rolling partitions, crash bursts, brownouts;
+chaos/scenarios.py) each run through the in-jit invariant monitor
+(chaos/monitor.py), with verdict manifests through the same JSONL
+pipeline.  One JSON line as always: green flag, per-invariant-code
+violation totals, one-line repros for any red scenario.  ``--chaos
+--smoke`` is the tier-1-safe mini campaign pinned by
+tests/test_chaos_campaign.py.  Env overrides: SCALECUBE_CHAOS_N,
+SCALECUBE_CHAOS_SCENARIOS, SCALECUBE_CHAOS_SEED.
+
 Env overrides for debugging: SCALECUBE_BENCH_N, SCALECUBE_BENCH_ROUNDS,
 SCALECUBE_BENCH_DELIVERY, SCALECUBE_BENCH_SKIP_CANARY,
 SCALECUBE_BENCH_COMPACT (=1: the capacity-oriented compact carry layout,
@@ -567,12 +578,70 @@ def write_telemetry(scenario, main_metrics):
     return sink.path
 
 
+def run_chaos_campaign():
+    """The --chaos mode: a seeded generated-scenario campaign through
+    the in-jit invariant monitor, one JSON line out (the same
+    never-ship-empty contract as the throughput bench)."""
+    result = {
+        "metric": "chaos_campaign_green_scenarios",
+        "value": None,
+        "unit": "green scenarios",
+        "smoke": SMOKE,
+    }
+    try:
+        jax, platform = init_backend()  # noqa: F841 — backend retry/fallback
+        result["platform"] = platform
+
+        from scalecube_cluster_tpu import chaos
+        from scalecube_cluster_tpu.telemetry import sink as tsink
+
+        n = int(os.environ.get("SCALECUBE_CHAOS_N",
+                               24 if SMOKE else 32))
+        n_scen = int(os.environ.get("SCALECUBE_CHAOS_SCENARIOS",
+                                    6 if SMOKE else 21))
+        seed = int(os.environ.get("SCALECUBE_CHAOS_SEED", 100))
+        scens = chaos.generate_campaign(seed=seed, n_scenarios=n_scen,
+                                        n=n)
+        t0 = time.time()
+        with tsink.TelemetrySink.from_env(
+                default_dir=os.path.join("artifacts", "telemetry"),
+                prefix="chaos-smoke" if SMOKE else "chaos") as sink:
+            campaign = chaos.run_campaign(scens, seed=seed, sink=sink)
+        summary = campaign.summary()
+        for v in campaign.verdicts:
+            log(f"chaos {v.scenario.name}: "
+                f"{'green' if v.green else 'RED ' + v.repro()}")
+        log(f"chaos campaign: {summary['green_scenarios']}/"
+            f"{summary['scenarios']} green in {time.time() - t0:.1f}s")
+        result.update(
+            value=summary["green_scenarios"],
+            scenarios=summary["scenarios"],
+            green=summary["green"],
+            violations_by_code=summary["violations_by_code"],
+            failing_repros=summary["failing_repros"],
+            n_members=n,
+            seed=seed,
+            manifest=campaign.manifest_path,
+        )
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
         "--smoke", action="store_true",
         help="fast CPU-safe pass (small N, few rounds, no canary) that "
              "still exercises the full pipeline incl. telemetry",
+    )
+    parser.add_argument(
+        "--chaos", action="store_true",
+        help="run the chaos campaign (generated fault scenarios through "
+             "the in-jit invariant monitor) instead of the throughput "
+             "bench; combine with --smoke for the tier-1-safe mini "
+             "campaign",
     )
     mode = parser.add_mutually_exclusive_group()
     mode.add_argument(
@@ -597,6 +666,12 @@ def main():
             parser.error(
                 "--gap-artifact pins the traced-vs-untraced gap and needs "
                 "BOTH paths measured; drop --traced/--untraced")
+        if args.chaos and (args.traced or args.untraced
+                           or args.gap_artifact):
+            parser.error(
+                "--chaos is the robustness workload; it measures no "
+                "throughput paths — drop --traced/--untraced/"
+                "--gap-artifact")
     except SystemExit as e:
         # The one-JSON-line contract holds even for a bad argv: argparse
         # already printed its usage message to stderr; ship the error
@@ -611,6 +686,8 @@ def main():
         raise
     if args.smoke:
         apply_smoke_preset()
+    if args.chaos:
+        return run_chaos_campaign()
 
     result = {
         "metric": "swim_member_rounds_per_sec_per_chip",

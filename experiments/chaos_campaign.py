@@ -1,0 +1,128 @@
+"""Seeded chaos-campaign sweep: generated fault scenarios through the
+in-jit invariant monitor, with JSONL verdict manifests + an artifact.
+
+Drives ``chaos.generate_campaign`` (severity-tiered scenarios — churn
+storms, flapping links, rolling partitions, crash bursts, brownouts)
+through ``chaos.run_campaign``: every scenario runs on-device under
+the invariant monitor (chaos/monitor.py) and any failure prints its
+one-line repro.  Optionally cross-validates the oracle-expressible
+scenarios (crash/leave schedules) against the event-driven oracle at
+small N.
+
+Writes ``artifacts/chaos_campaign.json`` (atomic) plus one JSONL
+manifest per invocation under ``SCALECUBE_TPU_TELEMETRY_DIR`` (default
+``artifacts/telemetry``).
+
+Usage:
+    python experiments/chaos_campaign.py                 # 21 scenarios, n=32
+    python experiments/chaos_campaign.py --scenarios 45 --n 64
+    python experiments/chaos_campaign.py --severity severe --seed 7
+    python experiments/chaos_campaign.py --cross-validate --n 16
+    python experiments/chaos_campaign.py --repro-seed 103 --severity mild
+                                          # re-run ONE failing scenario
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=100,
+                   help="campaign base seed (scenario i uses seed+i)")
+    p.add_argument("--scenarios", type=int, default=21,
+                   help="number of generated scenarios")
+    p.add_argument("--n", type=int, default=32, help="members per scenario")
+    p.add_argument("--severity", choices=["mild", "moderate", "severe"],
+                   default=None,
+                   help="restrict to one severity tier (default: cycle "
+                        "mild/moderate/severe)")
+    p.add_argument("--delivery", choices=["scatter", "shift"],
+                   default="shift")
+    p.add_argument("--cross-validate", action="store_true",
+                   help="also replay oracle-expressible scenarios on the "
+                        "event-driven oracle and diff event key sets "
+                        "(small n recommended)")
+    p.add_argument("--repro-seed", type=int, default=None,
+                   help="run exactly ONE scenario, "
+                        "generate_scenario(seed=REPRO_SEED, n, severity), "
+                        "with run seed REPRO_SEED (the campaign's seed "
+                        "alignment); requires --severity")
+    p.add_argument("--out", default=os.path.join("artifacts",
+                                                 "chaos_campaign.json"))
+    args = p.parse_args()
+
+    from scalecube_cluster_tpu import chaos
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+    from scalecube_cluster_tpu.utils import runlog
+
+    log = runlog.get_logger("chaos")
+    severities = ([args.severity] if args.severity
+                  else list(chaos.SEVERITIES))
+
+    if args.repro_seed is not None:
+        if args.severity is None:
+            p.error("--repro-seed needs --severity: the scenario is a "
+                    "pure function of (seed, n, severity), and a repro "
+                    "with the wrong tier is a different scenario")
+        scens = [chaos.generate_scenario(
+            seed=args.repro_seed, n=args.n, severity=args.severity)]
+        run_seed = args.repro_seed      # campaign alignment: run == scenario
+    else:
+        scens = chaos.generate_campaign(
+            seed=args.seed, n_scenarios=args.scenarios, n=args.n,
+            severities=severities)
+        run_seed = args.seed
+
+    t0 = time.time()
+    with tsink.TelemetrySink.from_env(
+            default_dir=os.path.join("artifacts", "telemetry"),
+            prefix="chaos") as sink:
+        result = chaos.run_campaign(
+            scens, seed=run_seed, delivery=args.delivery, sink=sink,
+            log=log, cross_validate_small_n=args.cross_validate)
+    elapsed = time.time() - t0
+
+    summary = result.summary()
+    xv = [v.cross_validation for v in result.verdicts
+          if v.cross_validation is not None]
+    artifact = {
+        "metric": "chaos_campaign",
+        "seed": run_seed,
+        "n_members": args.n,
+        "delivery": args.delivery,
+        "severities": severities,
+        "elapsed_sec": round(elapsed, 1),
+        "manifest": result.manifest_path,
+        "cross_validated": len(xv),
+        # null when nothing was cross-validated — a check that never
+        # ran must not read as a check that passed.
+        "cross_validation_agree": (all(d["agree"] for d in xv)
+                                   if xv else None),
+        **summary,
+    }
+    for v in result.verdicts:
+        tag = "green" if v.green else "RED"
+        log.info("%-44s %s  %s", v.scenario.name, tag,
+                 "" if v.green else v.repro())
+    log.info("campaign: %d/%d green in %.1fs -> %s",
+             summary["green_scenarios"], summary["scenarios"], elapsed,
+             result.manifest_path)
+
+    tmp = args.out + ".tmp"
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, args.out)
+    print(json.dumps(artifact))
+    return 0 if result.green else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

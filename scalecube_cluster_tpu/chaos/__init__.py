@@ -1,0 +1,58 @@
+"""Chaos engineering for the dense SWIM model: adversarial fault
+campaigns as a first-class, on-device workload.
+
+Two halves (ISSUE 3):
+
+  - :mod:`scalecube_cluster_tpu.chaos.scenarios` — the declarative
+    fault-scenario DSL (churn storms, flapping links, rolling
+    partitions, correlated crash bursts, asymmetric brownouts) that
+    compiles to the existing ``SwimWorld``/``LinkFaults`` schedule
+    arrays, plus the seeded severity-tiered campaign generator (any
+    failing scenario is a one-line repro).
+  - :mod:`scalecube_cluster_tpu.chaos.monitor` — the in-jit invariant
+    monitor: a fixed-capacity violation buffer carried through the scan
+    (the telemetry/trace.py pattern) evaluating the paper's
+    safety/liveness invariants every round on device, recording
+    first-violation evidence lanes with overflow counted — a violated
+    run COMPLETES and reports (graceful degradation), it never crashes.
+
+:mod:`scalecube_cluster_tpu.chaos.campaign` drives generated scenarios
+through the monitored run, cross-validates against the event-driven
+oracle at small N, and emits verdict manifests through the
+telemetry/sink.py JSONL pipeline (``bench.py --chaos``,
+``experiments/chaos_campaign.py``).
+"""
+
+from scalecube_cluster_tpu.chaos.monitor import (  # noqa: F401
+    DEFAULT_CAPACITY,
+    InvariantCode,
+    InvariantViolation,
+    MonitorSpec,
+    MonitorState,
+    decode_violations,
+    run_monitored,
+    verdict,
+)
+from scalecube_cluster_tpu.chaos.scenarios import (  # noqa: F401
+    Brownout,
+    ChurnStorm,
+    Crash,
+    CrashBurst,
+    FlappingLink,
+    Leave,
+    LinkLoss,
+    RollingPartition,
+    SEVERITIES,
+    Scenario,
+    completeness_bound,
+    generate_campaign,
+    generate_scenario,
+)
+from scalecube_cluster_tpu.chaos.campaign import (  # noqa: F401
+    CampaignResult,
+    ScenarioVerdict,
+    campaign_config,
+    cross_validate,
+    run_campaign,
+    run_scenario,
+)

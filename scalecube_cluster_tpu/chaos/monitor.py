@@ -1,0 +1,447 @@
+"""In-jit invariant monitor: the paper's guarantees checked every round
+ON DEVICE, with graceful degradation.
+
+The SWIM paper's headline properties — no false removal of a non-faulty
+member under a lossless network, monotone incarnations, bounded
+suspicion timers, time-bounded strong completeness after a permanent
+crash (PAPER.md) — were previously checked only by host-side numpy at
+N<=40 (tests/test_fuzz.py).  This module evaluates them INSIDE the
+``lax.scan`` that runs the protocol, so the same checks ride along at
+any scale the model simulates: the monitor state is a fixed-capacity
+violation buffer carried through the scan (the telemetry/trace.py
+pattern — fused elementwise derivation, one cumsum + one scatter,
+overflow counted, never silent).
+
+Invariant codes (:class:`InvariantCode`; lane values are stable):
+
+  FALSE_SUSPICION   a live observer newly marks a live subject SUSPECT
+                    although the scenario has no loss, link faults,
+                    delays or partitions — the "no false suspicion
+                    absent faults/loss" safety property.  Enabled per
+                    scenario (``MonitorSpec.check_false_suspicion``);
+                    under real network faults false suspicion is
+                    legitimate protocol behavior, not a violation.
+  INC_REGRESSION    a stored LIVE (ALIVE/SUSPECT) record's incarnation
+                    decreased without the record turning DEAD, or a
+                    node's own incarnation decreased: the
+                    monotone-incarnation property per cell.  (A DEAD
+                    winner may legally carry a lower incarnation —
+                    isOverrides case 3 — and a stored tombstone gates
+                    like ABSENT, so delete-then-re-add may restart the
+                    cell at any incarnation; records.py.)
+  TIMER_BOUND       a live observer's suspicion-timer contract broke:
+                    a pending timer on a non-SUSPECT entry, a SUSPECT
+                    entry without a timer, an expired timer that did
+                    not fire, or a deadline beyond
+                    round + suspicion_rounds.
+  WIRE_SATURATION   the carry holds an incarnation above the active
+                    wire key format's saturation point (or negative) —
+                    past it wire and table silently diverge at the
+                    merge gate (models/swim._wire_inc_sat).
+  COMPLETENESS      time-bounded completeness: past the scenario's
+                    per-subject ``complete_by`` deadline, an eligible
+                    observer (continuously alive since the subject's
+                    fault) still holds ALIVE/SUSPECT about a
+                    permanently crashed/left subject.
+
+Evidence policy: per code, the LANES record the violating cells of the
+first round that code trips (with overflow counted in ``dropped``);
+every later violating cell still counts in ``code_counts`` and the
+per-round totals, so the buffer cannot be flooded by a persistent
+violation re-firing each round — first-violation evidence plus exact
+totals, the graceful-degradation contract: a violated run completes
+and reports, it never crashes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalecube_cluster_tpu import records
+from scalecube_cluster_tpu.models import swim
+
+INT32_MAX = jnp.iinfo(jnp.int32).max
+
+# Violation-lane capacity: one round's worth of first-violation cells
+# per code is N*K worst case, but real violations cluster; 4096 lanes
+# (80 KB) is free next to any carry and far above the evidence a
+# diagnosable failure needs — overflow is counted, never silent.
+DEFAULT_CAPACITY = 1 << 12
+
+_N_LANES = 5  # (round, observer, subject, code, detail)
+
+
+class InvariantCode(enum.IntEnum):
+    """Violation kinds (module docstring; lane values stable — do not
+    renumber)."""
+
+    FALSE_SUSPICION = 0
+    INC_REGRESSION = 1
+    TIMER_BOUND = 2
+    WIRE_SATURATION = 3
+    COMPLETENESS = 4
+
+
+N_CODES = len(InvariantCode)
+
+
+# --------------------------------------------------------------------------
+# Carried state + the static-per-scenario spec
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MonitorState:
+    """Scan-carried violation evidence (module docstring).
+
+    ``lanes[i] = (round, observer, subject, code, detail)`` for
+    i < ``count``; ``detail`` is code-specific (incarnation, deadline,
+    or held status).  ``code_counts[c]`` totals EVERY violating cell of
+    code c across the run (not just recorded ones);
+    ``code_first_round[c]`` is the first round code c tripped
+    (INT32_MAX = never).  A run is green iff ``code_counts`` is all
+    zero.
+    """
+
+    lanes: jnp.ndarray              # [capacity, 5] int32
+    count: jnp.ndarray              # int32 scalar
+    dropped: jnp.ndarray            # int32 scalar (evidence overflow)
+    code_counts: jnp.ndarray        # [N_CODES] int32
+    code_first_round: jnp.ndarray   # [N_CODES] int32
+
+    @property
+    def capacity(self) -> int:
+        return self.lanes.shape[0]
+
+    @staticmethod
+    def init(capacity: int = DEFAULT_CAPACITY) -> "MonitorState":
+        return MonitorState(
+            lanes=jnp.full((capacity, _N_LANES), -1, dtype=jnp.int32),
+            count=jnp.int32(0),
+            dropped=jnp.int32(0),
+            code_counts=jnp.zeros((N_CODES,), dtype=jnp.int32),
+            code_first_round=jnp.full((N_CODES,), INT32_MAX,
+                                      dtype=jnp.int32),
+        )
+
+
+jax.tree_util.register_dataclass(
+    MonitorState,
+    data_fields=["lanes", "count", "dropped", "code_counts",
+                 "code_first_round"],
+    meta_fields=[],
+)
+
+
+@dataclasses.dataclass
+class MonitorSpec:
+    """What the scenario promises, so the monitor knows what to enforce.
+
+    ``complete_by`` [K] int32: per-subject completeness deadline —
+    by that round every eligible observer must have dropped the subject
+    (INT32_MAX = completeness unchecked for that subject; scenarios
+    compute deadlines from their fault/disruption schedules —
+    chaos/scenarios.Scenario.build).  ``check_false_suspicion`` is a
+    static (treedef) flag: True only when the scenario's network is
+    pristine, where any new suspicion of a live subject is a safety
+    violation.
+    """
+
+    complete_by: jnp.ndarray
+    check_false_suspicion: bool = False
+
+    @staticmethod
+    def passive(params: "swim.SwimParams") -> "MonitorSpec":
+        """Safety-only spec: monotone incarnations, timer bounds and
+        wire saturation checked; no scenario-derived liveness claims."""
+        return MonitorSpec(
+            complete_by=jnp.full((params.n_subjects,), INT32_MAX,
+                                 dtype=jnp.int32),
+            check_false_suspicion=False,
+        )
+
+
+jax.tree_util.register_dataclass(
+    MonitorSpec,
+    data_fields=["complete_by"],
+    meta_fields=["check_false_suspicion"],
+)
+
+
+# --------------------------------------------------------------------------
+# Per-round checking (called inside the scan body)
+# --------------------------------------------------------------------------
+
+
+def _record_flat(mon: MonitorState, mask, rows) -> MonitorState:
+    """Compact masked evidence rows into the lane buffer — the
+    telemetry/trace.record_events_batch shape: cumsum slot assignment,
+    ONE scatter, overflow counted (``cap`` index = drop)."""
+    cap = mon.capacity
+    slot = mon.count + jnp.cumsum(mask.astype(jnp.int32)) - 1
+    idx = jnp.where(mask & (slot < cap), slot, cap)
+    lanes = mon.lanes.at[idx].set(rows, mode="drop")
+    total = jnp.sum(mask, dtype=jnp.int32)
+    new_count = jnp.minimum(mon.count + total, cap)
+    dropped = mon.dropped + total - (new_count - mon.count)
+    return dataclasses.replace(mon, lanes=lanes, count=new_count,
+                               dropped=dropped)
+
+
+def check_round(mon: MonitorState, spec: MonitorSpec,
+                params: "swim.SwimParams", kn: "swim.Knobs", round_idx,
+                prev: "swim.SwimState", new: "swim.SwimState",
+                world: "swim.SwimWorld") -> MonitorState:
+    """Evaluate every invariant on one tick's (prev, new) WIDE carries.
+
+    Pure jnp, called inside the scan body; the whole evidence-recording
+    pass runs under a ``lax.cond`` and is skipped unless a code trips
+    for the first time, so green rounds cost a handful of fused
+    elementwise reductions.
+    """
+    n, k = prev.status.shape
+    node_ids = jnp.arange(n, dtype=jnp.int32)
+    subject_ids = jnp.asarray(world.subject_ids, jnp.int32)
+    alive_now = world.alive_at(round_idx)
+    obs_alive = alive_now[:, None]
+    subj_alive = alive_now[subject_ids][None, :]
+    is_self = subject_ids[None, :] == node_ids[:, None]
+
+    ps = prev.status
+    pi = prev.inc.astype(jnp.int32)
+    ns = new.status
+    ni = new.inc.astype(jnp.int32)
+    dl = new.suspect_deadline
+    sat = jnp.int32(swim._wire_inc_sat(params))
+
+    zero = jnp.zeros((n, k), dtype=jnp.bool_)
+
+    # FALSE_SUSPICION — new SUSPECT onset about a live subject on a
+    # pristine network (static flag: folds to the zero mask otherwise).
+    if spec.check_false_suspicion:
+        v_fs = (obs_alive & subj_alive & ~is_self
+                & (ns == records.SUSPECT) & (ps != records.SUSPECT))
+    else:
+        v_fs = zero
+
+    # INC_REGRESSION — per-cell monotonicity over LIVE prior records.
+    # A DEAD winner may legally carry a lower incarnation (isOverrides
+    # case 3), an ABSENT cell has no prior, and a stored DEAD tombstone
+    # gates like ABSENT (records.py storage convention) so the
+    # delete-then-re-add path may re-accept ALIVE at any incarnation.
+    v_inc = (((ps == records.ALIVE) | (ps == records.SUSPECT))
+             & (ns != records.DEAD) & (ni < pi))
+
+    # TIMER_BOUND — live observers' suspicion-timer contract.
+    susp = ns == records.SUSPECT
+    has_timer = dl != INT32_MAX
+    v_timer = obs_alive & (
+        (has_timer & ~susp)
+        | (susp & ~has_timer)
+        | (susp & has_timer & (dl <= round_idx))
+        | (has_timer & (dl > round_idx + kn.suspicion_rounds))
+    )
+
+    # WIRE_SATURATION — the carry must never exceed the wire cap.
+    v_sat = (ni > sat) | (ni < 0)
+
+    # COMPLETENESS — past the deadline, eligible observers must have
+    # dropped the subject.  Eligible = continuously alive since the
+    # subject's fault round: an observer whose own down window overlaps
+    # [fault, now] legitimately re-learns by FD re-detection on its own
+    # clock (SYNC never carries tombstones), so it is excluded.
+    fault_ref = jnp.minimum(world.down_from, world.leave_at)[subject_ids]
+    due = spec.complete_by[None, :] <= round_idx
+    disturbed = ((world.down_from[:, None] <= round_idx)
+                 & (world.down_until[:, None] > fault_ref[None, :]))
+    v_comp = (due & obs_alive & ~disturbed & ~is_self
+              & ((ns == records.ALIVE) | (ns == records.SUSPECT)))
+
+    vio = jnp.stack([v_fs, v_inc, v_timer, v_sat, v_comp])  # [C', N, K]
+    details = jnp.stack([ni, ni, jnp.where(has_timer, dl, -1), ni,
+                         ns.astype(jnp.int32)])
+    cell_code_of = jnp.asarray([
+        InvariantCode.FALSE_SUSPICION, InvariantCode.INC_REGRESSION,
+        InvariantCode.TIMER_BOUND, InvariantCode.WIRE_SATURATION,
+        InvariantCode.COMPLETENESS,
+    ], dtype=jnp.int32)
+
+    # Self-incarnation lanes (subject == observer): regression + cap.
+    v_self_inc = new.self_inc < prev.self_inc            # [N]
+    v_self_sat = new.self_inc > sat
+
+    totals = jnp.sum(vio, axis=(1, 2), dtype=jnp.int32)
+    totals = (totals
+              .at[InvariantCode.INC_REGRESSION]
+              .add(jnp.sum(v_self_inc, dtype=jnp.int32))
+              .at[InvariantCode.WIRE_SATURATION]
+              .add(jnp.sum(v_self_sat, dtype=jnp.int32)))
+
+    fresh = mon.code_counts == 0                          # [N_CODES]
+    new_counts = mon.code_counts + totals
+    first_round = jnp.where(
+        fresh & (totals > 0), jnp.asarray(round_idx, jnp.int32),
+        mon.code_first_round,
+    )
+
+    def record(m: MonitorState) -> MonitorState:
+        cell_fresh = fresh[cell_code_of][:, None, None]
+        obs_grid = jnp.broadcast_to(node_ids[None, :, None], vio.shape)
+        subj_grid = jnp.broadcast_to(subject_ids[None, None, :], vio.shape)
+        code_grid = jnp.broadcast_to(cell_code_of[:, None, None], vio.shape)
+        mask = jnp.concatenate([
+            (vio & cell_fresh).reshape(-1),
+            v_self_inc & fresh[InvariantCode.INC_REGRESSION],
+            v_self_sat & fresh[InvariantCode.WIRE_SATURATION],
+        ])
+        self_codes = (
+            jnp.full((n,), InvariantCode.INC_REGRESSION, jnp.int32),
+            jnp.full((n,), InvariantCode.WIRE_SATURATION, jnp.int32),
+        )
+        rows = jnp.stack([
+            jnp.full(mask.shape, round_idx, dtype=jnp.int32),
+            jnp.concatenate([obs_grid.reshape(-1), node_ids, node_ids]),
+            jnp.concatenate([subj_grid.reshape(-1), node_ids, node_ids]),
+            jnp.concatenate([code_grid.reshape(-1), *self_codes]),
+            jnp.concatenate([details.reshape(-1), new.self_inc,
+                             new.self_inc]),
+        ], axis=1)
+        return _record_flat(m, mask, rows)
+
+    mon = jax.lax.cond(
+        jnp.any(fresh & (totals > 0)), record, lambda m: m, mon
+    )
+    return dataclasses.replace(mon, code_counts=new_counts,
+                               code_first_round=first_round)
+
+
+# --------------------------------------------------------------------------
+# The monitored run
+# --------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("params", "n_rounds", "capacity"))
+def run_monitored(base_key, params: "swim.SwimParams",
+                  world: "swim.SwimWorld", spec: MonitorSpec,
+                  n_rounds: int, capacity: int = DEFAULT_CAPACITY,
+                  state: Optional["swim.SwimState"] = None,
+                  start_round: int = 0,
+                  knobs: Optional["swim.Knobs"] = None, shift_key=None,
+                  monitor: Optional[MonitorState] = None):
+    """``models/swim.run`` with the invariant monitor carried through
+    the scan.
+
+    Returns ``(final_state, monitor_state, metrics)``.  The monitor
+    only OBSERVES: protocol state and metrics are bit-identical to
+    ``swim.run`` on the same arguments, and a violated run completes
+    normally — the verdict lives in the returned
+    :class:`MonitorState` (graceful degradation).  ``monitor`` resumes
+    an existing buffer across chunked scans, like ``run_traced``'s
+    ``telemetry`` argument (the carry is NOT donated — chaos runs are
+    small-N adversarial workloads, not the 1M hot path).
+
+    Works on every carry layout: compact/int16 carries are decoded to
+    the wide form for checking only (``swim._carry_decode`` — lossless
+    below the caps the layouts already validate).
+    """
+    kn = knobs if knobs is not None else swim.Knobs.from_params(params)
+    if state is None:
+        state = swim.initial_state(params, world)
+    if monitor is None:
+        monitor = MonitorState.init(capacity)
+
+    def wide(st, cursor):
+        if params.compact_carry:
+            return swim._carry_decode(st, cursor)
+        if params.int16_wire:
+            return dataclasses.replace(st, inc=st.inc.astype(jnp.int32))
+        return st
+
+    def tick(carry, round_idx):
+        st, mon = carry
+        prev = wide(st, round_idx)
+        new_st, metrics = swim.swim_tick(st, round_idx, base_key, params,
+                                         world, knobs=kn,
+                                         shift_key=shift_key)
+        mon = check_round(mon, spec, params, kn, round_idx, prev,
+                          wide(new_st, round_idx + 1), world)
+        return (new_st, mon), metrics
+
+    (final_state, monitor), metrics = swim._fused_scan(
+        tick, (state, monitor), n_rounds, start_round,
+        params.rounds_per_step,
+    )
+    return final_state, monitor, metrics
+
+
+# --------------------------------------------------------------------------
+# Host-side decoding + verdicts
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class InvariantViolation:
+    """One recorded first-violation evidence lane."""
+
+    round: int
+    observer: int
+    subject: int
+    code: InvariantCode
+    detail: int
+
+    def to_json(self) -> dict:
+        return {
+            "round": self.round,
+            "observer": self.observer,
+            "subject": self.subject,
+            "code": self.code.name,
+            "detail": self.detail,
+        }
+
+
+def decode_violations(mon: MonitorState) -> List[InvariantViolation]:
+    """Device buffer -> typed evidence list (host side; exact recorded
+    prefix, ``mon.dropped`` counts what the capacity cut off)."""
+    lanes = np.asarray(mon.lanes)
+    return [
+        InvariantViolation(
+            round=int(lanes[i, 0]),
+            observer=int(lanes[i, 1]),
+            subject=int(lanes[i, 2]),
+            code=InvariantCode(int(lanes[i, 3])),
+            detail=int(lanes[i, 4]),
+        )
+        for i in range(int(mon.count))
+    ]
+
+
+def verdict(mon: MonitorState, max_evidence: int = 32) -> dict:
+    """Host-side verdict digest: green flag, per-code totals and first
+    rounds, and up to ``max_evidence`` decoded evidence lanes —
+    the JSONL-manifest-ready form."""
+    counts = np.asarray(mon.code_counts)
+    firsts = np.asarray(mon.code_first_round)
+    codes = {
+        InvariantCode(c).name: {
+            "violations": int(counts[c]),
+            "first_round": (int(firsts[c]) if firsts[c] != INT32_MAX
+                            else None),
+        }
+        for c in range(N_CODES)
+    }
+    return {
+        "green": bool(counts.sum() == 0),
+        "total_violations": int(counts.sum()),
+        "codes": codes,
+        "evidence_recorded": int(mon.count),
+        "evidence_dropped": int(mon.dropped),
+        "evidence": [v.to_json()
+                     for v in decode_violations(mon)[:max_evidence]],
+    }

@@ -1,0 +1,561 @@
+"""Declarative, composable fault-scenario DSL + seeded campaign generator.
+
+A :class:`Scenario` is a named list of fault OPS over an N-member world
+and a horizon; ``Scenario.build(params)`` compiles the ops to the
+existing dense fault schedules — ``SwimWorld``'s crash/leave/partition
+arrays and ``LinkFaults`` rules (models/swim.py) — plus the
+:class:`~scalecube_cluster_tpu.chaos.monitor.MonitorSpec` that tells
+the in-jit invariant monitor what the scenario promises:
+
+  - ``check_false_suspicion`` is on only for PRISTINE networks (no
+    loss, no link rules, no delays, no partitions): there, any new
+    suspicion of a live subject is a safety violation;
+  - per-subject completeness deadlines ``complete_by`` are derived
+    from the compiled schedules: a permanently crashed/left subject
+    must be dropped by every eligible observer within
+    :func:`completeness_bound` rounds of max(its fault round, the end
+    of the last network disruption).  Scenarios containing a PERMANENT
+    network disruption (a forever block/loss rule) make no completeness
+    promise — the disruption can legitimately isolate an observer.
+
+Ops (each is a frozen dataclass; ``apply(world, n, horizon)`` composes
+on the world builders, so op order is schedule-override order):
+
+  Crash / CrashBurst    process crash (single node / correlated set),
+                        optionally revived — ``SwimWorld.with_crash``.
+  Leave                 graceful leave — ``with_leave``.
+  ChurnStorm            staggered crash(/revive) waves over a node
+                        pool: wave w crashes its slice at
+                        ``start_round + w * wave_every``.
+  LinkLoss              one loss/delay rule — ``with_link_fault``.
+  FlappingLink          a link that goes fully down/up in cycles
+                        (n_cycles loss-1.0 windows).
+  Brownout              asymmetric range-to-range loss ramp: loss
+                        steps up to ``peak_loss``, holds, steps down.
+  RollingPartition      rotating split phases with re-heal phases in
+                        between, compiled to the ``partition_of``
+                        rolling schedule (explicit zero tail past the
+                        horizon so the cycle cannot wrap back into a
+                        split).
+
+Campaign generation: :func:`generate_scenario` is a PURE function of
+(seed, n, severity) — any failing scenario in a campaign is the
+one-line repro ``generate_scenario(seed=S, n=N, severity='tier')``.
+Severity tiers (:data:`SEVERITIES`): ``mild`` = one clean process or
+link fault on a lossless network; ``moderate`` = background wire loss
+plus two composed faults (bursts, churn, flaps, brownouts); ``severe``
+= rolling partitions + churn storms + brownouts over a lossy network.
+
+Compile hygiene: generated horizons are quantized (multiples of 64)
+and ``LinkFaults`` rule counts padded to a fixed width with
+match-nothing rules, so a campaign of many scenarios reuses a handful
+of compiled programs instead of one per scenario.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from scalecube_cluster_tpu.chaos.monitor import MonitorSpec
+from scalecube_cluster_tpu.models import swim
+
+INT32_MAX = int(jnp.iinfo(jnp.int32).max)
+
+SEVERITIES = ("mild", "moderate", "severe")
+
+# Fixed LinkFaults width generated scenarios pad to (match-nothing
+# rules are free: an empty id range matches no message).
+_RULE_PAD = 8
+_HORIZON_QUANTUM = 64
+
+
+def completeness_bound(params: "swim.SwimParams", n: int) -> int:
+    """Rounds within which a permanent crash/leave must be DEAD in every
+    eligible observer's view: detection slack (FD probe discovery has a
+    geometric tail over target draws) + the suspicion timeout +
+    dissemination/anti-entropy slack.  Deliberately generous — the
+    monitor's completeness check is a liveness CONTRACT, not a latency
+    benchmark (the latency histograms in telemetry/ measure that)."""
+    log2n = math.ceil(math.log2(n + 1))
+    return (params.suspicion_rounds
+            + 24 * max(1, params.ping_every)
+            + 4 * log2n
+            + 2 * max(1, params.sync_every)
+            + 16)
+
+
+# --------------------------------------------------------------------------
+# Ops
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Crash:
+    """Crash ``node`` during [at_round, until_round); INT32_MAX = forever."""
+
+    node: int
+    at_round: int
+    until_round: int = INT32_MAX
+
+    def apply(self, world, n, horizon):
+        return world.with_crash(self.node, self.at_round, self.until_round)
+
+    def disruption(self, n, horizon):
+        return None                      # process fault, not network
+
+
+@dataclasses.dataclass(frozen=True)
+class CrashBurst:
+    """Correlated burst: every node in ``nodes`` crashes at the same
+    round (revived together when ``until_round`` is finite)."""
+
+    nodes: Tuple[int, ...]
+    at_round: int
+    until_round: int = INT32_MAX
+
+    def apply(self, world, n, horizon):
+        return world.with_crash(list(self.nodes), self.at_round,
+                                self.until_round)
+
+    def disruption(self, n, horizon):
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Leave:
+    """Graceful leave at ``at_round`` (DEAD@inc+1 self-gossip, then down)."""
+
+    node: int
+    at_round: int
+
+    def apply(self, world, n, horizon):
+        return world.with_leave(self.node, self.at_round)
+
+    def disruption(self, n, horizon):
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnStorm:
+    """Staggered crash(/revive) waves: wave w crashes
+    ``nodes[w*wave_size:(w+1)*wave_size]`` at
+    ``start_round + w*wave_every``, each down for ``down_rounds``
+    (0 = permanent).  Node slices are disjoint by construction, so
+    waves never clobber each other's windows."""
+
+    nodes: Tuple[int, ...]
+    wave_size: int
+    start_round: int
+    wave_every: int
+    down_rounds: int = 0
+
+    def __post_init__(self):
+        if self.wave_size < 1 or len(self.nodes) % self.wave_size:
+            raise ValueError(
+                f"wave_size {self.wave_size} must divide the pool size "
+                f"{len(self.nodes)}")
+
+    @property
+    def n_waves(self) -> int:
+        return len(self.nodes) // self.wave_size
+
+    def apply(self, world, n, horizon):
+        for w in range(self.n_waves):
+            at = self.start_round + w * self.wave_every
+            until = at + self.down_rounds if self.down_rounds else INT32_MAX
+            world = world.with_crash(
+                list(self.nodes[w * self.wave_size:(w + 1) * self.wave_size]),
+                at, until)
+        return world
+
+    def disruption(self, n, horizon):
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkLoss:
+    """One per-link loss/delay rule (``src``/``dst``: id or (lo, hi))."""
+
+    src: object
+    dst: object
+    loss: float
+    delay_ms: float = 0.0
+    from_round: int = 0
+    until_round: int = INT32_MAX
+
+    def apply(self, world, n, horizon):
+        return world.with_link_fault(self.src, self.dst, self.loss,
+                                     self.delay_ms, self.from_round,
+                                     self.until_round)
+
+    def disruption(self, n, horizon):
+        if self.loss > 0.0 or self.delay_ms > 0.0:
+            return (self.from_round, self.until_round)
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class FlappingLink:
+    """src→dst link flaps: ``n_cycles`` windows of ``down_rounds`` at
+    ``loss`` (default full block), ``up_rounds`` healthy in between."""
+
+    src: int
+    dst: int
+    from_round: int
+    n_cycles: int
+    down_rounds: int
+    up_rounds: int
+    loss: float = 1.0
+
+    def __post_init__(self):
+        if self.down_rounds < 1 or self.n_cycles < 1:
+            raise ValueError(
+                f"FlappingLink needs down_rounds >= 1 and n_cycles >= 1 "
+                f"(got down_rounds={self.down_rounds}, "
+                f"n_cycles={self.n_cycles}) — a flap with no down window "
+                f"is no fault")
+
+    def apply(self, world, n, horizon):
+        period = self.down_rounds + self.up_rounds
+        for c in range(self.n_cycles):
+            start = self.from_round + c * period
+            world = world.with_link_fault(
+                self.src, self.dst, self.loss,
+                from_round=start, until_round=start + self.down_rounds)
+        return world
+
+    def disruption(self, n, horizon):
+        period = self.down_rounds + self.up_rounds
+        end = (self.from_round + (self.n_cycles - 1) * period
+               + self.down_rounds)
+        return (self.from_round, end)
+
+
+@dataclasses.dataclass(frozen=True)
+class Brownout:
+    """Asymmetric range-to-range loss ramp: loss steps
+    ``peak_loss/steps .. peak_loss`` over ``ramp_rounds``, holds at the
+    peak for ``hold_rounds`` (0 = ramp straight back down), then steps
+    back down — up to 2*steps+1 rules (zero-length windows are
+    skipped, not emitted)."""
+
+    src: Tuple[int, int]
+    dst: Tuple[int, int]
+    peak_loss: float
+    from_round: int
+    ramp_rounds: int
+    hold_rounds: int
+    steps: int = 3
+
+    def __post_init__(self):
+        if self.steps < 1 or self.ramp_rounds < 1:
+            raise ValueError(
+                f"Brownout needs steps >= 1 and ramp_rounds >= 1 (got "
+                f"steps={self.steps}, ramp_rounds={self.ramp_rounds})")
+
+    def _windows(self):
+        step_len = max(1, self.ramp_rounds // self.steps)
+        t = self.from_round
+        for i in range(1, self.steps + 1):          # ramp up
+            yield (t, t + step_len, self.peak_loss * i / self.steps)
+            t += step_len
+        if self.hold_rounds > 0:                    # hold at the peak
+            yield (t, t + self.hold_rounds, self.peak_loss)
+            t += self.hold_rounds
+        for i in range(self.steps - 1, 0, -1):      # ramp down
+            yield (t, t + step_len, self.peak_loss * i / self.steps)
+            t += step_len
+
+    def apply(self, world, n, horizon):
+        for lo, hi, loss in self._windows():
+            world = world.with_link_fault(tuple(self.src), tuple(self.dst),
+                                          loss, from_round=lo,
+                                          until_round=hi)
+        return world
+
+    def disruption(self, n, horizon):
+        end = max(hi for _, hi, _ in self._windows())
+        return (self.from_round, end)
+
+
+@dataclasses.dataclass(frozen=True)
+class RollingPartition:
+    """``n_cycles`` of [rotated half/half split for ``phase_rounds``,
+    then heal for ``phase_rounds``], starting at ``from_round`` (must be
+    a multiple of ``phase_rounds`` — the rolling schedule is
+    phase-quantized).  The compiled phase list is explicitly
+    zero-padded past the horizon so the cycle cannot wrap back into a
+    split within the run."""
+
+    from_round: int
+    phase_rounds: int
+    n_cycles: int
+    rotate: int = 0
+
+    def __post_init__(self):
+        if self.from_round % self.phase_rounds:
+            raise ValueError(
+                f"from_round ({self.from_round}) must be a multiple of "
+                f"phase_rounds ({self.phase_rounds}) — partition_at "
+                f"quantizes the rolling schedule by phase")
+
+    def apply(self, world, n, horizon):
+        lead = self.from_round // self.phase_rounds
+        phases = [[0] * n for _ in range(lead)]
+        for c in range(self.n_cycles):
+            phases.append([
+                1 if ((i + c * self.rotate) % n) < n // 2 else 0
+                for i in range(n)
+            ])
+            phases.append([0] * n)
+        while len(phases) * self.phase_rounds <= horizon:
+            phases.append([0] * n)
+        return world.with_partition_schedule(
+            np.asarray(phases, dtype=np.int8), self.phase_rounds)
+
+    def disruption(self, n, horizon):
+        lead = self.from_round // self.phase_rounds
+        end = (lead + 2 * self.n_cycles - 1) * self.phase_rounds
+        return (self.from_round, end)
+
+
+# --------------------------------------------------------------------------
+# Scenario
+# --------------------------------------------------------------------------
+
+
+def _pad_rules(faults: "swim.LinkFaults", total: int) -> "swim.LinkFaults":
+    """Pad the rule arrays to ``total`` with match-nothing rules (empty
+    id ranges) so scenarios with different rule counts share one traced
+    shape — the last-match-wins evaluation is unaffected."""
+    r = faults.n_rules
+    if r >= total:
+        return faults
+    pad = total - r
+
+    def cat(a, v, dtype):
+        return jnp.concatenate(
+            [a, jnp.full((pad,), v, dtype=dtype)])
+
+    return swim.LinkFaults(
+        src_lo=cat(faults.src_lo, 0, jnp.int32),
+        src_hi=cat(faults.src_hi, 0, jnp.int32),     # empty range
+        dst_lo=cat(faults.dst_lo, 0, jnp.int32),
+        dst_hi=cat(faults.dst_hi, 0, jnp.int32),
+        from_round=cat(faults.from_round, 0, jnp.int32),
+        until_round=cat(faults.until_round, 0, jnp.int32),
+        loss=cat(faults.loss, 0.0, jnp.float32),
+        delay_ms=cat(faults.delay_ms, 0.0, jnp.float32),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One declarative fault scenario (module docstring).
+
+    ``loss_probability`` is the background symmetric wire loss the
+    scenario asks the run for (a params knob, not a world schedule —
+    campaign.run_scenario applies it).  ``extra_slack`` widens the
+    completeness deadlines for hand-built scenarios whose network is
+    harsher than the generator's tiers.  ``seed``/``severity`` are
+    campaign provenance; :meth:`repro` is the one-line reconstruction
+    of a generated scenario.
+    """
+
+    name: str
+    n_members: int
+    horizon: int
+    ops: Tuple[object, ...]
+    loss_probability: float = 0.0
+    seed: Optional[int] = None
+    severity: Optional[str] = None
+    extra_slack: int = 0
+
+    def repro(self) -> str:
+        if self.seed is not None and self.severity is not None:
+            return (f"chaos.generate_scenario(seed={self.seed}, "
+                    f"n={self.n_members}, severity={self.severity!r})")
+        return f"<hand-built scenario {self.name!r}>"
+
+    def build(self, params: "swim.SwimParams",
+              rule_pad: int = _RULE_PAD):
+        """Compile to ``(SwimWorld, MonitorSpec)`` for ``params``."""
+        n = params.n_members
+        if n != self.n_members:
+            raise ValueError(
+                f"scenario {self.name!r} is for n_members="
+                f"{self.n_members}, params has {n}")
+        world = swim.SwimWorld.healthy(params)
+        for op in self.ops:
+            world = op.apply(world, n, self.horizon)
+        r = world.faults.n_rules
+        pad_to = max(rule_pad, -(-r // max(1, rule_pad)) * rule_pad)
+        world = dataclasses.replace(
+            world, faults=_pad_rules(world.faults, pad_to))
+
+        disruptions = [d for d in
+                       (op.disruption(n, self.horizon) for op in self.ops)
+                       if d is not None]
+        permanent_disruption = any(d[1] >= INT32_MAX for d in disruptions)
+        disruption_end = max((d[1] for d in disruptions), default=0)
+        pristine = (not disruptions
+                    and params.loss_probability == 0.0
+                    and self.loss_probability == 0.0
+                    and params.mean_delay_ms == 0.0)
+
+        bound = completeness_bound(params, n) + self.extra_slack
+        df = np.asarray(world.down_from, dtype=np.int64)
+        du = np.asarray(world.down_until, dtype=np.int64)
+        la = np.asarray(world.leave_at, dtype=np.int64)
+        fault = np.minimum(df, la)
+        permanent = (fault < INT32_MAX) & (du >= INT32_MAX)
+        checkable = permanent & (not permanent_disruption)
+        deadline = np.where(
+            checkable,
+            np.minimum(np.maximum(fault, disruption_end) + bound,
+                       INT32_MAX),
+            INT32_MAX,
+        )
+        slot = np.asarray(world.slot_of_node)
+        complete_by = np.full(params.n_subjects, INT32_MAX, dtype=np.int64)
+        tracked = slot >= 0
+        complete_by[slot[tracked]] = deadline[tracked]
+        spec = MonitorSpec(
+            complete_by=jnp.asarray(complete_by.astype(np.int32)),
+            check_false_suspicion=pristine,
+        )
+        return world, spec
+
+
+# --------------------------------------------------------------------------
+# Seeded campaign generation
+# --------------------------------------------------------------------------
+
+
+def _quantize_horizon(rounds: int) -> int:
+    return -(-rounds // _HORIZON_QUANTUM) * _HORIZON_QUANTUM
+
+
+def generate_scenario(seed: int, n: int = 32, severity: str = "moderate",
+                      params: Optional["swim.SwimParams"] = None
+                      ) -> Scenario:
+    """One scenario, a PURE function of (seed, n, severity) — the
+    campaign repro unit.  ``params`` only shapes the completeness/
+    horizon arithmetic (defaults to the campaign timing preset at n;
+    chaos/campaign.campaign_config)."""
+    if severity not in SEVERITIES:
+        raise ValueError(f"unknown severity {severity!r} "
+                         f"(choose from {SEVERITIES})")
+    if n < 16:
+        raise ValueError(f"campaign scenarios need n >= 16 (got {n})")
+    if params is None:
+        from scalecube_cluster_tpu.chaos.campaign import campaign_config
+        params = swim.SwimParams.from_config(campaign_config(), n_members=n)
+    rng = np.random.default_rng(
+        np.random.SeedSequence([seed, SEVERITIES.index(severity)]))
+    pool = [int(x) for x in rng.permutation(n)]
+    bound = completeness_bound(params, n)
+    revive_down = int(2 * params.suspicion_rounds + 24)
+
+    def take(k):
+        out, pool[:] = pool[:k], pool[k:]
+        return out
+
+    ops, kinds = [], []
+
+    def add(kind, op):
+        kinds.append(kind)
+        ops.append(op)
+
+    def op_crash():
+        add("crash", Crash(take(1)[0], at_round=int(rng.integers(0, 11))))
+
+    def op_crash_revive():
+        at = int(rng.integers(0, 9))
+        add("crash_revive",
+            Crash(take(1)[0], at_round=at, until_round=at + revive_down))
+
+    def op_leave():
+        add("leave", Leave(take(1)[0], at_round=int(rng.integers(2, 13))))
+
+    def op_flap():
+        s, d = take(2)
+        add("flap", FlappingLink(s, d, from_round=int(rng.integers(0, 9)),
+                                 n_cycles=3, down_rounds=4, up_rounds=6))
+
+    def op_burst(permanent=True):
+        sz = int(rng.integers(2, 4))
+        at = int(rng.integers(2, 11))
+        until = INT32_MAX if permanent else at + revive_down
+        add("burst", CrashBurst(tuple(take(sz)), at_round=at,
+                                until_round=until))
+
+    def op_churn(permanent):
+        nodes = tuple(take(4))
+        add("churn", ChurnStorm(nodes, wave_size=2,
+                                start_round=int(rng.integers(2, 7)),
+                                wave_every=int(rng.integers(6, 13)),
+                                down_rounds=0 if permanent else revive_down))
+
+    def op_brownout():
+        half = n // 2
+        add("brownout", Brownout(
+            src=(0, half), dst=(half, n),
+            peak_loss=float(rng.choice([0.3, 0.5])),
+            from_round=int(rng.integers(0, 9)),
+            ramp_rounds=12, hold_rounds=10))
+
+    loss = 0.0
+    if severity == "mild":
+        rng.choice([op_crash, op_crash_revive, op_leave, op_flap])()
+    elif severity == "moderate":
+        loss = float(rng.choice([0.0, 0.02, 0.05]))
+        menu = [lambda: op_burst(bool(rng.integers(0, 2))),
+                lambda: op_churn(bool(rng.integers(0, 2))),
+                op_flap, op_brownout, op_leave]
+        for f in rng.choice(len(menu), size=2, replace=False):
+            menu[int(f)]()
+    else:                                           # severe
+        loss = float(rng.choice([0.05, 0.1]))
+        add("partition", RollingPartition(
+            from_round=0, phase_rounds=16, n_cycles=2,
+            rotate=int(rng.integers(0, n))))
+        op_churn(permanent=bool(rng.integers(0, 2)))
+        (op_brownout if rng.integers(0, 2) else op_flap)()
+
+    # Horizon: every fault/disruption resolved, plus the completeness
+    # bound and a margin — quantized so campaigns share compilations.
+    ends = [0]
+    for op in ops:
+        d = op.disruption(n, 10 ** 9)
+        if d is not None and d[1] < INT32_MAX:
+            ends.append(d[1])
+        for attr in ("at_round", "until_round", "start_round"):
+            v = getattr(op, attr, None)
+            if v is not None and v < INT32_MAX:
+                ends.append(int(v))
+        if isinstance(op, ChurnStorm):
+            ends.append(op.start_round
+                        + op.n_waves * op.wave_every + op.down_rounds)
+    horizon = _quantize_horizon(max(ends) + bound + 24)
+    name = f"{severity}-{seed}-" + "+".join(kinds)
+    return Scenario(name=name, n_members=n, horizon=horizon,
+                    ops=tuple(ops), loss_probability=loss, seed=seed,
+                    severity=severity)
+
+
+def generate_campaign(seed: int, n_scenarios: int, n: int = 32,
+                      severities: Sequence[str] = SEVERITIES) -> list:
+    """``n_scenarios`` scenarios cycling through ``severities``;
+    scenario i is ``generate_scenario(seed + i, n, severities[i %
+    len(severities)])`` — every member is its own one-line repro."""
+    return [
+        generate_scenario(seed + i, n=n,
+                          severity=severities[i % len(severities)])
+        for i in range(n_scenarios)
+    ]

@@ -443,13 +443,35 @@ class LinkFaults:
     def add(self, src, dst, loss: float, delay_ms: float = 0.0,
             from_round: int = 0, until_round: int = INT32_MAX) -> "LinkFaults":
         """Append one rule.  ``src``/``dst`` are a node id or an (lo, hi)
-        half-open id range."""
+        half-open id range.
+
+        Host-side schedule builder: arguments are validated eagerly —
+        a loss outside [0, 1], an empty id range (``lo >= hi``) or an
+        inverted round window (``from_round >= until_round``) raises
+        instead of appending a rule that silently matches nothing (or,
+        for a bad loss, everything the sampler compares against).
+        """
         def rng(x):
             if isinstance(x, (tuple, list)):
                 return int(x[0]), int(x[1])
             return int(x), int(x) + 1
         s_lo, s_hi = rng(src)
         d_lo, d_hi = rng(dst)
+        if not 0.0 <= float(loss) <= 1.0:
+            raise ValueError(
+                f"loss must be a probability in [0, 1] (got {loss!r})")
+        if float(delay_ms) < 0.0:
+            raise ValueError(
+                f"delay_ms must be non-negative (got {delay_ms!r})")
+        if s_lo >= s_hi or d_lo >= d_hi:
+            raise ValueError(
+                f"empty id range: src=[{s_lo}, {s_hi}), dst=[{d_lo}, "
+                f"{d_hi}) — a half-open range needs lo < hi, and a rule "
+                f"over an empty range would silently match nothing")
+        if int(from_round) >= int(until_round):
+            raise ValueError(
+                f"inverted round window [{from_round}, {until_round}) — "
+                f"the rule would silently never apply")
 
         def cat(a, v, dtype):
             return jnp.concatenate([a, jnp.asarray([v], dtype=dtype)])
@@ -612,9 +634,39 @@ class SwimWorld:
                 jnp.int32(at_round)),
         )
 
+    def _checked_node_ids(self, node, method: str) -> jnp.ndarray:
+        """[ids] int32, validated in range [0, N) when concrete.
+
+        ``jnp .at[].set`` silently DROPS out-of-bounds updates, so a
+        typo'd node id would produce a healthy world and a vacuously
+        green scenario — the same guard ``with_spread`` already has for
+        gossip indices.  Traced ids (inside jit) can't be inspected and
+        pass through unchecked.
+        """
+        import numpy as np
+
+        n = self.down_from.shape[0]
+        ids = jnp.atleast_1d(jnp.asarray(node, dtype=jnp.int32))
+        try:
+            concrete = np.asarray(ids)
+        except Exception:  # noqa: BLE001 — tracer: defer to runtime semantics
+            return ids
+        if concrete.size and (concrete.min() < 0 or concrete.max() >= n):
+            bad = concrete[(concrete < 0) | (concrete >= n)]
+            raise ValueError(
+                f"{method}: node id(s) {bad.tolist()} out of range for "
+                f"n_members={n} (jnp would silently drop the "
+                f"out-of-bounds update)")
+        return ids
+
     def with_crash(self, node, at_round: int, until_round: int = INT32_MAX):
-        """Crash ``node`` (scalar or array) during [at_round, until_round)."""
-        node = jnp.atleast_1d(jnp.asarray(node, dtype=jnp.int32))
+        """Crash ``node`` (scalar or array) during [at_round, until_round).
+
+        ``until_round <= at_round`` is an EMPTY down window: the node is
+        never down (``alive_at`` tests ``down_from <= r < down_until``)
+        — the revive-before-crash composition edge, pinned by
+        tests/test_swim_world_validation.py."""
+        node = self._checked_node_ids(node, "with_crash")
         return dataclasses.replace(
             self,
             down_from=self.down_from.at[node].set(at_round),
@@ -623,8 +675,12 @@ class SwimWorld:
 
     def with_leave(self, node, at_round: int):
         """Graceful leave: gossip own DEAD@inc+1 at ``at_round``, then down
-        (MembershipProtocolImpl.leaveCluster, :197-206)."""
-        node = jnp.atleast_1d(jnp.asarray(node, dtype=jnp.int32))
+        (MembershipProtocolImpl.leaveCluster, :197-206).
+
+        Overwrites any prior crash window for the same node (one down
+        schedule per node — the leave clobbers the crash; composition
+        edge pinned by tests/test_swim_world_validation.py)."""
+        node = self._checked_node_ids(node, "with_leave")
         return dataclasses.replace(
             self,
             leave_at=self.leave_at.at[node].set(at_round),
@@ -665,9 +721,11 @@ class SwimWorld:
 
     def with_seeds(self, seed_ids) -> "SwimWorld":
         """Configure seed members (enables the known-or-seed contact gate
-        in full-view mode — see class docstring)."""
+        in full-view mode — see class docstring).  Ids are range-checked
+        like the crash/leave schedules: an out-of-range seed id would
+        otherwise gate every contact on a member that doesn't exist."""
         return dataclasses.replace(
-            self, seed_ids=jnp.atleast_1d(jnp.asarray(seed_ids, jnp.int32))
+            self, seed_ids=self._checked_node_ids(seed_ids, "with_seeds")
         )
 
     def alive_at(self, round_idx):

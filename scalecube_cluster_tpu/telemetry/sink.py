@@ -11,6 +11,13 @@ the dense runs' equivalent durable surface is one JSONL file per run:
             {"kind": "curve", ...}      per-round series (downsampled)
             {"kind": "summary", ...}    closing totals
 
+Chaos campaigns (chaos/campaign.py) reuse the same pipeline with two
+more kinds via :meth:`TelemetrySink.write_record`:
+``{"kind": "chaos_scenario", ...}`` — one verdict row per scenario
+(green flag, per-invariant-code violation counts + first rounds,
+first-violation evidence lanes, counter digests, the one-line repro) —
+and ``{"kind": "chaos_verdict", ...}``, the campaign summary.
+
 Everything is line-delimited JSON so a run is greppable, appendable and
 stream-parseable; :func:`read_records` / :func:`read_events` round-trip
 it (pinned by tests/test_telemetry_sink.py).
@@ -203,6 +210,12 @@ class TelemetrySink:
 
     def write_summary(self, **fields) -> None:
         self._write("summary", fields)
+
+    def write_record(self, kind: str, payload: dict) -> None:
+        """Generic typed row for schema extensions that don't warrant a
+        dedicated writer (the chaos verdict rows — module docstring).
+        ``payload`` must be JSON-serializable."""
+        self._write(kind, payload)
 
     def close(self) -> None:
         if not self._closed:

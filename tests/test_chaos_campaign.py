@@ -1,0 +1,159 @@
+"""Campaign runner + the bench --chaos --smoke contract.
+
+Tier-1 keeps a mini campaign (one scenario per severity tier, small N)
+plus the ``bench.py --chaos --smoke`` subprocess pin (the --smoke
+contract style of tests/test_bench_smoke.py, shrunk further through the
+documented env overrides to stay tier-1-safe).  The full >= 20-scenario
+acceptance campaign runs under the ``slow`` marker (and in CI-adjacent
+sweeps via ``experiments/chaos_campaign.py`` / ``bench.py --chaos``).
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from scalecube_cluster_tpu.chaos import campaign as cc
+from scalecube_cluster_tpu.chaos import monitor as cm
+from scalecube_cluster_tpu.chaos import scenarios as cs
+from scalecube_cluster_tpu.telemetry import sink as tsink
+
+pytestmark = pytest.mark.chaos
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_mini_campaign_green_with_manifest(tmp_path):
+    """One generated scenario per severity tier runs green through the
+    monitored scan, and the JSONL manifest round-trips: manifest header,
+    one chaos_scenario row per scenario (verdict + repro), closing
+    chaos_verdict summary."""
+    scens = [cs.generate_scenario(seed=100 + i, n=24, severity=sev)
+             for i, sev in enumerate(cs.SEVERITIES)]
+    with tsink.TelemetrySink(str(tmp_path), prefix="chaos") as sink:
+        result = cc.run_campaign(scens, seed=0, sink=sink)
+
+    assert result.green, result.summary()
+    summary = result.summary()
+    assert summary["scenarios"] == 3
+    assert summary["green_scenarios"] == 3
+    assert summary["failing_repros"] == []
+    assert set(summary["violations_by_code"]) \
+        == {c.name for c in cm.InvariantCode}
+    assert all(v == 0 for v in summary["violations_by_code"].values())
+
+    rows = tsink.read_records(result.manifest_path, kind="chaos_scenario")
+    assert len(rows) == 3
+    for row, scen in zip(rows, scens):
+        assert row["name"] == scen.name
+        assert row["green"] is True
+        assert scen.repro() in row["repro"]     # + run seed & delivery
+        assert f"severity={scen.severity!r}" in row["repro"]
+        assert row["verdict"]["total_violations"] == 0
+        assert row["counters"]["messages_gossip"] > 0
+    (verdict_row,) = tsink.read_records(result.manifest_path,
+                                        kind="chaos_verdict")
+    assert verdict_row["green"] is True
+    (manifest,) = tsink.read_records(result.manifest_path, kind="manifest")
+    assert manifest["config_digest"]
+    assert manifest["workload"]["kind"] == "chaos_campaign"
+    assert manifest["workload"]["scenarios"] == 3
+
+
+def test_red_scenario_reports_instead_of_failing(tmp_path):
+    """Graceful degradation end-to-end: a campaign containing a broken
+    scenario (completeness promised absurdly early) COMPLETES, writes
+    the red verdict row with evidence, and names the repro."""
+    good = cs.generate_scenario(seed=100, n=24, severity="mild")
+    # Hand-broken: a permanent crash whose completeness deadline is
+    # pulled (negative extra_slack) to 2 rounds after the crash —
+    # before the protocol can possibly detect + time out the fault.
+    broken = cs.Scenario(
+        name="broken-deadline", n_members=24, horizon=192,
+        ops=(cs.Crash(3, at_round=5),),
+        extra_slack=-cs.completeness_bound(
+            cc.campaign_params(good), 24) + 2,
+    )
+    scens = [good, broken]
+    with tsink.TelemetrySink(str(tmp_path), prefix="chaos") as sink:
+        result = cc.run_campaign(scens, seed=0, sink=sink)
+    assert not result.green
+    summary = result.summary()
+    assert summary["green_scenarios"] >= 1
+    assert summary["violations_by_code"]["COMPLETENESS"] > 0
+    # The repro line names the scenario AND the run seed (seed 0 + index
+    # 1): violations depend on the PRNG stream, so the full line is
+    # what reproduces.
+    (repro,) = summary["failing_repros"]
+    assert broken.repro() in repro and "seed=1" in repro
+    red_rows = [r for r in tsink.read_records(result.manifest_path,
+                                              kind="chaos_scenario")
+                if not r["green"]]
+    assert red_rows and red_rows[0]["verdict"]["evidence"]
+
+
+@pytest.mark.slow
+def test_full_campaign_20_scenarios_green(tmp_path):
+    """The acceptance-criterion campaign: >= 20 generated scenarios
+    across all severity tiers, zero invariant violations."""
+    scens = cs.generate_campaign(seed=100, n_scenarios=21, n=32)
+    with tsink.TelemetrySink(str(tmp_path), prefix="chaos") as sink:
+        result = cc.run_campaign(scens, seed=0, sink=sink)
+    assert result.green, result.summary()
+    assert result.summary()["scenarios"] == 21
+
+
+def test_bench_chaos_smoke_emits_result_and_manifest(tmp_path):
+    """bench.py --chaos --smoke: one JSON line, green mini campaign,
+    parseable chaos manifest — shrunk via the documented env overrides
+    so the pin stays tier-1-safe."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_TPU_TELEMETRY_DIR=str(tmp_path),
+        SCALECUBE_XLA_CACHE_DIR="",
+        SCALECUBE_CHAOS_SCENARIOS="3",
+        SCALECUBE_CHAOS_N="16",
+    )
+    env.pop("SCALECUBE_TPU_PROFILE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--chaos", "--smoke"],
+        capture_output=True, text=True, timeout=540, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout      # exactly ONE JSON line
+    result = json.loads(lines[0])
+
+    assert "error" not in result, result
+    assert result["metric"] == "chaos_campaign_green_scenarios"
+    assert result["smoke"] is True
+    assert result["scenarios"] == 3
+    assert result["value"] == 3              # all green
+    assert result["green"] is True
+    assert result["failing_repros"] == []
+    assert all(v == 0 for v in result["violations_by_code"].values())
+
+    path = result["manifest"]
+    assert os.path.dirname(path) == str(tmp_path)
+    kinds = {r["kind"] for r in tsink.read_records(path)}
+    assert {"manifest", "chaos_scenario", "chaos_verdict"} <= kinds
+    rows = tsink.read_records(path, kind="chaos_scenario")
+    assert len(rows) == 3 and all(r["green"] for r in rows)
+
+
+def test_bench_rejects_chaos_with_throughput_flags():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--chaos", "--traced"],
+        capture_output=True, text=True, timeout=60, cwd=str(REPO),
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode != 0
+    # The one-JSON-line contract holds even for bad argv.
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1
+    assert json.loads(lines[0])["value"] is None

@@ -1,0 +1,69 @@
+"""Chaos verdicts vs the event-driven oracle at small N.
+
+The acceptance criterion's second leg: under IDENTICAL fault schedules
+the monitor's green verdict must agree with oracle cross-validation —
+the model's on-device event trace and the oracle's listener stream
+yield the same timing-free (observer, subject, type, incarnation) key
+sets (telemetry/events.py), per victim, over continuously-live
+observers.  ``chaos.campaign.cross_validate`` replays crash schedules
+as the oracle's full link blockade and leaves as ``Cluster.shutdown``
+(the proven mapping of tests/test_telemetry_trace.py).
+"""
+
+import pytest
+
+from scalecube_cluster_tpu.chaos import campaign as cc
+from scalecube_cluster_tpu.chaos import scenarios as cs
+
+pytestmark = pytest.mark.chaos
+
+N = 16
+
+
+def test_permanent_crash_verdict_agrees_with_oracle():
+    scen = cs.Scenario(name="xval-crash", n_members=N, horizon=128,
+                       ops=(cs.Crash(3, at_round=2),))
+    v = cc.run_scenario(scen, seed=1)
+    assert v.green, v.verdict["codes"]
+    cv = cc.cross_validate(scen, seed=1)
+    assert cv is not None
+    assert cv["agree"], cv["victims"]
+    assert cv["observers"] == N - 1
+    assert cv["victims"]["3"] == {"only_model": [], "only_oracle": []}
+
+
+def test_graceful_leave_verdict_agrees_with_oracle():
+    scen = cs.Scenario(name="xval-leave", n_members=N, horizon=96,
+                       ops=(cs.Leave(4, at_round=6),))
+    v = cc.run_scenario(scen, seed=2)
+    assert v.green, v.verdict["codes"]
+    cv = cc.cross_validate(scen, seed=2)
+    assert cv is not None and cv["agree"], cv["victims"]
+
+
+def test_inexpressible_scenarios_return_none():
+    """Scenarios the oracle can't replay faithfully are declined, not
+    mis-compared: network ops, background loss, and short (non-quiescent)
+    crash/revive windows."""
+    for ops, loss in (
+        ((cs.LinkLoss(0, 1, loss=0.5),), 0.0),
+        ((cs.Crash(3, at_round=2),), 0.05),
+        ((cs.Crash(3, at_round=2, until_round=12),), 0.0),  # too short
+    ):
+        scen = cs.Scenario(name="nope", n_members=N, horizon=96,
+                           ops=ops, loss_probability=loss)
+        assert cc.cross_validate(scen, seed=0) is None, ops
+
+
+def test_campaign_attaches_cross_validation(tmp_path):
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+
+    scen = cs.Scenario(name="xval-crash", n_members=N, horizon=128,
+                       ops=(cs.Crash(5, at_round=3),))
+    with tsink.TelemetrySink(str(tmp_path), prefix="chaos") as sink:
+        result = cc.run_campaign([scen], seed=3, sink=sink,
+                                 cross_validate_small_n=True)
+    assert result.green
+    (row,) = tsink.read_records(result.manifest_path,
+                                kind="chaos_scenario")
+    assert row["cross_validation"]["agree"] is True

@@ -1,0 +1,261 @@
+"""In-jit invariant monitor: green on correct runs, the matching code
+(and ONLY evidence — never a crash) on broken ones.
+
+Graceful degradation is the contract under test: a violated run
+completes, returns its full metrics, and reports (round, observer,
+subject, code, detail) evidence lanes with overflow counted — the
+acceptance criterion's "trips the matching invariant code rather than
+crashing the run".
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.chaos import campaign as cc
+from scalecube_cluster_tpu.chaos import monitor as cm
+from scalecube_cluster_tpu.chaos import scenarios as cs
+from scalecube_cluster_tpu.models import swim
+
+pytestmark = pytest.mark.chaos
+
+INT32_MAX = cs.INT32_MAX
+N = 24
+
+
+def crash_scenario(**kw):
+    return cs.Scenario(name="crash", n_members=N, horizon=192,
+                       ops=(cs.Crash(3, at_round=5),), **kw)
+
+
+def run(scen, spec=None, knobs=None, state=None, capacity=256, seed=0,
+        horizon=None, params=None):
+    params = params if params is not None else cc.campaign_params(scen)
+    world, built_spec = scen.build(params)
+    return cm.run_monitored(
+        jax.random.key(seed), params, world,
+        built_spec if spec is None else spec,
+        horizon or scen.horizon, capacity=capacity, state=state,
+        knobs=knobs,
+    ), params, world, built_spec
+
+
+# --------------------------------------------------------------------------
+# Green paths
+# --------------------------------------------------------------------------
+
+
+def test_healthy_and_crash_runs_are_green():
+    (_, mon, metrics), _, _, _ = run(crash_scenario())
+    v = cm.verdict(mon)
+    assert v["green"] and v["total_violations"] == 0
+    assert v["evidence"] == [] and v["evidence_dropped"] == 0
+    # The run's protocol metrics come back intact (the monitor only
+    # observes — swim.run semantics unchanged).
+    assert int(np.asarray(metrics["dead"])[-1, 3]) == N - 1
+
+
+@pytest.mark.parametrize("layout", [{}, {"compact_carry": True},
+                                    {"int16_wire": True}])
+def test_monitor_is_layout_transparent(layout):
+    scen = crash_scenario()
+    params = cc.campaign_params(scen, **layout)
+    (_, mon, _), _, _, _ = run(scen, params=params)
+    assert cm.verdict(mon)["green"], (layout, cm.verdict(mon)["codes"])
+
+
+def test_monitor_is_deterministic():
+    (_, a, _), _, _, _ = run(crash_scenario(), seed=4)
+    (_, b, _), _, _, _ = run(crash_scenario(), seed=4)
+    assert np.array_equal(np.asarray(a.lanes), np.asarray(b.lanes))
+    assert int(a.count) == int(b.count)
+    assert np.array_equal(np.asarray(a.code_counts),
+                          np.asarray(b.code_counts))
+
+
+# --------------------------------------------------------------------------
+# Broken scenarios trip the MATCHING code (and never crash)
+# --------------------------------------------------------------------------
+
+
+def broken_codes(mon):
+    v = cm.verdict(mon)
+    return {c for c, d in v["codes"].items() if d["violations"]}
+
+
+def test_suspicion_timeout_above_completeness_bound_trips_completeness():
+    """The acceptance-criterion scenario: the spec's completeness
+    deadline assumes params.suspicion_rounds, but the run's (traced)
+    suspicion timeout is far larger — removal provably lands after the
+    deadline, tripping COMPLETENESS (with evidence), not an exception."""
+    scen = crash_scenario()
+    params = cc.campaign_params(scen)
+    kn = swim.Knobs.from_params(params)
+    kn = dataclasses.replace(
+        kn, suspicion_rounds=jnp.int32(10 * params.suspicion_rounds))
+    (_, mon, _), _, _, spec = run(scen, knobs=kn)
+    v = cm.verdict(mon)
+    assert not v["green"]
+    assert broken_codes(mon) == {"COMPLETENESS"}
+    assert v["codes"]["COMPLETENESS"]["first_round"] \
+        == int(spec.complete_by[3])
+    ev = v["evidence"]
+    assert ev and all(e["code"] == "COMPLETENESS" and e["subject"] == 3
+                      for e in ev)
+
+
+def test_loss_with_pristine_spec_trips_false_suspicion():
+    """A scenario that PROMISES a pristine network but runs with 25%
+    wire loss: FALSE_SUSPICION trips with (observer, subject) evidence
+    — the no-false-suspicion-absent-faults safety property, violated
+    on purpose."""
+    scen = crash_scenario()
+    params = cc.campaign_params(scen, loss_probability=0.25)
+    world, spec = scen.build(params)
+    assert not spec.check_false_suspicion      # build() is honest
+    forced = dataclasses.replace(spec, check_false_suspicion=True,
+                                 complete_by=jnp.full(
+                                     (N,), INT32_MAX, jnp.int32))
+    _, mon, _ = cm.run_monitored(jax.random.key(0), params, world,
+                                 forced, 120, capacity=256)
+    assert broken_codes(mon) == {"FALSE_SUSPICION"}
+    ev = cm.decode_violations(mon)
+    assert ev and all(e.code == cm.InvariantCode.FALSE_SUSPICION
+                      for e in ev)
+
+
+def test_corrupt_timer_state_trips_timer_bound():
+    """A pending suspicion timer on an ALIVE entry (and a SUSPECT entry
+    with no timer) — the timer contract's two halves."""
+    scen = crash_scenario()
+    params = cc.campaign_params(scen)
+    world, spec = scen.build(params)
+    state = swim.initial_state(params, world)
+    state = dataclasses.replace(
+        state,
+        suspect_deadline=state.suspect_deadline.at[2, 7].set(50),
+        status=state.status.at[4, 9].set(1),       # SUSPECT, no timer
+    )
+    _, mon, _ = cm.run_monitored(jax.random.key(0), params, world, spec,
+                                 4, capacity=64, state=state)
+    assert "TIMER_BOUND" in broken_codes(mon)
+    cells = {(e.observer, e.subject) for e in cm.decode_violations(mon)
+             if e.code == cm.InvariantCode.TIMER_BOUND}
+    assert (2, 7) in cells
+
+
+def test_saturated_incarnation_trips_wire_saturation():
+    scen = crash_scenario()
+    params = cc.campaign_params(scen, int16_wire=True)   # sat = 8191
+    world, spec = scen.build(params)
+    state = swim.initial_state(params, world)
+    state = dataclasses.replace(
+        state, inc=state.inc.at[1, 6].set(9000))
+    _, mon, _ = cm.run_monitored(jax.random.key(0), params, world, spec,
+                                 2, capacity=64, state=state)
+    assert "WIRE_SATURATION" in broken_codes(mon)
+    ev = [e for e in cm.decode_violations(mon)
+          if e.code == cm.InvariantCode.WIRE_SATURATION]
+    assert any(e.observer == 1 and e.subject == 6 and e.detail == 9000
+               for e in ev)
+
+
+def test_check_round_flags_inc_regression_directly():
+    """The one invariant no protocol path can reach (that is the
+    point): unit-test check_round on a synthetic regression — a LIVE
+    cell's incarnation stepping down without turning DEAD."""
+    scen = crash_scenario()
+    params = cc.campaign_params(scen)
+    world, spec = scen.build(params)
+    kn = swim.Knobs.from_params(params)
+    prev = swim.initial_state(params, world)
+    prev = dataclasses.replace(prev, inc=prev.inc.at[2, 5].set(4))
+    new = dataclasses.replace(prev, inc=prev.inc.at[2, 5].set(1))
+    mon = cm.check_round(cm.MonitorState.init(64), spec, params, kn,
+                         jnp.int32(7), prev, new, world)
+    assert int(mon.code_counts[cm.InvariantCode.INC_REGRESSION]) == 1
+    (ev,) = cm.decode_violations(mon)
+    assert (ev.round, ev.observer, ev.subject, ev.detail) == (7, 2, 5, 1)
+    # A DEAD winner with a lower incarnation is LEGAL (isOverrides
+    # case 3) — same cells, new status DEAD: no violation.
+    dead = dataclasses.replace(new, status=new.status.at[2, 5].set(2))
+    mon2 = cm.check_round(cm.MonitorState.init(64), spec, params, kn,
+                          jnp.int32(7), prev, dead, world)
+    assert int(mon2.code_counts.sum()) == 0
+
+
+# --------------------------------------------------------------------------
+# Evidence mechanics
+# --------------------------------------------------------------------------
+
+
+def test_evidence_overflow_is_counted_never_silent():
+    scen = crash_scenario()
+    spec_broken = cs.Scenario(name="b", n_members=N, horizon=64,
+                              ops=(cs.Crash(3, at_round=5),))
+    params = cc.campaign_params(spec_broken)
+    world, spec = spec_broken.build(params)
+    spec = dataclasses.replace(
+        spec, complete_by=spec.complete_by.at[3].set(7))
+    _, mon_small, _ = cm.run_monitored(jax.random.key(0), params, world,
+                                       spec, 64, capacity=4)
+    _, mon_big, _ = cm.run_monitored(jax.random.key(0), params, world,
+                                     spec, 64, capacity=4096)
+    assert int(mon_small.count) == 4
+    assert int(mon_small.dropped) > 0
+    # Exact accounting: small buffer's count+dropped = big buffer's
+    # recorded evidence; the recorded lanes are an exact prefix.
+    assert (int(mon_small.count) + int(mon_small.dropped)
+            == int(mon_big.count))
+    assert cm.decode_violations(mon_small) \
+        == cm.decode_violations(mon_big)[:4]
+    # Totals are NOT capacity-limited — every violating cell counts.
+    assert np.array_equal(np.asarray(mon_small.code_counts),
+                          np.asarray(mon_big.code_counts))
+
+
+def test_persistent_violation_records_first_round_only():
+    """COMPLETENESS re-fires every round past the deadline; the lanes
+    hold only the first round's cells (flood-proof) while code_counts
+    keeps the exact running total."""
+    scen = crash_scenario()
+    params = cc.campaign_params(scen)
+    world, spec = scen.build(params)
+    spec = dataclasses.replace(
+        spec, complete_by=spec.complete_by.at[3].set(7))
+    _, mon, _ = cm.run_monitored(jax.random.key(0), params, world, spec,
+                                 64, capacity=4096)
+    ev = cm.decode_violations(mon)
+    assert ev
+    assert {e.round for e in ev} == {7}
+    total = int(mon.code_counts[cm.InvariantCode.COMPLETENESS])
+    assert total > len(ev)                 # kept counting after round 7
+    assert int(mon.code_first_round[cm.InvariantCode.COMPLETENESS]) == 7
+
+
+def test_monitor_resumes_across_chunks():
+    scen = crash_scenario()
+    params = cc.campaign_params(scen)
+    world, spec = scen.build(params)
+    _, mon_once, _ = cm.run_monitored(jax.random.key(1), params, world,
+                                      spec, 128)
+    state, mon = None, None
+    for start in (0, 64):
+        state, mon, _ = cm.run_monitored(
+            jax.random.key(1), params, world, spec, 64, state=state,
+            start_round=start, monitor=mon)
+    assert np.array_equal(np.asarray(mon.lanes),
+                          np.asarray(mon_once.lanes))
+    assert np.array_equal(np.asarray(mon.code_counts),
+                          np.asarray(mon_once.code_counts))
+
+
+def test_verdict_json_roundtrips():
+    import json
+
+    (_, mon, _), _, _, _ = run(crash_scenario())
+    v = cm.verdict(mon)
+    assert json.loads(json.dumps(v)) == v

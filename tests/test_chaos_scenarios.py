@@ -1,0 +1,248 @@
+"""Scenario DSL compilation + seeded campaign generation.
+
+The DSL's contract: ops compile to exactly the ``SwimWorld``/
+``LinkFaults`` schedule arrays the dense tick already consumes, the
+derived ``MonitorSpec`` encodes what each scenario promises (pristine
+networks check false suspicion; permanent faults get completeness
+deadlines; permanent disruptions promise nothing), and
+``generate_scenario`` is a pure function of (seed, n, severity) — the
+one-line-repro property every campaign failure relies on.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.chaos import campaign as cc
+from scalecube_cluster_tpu.chaos import scenarios as cs
+from scalecube_cluster_tpu.models import swim
+
+pytestmark = pytest.mark.chaos
+
+INT32_MAX = cs.INT32_MAX
+N = 24
+
+
+def build(ops, horizon=192, loss=0.0, **scen_kw):
+    scen = cs.Scenario(name="t", n_members=N, horizon=horizon,
+                       ops=tuple(ops), loss_probability=loss, **scen_kw)
+    params = cc.campaign_params(scen)
+    world, spec = scen.build(params)
+    return scen, params, world, spec
+
+
+# --------------------------------------------------------------------------
+# Op compilation
+# --------------------------------------------------------------------------
+
+
+def test_crash_burst_and_leave_compile_to_world_schedules():
+    _, _, world, _ = build([
+        cs.CrashBurst((1, 2, 3), at_round=4),
+        cs.Crash(5, at_round=8, until_round=40),
+        cs.Leave(7, at_round=12),
+    ])
+    df = np.asarray(world.down_from)
+    du = np.asarray(world.down_until)
+    assert df[1] == df[2] == df[3] == 4 and du[1] == INT32_MAX
+    assert df[5] == 8 and du[5] == 40
+    assert int(np.asarray(world.leave_at)[7]) == 12 and df[7] == 13
+
+
+def test_churn_storm_staggers_disjoint_waves():
+    storm = cs.ChurnStorm((10, 11, 12, 13), wave_size=2, start_round=6,
+                          wave_every=9, down_rounds=30)
+    _, _, world, _ = build([storm])
+    df = np.asarray(world.down_from)
+    du = np.asarray(world.down_until)
+    assert df[10] == df[11] == 6 and du[10] == 36
+    assert df[12] == df[13] == 15 and du[12] == 45
+
+
+def test_churn_storm_rejects_ragged_waves():
+    with pytest.raises(ValueError, match="wave_size"):
+        cs.ChurnStorm((1, 2, 3), wave_size=2, start_round=0, wave_every=4)
+
+
+def test_flapping_link_compiles_to_block_windows():
+    flap = cs.FlappingLink(2, 9, from_round=10, n_cycles=3,
+                           down_rounds=4, up_rounds=6)
+    _, _, world, _ = build([flap])
+    f = world.faults
+    live = [(int(f.from_round[r]), int(f.until_round[r]), float(f.loss[r]))
+            for r in range(f.n_rules) if int(f.src_hi[r]) > int(f.src_lo[r])]
+    assert live == [(10, 14, 1.0), (20, 24, 1.0), (30, 34, 1.0)]
+    assert flap.disruption(N, 192) == (10, 34)
+
+
+def test_brownout_ramps_up_holds_and_ramps_down():
+    b = cs.Brownout(src=(0, 12), dst=(12, 24), peak_loss=0.6,
+                    from_round=8, ramp_rounds=12, hold_rounds=10, steps=3)
+    _, _, world, _ = build([b])
+    f = world.faults
+    live = [(int(f.from_round[r]), int(f.until_round[r]),
+             round(float(f.loss[r]), 2))
+            for r in range(f.n_rules) if int(f.src_hi[r]) > int(f.src_lo[r])]
+    assert live == [(8, 12, 0.2), (12, 16, 0.4), (16, 20, 0.6),
+                    (20, 30, 0.6), (30, 34, 0.4), (34, 38, 0.2)]
+    # Asymmetric: only src-range -> dst-range.
+    assert int(f.src_lo[0]) == 0 and int(f.src_hi[0]) == 12
+    assert int(f.dst_lo[0]) == 12 and int(f.dst_hi[0]) == 24
+
+
+def test_rolling_partition_phases_and_tail():
+    rp = cs.RollingPartition(from_round=16, phase_rounds=16, n_cycles=2,
+                             rotate=3)
+    _, _, world, _ = build([rp], horizon=192)
+    sched = np.asarray(world.partition_of)
+    pr = int(np.asarray(world.partition_phase_rounds))
+    assert pr == 16
+    # lead zero phase, split, heal, split, heal, zero tail past horizon.
+    assert not sched[0].any()
+    assert sched[1].any() and not sched[2].any() and sched[3].any()
+    assert sched.shape[0] * pr > 192
+    assert not sched[4:].any()
+    # Rotation: cycle 2's split differs from cycle 1's.
+    assert sched[1].tolist() != sched[3].tolist()
+    assert rp.disruption(N, 192) == (16, 64)
+
+
+def test_brownout_without_hold_skips_the_empty_window():
+    b = cs.Brownout(src=(0, 12), dst=(12, 24), peak_loss=0.6,
+                    from_round=8, ramp_rounds=12, hold_rounds=0, steps=3)
+    _, _, world, _ = build([b])      # builds cleanly (no empty rule)
+    f = world.faults
+    live = [(int(f.from_round[r]), int(f.until_round[r]))
+            for r in range(f.n_rules) if int(f.src_hi[r]) > int(f.src_lo[r])]
+    assert live == [(8, 12), (12, 16), (16, 20), (20, 24), (24, 28)]
+
+
+def test_flapping_link_rejects_empty_down_window():
+    with pytest.raises(ValueError, match="down_rounds"):
+        cs.FlappingLink(0, 1, from_round=0, n_cycles=2, down_rounds=0,
+                        up_rounds=4)
+
+
+def test_rolling_partition_rejects_unaligned_start():
+    with pytest.raises(ValueError, match="multiple of"):
+        cs.RollingPartition(from_round=10, phase_rounds=16, n_cycles=1)
+
+
+def test_rule_padding_preserves_semantics_and_shape():
+    _, _, world, _ = build([cs.LinkLoss(0, 1, loss=0.5)])
+    assert world.faults.n_rules == cs._RULE_PAD     # padded to fixed width
+    # Pad rules are empty ranges: they match no (src, dst) pair.
+    loss, _ = swim.link_eval(world.faults, 0,
+                             jnp.arange(N), jnp.arange(N)[:, None], 0.0, 0.0)
+    assert float(np.asarray(loss)[1, 0]) == 0.5     # dst=1 row, src=0
+    assert float(np.asarray(loss).sum()) == 0.5     # nothing else matches
+
+
+# --------------------------------------------------------------------------
+# MonitorSpec derivation
+# --------------------------------------------------------------------------
+
+
+def test_pristine_scenario_checks_false_suspicion():
+    _, params, _, spec = build([cs.Crash(3, at_round=5)])
+    assert spec.check_false_suspicion
+    bound = cs.completeness_bound(params, N)
+    assert int(spec.complete_by[3]) == 5 + bound
+    others = np.delete(np.asarray(spec.complete_by), 3)
+    assert (others == INT32_MAX).all()
+
+
+def test_network_disruption_disables_false_suspicion_check():
+    for ops, loss in ([[cs.LinkLoss(0, 1, loss=0.3)], 0.0],
+                      [[cs.RollingPartition(0, 16, 1)], 0.0],
+                      [[cs.Crash(3, at_round=5)], 0.05]):
+        _, _, _, spec = build(ops, loss=loss)
+        assert not spec.check_false_suspicion, (ops, loss)
+
+
+def test_disruption_extends_completeness_deadline():
+    scen, params, _, spec = build([
+        cs.Crash(3, at_round=5),
+        cs.FlappingLink(1, 2, from_round=20, n_cycles=2,
+                        down_rounds=4, up_rounds=6),
+    ], horizon=256)
+    bound = cs.completeness_bound(params, N)
+    assert int(spec.complete_by[3]) == 34 + bound   # disruption end, not 5
+
+
+def test_permanent_disruption_voids_completeness():
+    _, _, _, spec = build([
+        cs.Crash(3, at_round=5),
+        cs.LinkLoss((0, N), 7, loss=1.0),           # forever block
+    ])
+    assert (np.asarray(spec.complete_by) == INT32_MAX).all()
+
+
+def test_revived_crash_has_no_completeness_deadline():
+    _, _, _, spec = build([cs.Crash(3, at_round=5, until_round=60)])
+    assert int(spec.complete_by[3]) == INT32_MAX
+
+
+def test_build_rejects_mismatched_params():
+    scen = cs.Scenario(name="t", n_members=N, horizon=64,
+                       ops=(cs.Crash(0, at_round=1),))
+    other = swim.SwimParams.from_config(cc.campaign_config(),
+                                        n_members=N * 2)
+    with pytest.raises(ValueError, match="n_members"):
+        scen.build(other)
+
+
+# --------------------------------------------------------------------------
+# Campaign generation
+# --------------------------------------------------------------------------
+
+
+def test_generate_scenario_is_pure_and_tiered():
+    for sev in cs.SEVERITIES:
+        a = cs.generate_scenario(seed=11, n=32, severity=sev)
+        b = cs.generate_scenario(seed=11, n=32, severity=sev)
+        assert a == b                       # the one-line-repro property
+        assert a.severity == sev and a.seed == 11
+        assert a.horizon % 64 == 0          # quantized (compile sharing)
+        assert f"severity={sev!r}" in a.repro()
+    assert (cs.generate_scenario(seed=11, n=32, severity="mild")
+            != cs.generate_scenario(seed=12, n=32, severity="mild"))
+
+
+def test_generated_severities_escalate():
+    mild = cs.generate_scenario(seed=3, n=32, severity="mild")
+    severe = cs.generate_scenario(seed=3, n=32, severity="severe")
+    assert len(mild.ops) == 1
+    assert mild.loss_probability == 0.0
+    assert severe.loss_probability > 0.0
+    assert any(isinstance(op, cs.RollingPartition) for op in severe.ops)
+    assert any(isinstance(op, cs.ChurnStorm) for op in severe.ops)
+
+
+def test_generated_scenarios_build_cleanly():
+    """Every tier x several seeds compiles to a world + spec without
+    touching the DSL validation (the generator only emits legal ops)."""
+    for seed in range(5):
+        for sev in cs.SEVERITIES:
+            scen = cs.generate_scenario(seed=seed, n=32, severity=sev)
+            params = cc.campaign_params(scen)
+            world, spec = scen.build(params)
+            assert world.faults.n_rules % cs._RULE_PAD == 0
+            assert spec.complete_by.shape == (32,)
+            assert scen.horizon >= cs.completeness_bound(params, 32)
+
+
+def test_generate_campaign_cycles_severities():
+    scens = cs.generate_campaign(seed=50, n_scenarios=7, n=32)
+    assert [s.severity for s in scens] == [
+        "mild", "moderate", "severe", "mild", "moderate", "severe", "mild"]
+    assert [s.seed for s in scens] == list(range(50, 57))
+    assert len({s.name for s in scens}) == 7
+
+
+def test_extra_slack_widens_deadlines():
+    _, params, _, spec0 = build([cs.Crash(3, at_round=5)])
+    _, _, _, spec1 = build([cs.Crash(3, at_round=5)], extra_slack=40)
+    assert int(spec1.complete_by[3]) == int(spec0.complete_by[3]) + 40

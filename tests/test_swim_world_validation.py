@@ -1,0 +1,166 @@
+"""World/fault-schedule builder validation + composition edges.
+
+Satellites of ISSUE 3: ``LinkFaults.add`` argument validation (a
+nonsense rule used to be silently appended and matched nothing — or,
+for an out-of-range loss, skewed every Bernoulli draw it joined),
+out-of-range node-id guards on the ``SwimWorld`` crash/leave/seed
+builders (``jnp .at[].set`` silently drops out-of-bounds updates, so a
+typo'd node id produced a healthy world and a vacuously green test),
+and pinned behavior for the fault-schedule composition edges:
+leave-after-crash clobbering, revive-before-crash empty windows, and
+``partition_at`` phase boundaries.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.models import swim
+
+from tests.test_swim_model import fast_config
+
+INT32_MAX = int(jnp.iinfo(jnp.int32).max)
+
+
+def make_world(n=16):
+    params = swim.SwimParams.from_config(fast_config(), n_members=n)
+    return params, swim.SwimWorld.healthy(params)
+
+
+# --------------------------------------------------------------------------
+# LinkFaults.add validation
+# --------------------------------------------------------------------------
+
+
+class TestLinkFaultsValidation:
+    @pytest.mark.parametrize("loss", [-0.1, 1.5, 2.0])
+    def test_loss_outside_unit_interval_raises(self, loss):
+        with pytest.raises(ValueError, match="loss"):
+            swim.LinkFaults.none().add(0, 1, loss=loss)
+
+    @pytest.mark.parametrize("src,dst", [
+        ((3, 3), 1),          # empty src range
+        (0, (5, 2)),          # inverted dst range
+        ((4, 1), (7, 7)),     # both
+    ])
+    def test_empty_or_inverted_range_raises(self, src, dst):
+        with pytest.raises(ValueError, match="empty id range"):
+            swim.LinkFaults.none().add(src, dst, loss=0.5)
+
+    def test_inverted_round_window_raises(self):
+        with pytest.raises(ValueError, match="round window"):
+            swim.LinkFaults.none().add(0, 1, loss=0.5,
+                                       from_round=10, until_round=10)
+        with pytest.raises(ValueError, match="round window"):
+            swim.LinkFaults.none().add(0, 1, loss=0.5,
+                                       from_round=20, until_round=5)
+
+    def test_negative_delay_raises(self):
+        with pytest.raises(ValueError, match="delay_ms"):
+            swim.LinkFaults.none().add(0, 1, loss=0.0, delay_ms=-1.0)
+
+    def test_valid_rules_still_append(self):
+        f = (swim.LinkFaults.none()
+             .add(0, 1, loss=1.0)                        # block
+             .add((0, 4), (4, 8), loss=0.3, delay_ms=5.0,
+                  from_round=2, until_round=50))
+        assert f.n_rules == 2
+        assert float(f.loss[0]) == 1.0
+        assert int(f.until_round[1]) == 50
+
+    def test_world_builders_propagate_validation(self):
+        _, world = make_world()
+        with pytest.raises(ValueError, match="empty id range"):
+            world.with_link_fault((2, 2), 5, loss=0.5)
+        with pytest.raises(ValueError, match="round window"):
+            world.with_block(0, 1, from_round=9, until_round=3)
+
+
+# --------------------------------------------------------------------------
+# Node-id guards (with_crash / with_leave / with_seeds)
+# --------------------------------------------------------------------------
+
+
+class TestNodeIdGuards:
+    @pytest.mark.parametrize("bad", [-1, 16, 99, [3, 16], [-2, 5]])
+    def test_with_crash_out_of_range_raises(self, bad):
+        _, world = make_world(16)
+        with pytest.raises(ValueError, match="with_crash"):
+            world.with_crash(bad, at_round=0)
+
+    def test_with_leave_out_of_range_raises(self):
+        _, world = make_world(16)
+        with pytest.raises(ValueError, match="with_leave"):
+            world.with_leave(16, at_round=5)
+
+    def test_with_seeds_out_of_range_raises(self):
+        _, world = make_world(16)
+        with pytest.raises(ValueError, match="with_seeds"):
+            world.with_seeds([0, 16])
+
+    def test_in_range_ids_accepted(self):
+        _, world = make_world(16)
+        w = (world.with_crash([0, 15], at_round=3)
+                  .with_leave(7, at_round=9)
+                  .with_seeds([0, 1]))
+        assert int(w.down_from[15]) == 3
+        assert int(w.leave_at[7]) == 9
+        assert np.array_equal(np.asarray(w.seed_ids), [0, 1])
+
+
+# --------------------------------------------------------------------------
+# Fault-schedule composition edges (pinned behavior)
+# --------------------------------------------------------------------------
+
+
+class TestCompositionEdges:
+    def test_leave_after_crash_clobbers_the_crash_window(self):
+        """One down schedule per node: with_leave overwrites the crash
+        window (down from leave+1, forever) — the later builder wins,
+        like the reference's one-transport-per-node lifecycle."""
+        _, world = make_world()
+        w = (world.with_crash(4, at_round=10, until_round=30)
+                  .with_leave(4, at_round=50))
+        assert int(w.down_from[4]) == 51
+        assert int(w.down_until[4]) == INT32_MAX
+        assert int(w.leave_at[4]) == 50
+        # The crash window [10, 30) is GONE: node 4 is alive at 20.
+        assert bool(w.alive_at(20)[4])
+        assert bool(w.alive_at(50)[4])       # leave round: still sends
+        assert not bool(w.alive_at(51)[4])
+
+    def test_revive_before_crash_is_an_empty_window(self):
+        """until_round <= at_round: the down window is empty — the node
+        is never down (alive_at tests down_from <= r < down_until)."""
+        _, world = make_world()
+        w = world.with_crash(3, at_round=40, until_round=40)
+        alive = np.asarray(
+            jnp.stack([w.alive_at(r) for r in (0, 39, 40, 41, 100)]))
+        assert alive[:, 3].all()
+        w2 = world.with_crash(3, at_round=40, until_round=12)
+        assert bool(w2.alive_at(40)[3])
+
+    def test_partition_at_phase_boundary_rounds(self):
+        """Phase flips exactly at multiples of phase_rounds, and the
+        schedule wraps modulo the phase count."""
+        _, world = make_world(8)
+        sched = np.stack([
+            np.array([0] * 4 + [1] * 4, dtype=np.int8),
+            np.zeros(8, dtype=np.int8),
+        ])
+        w = world.with_partition_schedule(sched, phase_rounds=10)
+        assert np.asarray(w.partition_at(0)).tolist() == sched[0].tolist()
+        assert np.asarray(w.partition_at(9)).tolist() == sched[0].tolist()
+        assert np.asarray(w.partition_at(10)).tolist() == [0] * 8
+        assert np.asarray(w.partition_at(19)).tolist() == [0] * 8
+        # Wrap: round 20 re-enters phase 0 (the rolling schedule).
+        assert np.asarray(w.partition_at(20)).tolist() == sched[0].tolist()
+
+    def test_crash_then_recrash_overwrites_window(self):
+        """with_crash on an already-crashed node replaces (not merges)
+        its window — last write wins on the single down schedule."""
+        _, world = make_world()
+        w = (world.with_crash(2, at_round=5, until_round=20)
+                  .with_crash(2, at_round=40, until_round=60))
+        assert bool(w.alive_at(10)[2])       # first window clobbered
+        assert not bool(w.alive_at(45)[2])
