@@ -54,6 +54,20 @@ violation totals, one-line repros for any red scenario.  ``--chaos
 tests/test_chaos_campaign.py.  Env overrides: SCALECUBE_CHAOS_N,
 SCALECUBE_CHAOS_SCENARIOS, SCALECUBE_CHAOS_SEED.
 
+``--resilience``: the preemption-survival workload — the kill-injection
+drill (resilience/harness.py) SIGKILLs a resilient run (rotated,
+checksummed checkpoints + resumable JSONL journal;
+resilience/supervisor.py) at seeded random rounds/write-stages in a
+subprocess, relaunches it, and asserts the resumed final state is
+bit-identical to an uninterrupted run with gap-free, duplicate-free
+telemetry — for each of the plain/traced/monitored run shapes — plus
+the corrupted-latest-generation fallback drill.  Runs on CPU by design
+(a correctness harness, not a throughput one).  One JSON line as
+always.  ``--resilience --smoke`` is the tier-1-safe mini drill.  Env
+overrides: SCALECUBE_RESILIENCE_N, SCALECUBE_RESILIENCE_ROUNDS,
+SCALECUBE_RESILIENCE_SEGMENT, SCALECUBE_RESILIENCE_KILLS,
+SCALECUBE_RESILIENCE_SEED, SCALECUBE_RESILIENCE_SHAPES (comma list).
+
 Env overrides for debugging: SCALECUBE_BENCH_N, SCALECUBE_BENCH_ROUNDS,
 SCALECUBE_BENCH_DELIVERY, SCALECUBE_BENCH_SKIP_CANARY,
 SCALECUBE_BENCH_COMPACT (=1: the capacity-oriented compact carry layout,
@@ -629,6 +643,81 @@ def run_chaos_campaign():
     print(json.dumps(result), flush=True)
 
 
+def run_resilience_drill():
+    """The --resilience mode: the subprocess kill-injection drill over
+    all three run shapes + the corruption-fallback drill, one JSON line
+    out (the never-ship-empty contract).  Forces CPU: this is a
+    correctness harness — the children must not fight over an attached
+    TPU, and the guarantees under test are backend-independent."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    result = {
+        "metric": "resilience_drill_green_shapes",
+        "value": None,
+        "unit": "green shapes",
+        "smoke": SMOKE,
+        "platform": "cpu(forced)",
+    }
+    try:
+        import tempfile
+
+        from scalecube_cluster_tpu.resilience import harness as rh
+
+        shapes = tuple(
+            s for s in os.environ.get(
+                "SCALECUBE_RESILIENCE_SHAPES",
+                "plain,traced,monitored").split(",") if s
+        )
+        overrides = {
+            "n_members": int(os.environ.get(
+                "SCALECUBE_RESILIENCE_N", 16 if SMOKE else 32)),
+            "n_rounds": int(os.environ.get(
+                "SCALECUBE_RESILIENCE_ROUNDS", 30 if SMOKE else 96)),
+            "segment_rounds": int(os.environ.get(
+                "SCALECUBE_RESILIENCE_SEGMENT", 10 if SMOKE else 16)),
+        }
+        n_kills = int(os.environ.get("SCALECUBE_RESILIENCE_KILLS",
+                                     1 if SMOKE else 3))
+        seed = int(os.environ.get("SCALECUBE_RESILIENCE_SEED", 1234))
+        t0 = time.time()
+        with tempfile.TemporaryDirectory(
+                prefix="resilience-drill-") as workdir:
+            report = rh.run_drill(
+                shapes, workdir, kill_seed=seed, n_kills=n_kills,
+                cfg_overrides=overrides,
+                extra_env={"JAX_PLATFORMS": "cpu"},
+            )
+        for shape, verdict in report["shapes"].items():
+            log(f"resilience {shape}: "
+                f"{'green' if verdict['ok'] else 'RED ' + json.dumps(verdict)}"
+                f" (kills {verdict.get('kills')})")
+        log(f"resilience corruption drill: "
+            f"{'green' if report['corruption']['ok'] else 'RED'}")
+        log(f"resilience drill: green={report['green']} in "
+            f"{time.time() - t0:.1f}s")
+        result.update(
+            value=sum(1 for v in report["shapes"].values() if v["ok"]),
+            shapes_run=list(report["shapes"]),
+            green=report["green"],
+            n_kills=n_kills,
+            kill_seed=seed,
+            workload=overrides,
+            verdicts={
+                s: {k: v[k] for k in ("ok", "bit_identical",
+                                      "journal_complete", "events_match",
+                                      "journal_segments", "kills")
+                    if k in v}
+                for s, v in report["shapes"].items()
+            },
+            corruption={k: report["corruption"][k]
+                        for k in ("ok", "loaded_generation", "fallbacks")
+                        if k in report["corruption"]},
+        )
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -642,6 +731,13 @@ def main():
              "the in-jit invariant monitor) instead of the throughput "
              "bench; combine with --smoke for the tier-1-safe mini "
              "campaign",
+    )
+    parser.add_argument(
+        "--resilience", action="store_true",
+        help="run the kill-injection resilience drill (subprocess "
+             "SIGKILL + relaunch over rotated checksummed checkpoints, "
+             "all three run shapes) instead of the throughput bench; "
+             "combine with --smoke for the tier-1-safe mini drill",
     )
     mode = parser.add_mutually_exclusive_group()
     mode.add_argument(
@@ -672,6 +768,12 @@ def main():
                 "--chaos is the robustness workload; it measures no "
                 "throughput paths — drop --traced/--untraced/"
                 "--gap-artifact")
+        if args.resilience and (args.chaos or args.traced
+                                or args.untraced or args.gap_artifact):
+            parser.error(
+                "--resilience is the preemption-survival workload; it "
+                "measures no throughput paths and is not --chaos — "
+                "drop the other mode flags")
     except SystemExit as e:
         # The one-JSON-line contract holds even for a bad argv: argparse
         # already printed its usage message to stderr; ship the error
@@ -686,6 +788,8 @@ def main():
         raise
     if args.smoke:
         apply_smoke_preset()
+    if args.resilience:
+        return run_resilience_drill()
     if args.chaos:
         return run_chaos_campaign()
 

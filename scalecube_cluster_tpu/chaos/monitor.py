@@ -131,6 +131,27 @@ class MonitorState:
                                       dtype=jnp.int32),
         )
 
+    def to_arrays(self, prefix: str = "monitor/") -> dict:
+        """Flat host-side ``{prefix<field>: np.ndarray}`` dict — the
+        checkpoint-payload form the resilient supervisor persists, so a
+        preemption cannot lose accumulated violation evidence
+        (resilience/supervisor.py)."""
+        return {
+            f"{prefix}{f.name}": np.asarray(getattr(self, f.name))
+            for f in dataclasses.fields(self)
+        }
+
+    @staticmethod
+    def from_arrays(arrays: dict,
+                    prefix: str = "monitor/") -> "MonitorState":
+        """Inverse of :meth:`to_arrays` (device transfer included) —
+        resumes the monitor mid-run as ``run_monitored``'s ``monitor``
+        argument."""
+        return MonitorState(**{
+            f.name: jnp.asarray(arrays[f"{prefix}{f.name}"])
+            for f in dataclasses.fields(MonitorState)
+        })
+
 
 jax.tree_util.register_dataclass(
     MonitorState,

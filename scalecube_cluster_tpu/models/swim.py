@@ -934,11 +934,25 @@ def _carry_decode(state: SwimState, round_idx) -> SwimState:
 def _carry_encode(state: SwimState, round_idx) -> SwimState:
     """wide -> compact, relative to the NEXT round's cursor.
 
-    A deadline already at/below next-round clips to 0 remaining — it
-    decodes to "fires immediately", which is exactly the absolute
-    semantics (any past deadline fires on the next live evaluation; a
-    frozen crashed row's pending timer therefore fires on revival, same
-    as the wide layout).
+    A ``suspect_deadline`` in the past encodes as a NEGATIVE remaining
+    count — a frozen (crashed/left) row's pending timer goes stale
+    while the rest of the world moves on, and clipping it to 0 would
+    decode it to the current cursor instead of the round it actually
+    pointed at (the leave + ring-shift divergence
+    tests/test_compact_carry.py pins).  Behavior is unchanged either
+    way (any past deadline fires on the next live evaluation, i.e. on
+    revival), but the decoded DEADLINE table must match the wide layout
+    bit for bit.  Staleness saturates at -(32766) remaining — beyond
+    that (impossible inside the <32k-round compact contract) the
+    decoded round drifts but the fires-immediately semantics still
+    hold.
+
+    ``spread_until`` keeps its clip-to-0 for stale rows: its only
+    consumer is the ``round_idx < spread_until`` spread gate, which a
+    stale absolute round and the cursor both fail identically, and
+    nothing compares the decoded spread table across layouts — so the
+    int8 stays narrow instead of spending a sign bit on an
+    unobservable distinction.
 
     A deadline MORE than 32765 rounds out (possible only through a
     traced ``Knobs.suspicion_rounds`` override — static params are
@@ -961,7 +975,8 @@ def _carry_encode(state: SwimState, round_idx) -> SwimState:
         suspect_deadline=jnp.where(
             (dl == INT32_MAX) | (remaining > _DEADLINE_NONE16 - 1),
             _DEADLINE_NONE16,
-            jnp.clip(remaining, 0, _DEADLINE_NONE16 - 1),
+            jnp.clip(remaining, -(_DEADLINE_NONE16 - 1),
+                     _DEADLINE_NONE16 - 1),
         ).astype(jnp.int16),
     )
 

@@ -1,0 +1,93 @@
+"""shard_map compatibility: one resolution point for every JAX vintage.
+
+``shard_map`` has lived at three addresses across the JAX versions this
+repo meets in the wild: ``jax.experimental.shard_map.shard_map`` (the
+original, replication-checking kwarg ``check_rep``), ``jax.shard_map``
+(promoted to the public namespace, kwarg renamed ``check_vma``), and —
+on trimmed builds — nowhere at all.  Resolving the symbol lazily at
+call sites meant every caller re-discovered the difference (and the
+tests died with ``AttributeError`` at run time on older installs), so
+this module resolves it ONCE at import:
+
+  - :data:`HAS_SHARD_MAP` — whether any implementation exists; test
+    modules that need sharding skip cleanly on it instead of erroring.
+  - :func:`shard_map` — the unified wrapper.  Call it with the mesh /
+    in_specs / out_specs keywords and the version-neutral
+    ``check_replication`` flag; the wrapper forwards to whichever
+    kwarg spelling the installed implementation takes.
+
+Nothing else in the repo should touch ``jax.shard_map`` or
+``jax.experimental.shard_map`` directly.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+
+def _resolve():
+    """(callable-or-None, replication-kwarg-name-or-None), chosen once.
+
+    Prefers the public ``jax.shard_map`` when present (the experimental
+    module is deleted in the versions that have it), else the
+    experimental location.  The replication-check kwarg is discovered
+    from the signature rather than hard-coded per location, so an
+    implementation that renames it again degrades to "don't pass it"
+    instead of a TypeError.
+    """
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        try:
+            from jax.experimental.shard_map import shard_map as fn
+        except Exception:  # pragma: no cover — trimmed build
+            return None, None
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # pragma: no cover — C-level signature
+        params = {}
+    for name in ("check_vma", "check_rep"):
+        if name in params:
+            return fn, name
+    return fn, None
+
+
+_SHARD_MAP, _CHECK_KWARG = _resolve()
+
+HAS_SHARD_MAP = _SHARD_MAP is not None
+
+# Human-readable origin for skip messages / diagnostics.
+SHARD_MAP_ORIGIN = (
+    None if _SHARD_MAP is None
+    else ("jax.shard_map" if _SHARD_MAP is getattr(jax, "shard_map", None)
+          else "jax.experimental.shard_map.shard_map")
+)
+
+SKIP_REASON = ("no shard_map implementation in this JAX build "
+               "(neither jax.shard_map nor jax.experimental.shard_map)")
+
+# The legacy experimental implementation lowers each in-scan psum to its
+# own all-reduce; the public one (check_vma era) lowers to the combined
+# collectives the traffic byte model pins.  HLO-pinning tests assert the
+# modern lowering only — semantics are identical either way.
+MODERN_LOWERING = _CHECK_KWARG == "check_vma"
+LEGACY_LOWERING_REASON = (
+    f"HLO collective pinning assumes the public jax.shard_map lowering; "
+    f"this build resolves to {SHARD_MAP_ORIGIN}, whose legacy lowering "
+    f"emits per-psum all-reduces"
+)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_replication=False):
+    """Version-neutral ``shard_map`` (module docstring).
+
+    ``check_replication`` maps onto ``check_vma`` / ``check_rep`` —
+    whichever the installed implementation spells it as.
+    """
+    if _SHARD_MAP is None:
+        raise NotImplementedError(SKIP_REASON)
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if _CHECK_KWARG is not None:
+        kwargs[_CHECK_KWARG] = check_replication
+    return _SHARD_MAP(f, **kwargs)

@@ -31,6 +31,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.parallel import compat
 
 NODE_AXIS = "nodes"
 
@@ -104,10 +105,10 @@ def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
     if params.n_user_gossips > 0:
         metric_names.append("user_gossip_infected")
     out_metric_specs = {name: metric_spec for name in metric_names}
-    return jax.shard_map(
+    return compat.shard_map(
         sharded_body,
         mesh=mesh,
         in_specs=(P(), world_specs, state_specs),
         out_specs=(state_specs, out_metric_specs),
-        check_vma=False,
+        check_replication=False,
     )(base_key, world, state)

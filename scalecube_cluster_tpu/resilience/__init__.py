@@ -1,0 +1,40 @@
+"""Preemption-safe resilient running: rotated checksummed checkpoints,
+a segment supervisor for every run shape, and a real kill-injection
+harness.
+
+The reference cluster keeps no persistent state — a restarted node
+rejoins from seeds (SURVEY.md §5.4) — so on this repo's north-star
+workloads (1M-member × 10k-round sweeps on preemptible TPUs) the
+weakest failure domain is the HARNESS, not the protocol.  This package
+makes the harness as fault-tolerant as the protocol it drives:
+
+  - :mod:`resilience.store` — generation-rotated ``.npz`` checkpoints
+    whose payload carries a content checksum; load falls back to the
+    newest INTACT generation when the latest is truncated or bit-
+    flipped, and old single-file ``utils/checkpoint`` files still load.
+  - :mod:`resilience.supervisor` — drives ``swim.run``,
+    ``swim.run_traced`` and ``chaos.monitor.run_monitored`` in
+    checkpointed segments with bounded exponential-backoff retry
+    around transient failures, and appends gap-free, duplicate-free
+    per-segment telemetry to a resumable JSONL journal (round-cursor
+    dedup; trace-first / checkpoint-second write order).
+  - :mod:`resilience.harness` — a subprocess driver that SIGKILLs the
+    run at a seeded random round + write-stage and relaunches it,
+    asserting the resumed final state is bit-identical to an
+    uninterrupted run and the merged telemetry is complete.
+
+Entry points: ``bench.py --resilience [--smoke]`` and
+``experiments/resilience_drill.py``.
+"""
+
+from scalecube_cluster_tpu.resilience.store import (  # noqa: F401
+    CheckpointCorruptError,
+    CheckpointExhaustedError,
+    CheckpointStore,
+)
+from scalecube_cluster_tpu.resilience.supervisor import (  # noqa: F401
+    KillPlan,
+    RetryPolicy,
+    RunShape,
+    run_resilient,
+)

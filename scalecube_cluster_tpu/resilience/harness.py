@@ -1,0 +1,423 @@
+"""Kill-injection harness: real SIGKILLs against the resilient runner.
+
+``utils/checkpoint``'s "kill" test was an in-process simulation — stop
+calling the driver, call it again.  A real preemption is harsher: no
+Python finally-blocks, no atexit, buffers torn mid-byte.  This harness
+runs the supervisor in a SUBPROCESS, SIGKILLs it at a seeded random
+round and write-stage (supervisor.KillPlan — before/inside/after the
+journal write, after the checkpoint), relaunches until completion, and
+then asserts the two headline guarantees:
+
+  - the resumed final state (full checkpoint payload: SwimState + the
+    per-shape aux arrays) is BIT-IDENTICAL to an uninterrupted run —
+    compared by content digest (resilience/store.payload_checksum);
+  - the merged journal is COMPLETE: segment records tile
+    ``[0, n_rounds)`` exactly once (no holes, no duplicate rounds), and
+    for the traced shape the merged event stream equals the
+    uninterrupted run's event for event.
+
+Entry points: :func:`run_drill` (the matrix bench.py --resilience and
+experiments/resilience_drill.py drive) and the module's ``__main__``
+child mode (``python -m scalecube_cluster_tpu.resilience.harness
+--config cfg.json``), which runs one resilient run to completion and
+prints a one-line JSON summary.  The kill is armed through the
+``SCALECUBE_RESILIENCE_KILL`` env var so the child process needs no
+special code path — production and harnessed runs execute the same
+supervisor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pathlib
+import random
+import signal
+import subprocess
+import sys
+import zlib
+from typing import List, Optional
+
+_REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[2])
+
+
+# --------------------------------------------------------------------------
+# Workload config (JSON round-trippable — it rides to the child process)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DrillConfig:
+    """One resilient-run workload, fully determined by its fields (the
+    child rebuilds params/world/key from them bit-for-bit)."""
+
+    shape: str
+    base_path: str                  # checkpoint-store base (workdir file)
+    n_members: int = 24
+    n_subjects: int = 16
+    n_rounds: int = 48
+    segment_rounds: int = 12
+    seed: int = 7
+    crash_node: int = 3
+    crash_round: int = 5
+    loss_probability: float = 0.05
+    delivery: str = "shift"
+    keep_generations: int = 3
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(obj: dict) -> "DrillConfig":
+        return DrillConfig(**obj)
+
+
+def build_workload(cfg: DrillConfig):
+    """(key, params, world, spec) for one drill config — the sped-up
+    protocol preset bench.py's telemetry scenario uses, so suspicion
+    resolves inside a short run and the trace/monitor have real events
+    to carry across the kill."""
+    import jax
+
+    from scalecube_cluster_tpu.chaos import monitor as cmon
+    from scalecube_cluster_tpu.config import ClusterConfig
+    from scalecube_cluster_tpu.models import swim
+
+    c = ClusterConfig.default().replace(
+        gossip_interval=100, ping_interval=200, ping_timeout=100,
+        sync_interval=1_000, suspicion_mult=3,
+    )
+    params = swim.SwimParams.from_config(
+        c, n_members=cfg.n_members,
+        n_subjects=min(cfg.n_subjects, cfg.n_members),
+        loss_probability=cfg.loss_probability, delivery=cfg.delivery,
+    )
+    world = swim.SwimWorld.healthy(params).with_crash(
+        cfg.crash_node, at_round=cfg.crash_round
+    )
+    spec = (cmon.MonitorSpec.passive(params)
+            if cfg.shape == "monitored" else None)
+    return jax.random.key(cfg.seed), params, world, spec
+
+
+def run_config(cfg: DrillConfig, kill_plan=None):
+    """One resilient run of ``cfg`` in THIS process (the child body and
+    the uninterrupted-reference path)."""
+    from scalecube_cluster_tpu.resilience import store as rstore
+    from scalecube_cluster_tpu.resilience import supervisor as rsup
+
+    key, params, world, spec = build_workload(cfg)
+    store = rstore.CheckpointStore(cfg.base_path,
+                                   keep=cfg.keep_generations)
+    return rsup.run_resilient(
+        cfg.shape, key, params, world, cfg.n_rounds, store=store,
+        segment_rounds=cfg.segment_rounds, spec=spec,
+        kill_plan=kill_plan,
+    )
+
+
+def result_digest(result) -> str:
+    """Content digest of the FULL final carry (SwimState + aux) — the
+    bit-identity the harness asserts."""
+    from scalecube_cluster_tpu.resilience import store as rstore
+
+    return rstore.payload_checksum(result.carry_arrays)
+
+
+# --------------------------------------------------------------------------
+# Journal verification
+# --------------------------------------------------------------------------
+
+
+def verify_journal(path: str, n_rounds: int) -> dict:
+    """No holes, no duplicates: the segment records must tile
+    ``[0, n_rounds)`` exactly once, in order."""
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+
+    segs = tsink.read_records(path, kind="segment")
+    ranges = [(int(r["round_start"]), int(r["round_end"])) for r in segs]
+    problems = []
+    expected = 0
+    for start, end in ranges:
+        if start != expected:
+            kind = "duplicate rounds" if start < expected else "hole"
+            problems.append(
+                f"{kind}: segment [{start}, {end}) after coverage "
+                f"reached {expected}"
+            )
+        expected = max(expected, end)
+    if expected != n_rounds:
+        problems.append(f"coverage ends at {expected}, run had "
+                        f"{n_rounds} rounds")
+    return {
+        "complete": not problems,
+        "problems": problems,
+        "n_segments": len(ranges),
+        "ranges": ranges,
+    }
+
+
+def merged_events(path: str) -> List[dict]:
+    """The journal's event stream in round order (traced shape)."""
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+
+    out: List[dict] = []
+    for rec in tsink.read_records(path, kind="segment"):
+        out.extend(rec.get("events", ()))
+    return out
+
+
+# --------------------------------------------------------------------------
+# The subprocess driver
+# --------------------------------------------------------------------------
+
+
+def _child_env(extra_env: Optional[dict] = None) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (_REPO_ROOT, env.get("PYTHONPATH")) if p
+    )
+    env.update(extra_env or {})
+    return env
+
+
+def launch_child(cfg: DrillConfig, cfg_path: str, kill_plan=None,
+                 timeout: float = 300.0,
+                 extra_env: Optional[dict] = None):
+    """One child launch; returns the CompletedProcess.  The kill plan
+    rides in SCALECUBE_RESILIENCE_KILL (supervisor.KILL_ENV).
+
+    The child runs with ``cwd=_REPO_ROOT`` (imports must resolve even
+    when the driver sits elsewhere), so the config's base path is
+    absolutized first — otherwise parent and child would resolve the
+    same relative lineage against different directories and the driver
+    would verify files the child never wrote."""
+    from scalecube_cluster_tpu.resilience import supervisor as rsup
+
+    cfg = dataclasses.replace(
+        cfg, base_path=os.path.abspath(cfg.base_path))
+    with open(cfg_path, "w") as f:
+        json.dump(cfg.to_json(), f)
+    env = _child_env(extra_env)
+    if kill_plan is not None:
+        env[rsup.KILL_ENV] = kill_plan.encode()
+    else:
+        env.pop(rsup.KILL_ENV, None)
+    return subprocess.run(
+        [sys.executable, "-m",
+         "scalecube_cluster_tpu.resilience.harness", "--config",
+         cfg_path],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=_REPO_ROOT,
+    )
+
+
+def run_kill_sequence(cfg: DrillConfig, kill_seed: int, n_kills: int,
+                      workdir: str, timeout: float = 300.0,
+                      extra_env: Optional[dict] = None) -> dict:
+    """SIGKILL the run ``n_kills`` times at seeded random (round, stage)
+    points, relaunch to completion, and verify against an uninterrupted
+    in-process reference.  Returns the verdict dict for one shape."""
+    from scalecube_cluster_tpu.resilience import supervisor as rsup
+
+    os.makedirs(workdir, exist_ok=True)
+
+    # Uninterrupted reference in its own lineage — run as a SUBPROCESS
+    # with the same env as the killed children, so the bit-identity
+    # comparison never crosses backends (the driver may sit on an
+    # accelerator while extra_env pins the children to CPU;
+    # float-dependent draws are not guaranteed identical across
+    # backends).
+    ref_cfg = dataclasses.replace(
+        cfg, base_path=os.path.join(workdir, "ref.ckpt"))
+    ref_proc = launch_child(
+        ref_cfg, os.path.join(workdir, "ref_config.json"),
+        kill_plan=None, timeout=timeout, extra_env=extra_env,
+    )
+    if ref_proc.returncode != 0:
+        return {"ok": False, "error": "reference run failed",
+                "stderr_tail": ref_proc.stderr[-2000:], "launches": []}
+    ref_summary = json.loads(
+        [ln for ln in ref_proc.stdout.strip().splitlines() if ln][-1])
+    ref_digest = ref_summary["state_digest"]
+    ref_events = merged_events(ref_summary["journal"])
+
+    # Seeded kill schedule: strictly increasing rounds so every kill
+    # lands in territory the previous relaunch has not yet re-covered,
+    # cycling write-stages so each boundary fault class gets exercised.
+    rng = random.Random(kill_seed)
+    rounds = sorted(rng.sample(range(1, cfg.n_rounds + 1),
+                               min(n_kills, cfg.n_rounds)))
+    stages = [rsup.KILL_STAGES[rng.randrange(len(rsup.KILL_STAGES))]
+              for _ in rounds]
+    plans = [rsup.KillPlan(round=r, stage=s)
+             for r, s in zip(rounds, stages)]
+
+    cfg_path = os.path.join(workdir, "drill_config.json")
+    launches = []
+    for plan in plans:
+        proc = launch_child(cfg, cfg_path, kill_plan=plan,
+                            timeout=timeout, extra_env=extra_env)
+        launches.append({
+            "kill": plan.encode(), "returncode": proc.returncode,
+        })
+        if proc.returncode != -signal.SIGKILL:
+            # The child survived past its own kill point (e.g. the kill
+            # round exceeded the rounds left) — acceptable only if it
+            # COMPLETED; anything else is a harness failure.
+            if proc.returncode != 0:
+                launches[-1]["stderr_tail"] = proc.stderr[-2000:]
+                return {"ok": False, "error": "child failed",
+                        "launches": launches}
+    final = launch_child(cfg, cfg_path, kill_plan=None, timeout=timeout,
+                         extra_env=extra_env)
+    launches.append({"kill": None, "returncode": final.returncode})
+    if final.returncode != 0:
+        return {"ok": False, "error": "final relaunch failed",
+                "stderr_tail": final.stderr[-2000:],
+                "launches": launches}
+    summary_lines = [ln for ln in final.stdout.strip().splitlines()
+                     if ln]
+    summary = json.loads(summary_lines[-1])
+
+    journal = verify_journal(summary["journal"], cfg.n_rounds)
+    got_events = merged_events(summary["journal"])
+    bit_identical = summary["state_digest"] == ref_digest
+    events_match = got_events == ref_events
+    return {
+        "ok": bool(bit_identical and journal["complete"]
+                   and events_match),
+        "shape": cfg.shape,
+        "bit_identical": bit_identical,
+        "state_digest": summary["state_digest"],
+        "ref_digest": ref_digest,
+        "journal_complete": journal["complete"],
+        "journal_problems": journal["problems"],
+        "journal_segments": journal["n_segments"],
+        "events_match": events_match,
+        "events": len(got_events),
+        "kills": [p.encode() for p in plans],
+        "launches": launches,
+        "resumed_segments_final_launch": summary["segments_run"],
+    }
+
+
+def corruption_drill(cfg: DrillConfig, workdir: str) -> dict:
+    """The fallback guarantee, demonstrated on a real lineage: complete
+    a run, bit-flip the newest generation, and show load_latest recovers
+    from the previous intact one (exhaustion of every candidate is
+    pinned separately in tests/test_resilience_store.py)."""
+    from scalecube_cluster_tpu.resilience import store as rstore
+
+    os.makedirs(workdir, exist_ok=True)
+    cfg = dataclasses.replace(
+        cfg, base_path=os.path.join(workdir, "corrupt.ckpt"))
+    run_config(cfg)
+    store = rstore.CheckpointStore(cfg.base_path,
+                                   keep=cfg.keep_generations)
+    gens = store.generations_on_disk()
+    if len(gens) < 2:
+        # keep=1, or a run short enough for one segment: there is no
+        # previous generation to fall back TO — report red instead of
+        # crashing into gens[-2] / exhaustion below.
+        return {
+            "ok": False,
+            "error": f"corruption drill needs >= 2 surviving "
+                     f"generations, got {gens}; use keep >= 2 and "
+                     f"rounds > segment_rounds",
+            "generations": gens,
+        }
+    latest = store.gen_path(gens[-1])
+    # Flip one payload byte mid-file (past the zip local header).
+    with open(latest, "rb+") as f:
+        f.seek(os.path.getsize(latest) // 2)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    _, next_round, _, _, info = store.load_latest()
+    fell_back = (info["generation"] == gens[-2]
+                 and len(info["fallbacks"]) == 1
+                 and next_round == gens[-2])
+    return {
+        "ok": bool(fell_back),
+        "generations": gens,
+        "corrupted": latest,
+        "loaded_generation": info["generation"],
+        "fallbacks": [why for _, why in info["fallbacks"]],
+    }
+
+
+def run_drill(shapes, workdir: str, kill_seed: int = 1234,
+              n_kills: int = 1, timeout: float = 300.0,
+              extra_env: Optional[dict] = None,
+              cfg_overrides: Optional[dict] = None) -> dict:
+    """The full matrix: one kill sequence per shape + the corruption
+    drill.  Returns the report dict bench.py --resilience prints."""
+    report = {"shapes": {}, "kill_seed": kill_seed, "n_kills": n_kills}
+    overrides = cfg_overrides or {}
+    for shape in shapes:
+        shape_dir = os.path.join(workdir, shape)
+        cfg = DrillConfig(
+            shape=shape,
+            base_path=os.path.join(shape_dir, "drill.ckpt"),
+            **overrides,
+        )
+        report["shapes"][shape] = run_kill_sequence(
+            cfg, kill_seed=kill_seed + zlib.crc32(shape.encode()) % 1000,
+            n_kills=n_kills, workdir=shape_dir, timeout=timeout,
+            extra_env=extra_env,
+        )
+    corrupt_cfg = DrillConfig(
+        shape="plain",
+        base_path=os.path.join(workdir, "corruption", "drill.ckpt"),
+        **overrides,
+    )
+    report["corruption"] = corruption_drill(
+        corrupt_cfg, os.path.join(workdir, "corruption"))
+    report["green"] = bool(
+        all(v["ok"] for v in report["shapes"].values())
+        and report["corruption"]["ok"]
+    )
+    return report
+
+
+# --------------------------------------------------------------------------
+# Child mode
+# --------------------------------------------------------------------------
+
+
+def child_main(argv=None) -> int:
+    """Run one resilient run to completion (the subprocess body).  Arms
+    the kill plan from SCALECUBE_RESILIENCE_KILL; on normal completion
+    prints one JSON summary line with the state digest + journal path.
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--config", required=True,
+                        help="path to a DrillConfig JSON file")
+    args = parser.parse_args(argv)
+    with open(args.config) as f:
+        cfg = DrillConfig.from_json(json.load(f))
+
+    from scalecube_cluster_tpu.resilience import supervisor as rsup
+    from scalecube_cluster_tpu.utils import runlog
+
+    runlog.enable_compilation_cache()
+    kill_plan = rsup.KillPlan.from_env()
+    result = run_config(cfg, kill_plan=kill_plan)
+    print(json.dumps({
+        "state_digest": result_digest(result),
+        "next_round": result.next_round,
+        "segments_run": result.segments_run,
+        "segments_deduped": result.segments_deduped,
+        "resumed": result.resumed_from is not None,
+        "retries": result.retries,
+        "journal": result.journal_path,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(child_main())

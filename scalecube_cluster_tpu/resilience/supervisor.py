@@ -1,0 +1,583 @@
+"""The resilient run supervisor: every run shape, in checkpointed
+segments, with retry, rotation and gap-free resumable telemetry.
+
+One driver for the repo's three run shapes —
+
+  - ``plain``     ``models/swim.run``
+  - ``traced``    ``models/swim.run_traced`` (membership event trace)
+  - ``monitored`` ``chaos/monitor.run_monitored`` (invariant monitor)
+
+— each executed as a sequence of ``segment_rounds``-round segments.
+After every segment, in this order (the trace-first/checkpoint-second
+ordering ``utils/checkpoint.run_checkpointed`` established):
+
+  1. the segment's telemetry (digested counters + decoded trace events
+     + monitor verdict progress) is APPENDED to a JSONL journal
+     (telemetry/sink.TelemetrySink in path/append mode, flushed per
+     record);
+  2. the carry (SwimState + per-shape aux arrays) is checkpointed into
+     the generation-rotated, checksummed store (resilience/store.py).
+
+A preemption between the two re-runs the segment on resume and the
+journal's round cursor (``sink.covered_upto``) dedups the re-written
+record, so the merged journal of ANY kill/relaunch sequence holds every
+round exactly once — no holes, no duplicates.  Runs are bit-reproducible
+(every draw is a pure function of (key, round) — ops/prng.py), so the
+resumed final state is bit-identical to an uninterrupted run; the
+kill-injection harness (resilience/harness.py) asserts exactly that
+with real SIGKILLs.
+
+Segment execution is wrapped in bounded exponential-backoff retry with
+jitter (:class:`RetryPolicy`): transient device/host errors (a
+flaky backend init, an OOM-killed compile server, an I/O hiccup) are
+retried from the segment's host-side carry copy — every attempt
+re-transfers from host numpy, so donated device buffers from a failed
+attempt are never reused.  Deterministic failures (shape/meta
+mismatch: ``ValueError``/``TypeError``/``KeyError``/``AssertionError``)
+raise immediately — retrying a wrong-config resume can only burn the
+preemption budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import random
+import signal
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+RUN_SHAPES = ("plain", "traced", "monitored")
+
+# Env var the kill harness uses to arm a kill inside a child process:
+# "<round>:<stage>" (see KillPlan.from_env).
+KILL_ENV = "SCALECUBE_RESILIENCE_KILL"
+
+KILL_STAGES = ("pre_journal", "mid_journal", "post_journal",
+               "post_checkpoint")
+
+
+# --------------------------------------------------------------------------
+# Retry policy + classification
+# --------------------------------------------------------------------------
+
+
+#: Deterministic-failure types: retrying cannot change the outcome, so
+#: they raise immediately (meta/shape mismatch, bad arguments).
+NON_RETRYABLE = (ValueError, TypeError, KeyError, AssertionError)
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Transient (True) vs deterministic (False) — module docstring.
+    Anything not in :data:`NON_RETRYABLE` is presumed transient:
+    RuntimeError covers jaxlib's XlaRuntimeError family, OSError the
+    host I/O family."""
+    return isinstance(exc, Exception) and not isinstance(exc, NON_RETRYABLE)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with jitter around one segment.
+
+    Delay before retry k (0-based) is
+    ``min(base_delay_s * 2**k, max_delay_s) * (1 + jitter * u)`` with
+    ``u ~ U[0, 1)`` drawn from a generator seeded by (seed, label) —
+    deterministic per call site, decorrelated across segments (the
+    thundering-herd argument for jitter, scaled down to one host).
+    """
+
+    max_attempts: int = 4
+    base_delay_s: float = 0.25
+    max_delay_s: float = 8.0
+    jitter: float = 0.5
+    seed: int = 0
+
+
+def with_retry(fn: Callable, policy: RetryPolicy, label: str = "",
+               log=None, sleep: Callable[[float], None] = time.sleep):
+    """Run ``fn()`` under ``policy``; non-retryable errors propagate
+    immediately, the last transient error propagates after the attempt
+    budget is spent."""
+    rng = random.Random(f"{policy.seed}:{label}")
+    for attempt in range(policy.max_attempts):
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — classified below
+            if not is_retryable(e) or attempt == policy.max_attempts - 1:
+                raise
+            delay = min(policy.base_delay_s * (2 ** attempt),
+                        policy.max_delay_s)
+            delay *= 1.0 + policy.jitter * rng.random()
+            if log is not None:
+                log.warning(
+                    "%s: attempt %d/%d failed (%s: %s); retrying in "
+                    "%.2fs", label or "segment", attempt + 1,
+                    policy.max_attempts, type(e).__name__, e, delay,
+                )
+            sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+# --------------------------------------------------------------------------
+# Kill injection (the harness's fault lever)
+# --------------------------------------------------------------------------
+
+
+class SimulatedPreemption(BaseException):
+    """In-process stand-in for SIGKILL (KillPlan mode="raise") — a
+    BaseException so neither retry nor the supervisor absorbs it, like
+    the real signal absorbs nothing."""
+
+
+@dataclasses.dataclass(frozen=True)
+class KillPlan:
+    """Kill the process at the first segment boundary whose
+    ``round_end`` >= ``round``, at write-stage ``stage``:
+
+      pre_journal      before the segment record is written (journal
+                       AND checkpoint behind — the whole segment
+                       re-runs on resume);
+      mid_journal      after HALF the record's bytes are written and
+                       flushed — a torn trailing line the readers must
+                       skip (telemetry/sink.read_records);
+      post_journal     record durable, checkpoint behind — the re-run
+                       segment's record is DEDUPED on resume;
+      post_checkpoint  both durable — resume continues with the next
+                       segment.
+
+    ``mode="sigkill"`` delivers a real ``SIGKILL`` to this process (no
+    cleanup, no atexit — the preemption shape); ``mode="raise"`` throws
+    :class:`SimulatedPreemption` for in-process tests.
+    """
+
+    round: int
+    stage: str = "post_journal"
+    mode: str = "sigkill"
+
+    def __post_init__(self):
+        if self.stage not in KILL_STAGES:
+            raise ValueError(f"stage {self.stage!r} not in {KILL_STAGES}")
+        if self.mode not in ("sigkill", "raise"):
+            raise ValueError(f"mode {self.mode!r}")
+
+    def fire(self):
+        if self.mode == "raise":
+            raise SimulatedPreemption(
+                f"simulated preemption at round {self.round} "
+                f"({self.stage})"
+            )
+        os.kill(os.getpid(), signal.SIGKILL)  # pragma: no cover
+
+    @staticmethod
+    def from_env(env: Optional[str] = None) -> Optional["KillPlan"]:
+        """Parse the harness's ``<round>:<stage>`` env encoding."""
+        raw = os.environ.get(KILL_ENV) if env is None else env
+        if not raw:
+            return None
+        round_s, _, stage = raw.partition(":")
+        return KillPlan(round=int(round_s),
+                        stage=stage or "post_journal")
+
+    def encode(self) -> str:
+        return f"{self.round}:{self.stage}"
+
+
+# --------------------------------------------------------------------------
+# Shape drivers: pack/unpack + segment runners
+# --------------------------------------------------------------------------
+
+
+class RunShape:
+    """Names for the three run shapes (plain str values so they embed
+    directly in meta/journal JSON)."""
+
+    PLAIN = "plain"
+    TRACED = "traced"
+    MONITORED = "monitored"
+
+
+def _default_trace_capacity(params) -> int:
+    # Per-segment trace capacity policy shared with bench.py: the scan
+    # functionally updates the whole lane buffer on event rounds, so an
+    # oversized buffer IS overhead at small N.
+    from scalecube_cluster_tpu.telemetry import trace as ttrace
+
+    return min(ttrace.DEFAULT_CAPACITY, max(4 * params.n_members, 4096))
+
+
+def _initial_carry(shape: str, params, world, opts: dict) -> dict:
+    """Fresh host-side carry arrays for ``shape`` (flat dict — the
+    checkpoint payload; resilience/store.py module docstring)."""
+    from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.utils import checkpoint as ckpt
+
+    arrays = ckpt.state_to_arrays(swim.initial_state(params, world))
+    if shape == RunShape.TRACED:
+        full = np.full((params.n_members, params.n_subjects),
+                       np.iinfo(np.int32).max, dtype=np.int32)
+        arrays["telemetry/first_suspect"] = full
+        arrays["telemetry/first_removed"] = full.copy()
+    elif shape == RunShape.MONITORED:
+        from scalecube_cluster_tpu.chaos import monitor as cmon
+
+        arrays.update(
+            cmon.MonitorState.init(opts["monitor_capacity"]).to_arrays()
+        )
+    return arrays
+
+
+def _run_segment(shape: str, key, params, world, start: int, end: int,
+                 carry: dict, opts: dict):
+    """One segment from host-side ``carry`` arrays; returns
+    ``(new_carry_arrays, journal_record_payload)`` — everything host-
+    side numpy, so a retry can simply call again (donated device
+    buffers are re-created from the host copy per attempt)."""
+    import jax
+
+    from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+    from scalecube_cluster_tpu.utils import checkpoint as ckpt
+
+    state = ckpt.state_from_arrays(
+        {k[len("state/"):]: v for k, v in carry.items()
+         if k.startswith("state/")}
+    )
+    step = end - start
+    common = dict(state=state, start_round=start, knobs=opts.get("knobs"),
+                  shift_key=opts.get("shift_key"))
+    record = {"shape": shape, "round_start": start, "round_end": end}
+
+    if shape == RunShape.PLAIN:
+        new_state, metrics = swim.run(key, params, world, step, **common)
+        aux_out, extras = {}, {}
+    elif shape == RunShape.TRACED:
+        from scalecube_cluster_tpu.telemetry import trace as ttrace
+
+        cap = opts["trace_capacity"]
+        tel_in = ttrace.TelemetryState.resume(
+            carry["telemetry/first_suspect"],
+            carry["telemetry/first_removed"], capacity=cap,
+        )
+        new_state, tel_out, metrics = swim.run_traced(
+            key, params, world, step, trace_capacity=cap,
+            telemetry=tel_in, **common,
+        )
+        (lanes, count, dropped), fs, fr = jax.device_get((
+            (tel_out.trace.lanes, tel_out.trace.count,
+             tel_out.trace.dropped),
+            tel_out.first_suspect, tel_out.first_removed,
+        ))
+        events = ttrace.decode_events(ttrace.EventTrace(
+            lanes=lanes, count=count, dropped=dropped,
+        ))
+        aux_out = {"telemetry/first_suspect": np.asarray(fs),
+                   "telemetry/first_removed": np.asarray(fr)}
+        extras = {
+            "events": [e.to_json() for e in events],
+            "events_recorded": int(count),
+            "events_dropped": int(dropped),
+        }
+    elif shape == RunShape.MONITORED:
+        from scalecube_cluster_tpu.chaos import monitor as cmon
+
+        mon_in = cmon.MonitorState.from_arrays(carry)
+        new_state, mon_out, metrics = cmon.run_monitored(
+            key, params, world, opts["spec"], step,
+            capacity=opts["monitor_capacity"], monitor=mon_in, **common,
+        )
+        mon_host = jax.device_get(mon_out)
+        aux_out = mon_host.to_arrays()
+        extras = {"monitor": cmon.verdict(mon_host, max_evidence=8)}
+    else:
+        raise ValueError(f"unknown run shape {shape!r}; "
+                         f"expected one of {RUN_SHAPES}")
+
+    jax.block_until_ready(new_state.status)
+    new_carry = ckpt.state_to_arrays(new_state)
+    new_carry.update(aux_out)
+    record["counters"] = tsink.counters_row(
+        jax.device_get(metrics), round_offset=start
+    )
+    record.update(extras)
+    return new_carry, record
+
+
+# --------------------------------------------------------------------------
+# The supervisor
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ResilientRunResult:
+    """What :func:`run_resilient` hands back (host side)."""
+
+    state: object                 # final SwimState (rebuilt from the
+                                  # host-side checkpoint payload)
+    carry_arrays: dict            # full final checkpoint payload
+    next_round: int
+    journal_path: str
+    segments_run: int             # segments executed by THIS process
+    segments_deduped: int         # re-runs whose records were deduped
+    resumed_from: Optional[dict]  # store.load_latest info, or None
+    retries: int                  # transient-failure retries consumed
+    events_recorded: int = 0      # traced: this process's total
+    events_dropped: int = 0
+    monitor_verdict: Optional[dict] = None   # monitored: final verdict
+
+
+def _spec_digest(spec) -> str:
+    """Stable digest of a MonitorSpec (complete_by array + flag) for
+    the meta-mismatch check."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(np.asarray(spec.complete_by)).tobytes())
+    h.update(b"1" if spec.check_false_suspicion else b"0")
+    return h.hexdigest()[:12]
+
+
+def _world_digest(world) -> str:
+    """Stable digest of the FULL fault schedule (every SwimWorld leaf:
+    crash/leave/revive rounds, link-fault rules, partition phases,
+    seeds).  config_digest covers SwimParams only — without this a
+    relaunch against a different scenario would be silently adopted as
+    the same run and produce a state matching neither."""
+    import jax
+
+    h = hashlib.sha256()
+    leaves, treedef = jax.tree_util.tree_flatten(world)
+    h.update(repr(treedef).encode())
+    for leaf in leaves:
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:12]
+
+
+def run_resilient(shape: str, key, params, world, n_rounds: int, *,
+                  store, segment_rounds: int = 256,
+                  journal_path: Optional[str] = None,
+                  meta: Optional[dict] = None,
+                  knobs=None, shift_key=None, spec=None,
+                  trace_capacity: Optional[int] = None,
+                  monitor_capacity: int = 1 << 12,
+                  retry: Optional[RetryPolicy] = None,
+                  kill_plan: Optional[KillPlan] = None,
+                  log=None, sleep=time.sleep) -> ResilientRunResult:
+    """Drive ``shape`` over ``n_rounds`` rounds with checkpointed
+    segments, retry, and a resumable journal (module docstring).
+
+    ``store`` is a :class:`resilience.store.CheckpointStore`; the
+    journal defaults to ``<store.base_path>.journal.jsonl``.  On resume
+    the stored meta must equal this call's (shape, config digest,
+    n_rounds, segment grid, user ``meta``) — a mismatch raises
+    ``ValueError`` immediately (non-retryable by definition: it means
+    the caller is trying to continue a DIFFERENT run).  ``spec`` is
+    required for the monitored shape (chaos/monitor.MonitorSpec).
+
+    ``kill_plan`` is the harness's fault lever — None in production.
+    """
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+    from scalecube_cluster_tpu.utils import checkpoint as ckpt
+
+    if shape not in RUN_SHAPES:
+        raise ValueError(f"unknown run shape {shape!r}; "
+                         f"expected one of {RUN_SHAPES}")
+    if shape == RunShape.MONITORED and spec is None:
+        raise ValueError("monitored shape needs a MonitorSpec (spec=)")
+    if segment_rounds < 1:
+        raise ValueError(f"segment_rounds must be >= 1, "
+                         f"got {segment_rounds}")
+    retry = retry or RetryPolicy()
+    journal_path = journal_path or f"{store.base_path}.journal.jsonl"
+
+    opts = {
+        "knobs": knobs, "shift_key": shift_key, "spec": spec,
+        "monitor_capacity": monitor_capacity,
+        "trace_capacity": trace_capacity or _default_trace_capacity(params),
+    }
+    # The resume-identity pin: everything that must not change under a
+    # relaunch.  segment_rounds is included because the journal's dedup
+    # cursor only composes with a stable segment grid — resuming with a
+    # different grid would write records overlapping already-journaled
+    # rounds.
+    full_meta = json.loads(json.dumps({
+        "shape": shape,
+        "config_digest": tsink.config_digest(params),
+        "world_digest": _world_digest(world),
+        "n_rounds": n_rounds,
+        "segment_rounds": segment_rounds,
+        "spec_digest": _spec_digest(spec) if spec is not None else None,
+        # Capacities change observable behavior for their shape (per-
+        # segment drop points; the monitor buffer's lane shape), so
+        # they join the pin where they matter and stay None elsewhere.
+        "trace_capacity": (opts["trace_capacity"]
+                           if shape == RunShape.TRACED else None),
+        "monitor_capacity": (monitor_capacity
+                             if shape == RunShape.MONITORED else None),
+        "user": meta or {},
+    }))
+
+    loaded = store.load_latest(log=log)
+    legacy = False
+    if loaded is not None:
+        carry, cursor, saved_key, saved_meta, info = loaded
+        legacy = bool(info.get("legacy"))
+        if saved_key is not None:
+            key = saved_key
+        # A legacy single-file checkpoint (utils/checkpoint.save, pre-
+        # rotation — MIGRATING.md) stored only the CALLER's meta dict,
+        # so the adoption check compares against the user part; rotated
+        # generations carry the full resume-identity pin.
+        expected = full_meta["user"] if legacy else full_meta
+        if saved_meta != expected:
+            raise ValueError(
+                f"checkpoint meta mismatch: saved {saved_meta!r} != "
+                f"current {expected!r} — refusing to resume a "
+                f"different run"
+            )
+        if legacy and shape != RunShape.PLAIN:
+            raise ValueError(
+                f"legacy single-file checkpoint {info['path']!r} holds "
+                f"only the plain-run carry; cannot adopt it into a "
+                f"{shape!r} run (its aux arrays never existed)"
+            )
+        if log is not None:
+            log.info("resumed %s from %s at round %d (%d corrupt "
+                     "generation(s) skipped)", shape, info["path"],
+                     cursor, len(info["fallbacks"]))
+    else:
+        carry, cursor, info = _initial_carry(shape, params, world,
+                                             opts), 0, None
+
+    # The sink heals a torn trailing line at reopen (append=True)
+    # BEFORE the journal is classified below, so the freshness check
+    # sees the durable byte count: a journal whose only content is a
+    # torn first line (writer killed mid-manifest-write) heals to
+    # empty and is still FRESH — its manifest gets written.
+    sink = tsink.TelemetrySink(path=journal_path, append=True)
+    killed_stage_armed = kill_plan is not None
+    retries = 0
+
+    def attempt_counter(fn, label):
+        nonlocal retries
+
+        def counted():
+            nonlocal retries
+            try:
+                return fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised
+                if is_retryable(e):
+                    retries += 1
+                raise
+
+        return with_retry(counted, retry, label=label, log=log,
+                          sleep=sleep)
+
+    try:
+        fresh_journal = os.path.getsize(journal_path) == 0
+        covered = 0 if fresh_journal else tsink.covered_upto(journal_path)
+        if legacy and fresh_journal:
+            # Adopting a pre-journal lineage: rounds [0, cursor) were
+            # run before this journal existed, so its coverage contract
+            # starts at the adoption cursor (recorded in the manifest
+            # below) — not a hole, a documented origin.
+            covered = cursor
+        elif covered < cursor:
+            # The journal write precedes the checkpoint save, so a kill
+            # can only ever leave the journal AHEAD of the cursor —
+            # behind it means records were lost out-of-band (deleted/
+            # rewritten journal next to surviving checkpoints).
+            # Continuing would leave a silent interior hole in the
+            # telemetry; same contract as utils/checkpoint
+            # .run_checkpointed's missing-trace refusal.
+            raise ValueError(
+                f"journal {journal_path!r} covers rounds [0, {covered}) "
+                f"but the checkpoint cursor is {cursor} — rounds "
+                f"[{covered}, {cursor}) were lost out-of-band; restore "
+                f"the journal or delete the checkpoint lineage to "
+                f"start over"
+            )
+        if fresh_journal:
+            sink.write_manifest(params=params, workload={
+                "kind": "resilient_run", "journal_origin": covered,
+                "legacy_adoption": legacy, **full_meta,
+            })
+        elif info is not None or covered:
+            sink.write_record("resume", {
+                "round_cursor": cursor,
+                "journal_covered": covered,
+                "checkpoint": None if info is None else {
+                    "path": info["path"],
+                    "generation": info.get("generation"),
+                    "fallbacks": info["fallbacks"],
+                },
+            })
+
+        segments_run = deduped = 0
+        events_recorded = events_dropped = 0
+        monitor_verdict = None
+        r = cursor
+        while r < n_rounds:
+            end = min(r + segment_rounds, n_rounds)
+            new_carry, record = attempt_counter(
+                lambda: _run_segment(shape, key, params, world, r, end,
+                                     carry, opts),
+                label=f"{shape}-segment@{r}",
+            )
+            record["checkpoint_generation"] = end
+            events_recorded += record.get("events_recorded", 0)
+            events_dropped += record.get("events_dropped", 0)
+            monitor_verdict = record.get("monitor", monitor_verdict)
+
+            due_kill = (killed_stage_armed and end >= kill_plan.round)
+            if due_kill and kill_plan.stage == "pre_journal":
+                kill_plan.fire()
+            if end > covered:
+                if due_kill and kill_plan.stage == "mid_journal":
+                    # Half a record then death: the torn-trailing-line
+                    # case read_records must absorb.  Raw write on the
+                    # sink's stream — this IS the fault injection, not
+                    # an API anyone else should use.
+                    text = json.dumps({"kind": "segment",
+                                       "run_id": sink.run_id, **record})
+                    sink._f.write(text[:max(1, len(text) // 2)])
+                    sink._f.flush()
+                    kill_plan.fire()
+                sink.write_record("segment", record)
+            else:
+                deduped += 1
+            if due_kill and kill_plan.stage == "post_journal":
+                kill_plan.fire()
+            store.save(new_carry, end, key=key, meta=full_meta)
+            if due_kill and kill_plan.stage == "post_checkpoint":
+                kill_plan.fire()
+            carry = new_carry
+            r = end
+            segments_run += 1
+            if log is not None:
+                log.info("%s: segment [%d, %d) journaled + "
+                         "checkpointed (gen %d)", shape, record
+                         ["round_start"], end, end)
+
+        sink.write_summary(
+            shape=shape, rounds=n_rounds,
+            segments_run=segments_run, retries=retries,
+        )
+    finally:
+        sink.close()
+
+    state = ckpt.state_from_arrays(
+        {k[len("state/"):]: v for k, v in carry.items()
+         if k.startswith("state/")}
+    )
+    return ResilientRunResult(
+        state=state, carry_arrays=carry, next_round=n_rounds,
+        journal_path=journal_path, segments_run=segments_run,
+        segments_deduped=deduped, resumed_from=info, retries=retries,
+        events_recorded=events_recorded, events_dropped=events_dropped,
+        monitor_verdict=monitor_verdict,
+    )

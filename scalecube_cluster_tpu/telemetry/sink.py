@@ -124,15 +124,79 @@ def counters_row(metrics: dict, round_offset: int = 0,
 
 
 class TelemetrySink:
-    """One JSONL run manifest under a sink directory (module docstring)."""
+    """One JSONL run manifest under a sink directory (module docstring).
 
-    def __init__(self, out_dir: str, run_id: Optional[str] = None,
-                 prefix: str = "run"):
-        self.run_id = run_id or new_run_id(prefix)
-        os.makedirs(out_dir, exist_ok=True)
-        self.path = os.path.join(out_dir, f"{self.run_id}.jsonl")
-        self._f = open(self.path, "w")
+    Every record is flushed as it is written, so a SIGKILL loses at most
+    the one line being emitted — and :func:`read_records` skips that
+    torn trailing line instead of refusing the whole file.
+
+    ``path`` pins the sink to an exact file instead of deriving one
+    from (out_dir, run_id); with ``append=True`` an existing file is
+    extended rather than truncated — the resilient-runner journal shape
+    (resilience/supervisor.py), where a relaunched process must
+    continue the SAME file with no holes and no duplicate rounds
+    (:func:`covered_upto` is the dedup cursor).
+    """
+
+    def __init__(self, out_dir: Optional[str] = None,
+                 run_id: Optional[str] = None, prefix: str = "run",
+                 path: Optional[str] = None, append: bool = False):
+        if path is not None:
+            self.path = path
+            stem = os.path.splitext(os.path.basename(path))[0]
+            self.run_id = run_id or stem
+            directory = os.path.dirname(os.path.abspath(path)) or "."
+        else:
+            if out_dir is None:
+                raise ValueError("TelemetrySink needs out_dir or path")
+            self.run_id = run_id or new_run_id(prefix)
+            directory = out_dir
+            self.path = os.path.join(out_dir, f"{self.run_id}.jsonl")
+        os.makedirs(directory, exist_ok=True)
+        if append:
+            self._heal_torn_tail(self.path)
+        self._f = open(self.path, "a" if append else "w")
         self._closed = False
+
+    @staticmethod
+    def _heal_torn_tail(path: str) -> None:
+        """Truncate an unterminated final line before appending.
+
+        A record is durable iff its line is newline-terminated (writes
+        are flushed per record); a file ending mid-line means the
+        previous writer was killed mid-write.  Appending after it would
+        fuse the torn fragment with the next record into one corrupt
+        INTERIOR line — which read_records correctly refuses — so the
+        fragment is dropped at reopen instead: it was never durable.
+        """
+        if not os.path.exists(path) or os.path.getsize(path) == 0:
+            return
+        with open(path, "rb+") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(-1, os.SEEK_END)
+            if f.read(1) == b"\n":
+                return
+            # Bounded backward scan for the last newline (journals at
+            # scale run to GBs — never slurped; only the torn tail is
+            # ever in memory, one chunk at a time).
+            keep, pos, chunk = 0, size, 1 << 16
+            while pos > 0:
+                start = max(0, pos - chunk)
+                f.seek(start)
+                idx = f.read(pos - start).rfind(b"\n")
+                if idx != -1:
+                    keep = start + idx + 1
+                    break
+                pos = start
+            import warnings
+
+            warnings.warn(
+                f"{path}: dropping {size - keep}-byte torn trailing "
+                f"record before appending (writer killed mid-line)",
+                stacklevel=3,
+            )
+            f.truncate(keep)
 
     @staticmethod
     def from_env(default_dir: Optional[str] = None,
@@ -234,17 +298,94 @@ class TelemetrySink:
 # --------------------------------------------------------------------------
 
 
-def read_records(path: str, kind: Optional[str] = None) -> List[dict]:
-    out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
+def iter_records(path: str, kind: Optional[str] = None):
+    """Stream the records of a JSONL manifest one at a time.
+
+    A record is durable iff its line is NEWLINE-TERMINATED: the writer
+    emits ``json + "\\n"`` per record and flushes, so a SIGKILL landing
+    mid-write leaves at most one unterminated trailing line.  That line
+    is skipped with a warning — EVEN IF it happens to parse (the kill
+    can land between the payload bytes and the newline; counting such a
+    record would disagree with the byte-identical truncation
+    ``TelemetrySink._heal_torn_tail`` applies at reopen, and a resumed
+    writer would then dedup against a record that no longer exists).
+    An unparseable newline-terminated line still raises: the per-record
+    write discipline cannot produce one, so it is real corruption, not
+    a torn write.
+
+    Generator on purpose: journals at scale run to GBs of event
+    batches, and consumers that fold over them (covered_upto's running
+    max) must not hold every record resident the way
+    :func:`read_records`'s list does.
+    """
+    # One-byte tail probe: is the final line newline-terminated?
+    with open(path, "rb") as fb:
+        fb.seek(0, os.SEEK_END)
+        size = fb.tell()
+        terminated = True
+        if size:
+            fb.seek(-1, os.SEEK_END)
+            terminated = fb.read(1) == b"\n"
+
+    def parse(lineno: int, line: str, is_final_payload: bool):
+        line = line.strip()
+        if is_final_payload and not terminated:
+            import warnings
+
+            warnings.warn(
+                f"{path}: skipping torn trailing record ({len(line)} "
+                f"bytes, no newline) — the writer was killed mid-line",
+                stacklevel=4,
+            )
+            return None
+        try:
             rec = json.loads(line)
-            if kind is None or rec.get("kind") == kind:
-                out.append(rec)
-    return out
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"{path}: unparseable newline-terminated record at "
+                f"line {lineno} — interior corruption, not a torn tail"
+            ) from e
+        if kind is None or rec.get("kind") == kind:
+            return rec
+        return None
+
+    # Streamed with one payload-line of lookahead: a line is processed
+    # once a later payload line proves it is not the file's last.
+    with open(path) as f:
+        pending = None
+        for i, raw in enumerate(f):
+            if not raw.strip():
+                continue
+            if pending is not None:
+                rec = parse(pending[0], pending[1], False)
+                if rec is not None:
+                    yield rec
+            pending = (i + 1, raw)
+        if pending is not None:
+            rec = parse(pending[0], pending[1], True)
+            if rec is not None:
+                yield rec
+
+
+def read_records(path: str, kind: Optional[str] = None) -> List[dict]:
+    """All (or one ``kind`` of) records in a JSONL manifest, as a list
+    (:func:`iter_records` has the durability/torn-tail contract)."""
+    return list(iter_records(path, kind=kind))
+
+
+def covered_upto(path: str, kind: str = "segment") -> int:
+    """The journal's round cursor: max ``round_end`` over well-formed
+    ``kind`` records, 0 for a missing/empty journal.  Torn trailing
+    lines don't count (iter_records skips them) — exactly the
+    resume-dedup semantics the resilient supervisor needs: a segment
+    whose ``round_end`` <= this cursor is already durably journaled.
+    Streams: each record is dropped after its round_end is folded in.
+    """
+    if not os.path.exists(path):
+        return 0
+    ends = (int(r["round_end"]) for r in iter_records(path, kind=kind)
+            if "round_end" in r)
+    return max(ends, default=0)
 
 
 def read_events(path: str) -> List[MembershipTraceEvent]:
@@ -356,10 +497,7 @@ def stream_traced_run(base_key, params, world, n_rounds: int, *,
     r, n_segments = 0, 0
     while r < n_rounds:
         step = min(segment_rounds, n_rounds - r)
-        tel_in = ttrace.TelemetryState(
-            trace=ttrace.EventTrace.empty(cap),
-            first_suspect=fs, first_removed=fr,
-        )
+        tel_in = ttrace.TelemetryState.resume(fs, fr, capacity=cap)
         state, tel_out, metrics = swim.run_traced(
             base_key, params, world, step, trace_capacity=cap,
             state=state, start_round=start_round + r, knobs=knobs,

@@ -135,6 +135,21 @@ class TelemetryState:
             first_removed=full(),
         )
 
+    @staticmethod
+    def resume(first_suspect, first_removed,
+               capacity: int = DEFAULT_CAPACITY) -> "TelemetryState":
+        """Segment-resume shape: a FRESH event buffer with the
+        first-transition matrices carried over — what every segmented
+        traced driver hands run_traced per segment (sink
+        .stream_traced_run's overlapped offload, the resilient
+        supervisor's checkpoint restore).  The matrices are converted
+        on the way in, so host numpy from a checkpoint is fine."""
+        return TelemetryState(
+            trace=EventTrace.empty(capacity),
+            first_suspect=jnp.asarray(first_suspect),
+            first_removed=jnp.asarray(first_removed),
+        )
+
 
 jax.tree_util.register_dataclass(
     TelemetryState,

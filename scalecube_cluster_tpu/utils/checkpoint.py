@@ -29,6 +29,41 @@ import numpy as np
 from scalecube_cluster_tpu.models.swim import SwimState
 
 
+def state_to_arrays(state: SwimState) -> dict:
+    """SwimState -> flat ``{"state/<field>": np.ndarray}`` dict — the
+    checkpoint payload naming shared with resilience/store.py."""
+    return {
+        f"state/{f.name}": np.asarray(getattr(state, f.name))
+        for f in dataclasses.fields(state)
+    }
+
+
+def state_from_arrays(fields: dict, origin: str = "checkpoint") -> SwimState:
+    """Inverse of :func:`state_to_arrays` (keys WITHOUT the ``state/``
+    prefix).  Checkpoints written before the user-gossip fields existed
+    load as G=0 (zero-width arrays) — the layout params.n_user_gossips=0
+    produces, so resume validation stays meaningful."""
+    fields = {k: jax.numpy.asarray(v) for k, v in fields.items()}
+    missing = ({f.name for f in dataclasses.fields(SwimState)}
+               - set(fields))
+    if missing:
+        n = fields["status"].shape[0]
+        g_defaults = {
+            "g_infected": jax.numpy.zeros((n, 0), dtype=bool),
+            "g_spread_until": jax.numpy.zeros(
+                (n, 0), dtype=jax.numpy.int32),
+            "g_ring": jax.numpy.zeros((0, n, 0), dtype=bool),
+        }
+        unknown = missing - set(g_defaults)
+        if unknown:
+            raise KeyError(
+                f"{origin} lacks state fields {sorted(unknown)}"
+            )
+        for name in missing:
+            fields[name] = g_defaults[name]
+    return SwimState(**fields)
+
+
 def save(path: str, state: SwimState, next_round: int,
          key=None, meta: Optional[dict] = None) -> None:
     """Atomically write ``state`` + cursor to ``path`` (.npz).
@@ -36,10 +71,7 @@ def save(path: str, state: SwimState, next_round: int,
     ``meta`` is an arbitrary JSON-able dict (config snapshot, world hash)
     stored alongside for validation at load time.
     """
-    arrays = {
-        f"state/{f.name}": np.asarray(getattr(state, f.name))
-        for f in dataclasses.fields(state)
-    }
+    arrays = state_to_arrays(state)
     arrays["next_round"] = np.int64(next_round)
     if key is not None:
         arrays["key_data"] = np.asarray(jax.random.key_data(key))
@@ -69,30 +101,10 @@ def load(path: str) -> Tuple[SwimState, int, Optional[jax.Array], dict]:
     """Load (state, next_round, key-or-None, meta) written by :func:`save`."""
     with np.load(path) as z:
         fields = {
-            name[len("state/"):]: jax.numpy.asarray(z[name])
+            name[len("state/"):]: z[name]
             for name in z.files if name.startswith("state/")
         }
-        # Checkpoints written before the user-gossip fields existed load
-        # as G=0 (zero-width arrays) — the layout params.n_user_gossips=0
-        # produces, so resume validation stays meaningful.
-        missing = ({f.name for f in dataclasses.fields(SwimState)}
-                   - set(fields))
-        if missing:
-            n = fields["status"].shape[0]
-            g_defaults = {
-                "g_infected": jax.numpy.zeros((n, 0), dtype=bool),
-                "g_spread_until": jax.numpy.zeros(
-                    (n, 0), dtype=jax.numpy.int32),
-                "g_ring": jax.numpy.zeros((0, n, 0), dtype=bool),
-            }
-            unknown = missing - set(g_defaults)
-            if unknown:
-                raise KeyError(
-                    f"checkpoint {path} lacks state fields {sorted(unknown)}"
-                )
-            for name in missing:
-                fields[name] = g_defaults[name]
-        state = SwimState(**fields)
+        state = state_from_arrays(fields, origin=f"checkpoint {path}")
         next_round = int(z["next_round"])
         key = None
         if "key_data" in z.files:

@@ -169,13 +169,20 @@ def test_compact_node_snapshot_matches_wide():
         assert snap_w == snap_c, (node, snap_w, snap_c)
 
 
+@pytest.mark.slow
 def test_compact_sharded_matches_wide_sharded():
     """The compact layout under shard_map (int16 payload blocks riding
     the ppermute rotations) equals the WIDE layout under the same
     sharding, metric for metric.  (Sharded runs are not bit-identical
     to single-device ones in either layout — per-device PRNG folding —
-    so the layout-equivalence comparison is made at equal sharding.)"""
+    so the layout-equivalence comparison is made at equal sharding.)
+    @slow: two 250-round 128-member runs on the virtual 8-device mesh —
+    the heaviest case in this file by an order of magnitude."""
     import jax as jax_mod
+
+    from scalecube_cluster_tpu.parallel import compat
+    if not compat.HAS_SHARD_MAP:
+        pytest.skip(compat.SKIP_REASON)
 
     from scalecube_cluster_tpu.parallel import mesh as pmesh
 

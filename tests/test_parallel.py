@@ -8,9 +8,13 @@ import numpy as np
 import pytest
 
 from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.parallel import compat
 from scalecube_cluster_tpu.parallel import mesh as pmesh
 
 from tests.test_swim_model import fast_config
+
+pytestmark = pytest.mark.skipif(not compat.HAS_SHARD_MAP,
+                                reason=compat.SKIP_REASON)
 
 
 def make(n, k=None, loss=0.0, **overrides):

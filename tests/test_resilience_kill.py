@@ -1,0 +1,122 @@
+"""The REAL kill-injection drills: subprocess SIGKILL + relaunch.
+
+Tier-1 keeps one single-kill smoke case (one shape, one seeded SIGKILL,
+one relaunch, verified bit-identical with a complete journal) plus the
+``bench.py --resilience --smoke`` subprocess pin, shrunk through the
+documented env overrides.  The full shapes x kills matrix — every run
+shape SIGKILLed at multiple seeded random (round, write-stage) points —
+runs under ``slow`` (and in CI-adjacent sweeps via
+``experiments/resilience_drill.py`` / ``bench.py --resilience``).
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from scalecube_cluster_tpu.resilience import harness as rh
+
+pytestmark = pytest.mark.resilience
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+CPU_ENV = {"JAX_PLATFORMS": "cpu", "SCALECUBE_XLA_CACHE_DIR": ""}
+
+
+def test_single_kill_smoke_traced(tmp_path):
+    """One seeded SIGKILL against the traced shape (the richest
+    telemetry surface), one relaunch: bit-identical final state,
+    gap-free duplicate-free journal, event stream equal to the
+    uninterrupted run's."""
+    cfg = rh.DrillConfig(
+        shape="traced", base_path=str(tmp_path / "drill.ckpt"),
+        n_members=12, n_rounds=24, segment_rounds=8,
+    )
+    report = rh.run_kill_sequence(
+        cfg, kill_seed=42, n_kills=1, workdir=str(tmp_path),
+        extra_env=CPU_ENV,
+    )
+    assert report["ok"], report
+    assert report["bit_identical"]
+    assert report["journal_complete"], report["journal_problems"]
+    assert report["events_match"] and report["events"] > 0
+    # Exactly one real SIGKILL (-9) then one clean completion.
+    assert [launch["returncode"] for launch in report["launches"]] \
+        == [-9, 0]
+
+
+@pytest.mark.slow
+def test_full_kill_matrix_all_shapes(tmp_path):
+    """The acceptance matrix: every run shape SIGKILLed at 3 seeded
+    random (round, write-stage) points and relaunched; plus the
+    corrupted-latest-generation fallback drill."""
+    report = rh.run_drill(
+        ("plain", "traced", "monitored"), str(tmp_path),
+        kill_seed=1234, n_kills=3,
+        cfg_overrides=dict(n_members=16, n_rounds=48, segment_rounds=12),
+        extra_env=CPU_ENV,
+    )
+    assert report["green"], json.dumps(report, indent=1)
+    for shape, verdict in report["shapes"].items():
+        assert verdict["bit_identical"], (shape, verdict)
+        assert verdict["journal_complete"], (shape, verdict)
+        assert verdict["events_match"], (shape, verdict)
+        # 3 SIGKILLs + the clean final completion.
+        codes = [launch["returncode"] for launch in verdict["launches"]]
+        assert codes.count(-9) == 3 and codes[-1] == 0, (shape, codes)
+    assert report["corruption"]["ok"], report["corruption"]
+
+
+def test_bench_resilience_smoke_emits_result(tmp_path):
+    """bench.py --resilience --smoke: one JSON line, all shapes green,
+    corruption fallback green — shrunk via the documented env overrides
+    so the pin stays tier-1-safe."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_XLA_CACHE_DIR="",
+        SCALECUBE_RESILIENCE_N="12",
+        SCALECUBE_RESILIENCE_ROUNDS="24",
+        SCALECUBE_RESILIENCE_SEGMENT="8",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--resilience",
+         "--smoke"],
+        capture_output=True, text=True, timeout=540, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout      # exactly ONE JSON line
+    result = json.loads(lines[0])
+
+    assert "error" not in result, result
+    assert result["metric"] == "resilience_drill_green_shapes"
+    assert result["smoke"] is True
+    assert result["green"] is True
+    assert result["value"] == 3              # plain, traced, monitored
+    assert sorted(result["shapes_run"]) == ["monitored", "plain",
+                                            "traced"]
+    for shape, verdict in result["verdicts"].items():
+        assert verdict["ok"] and verdict["bit_identical"], (shape,
+                                                            verdict)
+        assert verdict["journal_complete"] and verdict["events_match"]
+        assert len(verdict["kills"]) == 1    # smoke = single kill
+    assert result["corruption"]["ok"] is True
+    assert result["corruption"]["fallbacks"]  # the reason is recorded
+
+
+def test_bench_rejects_resilience_with_other_modes():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--resilience",
+         "--chaos"],
+        capture_output=True, text=True, timeout=60, cwd=str(REPO),
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode != 0
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1                   # one-JSON-line contract
+    assert json.loads(lines[0])["value"] is None

@@ -1,0 +1,331 @@
+"""resilience/supervisor.py: segmentation, retry classification, and
+in-process preemption/resume semantics.
+
+The REAL (subprocess SIGKILL) drills live in tests/test_resilience_kill
+.py; this module pins the same guarantees in-process where they are
+cheap: segmented == monolithic bit for bit, a simulated preemption at
+the nastiest write stages resumes bit-identically with a gap-free
+journal, transient errors retry with backoff while deterministic ones
+raise immediately.
+"""
+
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.resilience import harness as rh
+from scalecube_cluster_tpu.resilience import store as rstore
+from scalecube_cluster_tpu.resilience import supervisor as rsup
+from scalecube_cluster_tpu.telemetry import sink as tsink
+
+pytestmark = pytest.mark.resilience
+
+
+def drill_cfg(tmp_path, shape="plain", sub="run", **overrides):
+    base = tmp_path / sub
+    os.makedirs(base, exist_ok=True)
+    kw = dict(n_members=12, n_rounds=24, segment_rounds=8)
+    kw.update(overrides)
+    return rh.DrillConfig(shape=shape, base_path=str(base / "ck"), **kw)
+
+
+def test_segmented_plain_matches_monolithic(tmp_path):
+    cfg = drill_cfg(tmp_path)
+    key, params, world, _ = rh.build_workload(cfg)
+    mono_state, _ = swim.run(key, params, world, cfg.n_rounds)
+    res = rh.run_config(cfg)
+    for f in dataclasses.fields(swim.SwimState):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(mono_state, f.name)),
+            np.asarray(getattr(res.state, f.name)),
+            err_msg=f"segmented vs monolithic diverged on {f.name}",
+        )
+    v = rh.verify_journal(res.journal_path, cfg.n_rounds)
+    assert v["complete"], v["problems"]
+    assert v["n_segments"] == 3
+    # The journal round-trips through the standard sink readers, with a
+    # manifest and a closing summary.
+    kinds = [r["kind"] for r in tsink.read_records(res.journal_path)]
+    assert kinds[0] == "manifest" and kinds[-1] == "summary"
+
+
+@pytest.mark.parametrize("stage", ["mid_journal", "post_journal"])
+def test_simulated_preemption_resumes_bit_identical(tmp_path, stage):
+    """The two nastiest write stages in-process (torn journal line;
+    journal ahead of checkpoint -> dedup).  The full stage x shape
+    matrix runs under @slow with real SIGKILLs."""
+    ref = rh.run_config(drill_cfg(tmp_path, shape="traced", sub="ref"))
+    ref_digest = rh.result_digest(ref)
+    ref_events = rh.merged_events(ref.journal_path)
+    assert ref.events_recorded > 0          # the crash really traced
+
+    cfg = drill_cfg(tmp_path, shape="traced", sub=stage)
+    with pytest.raises(rsup.SimulatedPreemption):
+        rh.run_config(cfg, kill_plan=rsup.KillPlan(
+            round=12, stage=stage, mode="raise"))
+    res = rh.run_config(cfg)
+    assert res.resumed_from is not None
+    assert rh.result_digest(res) == ref_digest
+    v = rh.verify_journal(res.journal_path, cfg.n_rounds)
+    assert v["complete"], v["problems"]
+    assert rh.merged_events(res.journal_path) == ref_events
+    if stage == "post_journal":
+        # The re-run segment's record was already durable: deduped.
+        assert res.segments_deduped == 1
+
+
+def test_resume_after_corrupt_latest_generation(tmp_path):
+    """Preemption + disk corruption stacked: kill mid-run, bit-flip the
+    newest surviving generation, and the relaunch still completes
+    bit-identically from the generation before it."""
+    ref = rh.run_config(drill_cfg(tmp_path, sub="ref2"))
+    cfg = drill_cfg(tmp_path, sub="both")
+    with pytest.raises(rsup.SimulatedPreemption):
+        rh.run_config(cfg, kill_plan=rsup.KillPlan(
+            round=17, stage="post_checkpoint", mode="raise"))
+    store = rstore.CheckpointStore(cfg.base_path, keep=3)
+    gens = store.generations_on_disk()
+    assert len(gens) >= 2
+    with open(store.gen_path(gens[-1]), "rb+") as f:
+        f.seek(os.path.getsize(store.gen_path(gens[-1])) // 2)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    res = rh.run_config(cfg)
+    assert rh.result_digest(res) == rh.result_digest(ref)
+    assert res.resumed_from["fallbacks"]    # the corrupt gen was named
+    assert res.resumed_from["generation"] == gens[-2]
+    v = rh.verify_journal(res.journal_path, cfg.n_rounds)
+    assert v["complete"], v["problems"]
+
+
+# --------------------------------------------------------------------------
+# Retry policy
+# --------------------------------------------------------------------------
+
+
+def test_transient_errors_retry_with_backoff_then_succeed(tmp_path,
+                                                          monkeypatch):
+    cfg = drill_cfg(tmp_path, sub="retry")
+    real = rsup._run_segment
+    failures = {"left": 2}
+
+    def flaky(*args, **kwargs):
+        if failures["left"] > 0:
+            failures["left"] -= 1
+            raise RuntimeError("transient device hiccup")
+        return real(*args, **kwargs)
+
+    sleeps = []
+    monkeypatch.setattr(rsup, "_run_segment", flaky)
+    key, params, world, _ = rh.build_workload(cfg)
+    store = rstore.CheckpointStore(cfg.base_path)
+    res = rsup.run_resilient(
+        "plain", key, params, world, cfg.n_rounds, store=store,
+        segment_rounds=cfg.segment_rounds,
+        retry=rsup.RetryPolicy(max_attempts=4, base_delay_s=0.1,
+                               max_delay_s=1.0, jitter=0.5, seed=7),
+        sleep=sleeps.append,
+    )
+    assert res.retries == 2
+    assert len(sleeps) == 2
+    # Exponential envelope with non-negative jitter: delay k in
+    # [base * 2^k, base * 2^k * (1 + jitter)].
+    assert 0.1 <= sleeps[0] <= 0.1 * 1.5
+    assert 0.2 <= sleeps[1] <= 0.2 * 1.5
+    # And the flaky run still produced the right answer.
+    mono, _ = swim.run(key, params, world, cfg.n_rounds)
+    np.testing.assert_array_equal(np.asarray(mono.status),
+                                  np.asarray(res.state.status))
+
+
+def test_transient_errors_exhaust_attempt_budget(tmp_path, monkeypatch):
+    cfg = drill_cfg(tmp_path, sub="exhaust")
+    monkeypatch.setattr(
+        rsup, "_run_segment",
+        lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("permanently flaky")),
+    )
+    key, params, world, _ = rh.build_workload(cfg)
+    sleeps = []
+    with pytest.raises(RuntimeError, match="permanently flaky"):
+        rsup.run_resilient(
+            "plain", key, params, world, cfg.n_rounds,
+            store=rstore.CheckpointStore(cfg.base_path),
+            segment_rounds=cfg.segment_rounds,
+            retry=rsup.RetryPolicy(max_attempts=3, base_delay_s=0.01),
+            sleep=sleeps.append,
+        )
+    assert len(sleeps) == 2                 # attempts - 1 backoffs
+
+
+def test_deterministic_failures_raise_immediately(tmp_path):
+    """Meta mismatch (a DIFFERENT run at the same lineage) is
+    non-retryable: no sleeps, immediate ValueError."""
+    cfg = drill_cfg(tmp_path, sub="meta")
+    rh.run_config(cfg)                      # complete a lineage
+    key, params, world, _ = rh.build_workload(cfg)
+    sleeps = []
+    with pytest.raises(ValueError, match="meta mismatch"):
+        rsup.run_resilient(
+            "plain", key, params, world, cfg.n_rounds + 8,   # different
+            store=rstore.CheckpointStore(cfg.base_path),
+            segment_rounds=cfg.segment_rounds, sleep=sleeps.append,
+        )
+    with pytest.raises(ValueError, match="meta mismatch"):
+        rsup.run_resilient(                 # different segment grid
+            "plain", key, params, world, cfg.n_rounds,
+            store=rstore.CheckpointStore(cfg.base_path),
+            segment_rounds=cfg.segment_rounds + 1,
+            sleep=sleeps.append,
+        )
+    with pytest.raises(ValueError, match="meta mismatch"):
+        rsup.run_resilient(                 # different fault schedule
+            "plain", key, params, world.with_crash(7, at_round=11),
+            cfg.n_rounds, store=rstore.CheckpointStore(cfg.base_path),
+            segment_rounds=cfg.segment_rounds, sleep=sleeps.append,
+        )
+    assert sleeps == []
+
+
+def test_is_retryable_classification():
+    assert rsup.is_retryable(RuntimeError("xla runtime"))
+    assert rsup.is_retryable(OSError("disk wobble"))
+    assert not rsup.is_retryable(ValueError("shape mismatch"))
+    assert not rsup.is_retryable(TypeError("bad arg"))
+    assert not rsup.is_retryable(KeyError("state/status"))
+    assert not rsup.is_retryable(AssertionError("invariant"))
+    assert not rsup.is_retryable(KeyboardInterrupt())    # BaseException
+    assert not rsup.is_retryable(rsup.SimulatedPreemption())
+
+
+def test_monitored_shape_requires_spec(tmp_path):
+    cfg = drill_cfg(tmp_path, sub="spec")
+    key, params, world, _ = rh.build_workload(cfg)
+    with pytest.raises(ValueError, match="MonitorSpec"):
+        rsup.run_resilient(
+            "monitored", key, params, world, 8,
+            store=rstore.CheckpointStore(cfg.base_path),
+        )
+    with pytest.raises(ValueError, match="run shape"):
+        rsup.run_resilient(
+            "warped", key, params, world, 8,
+            store=rstore.CheckpointStore(cfg.base_path),
+        )
+
+
+def test_monitored_resume_carries_violation_counts(tmp_path):
+    """The monitor buffer rides the checkpoint: the resumed run's final
+    verdict (counts, first rounds, evidence) equals the uninterrupted
+    reference's exactly, and the full carry digest matches."""
+    ref = rh.run_config(drill_cfg(tmp_path, shape="monitored",
+                                  sub="mref"))
+    cfg = drill_cfg(tmp_path, shape="monitored", sub="mkill")
+    with pytest.raises(rsup.SimulatedPreemption):
+        rh.run_config(cfg, kill_plan=rsup.KillPlan(
+            round=12, stage="post_checkpoint", mode="raise"))
+    res = rh.run_config(cfg)
+    assert res.monitor_verdict == ref.monitor_verdict
+    assert res.monitor_verdict["green"] is True
+    assert rh.result_digest(res) == rh.result_digest(ref)
+
+
+def test_legacy_single_file_lineage_adopts_and_continues(tmp_path):
+    """A pre-rotation utils/checkpoint lineage (plain <base>.npz, no
+    checksum, no journal) resumes through run_resilient: the user meta
+    is matched, the journal starts at the adoption cursor, the
+    continuation is bit-identical to an unbroken run, and the first
+    rotated generation appears at the next boundary (MIGRATING.md)."""
+    from scalecube_cluster_tpu.utils import checkpoint as ckpt
+
+    cfg = drill_cfg(tmp_path, sub="legacy")
+    key, params, world, _ = rh.build_workload(cfg)
+    mid, _ = swim.run(key, params, world, 8)
+    ckpt.save(cfg.base_path, jax.device_get(mid), next_round=8, key=key,
+              meta={"who": "legacy"})
+
+    store = rstore.CheckpointStore(cfg.base_path, keep=3)
+    res = rsup.run_resilient(
+        "plain", key, params, world, cfg.n_rounds, store=store,
+        segment_rounds=cfg.segment_rounds, meta={"who": "legacy"},
+    )
+    assert res.resumed_from is not None \
+        and res.resumed_from.get("legacy") is True
+    mono, _ = swim.run(key, params, world, cfg.n_rounds)
+    np.testing.assert_array_equal(np.asarray(mono.status),
+                                  np.asarray(res.state.status))
+    np.testing.assert_array_equal(np.asarray(mono.inc),
+                                  np.asarray(res.state.inc))
+    # Rotated, checksummed generations now exist; the legacy file stays.
+    assert store.generations_on_disk()
+    assert os.path.exists(cfg.base_path)
+    # The journal's origin is the adoption cursor, and coverage from
+    # there is complete.
+    (manifest,) = tsink.read_records(res.journal_path, kind="manifest")
+    assert manifest["workload"]["legacy_adoption"] is True
+    assert manifest["workload"]["journal_origin"] == 8
+    segs = tsink.read_records(res.journal_path, kind="segment")
+    assert [r["round_start"] for r in segs][0] == 8
+    assert segs[-1]["round_end"] == cfg.n_rounds
+    # Wrong user meta refuses the adoption (a different run).
+    cfg2 = drill_cfg(tmp_path, sub="legacy2")
+    ckpt.save(cfg2.base_path, jax.device_get(mid), next_round=8,
+              key=key, meta={"who": "legacy"})
+    with pytest.raises(ValueError, match="meta mismatch"):
+        rsup.run_resilient(
+            "plain", key, params, world, cfg.n_rounds,
+            store=rstore.CheckpointStore(cfg2.base_path, keep=3),
+            segment_rounds=cfg.segment_rounds, meta={"who": "else"},
+        )
+    # Non-plain shapes cannot adopt a carry whose aux never existed.
+    with pytest.raises(ValueError, match="legacy"):
+        rsup.run_resilient(
+            "traced", key, params, world, cfg.n_rounds,
+            store=rstore.CheckpointStore(cfg2.base_path, keep=3),
+            segment_rounds=cfg.segment_rounds, meta={"who": "legacy"},
+        )
+
+
+def test_torn_manifest_only_journal_still_gets_manifest(tmp_path):
+    """A first launch killed mid-manifest-write leaves a journal whose
+    ONLY content is one torn unterminated line.  The relaunch heals it
+    to empty at sink reopen and must then classify it FRESH — writing
+    the manifest — rather than reading the pre-heal byte count and
+    skipping the manifest for the rest of the run's life."""
+    cfg = drill_cfg(tmp_path, sub="tornfirst")
+    journal = cfg.base_path + ".journal.jsonl"
+    with open(journal, "w") as f:
+        f.write('{"kind": "manifest", "run_id": "ck.journal", "schem')
+    with pytest.warns(UserWarning, match="torn trailing"):
+        res = rh.run_config(cfg)
+    kinds = [r["kind"] for r in tsink.read_records(res.journal_path)]
+    assert kinds[0] == "manifest" and kinds[-1] == "summary"
+    v = rh.verify_journal(res.journal_path, cfg.n_rounds)
+    assert v["complete"], v["problems"]
+
+
+def test_out_of_band_journal_loss_refuses_resume(tmp_path):
+    """The journal write precedes the checkpoint save, so the journal
+    can never legitimately be BEHIND the cursor; a deleted journal next
+    to surviving checkpoints must refuse to continue instead of leaving
+    a silent interior hole in the telemetry."""
+    cfg = drill_cfg(tmp_path, sub="gone")
+    with pytest.raises(rsup.SimulatedPreemption):
+        rh.run_config(cfg, kill_plan=rsup.KillPlan(
+            round=12, stage="post_checkpoint", mode="raise"))
+    journal = cfg.base_path + ".journal.jsonl"
+    os.unlink(journal)
+    with pytest.raises(ValueError, match="lost out-of-band"):
+        rh.run_config(cfg)
+
+
+def test_kill_plan_env_roundtrip():
+    plan = rsup.KillPlan(round=17, stage="mid_journal")
+    assert rsup.KillPlan.from_env(plan.encode()) == plan
+    assert rsup.KillPlan.from_env("") is None
+    with pytest.raises(ValueError, match="stage"):
+        rsup.KillPlan(round=1, stage="nonsense")

@@ -175,3 +175,104 @@ def test_bench_manifest_shape_end_to_end(tmp_path):
     (summary,) = tsink.read_records(sink.path, kind="summary")
     assert summary["event_drops"] == 0
     assert tsink.read_events(sink.path) == ttrace.decode_events(tel)
+
+
+# --------------------------------------------------------------------------
+# Torn-line hardening + the resumable-journal surface (resilience)
+# --------------------------------------------------------------------------
+
+
+def _write_lines(path, lines, torn_tail=None):
+    with open(path, "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+        if torn_tail is not None:
+            f.write(torn_tail)          # no newline: a mid-write kill
+
+
+def test_read_records_skips_torn_trailing_line(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    whole = [{"kind": "manifest", "run_id": "r"},
+             {"kind": "segment", "round_start": 0, "round_end": 8}]
+    torn = json.dumps({"kind": "segment", "round_start": 8,
+                       "round_end": 16})[:25]
+    _write_lines(path, whole, torn_tail=torn)
+    with pytest.warns(UserWarning, match="torn trailing"):
+        recs = tsink.read_records(path)
+    assert recs == whole                # the torn record never counts
+    with pytest.warns(UserWarning, match="torn trailing"):
+        assert tsink.covered_upto(path) == 8
+
+
+def test_parseable_but_unterminated_tail_is_still_torn(tmp_path):
+    """A kill can land BETWEEN a record's payload bytes and its
+    newline: the line parses but is not durable (reopen truncates it),
+    so the readers — and above all the dedup cursor — must not count
+    it.  Counting it would dedup a resumed segment against a record the
+    heal then deletes: a permanent journal hole."""
+    path = str(tmp_path / "run.jsonl")
+    whole = [{"kind": "segment", "round_start": 0, "round_end": 8}]
+    parseable_torn = json.dumps(
+        {"kind": "segment", "round_start": 8, "round_end": 16})
+    _write_lines(path, whole, torn_tail=parseable_torn)   # no newline
+    with pytest.warns(UserWarning, match="torn trailing"):
+        assert tsink.covered_upto(path) == 8              # NOT 16
+    # The heal + rewrite path converges to a whole file covering 16.
+    with pytest.warns(UserWarning, match="torn trailing"):
+        sink = tsink.TelemetrySink(path=path, append=True)
+    sink.write_record("segment", {"round_start": 8, "round_end": 16})
+    sink.close()
+    assert tsink.covered_upto(path) == 16
+
+
+def test_read_records_raises_on_interior_corruption(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "manifest"}) + "\n")
+        f.write("{definitely not json\n")
+        f.write(json.dumps({"kind": "summary"}) + "\n")
+    with pytest.raises(ValueError, match="interior"):
+        tsink.read_records(path)
+
+
+def test_append_mode_heals_torn_tail_before_writing(tmp_path):
+    """A relaunched writer must not fuse its first record onto a torn
+    fragment: the unterminated tail is truncated at reopen (it was
+    never durable), and the resumed file parses clean end to end."""
+    path = str(tmp_path / "run.jsonl")
+    whole = [{"kind": "segment", "round_start": 0, "round_end": 8}]
+    _write_lines(path, whole, torn_tail='{"kind": "segm')
+    with pytest.warns(UserWarning, match="torn trailing"):
+        sink = tsink.TelemetrySink(path=path, append=True)
+    sink.write_record("segment", {"round_start": 8, "round_end": 16})
+    sink.close()
+    recs = tsink.read_records(path)     # no warning: file is clean now
+    assert [r["round_end"] for r in recs if r["kind"] == "segment"] \
+        == [8, 16]
+    assert tsink.covered_upto(path) == 16
+
+
+def test_append_mode_continues_existing_file(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    first = tsink.TelemetrySink(path=path)
+    first.write_record("segment", {"round_start": 0, "round_end": 4})
+    first.close()
+    second = tsink.TelemetrySink(path=path, append=True)
+    second.write_record("segment", {"round_start": 4, "round_end": 8})
+    second.close()
+    assert tsink.covered_upto(path) == 8
+    # Both writers stamped the same run id (derived from the filename).
+    run_ids = {r["run_id"] for r in tsink.read_records(path)}
+    assert run_ids == {"run"}
+
+
+def test_covered_upto_missing_and_empty(tmp_path):
+    assert tsink.covered_upto(str(tmp_path / "nope.jsonl")) == 0
+    path = str(tmp_path / "empty.jsonl")
+    open(path, "w").close()
+    assert tsink.covered_upto(path) == 0
+
+
+def test_sink_requires_out_dir_or_path():
+    with pytest.raises(ValueError, match="out_dir or path"):
+        tsink.TelemetrySink()

@@ -21,10 +21,14 @@ import pytest
 
 from scalecube_cluster_tpu.models import swim
 from scalecube_cluster_tpu.ops import shift as shift_ops
+from scalecube_cluster_tpu.parallel import compat
 from scalecube_cluster_tpu.parallel import mesh as pmesh
 from scalecube_cluster_tpu.parallel import traffic
 
 from tests.test_swim_model import fast_config
+
+pytestmark = pytest.mark.skipif(not compat.HAS_SHARD_MAP,
+                                reason=compat.SKIP_REASON)
 
 N_DEV = 8
 
@@ -56,6 +60,13 @@ def _op_operand_bytes(hlo_text, op_name):
     return out
 
 
+hlo_pinned = pytest.mark.skipif(
+    compat.HAS_SHARD_MAP and not compat.MODERN_LOWERING,
+    reason=compat.LEGACY_LOWERING_REASON,
+)
+
+
+@hlo_pinned
 @pytest.mark.parametrize("n,k,gate,layout", [
     (256, 16, False, "wide"),
     (128, 128, True, "wide"),
@@ -107,6 +118,7 @@ def test_shift_hlo_collectives_match_traffic_model(n, k, gate, layout):
     assert _op_operand_bytes(hlo, "all-reduce") == []
 
 
+@hlo_pinned
 @pytest.mark.parametrize("compact", [False, True])
 def test_scatter_hlo_collectives_match_traffic_model(compact):
     n, k = 256, 16
@@ -211,11 +223,11 @@ def test_scatter_collective_count_matches_tick():
 
     with mock.patch.object(jax.lax, "pmax", counting):
         jax.make_jaxpr(
-            lambda s: jax.shard_map(
+            lambda s: compat.shard_map(
                 body, mesh=jax.sharding.Mesh(jax.devices()[:1], ("x",)),
                 in_specs=(jax.sharding.PartitionSpec(),),
                 out_specs=jax.sharding.PartitionSpec(),
-                check_vma=False,
+                check_replication=False,
             )(s)
         )(state)
     assert len(pmax_calls) == traffic.scatter_collectives_per_round(params)
