@@ -54,6 +54,21 @@ violation totals, one-line repros for any red scenario.  ``--chaos
 tests/test_chaos_campaign.py.  Env overrides: SCALECUBE_CHAOS_N,
 SCALECUBE_CHAOS_SCENARIOS, SCALECUBE_CHAOS_SEED.
 
+``--metrics``: the observability-cost workload — the always-on health
+registry (telemetry/metrics.py: in-jit counters/gauges/histograms
+carried through the scan) measured against the bare hot path on the
+same interleaved best-of window discipline as the traced/untraced
+gap.  One JSON line out with ``metrics_overhead_ratio``
+(unmetered/metered rate; 1.0 = the health plane is free), the window
+registry digest, the health SLOs (telemetry/query.py), and a JSONL
+manifest of ``metrics_window`` rows.  Writes a BENCH_*-style artifact
+(default ``artifacts/metrics_smoke.json`` under --smoke,
+``artifacts/metrics_bench.json`` otherwise; override with
+SCALECUBE_METRICS_ARTIFACT).  ``--metrics --smoke`` is the tier-1-safe
+pass pinned by tests/test_bench_metrics_smoke.py; the
+``python -m scalecube_cluster_tpu.telemetry regress`` gate checks the
+recorded ratio.
+
 ``--resilience``: the preemption-survival workload — the kill-injection
 drill (resilience/harness.py) SIGKILLs a resilient run (rotated,
 checksummed checkpoints + resumable JSONL journal;
@@ -718,6 +733,176 @@ def run_resilience_drill():
     print(json.dumps(result), flush=True)
 
 
+def run_metrics_bench():
+    """The --metrics mode: metrics-on vs metrics-off on the bench
+    workload (interleaved best-of windows, the timed_both discipline)
+    plus a windowed metered run flushed through the JSONL pipeline and
+    digested into health SLOs.  One JSON line out, a BENCH_*-style
+    artifact recording the overhead ratio (the never-ship-empty
+    contract)."""
+    result = {
+        "metric": "swim_metrics_overhead_ratio",
+        "value": None,
+        "unit": "unmetered/metered rate ratio",
+        "smoke": SMOKE,
+    }
+    artifact = os.environ.get("SCALECUBE_METRICS_ARTIFACT") or os.path.join(
+        "artifacts", "metrics_smoke.json" if SMOKE else "metrics_bench.json"
+    )
+    try:
+        jax, platform = init_backend()
+        result["platform"] = platform
+
+        from scalecube_cluster_tpu.models import swim
+        from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+        from scalecube_cluster_tpu.telemetry import query as tquery
+        from scalecube_cluster_tpu.telemetry import sink as tsink
+        from scalecube_cluster_tpu.utils import runlog
+
+        def force(state):
+            return runlog.completion_barrier(state.status)
+
+        params, world, key = bench_workload(N_MEMBERS)
+        spec = tmetrics.MetricsSpec.default()
+        rounds = BENCH_ROUNDS
+
+        t0 = time.perf_counter()
+        u_state = swim.initial_state(params, world)
+        u_state, _ = swim.run(key, params, world, rounds, state=u_state,
+                              start_round=0)
+        force(u_state)
+        m_state = swim.initial_state(params, world)
+        m_state, ms, _ = swim.run_metered(key, params, world, rounds,
+                                          spec=spec, state=m_state,
+                                          start_round=0)
+        force(m_state)
+        log(f"metrics@{N_MEMBERS}: compile+first-run (both paths) took "
+            f"{time.perf_counter() - t0:.1f}s")
+
+        reps = 6 if SMOKE else 3
+        u_best = m_best = None
+        for rep in range(reps):
+            start = rounds * (1 + rep)
+
+            def run_plain():
+                nonlocal u_state, u_best
+                t0 = time.perf_counter()
+                u_state, _ = swim.run(key, params, world, rounds,
+                                      state=u_state, start_round=start)
+                force(u_state)
+                dt = time.perf_counter() - t0
+                u_best = dt if u_best is None else min(u_best, dt)
+
+            def run_metered():
+                nonlocal m_state, ms, m_best
+                t0 = time.perf_counter()
+                m_state, ms, _ = swim.run_metered(
+                    key, params, world, rounds, spec=spec, state=m_state,
+                    start_round=start, metrics_state=ms,
+                )
+                force(m_state)
+                dt = time.perf_counter() - t0
+                m_best = dt if m_best is None else min(m_best, dt)
+
+            # Interleave + alternate order per rep — the timed_both
+            # host-drift discipline, so the ratio measures the registry,
+            # not whichever path ran on the warmer core.
+            pair = ((run_plain, run_metered) if rep % 2 == 0
+                    else (run_metered, run_plain))
+            for f in pair:
+                f()
+        u_rate = N_MEMBERS * rounds / u_best
+        m_rate = N_MEMBERS * rounds / m_best
+        ratio = round(u_rate / m_rate, 4)
+        log(f"metrics@{N_MEMBERS}: unmetered {u_best:.3f}s vs metered "
+            f"{m_best:.3f}s per {rounds}-round window (best of {reps}, "
+            f"interleaved) -> overhead ratio {ratio}")
+        result.update(
+            value=ratio,
+            metrics_overhead_ratio=ratio,
+            unmetered_member_rounds_per_sec=round(u_rate, 1),
+            metered_member_rounds_per_sec=round(m_rate, 1),
+            n_members=N_MEMBERS,
+            rounds_timed=rounds,
+            delivery=DELIVERY,
+            rounds_per_step=resolve_rounds_per_step(),
+        )
+
+        # The windowed health run: registry flushes through the JSONL
+        # pipeline, folded back into SLOs by the query layer.
+        out_dir = (os.environ.get(tsink.TELEMETRY_DIR_ENV)
+                   or os.path.join("artifacts", "telemetry"))
+        sink = tsink.TelemetrySink(
+            out_dir, prefix="metrics-smoke" if SMOKE else "metrics")
+        sink.write_manifest(params=params, workload={
+            "mode": "metrics",
+            "bench_n_members": N_MEMBERS,
+            "bench_rounds": rounds,
+            "delivery": DELIVERY,
+            "smoke": SMOKE,
+        })
+        _, windows = tmetrics.stream_metered_run(
+            key, params, world, rounds, sink=sink,
+            window_rounds=max(1, rounds // 4),
+        )
+        sink.write_summary(metrics_windows=len(windows))
+        sink.close()
+        report = tquery.load_report(sink.path)
+        slos = tquery.compute_slos(report)
+        log(f"metrics manifest written to {sink.path} "
+            f"({len(windows)} windows)")
+        result.update(
+            manifest=sink.path,
+            windows=len(windows),
+            counters=report.counters,
+            gauges=report.gauges,
+            slos=slos,
+        )
+
+        art = {
+            "metric": "metered_vs_unmetered_member_rounds_per_sec",
+            "unmetered": result["unmetered_member_rounds_per_sec"],
+            "metered": result["metered_member_rounds_per_sec"],
+            "metrics_overhead_ratio": ratio,
+            "n_members": N_MEMBERS,
+            "rounds_timed": rounds,
+            "rounds_per_step": resolve_rounds_per_step(),
+            "delivery": DELIVERY,
+            "smoke": SMOKE,
+            "platform": platform,
+            "counters": report.counters,
+            "gauges": report.gauges,
+            "slos": slos,
+        }
+        os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        result["artifact"] = artifact
+        log(f"metrics-overhead artifact written to {artifact}")
+
+        # The cross-run regression gate over the committed BENCH
+        # trajectory + the artifact just written (the same check
+        # `python -m scalecube_cluster_tpu.telemetry regress` serves):
+        # a throughput/SLO regression is reported in the JSON line, it
+        # does not void the measurement (never-ship-empty).
+        gate_paths = tquery.expand_paths(["BENCH_*.json", artifact])
+        gate_paths = [p for p in gate_paths if os.path.exists(p)]
+        ok, checks = tquery.regress(gate_paths)
+        failed = [c for c in checks if c.get("ok") is False]
+        log(f"regress gate over {len(gate_paths)} artifacts: "
+            f"{'PASS' if ok else 'REGRESSION ' + json.dumps(failed)}")
+        result["regress"] = {
+            "ok": ok,
+            "artifacts": len(gate_paths),
+            "failed_checks": failed,
+        }
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -731,6 +916,13 @@ def main():
              "the in-jit invariant monitor) instead of the throughput "
              "bench; combine with --smoke for the tier-1-safe mini "
              "campaign",
+    )
+    parser.add_argument(
+        "--metrics", action="store_true",
+        help="measure the always-on health-metrics registry against "
+             "the bare hot path (metrics_overhead_ratio) and emit the "
+             "windowed health manifest + SLO digest; combine with "
+             "--smoke for the tier-1-safe pass",
     )
     parser.add_argument(
         "--resilience", action="store_true",
@@ -774,6 +966,11 @@ def main():
                 "--resilience is the preemption-survival workload; it "
                 "measures no throughput paths and is not --chaos — "
                 "drop the other mode flags")
+        if args.metrics and (args.chaos or args.resilience or args.traced
+                             or args.untraced or args.gap_artifact):
+            parser.error(
+                "--metrics measures the metered-vs-unmetered gap on its "
+                "own interleaved windows — drop the other mode flags")
     except SystemExit as e:
         # The one-JSON-line contract holds even for a bad argv: argparse
         # already printed its usage message to stderr; ship the error
@@ -792,6 +989,8 @@ def main():
         return run_resilience_drill()
     if args.chaos:
         return run_chaos_campaign()
+    if args.metrics:
+        return run_metrics_bench()
 
     result = {
         "metric": "swim_member_rounds_per_sec_per_chip",

@@ -1,0 +1,152 @@
+"""Health report under chaos: a seeded fault scenario with the
+always-on metrics registry riding the monitored run.
+
+The end-to-end drill of the numeric health plane (telemetry/metrics.py
++ telemetry/query.py): one generated chaos scenario
+(chaos/scenarios.py, reproducible from its seed line) runs through
+``chaos.monitor.run_monitored_metered`` in flush windows; every window
+lands as a ``metrics_window`` JSONL record, the invariant verdict as a
+``chaos_scenario`` record, and the script then folds the manifest BACK
+through the query layer — the same ``report`` path the CLI serves — to
+render the per-window SLO table and write ``artifacts/
+health_report.json``.  What this proves: health numbers survive the
+full device → registry → JSONL → query round trip under real faults,
+not just on a healthy run.
+
+Env overrides: SCALECUBE_HEALTH_SEED (default 7), SCALECUBE_HEALTH_N
+(default 32), SCALECUBE_HEALTH_SEVERITY (default "moderate"),
+SCALECUBE_HEALTH_WINDOW (default horizon/4).
+
+Usage:  JAX_PLATFORMS=cpu python experiments/health_report.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import numpy as np  # noqa: F401 — keeps the experiment import shape
+
+    from scalecube_cluster_tpu import chaos
+    from scalecube_cluster_tpu.chaos import campaign as ccampaign
+    from scalecube_cluster_tpu.chaos import monitor as cmonitor
+    from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+    from scalecube_cluster_tpu.telemetry import query as tquery
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+
+    seed = int(os.environ.get("SCALECUBE_HEALTH_SEED", 7))
+    n = int(os.environ.get("SCALECUBE_HEALTH_N", 32))
+    severity = os.environ.get("SCALECUBE_HEALTH_SEVERITY", "moderate")
+
+    scenario = chaos.generate_scenario(seed=seed, n=n, severity=severity)
+    params = ccampaign.campaign_params(scenario)
+    world, mon_spec = scenario.build(params)
+    spec = tmetrics.MetricsSpec.default()
+    window = int(os.environ.get("SCALECUBE_HEALTH_WINDOW",
+                                max(1, scenario.horizon // 4)))
+    print(f"[health] scenario {scenario.name} (repro: {scenario.repro()})"
+          f"\n[health] horizon {scenario.horizon} rounds, "
+          f"window {window}, n={n}", file=sys.stderr)
+
+    out_dir = (os.environ.get(tsink.TELEMETRY_DIR_ENV)
+               or os.path.join("artifacts", "telemetry"))
+    sink = tsink.TelemetrySink(out_dir, prefix="health")
+    sink.write_manifest(params=params, workload={
+        "mode": "health_report",
+        "scenario": scenario.name,
+        "repro": scenario.repro(),
+        "severity": severity,
+        "horizon": scenario.horizon,
+    })
+
+    t0 = time.time()
+    state = swim.initial_state(params, world)
+    monitor = None
+    ms = tmetrics.MetricsState.init(spec)
+    r = 0
+    while r < scenario.horizon:
+        step = min(window, scenario.horizon - r)
+        state, monitor, ms, _ = cmonitor.run_monitored_metered(
+            jax.random.key(seed), params, world, mon_spec, step,
+            state=state, start_round=r, monitor=monitor,
+            metrics_spec=spec, metrics_state=ms,
+        )
+        row = {"round_start": r, "round_end": r + step,
+               **tmetrics.to_json(jax.device_get(ms), spec)}
+        sink.write_metrics_window(row)
+        ms = tmetrics.reset_window(ms)
+        r += step
+    verdict = cmonitor.verdict(monitor)
+    sink.write_record("chaos_scenario", {
+        "name": scenario.name, "repro": scenario.repro(),
+        "green": verdict["green"], "verdict": verdict,
+    })
+    sink.write_summary(green=verdict["green"],
+                       total_violations=verdict["total_violations"])
+    sink.close()
+    elapsed = time.time() - t0
+
+    # Fold the manifest back through the query layer (the CLI's path).
+    report = tquery.load_report(sink.path)
+    slos = tquery.compute_slos(report)
+
+    wrows = [{
+        "window": f"[{w['round_start']}, {w['round_end']})",
+        "fp_onsets": w["counters"]["false_suspicion_onsets"],
+        "suspicions": w["counters"]["suspicions_started"],
+        "fired": w["counters"]["suspicions_fired"],
+        "violations": w["counters"]["chaos_violations"],
+        "suspect_q": w["gauges"]["suspect_entries"],
+        "occupancy": w["gauges"]["gossip_piggyback_occupancy"],
+    } for w in report.windows]
+    print(f"\n# per-window health ({scenario.name}, seed {seed})")
+    print(tquery.format_table(
+        wrows, ["window", "fp_onsets", "suspicions", "fired",
+                "violations", "suspect_q", "occupancy"]))
+    print("\n# SLOs")
+    print(tquery.format_table(
+        [{"metric": k, "value": v} for k, v in slos.items()],
+        ["metric", "value"]))
+    print(f"\n[health] verdict: "
+          f"{'green' if verdict['green'] else 'RED'} in {elapsed:.1f}s",
+          file=sys.stderr)
+
+    payload = {
+        "experiment": "health_report",
+        "scenario": scenario.name,
+        "repro": scenario.repro(),
+        "seed": seed,
+        "n_members": n,
+        "severity": severity,
+        "horizon": scenario.horizon,
+        "window_rounds": window,
+        "green": verdict["green"],
+        "violations_by_code": {k: v["violations"]
+                               for k, v in verdict["codes"].items()},
+        "windows": report.windows,
+        "slos": slos,
+        "counters": report.counters,
+        "gauges": report.gauges,
+        "manifest": sink.path,
+        "elapsed_sec": round(elapsed, 2),
+    }
+    out = os.environ.get("SCALECUBE_HEALTH_ARTIFACT",
+                         os.path.join("artifacts", "health_report.json"))
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"experiment": "health_report", "green":
+                      verdict["green"], "artifact": out,
+                      "slos": {k: v for k, v in slos.items()
+                               if v is not None}}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -402,6 +402,89 @@ def run_monitored(base_key, params: "swim.SwimParams",
     return final_state, monitor, metrics
 
 
+@partial(jax.jit, static_argnames=("params", "n_rounds", "capacity",
+                                   "metrics_spec"),
+         donate_argnames=("metrics_state",))
+def run_monitored_metered(base_key, params: "swim.SwimParams",
+                          world: "swim.SwimWorld", spec: MonitorSpec,
+                          n_rounds: int,
+                          capacity: int = DEFAULT_CAPACITY,
+                          state: Optional["swim.SwimState"] = None,
+                          start_round: int = 0,
+                          knobs: Optional["swim.Knobs"] = None,
+                          shift_key=None,
+                          monitor: Optional[MonitorState] = None,
+                          metrics_spec=None, metrics_state=None):
+    """``run_monitored`` with the health-metrics registry riding along
+    (telemetry/metrics.py): the chaos shape of the always-on numeric
+    health plane.
+
+    Per round the registry folds the same protocol health signals as
+    ``swim.run_metered`` PLUS the invariant monitor's violation stream:
+    the ``chaos_violations`` counter advances by the round's new
+    violation total (the delta of ``MonitorState.code_counts`` — exact
+    totals, not just recorded evidence lanes).  Monitor verdicts and
+    protocol state are bit-identical to ``run_monitored``.
+
+    Returns ``(final_state, monitor_state, metrics_state, metrics)``;
+    ``metrics_state``/``metrics_spec`` resume/declare the registry like
+    ``swim.run_metered`` (the registry carry is donated; the monitor
+    carry is not, matching ``run_monitored``).
+    """
+    from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+
+    if metrics_spec is None:
+        metrics_spec = tmetrics.MetricsSpec.default()
+    kn = knobs if knobs is not None else swim.Knobs.from_params(params)
+    if state is None:
+        state = swim.initial_state(params, world)
+    if monitor is None:
+        monitor = MonitorState.init(capacity)
+    if metrics_state is None:
+        metrics_state = tmetrics.MetricsState.init(metrics_spec)
+
+    def wide(st, cursor):
+        if params.compact_carry:
+            return swim._carry_decode(st, cursor)
+        if params.int16_wire:
+            return dataclasses.replace(st, inc=st.inc.astype(jnp.int32))
+        return st
+
+    def tick(carry, round_idx):
+        st, mon, ms = carry
+        prev = wide(st, round_idx)
+        new_st, metrics = swim.swim_tick(st, round_idx, base_key, params,
+                                         world, knobs=kn,
+                                         shift_key=shift_key)
+        new_mon = check_round(mon, spec, params, kn, round_idx, prev,
+                              wide(new_st, round_idx + 1), world)
+        ms = tmetrics.observe_tick(
+            ms, metrics_spec, params, kn, round_idx, prev.status,
+            prev.suspect_deadline, new_st.status, metrics, world,
+        )
+        if "chaos_violations" in metrics_spec.counters:
+            ms = tmetrics.inc(
+                ms, metrics_spec, "chaos_violations",
+                jnp.sum(new_mon.code_counts - mon.code_counts,
+                        dtype=jnp.int32),
+            )
+        return (new_st, new_mon, ms), metrics
+
+    (final_state, monitor, ms), metrics = swim._fused_scan(
+        tick, (state, monitor, metrics_state), n_rounds, start_round,
+        params.rounds_per_step,
+    )
+    end = start_round + n_rounds
+    _, spread_wide = swim._wide_timer_fields(final_state, params, end)
+    ms = tmetrics.sample_gauges(
+        ms, metrics_spec, params, kn, final_state.status, spread_wide,
+        world.alive_at(end), end, world,
+        last_tick_metrics={k: metrics[k][-1]
+                           for k in ("messages_gossip",) if k in metrics},
+    )
+    return final_state, monitor, ms, metrics
+
+
 # --------------------------------------------------------------------------
 # Host-side decoding + verdicts
 # --------------------------------------------------------------------------

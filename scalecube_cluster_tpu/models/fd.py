@@ -46,6 +46,32 @@ def fd_only_knobs(params: swim.SwimParams) -> swim.Knobs:
     )
 
 
+def probe_outcome_updates(tick_metrics: dict) -> dict:
+    """FD probe-outcome counters for the health registry
+    (telemetry/metrics.py) from one tick's metrics row.
+
+    Maps the probe phase's wire-level counter families onto the
+    registry's health-lane names — the FailureDetector half of the
+    Lifeguard-style health plane: probe volume (``fd_probes_sent``, the
+    reference's per-period PING count, FailureDetectorImpl.java:148),
+    indirect-probe escalation (``fd_ping_req_sent``, the k-proxy
+    fan-out that fires exactly when a direct ping failed — its rate IS
+    the local-saturation/loss signal), and tracked-subject verdict
+    volume (``fd_tracked_verdicts``, the stream that drives suspicion
+    state).  Pure renaming on purpose: the counters are computed inside
+    the tick where the probes are issued; this hook just owns which of
+    them constitute FD health.
+    """
+    out = {}
+    for reg_name, key in (("fd_probes_sent", "messages_ping_sent"),
+                          ("fd_ping_req_sent", "messages_ping_req_sent"),
+                          ("fd_tracked_verdicts", "messages_ping")):
+        if key in tick_metrics:
+            out[reg_name] = jnp.sum(
+                jnp.asarray(tick_metrics[key]), dtype=jnp.int32)
+    return out
+
+
 def run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
         n_rounds: int, state: Optional[swim.SwimState] = None,
         start_round: int = 0):

@@ -205,6 +205,22 @@ def run(base_key, params: GossipSimParams, n_rounds: int,
     return final_state, metrics
 
 
+def piggyback_occupancy(hot_count, capacity):
+    """Gossip piggyback occupancy: fraction of live tracked records
+    currently inside their retransmission window (the health-registry
+    gauge, telemetry/metrics.py).
+
+    ``hot_count`` = records matching the ``selectGossipsToSend`` window
+    (GossipProtocolImpl.java:239-250 — the same ``hot`` mask the send
+    path transmits); ``capacity`` = live members x tracked subjects.
+    Near 0 in the steady state (nothing to piggyback), near 1 when the
+    membership churns faster than the spread windows drain — sustained
+    high occupancy is the wire-amplification early warning.
+    """
+    cap = jnp.maximum(jnp.asarray(capacity, jnp.float32), 1.0)
+    return jnp.asarray(hot_count, jnp.float32) / cap
+
+
 def dissemination_rounds(metrics, n_members: int):
     """First round at which each gossip reached all N members (-1 if never).
 

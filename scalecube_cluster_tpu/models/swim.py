@@ -2758,6 +2758,86 @@ def node_snapshot(state: SwimState, params: SwimParams, world: SwimWorld,
     }
 
 
+def _wide_timer_fields(state: SwimState, params: SwimParams, cursor):
+    """(suspect_deadline, spread_until) decoded to ABSOLUTE rounds at
+    ``cursor`` — the two carry fields the health registry reads
+    (telemetry/metrics.observe_tick's suspicion lifetimes,
+    sample_gauges' piggyback occupancy), layout-neutral: the wide carry
+    passes through, the compact carry decodes its relative int16/int8
+    encodings exactly like ``_carry_decode`` (without materializing the
+    full wide state when only these two lanes are needed)."""
+    if not params.compact_carry:
+        return state.suspect_deadline, state.spread_until
+    dl = state.suspect_deadline.astype(jnp.int32)
+    dl = jnp.where(dl == _DEADLINE_NONE16, INT32_MAX, cursor + dl)
+    return dl, cursor + state.spread_until.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("params", "n_rounds", "spec"),
+         donate_argnames=("state", "metrics_state"))
+def run_metered(base_key, params: SwimParams, world: SwimWorld,
+                n_rounds: int, spec=None,
+                state: Optional[SwimState] = None, start_round: int = 0,
+                knobs: Optional[Knobs] = None, shift_key=None,
+                metrics_state=None):
+    """``run`` with the always-on health-metrics registry carried
+    through the scan (telemetry/metrics.py).
+
+    Each tick folds its health signals — FD probe outcomes
+    (models/fd.probe_outcome_updates), gossip/wire counters, suspicion
+    onset/refute/fire transitions and the suspicion-lifetime histogram
+    — into one fixed-shape registry pytree
+    (``telemetry.metrics.MetricsState``); gauges (queue depths,
+    piggyback occupancy, wire saturation) are sampled once from the
+    final carry.  ``spec`` (static) declares the registry; ``None`` =
+    the default protocol-health spec.  Protocol state and the returned
+    per-round metrics are bit-identical to ``run`` on the same
+    arguments — the registry only observes.
+
+    Returns ``(final_state, metrics_state, metrics)``.
+    ``metrics_state`` resumes a registry across windows
+    (``telemetry.metrics.stream_metered_run`` is the windowed-flush
+    driver); like ``state`` it is DONATED — don't reuse either after
+    the call.  Rounds fuse per ``params.rounds_per_step`` exactly like
+    ``run``.
+    """
+    from scalecube_cluster_tpu.telemetry import metrics as telemetry_metrics
+
+    if spec is None:
+        spec = telemetry_metrics.MetricsSpec.default()
+    kn = knobs if knobs is not None else Knobs.from_params(params)
+    if state is None:
+        state = initial_state(params, world)
+    if metrics_state is None:
+        metrics_state = telemetry_metrics.MetricsState.init(spec)
+
+    def tick(carry, round_idx):
+        st, ms = carry
+        prev_status = st.status
+        prev_deadline, _ = _wide_timer_fields(st, params, round_idx)
+        new_st, m = swim_tick(st, round_idx, base_key, params, world,
+                              knobs=kn, shift_key=shift_key)
+        ms = telemetry_metrics.observe_tick(
+            ms, spec, params, kn, round_idx, prev_status, prev_deadline,
+            new_st.status, m, world,
+        )
+        return (new_st, ms), m
+
+    (final_state, ms), metrics = _fused_scan(
+        tick, (state, metrics_state), n_rounds, start_round,
+        params.rounds_per_step,
+    )
+    end = start_round + n_rounds
+    _, spread_wide = _wide_timer_fields(final_state, params, end)
+    ms = telemetry_metrics.sample_gauges(
+        ms, spec, params, kn, final_state.status, spread_wide,
+        world.alive_at(end), end, world,
+        last_tick_metrics={k: metrics[k][-1]
+                           for k in ("messages_gossip",) if k in metrics},
+    )
+    return final_state, ms, metrics
+
+
 def _fused_scan(tick, carry, n_rounds: int, start_round, k: int,
                 fused_body=None):
     """Scan ``tick`` over ``n_rounds`` rounds, K ticks per scan step.

@@ -140,6 +140,26 @@ def scatter_or(flags, targets, drop, n_rows: int):
     return inbox
 
 
+def wire_saturation(messages_sent, live_senders, fanout):
+    """Wire-channel saturation: gossip messages actually sent this
+    round over the channel's send-slot capacity (the health-registry
+    gauge, telemetry/metrics.py).
+
+    Capacity = live senders x fanout slots — every live member owns
+    ``fanout`` gossip sends per round whether or not it has hot records
+    (GossipProtocolImpl.java:211-237 batches all selected gossips into
+    one message per target, so a sender's per-round wire budget is its
+    fanout).  Saturation 0 = idle channel; 1 = every live member
+    spreading every round, the dissemination-backlog ceiling.
+    """
+    cap = jnp.maximum(
+        jnp.asarray(live_senders, jnp.float32)
+        * jnp.asarray(fanout, jnp.float32),
+        1.0,
+    )
+    return jnp.asarray(messages_sent, jnp.float32) / cap
+
+
 def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
                 compact: bool = False):
     """Merge one round's inbox into the membership table rows.

@@ -79,6 +79,23 @@ LEGACY_LOWERING_REASON = (
 )
 
 
+def psum_tree(tree, axis_name):
+    """``lax.psum`` every leaf of a pytree over ``axis_name``; identity
+    when ``axis_name`` is None (the single-device path).
+
+    The one mesh-reduction helper additive telemetry shares (the
+    health-metrics registry psums its counters/histograms across the
+    mesh before offload — telemetry/metrics.aggregate_across_devices),
+    kept here so multichip aggregation has a single resolution point
+    next to the shard_map shim it always rides under.
+    """
+    if axis_name is None:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.psum(x, axis_name), tree
+    )
+
+
 def shard_map(f, *, mesh, in_specs, out_specs, check_replication=False):
     """Version-neutral ``shard_map`` (module docstring).
 

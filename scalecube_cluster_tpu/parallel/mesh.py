@@ -112,3 +112,107 @@ def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
         out_specs=(state_specs, out_metric_specs),
         check_replication=False,
     )(base_key, world, state)
+
+
+@partial(jax.jit, static_argnames=("params", "n_rounds", "mesh", "spec"))
+def shard_run_metered(base_key, params: swim.SwimParams,
+                      world: swim.SwimWorld, n_rounds: int, mesh: Mesh,
+                      spec=None, state: Optional[swim.SwimState] = None,
+                      start_round: int = 0):
+    """``shard_run`` with the health-metrics registry carried per device
+    and psum-combined across the mesh before offload
+    (telemetry/metrics.py; the combine rides
+    ``parallel/compat.psum_tree``, the same seam as the inbox pmax).
+
+    Each device accumulates a LOCAL registry inside the scan: row-local
+    signals (suspicion transitions, the lifetime histogram) add on
+    every device, while tick counters that are already psum-global
+    inside ``swim_tick`` add on the lead device only (the ``lead``
+    weight in ``telemetry.metrics.observe_tick``) — so the single
+    end-of-run registry psum yields exact global totals with no
+    per-round collective beyond what the tick already pays.  Gauges are
+    assembled from psum'd numerators and come back replicated.
+
+    Returns ``(final_state, metrics_state, metrics)`` with the state
+    rows sharded, the registry and metrics replicated.
+    """
+    from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+
+    if spec is None:
+        spec = tmetrics.MetricsSpec.default()
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    if params.n_members % n_dev != 0:
+        raise ValueError(
+            f"n_members ({params.n_members}) must divide the mesh size ({n_dev})"
+        )
+    n_local = params.n_members // n_dev
+    kn = swim.Knobs.from_params(params)
+
+    if state is None:
+        state = swim.initial_state(params, world)
+    ms0 = tmetrics.MetricsState.init(spec)
+
+    state_specs = swim.SwimState(
+        status=P(axis), inc=P(axis), spread_until=P(axis),
+        suspect_deadline=P(axis), self_inc=P(axis),
+        inbox_ring=P(None, axis), flag_ring=P(None, axis),
+        g_infected=P(axis), g_spread_until=P(axis), g_ring=P(None, axis),
+    )
+    world_specs = jax.tree.map(lambda _: P(), world)
+    ms_specs = jax.tree.map(lambda _: P(), ms0)
+
+    def sharded_body(base_key, world, state, ms):
+        offset = jax.lax.axis_index(axis) * n_local
+        lead = (jax.lax.axis_index(axis) == 0).astype(jnp.int32)
+
+        def body(carry, round_idx):
+            st, ms = carry
+            prev_status = st.status
+            prev_deadline, _ = swim._wide_timer_fields(st, params,
+                                                       round_idx)
+            new_st, m = swim.swim_tick(
+                st, round_idx, base_key, params, world,
+                offset=offset, axis_name=axis, n_devices=n_dev,
+            )
+            ms = tmetrics.observe_tick(
+                ms, spec, params, kn, round_idx, prev_status,
+                prev_deadline, new_st.status, m, world, lead=lead,
+            )
+            return (new_st, ms), m
+
+        rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
+        (final_state, ms), metrics = jax.lax.scan(body, (state, ms),
+                                                  rounds)
+        end = start_round + n_rounds
+        _, spread_wide = swim._wide_timer_fields(final_state, params, end)
+        alive_here = jax.lax.dynamic_slice_in_dim(
+            world.alive_at(end), offset, n_local
+        )
+        ms = tmetrics.sample_gauges(
+            ms, spec, params, kn, final_state.status, spread_wide,
+            alive_here, end, world,
+            last_tick_metrics={k: metrics[k][-1]
+                               for k in ("messages_gossip",)
+                               if k in metrics},
+            axis_name=axis,
+        )
+        ms = tmetrics.aggregate_across_devices(ms, axis)
+        return final_state, ms, metrics
+
+    metric_names = ["alive", "suspect", "dead", "absent", "false_positives",
+                    "false_suspicion_onsets", "false_suspect_rounds",
+                    "stale_view_rounds",
+                    "messages_gossip", "messages_ping",
+                    "messages_ping_sent", "messages_ping_req_sent",
+                    "refutations"]
+    if params.n_user_gossips > 0:
+        metric_names.append("user_gossip_infected")
+    out_metric_specs = {name: P() for name in metric_names}
+    return compat.shard_map(
+        sharded_body,
+        mesh=mesh,
+        in_specs=(P(), world_specs, state_specs, ms_specs),
+        out_specs=(state_specs, ms_specs, out_metric_specs),
+        check_replication=False,
+    )(base_key, world, state, ms0)

@@ -18,12 +18,23 @@ package is the dense-equivalent observability stack:
                 first-suspect/first-removal round tracking, and in-jit
                 detection/removal latency histograms.
   - ``sink``    host sinks: a JSONL run manifest (run id, config digest,
-                device info, counter rows, histograms, event batches)
-                and a TensorBoard exporter gated behind
-                ``SCALECUBE_TPU_PROFILE_DIR``.
+                device info, counter rows, histograms, event batches,
+                windowed health-metrics flushes) and a TensorBoard
+                exporter gated behind ``SCALECUBE_TPU_PROFILE_DIR``.
+  - ``metrics`` the always-on numeric health plane: a fixed-shape
+                in-jit counter/gauge/histogram registry carried through
+                the scan (``models/swim.run_metered``), psum-combined
+                across a device mesh, flushed per window as
+                ``metrics_window`` records.
+  - ``query``   the cross-run half: load/merge manifests, compute the
+                health SLOs (false-positive observer-rate, latency
+                percentiles, dissemination rounds), ``diff`` two runs,
+                ``regress`` along a BENCH trajectory — all behind the
+                ``python -m scalecube_cluster_tpu.telemetry`` CLI.
 """
 
 from scalecube_cluster_tpu.telemetry import events, sink, trace
+from scalecube_cluster_tpu.telemetry import metrics, query  # noqa: E402
 from scalecube_cluster_tpu.telemetry.events import (
     MembershipTraceEvent,
     OracleTraceCollector,
@@ -33,6 +44,8 @@ from scalecube_cluster_tpu.telemetry.events import (
 
 __all__ = [
     "events",
+    "metrics",
+    "query",
     "sink",
     "trace",
     "MembershipTraceEvent",

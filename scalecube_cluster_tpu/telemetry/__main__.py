@@ -1,0 +1,142 @@
+"""The telemetry CLI: ``python -m scalecube_cluster_tpu.telemetry``.
+
+Three subcommands over the JSONL manifests and BENCH artifacts
+(telemetry/query.py):
+
+  report   <manifest.jsonl> [...]   fold manifests, print the health
+                                    SLO table (``--json`` for machines,
+                                    ``--windows`` for the per-window
+                                    time series)
+  diff     <a.jsonl> <b.jsonl>      per-SLO/counter/gauge comparison
+                                    of two runs
+  regress  [paths/globs ...]        walk a BENCH_*.json trajectory
+                                    (default glob: BENCH_*.json) and
+                                    exit 1 on throughput or SLO
+                                    regressions beyond ``--band``
+
+Exit codes: 0 ok, 1 regression detected (regress), 2 usage/input error
+— stable for CI gating (tests/test_metrics_query.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from scalecube_cluster_tpu.telemetry import query
+
+
+def _cmd_report(args) -> int:
+    reports = [query.load_report(p) for p in args.manifests]
+    merged = (query.merge_reports(reports) if len(reports) > 1
+              else reports[0])
+    slos = query.compute_slos(merged)
+    if args.json:
+        print(json.dumps({
+            "manifests": [r.path for r in reports],
+            "slos": slos,
+            "counters": merged.counters,
+            "gauges": merged.gauges,
+            "windows": merged.windows if args.windows else len(merged.windows),
+        }))
+        return 0
+    rows = [{"metric": k, "value": v} for k, v in slos.items()]
+    print(f"# health report: {', '.join(r.path for r in reports)}")
+    print(query.format_table(rows, ["metric", "value"]))
+    if merged.counters:
+        print("\n# counters (summed over windows)")
+        print(query.format_table(
+            [{"metric": k, "value": v}
+             for k, v in sorted(merged.counters.items())],
+            ["metric", "value"]))
+    if args.windows and merged.windows:
+        print("\n# per-window")
+        wrows = [{
+            "window": f"[{w['round_start']}, {w['round_end']})",
+            "fp_onsets": w.get("counters", {}).get("false_suspicion_onsets"),
+            "suspect": w.get("gauges", {}).get("suspect_entries"),
+            "occupancy": w.get("gauges", {}).get(
+                "gossip_piggyback_occupancy"),
+            "saturation": w.get("gauges", {}).get("wire_saturation"),
+        } for w in merged.windows]
+        print(query.format_table(
+            wrows, ["window", "fp_onsets", "suspect", "occupancy",
+                    "saturation"]))
+    return 0
+
+
+def _cmd_diff(args) -> int:
+    a = query.load_report(args.a)
+    b = query.load_report(args.b)
+    rows = query.diff_reports(a, b)
+    if args.json:
+        print(json.dumps({"a": a.path, "b": b.path, "rows": rows}))
+        return 0
+    print(f"# diff: a={a.path}  b={b.path}")
+    print(query.format_table(rows, ["metric", "a", "b", "delta", "rel"]))
+    return 0
+
+
+def _cmd_regress(args) -> int:
+    paths = query.expand_paths(args.paths or ["BENCH_*.json"])
+    readable = [p for p in paths if os.path.exists(p)]
+    if not readable:
+        print("regress: no artifacts matched", file=sys.stderr)
+        return 2
+    ok, rows = query.regress(readable, band=args.band)
+    if args.json:
+        print(json.dumps({"ok": ok, "band": args.band, "checks": rows}))
+    else:
+        print(f"# regress over {len(readable)} artifacts "
+              f"(noise band {args.band:.0%})")
+        print(query.format_table(
+            rows, ["check", "source", "latest", "reference", "threshold",
+                   "ok", "note"]))
+        print("PASS" if ok else "REGRESSION")
+    return 0 if ok else 1
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m scalecube_cluster_tpu.telemetry",
+        description=__doc__.splitlines()[0],
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("report", help="health SLO report of manifest(s)")
+    p.add_argument("manifests", nargs="+")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--windows", action="store_true",
+                   help="include the per-window time series")
+    p.set_defaults(fn=_cmd_report)
+
+    p = sub.add_parser("diff", help="compare two run manifests")
+    p.add_argument("a")
+    p.add_argument("b")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_diff)
+
+    p = sub.add_parser(
+        "regress", help="fail on regressions along a BENCH trajectory")
+    p.add_argument("paths", nargs="*",
+                   help="artifact files/globs (default: BENCH_*.json)")
+    p.add_argument("--band", type=float, default=query.DEFAULT_NOISE_BAND,
+                   help="relative noise band (default 0.10)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_regress)
+
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except (OSError, ValueError, KeyError) as e:
+        # KeyError: a malformed manifest record (e.g. a histogram row a
+        # foreign writer truncated) — input error (2), not regression (1).
+        print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,504 @@
+"""Always-on protocol health metrics: the in-jit registry.
+
+The event trace (telemetry/trace.py) answers *what happened*; production
+SWIM also needs an always-on NUMERIC health plane — probe outcomes,
+suspicion lifetimes, piggyback occupancy, wire saturation — the signals
+Lifeguard (Dadgar et al., 2018) argues a deployed SWIM must export to be
+operable.  This module is that plane for the dense tick:
+
+  - :class:`MetricsSpec` — the fixed registry declaration (counter,
+    gauge and bucketed-histogram names, histogram edges), a frozen
+    hashable dataclass passed as a STATIC jit argument: the registry's
+    shape never depends on data, so the carried state is one
+    fixed-shape pytree.
+  - :class:`MetricsState` — the carried values: ``[C]`` int32 counters,
+    ``[G]`` float32 gauges, one ``[B]`` int32 count vector per
+    histogram.  ``models/swim.run_metered`` threads it through the scan
+    as a DONATED carry, exactly like the trace buffer.
+  - pure update ops — :func:`inc` / :func:`inc_many` (counters),
+    :func:`set_gauge`, :func:`observe` (bucketize + scatter-add, gated
+    on any-sample so silent rounds cost one reduction) — all usable
+    inside jit.
+
+Instrumentation lives where the signals originate: FD probe-outcome
+counter mapping in ``models/fd.py``, gossip piggyback occupancy in
+``models/gossip.py``, wire saturation in ``ops/delivery.py``, suspicion
+queue/lifetime derivation here from the carry fields ``models/swim.py``
+exposes, chaos violation counts from ``chaos/monitor.py``'s
+run shape.  :func:`observe_tick` is the one per-round entry the run
+shapes call.
+
+Cost: per round, a handful of scalar counter adds (XLA fuses them into
+the scan body) plus ONE [N, K] status-compare reduction gating the
+suspicion-transition block (the telemetry/trace.py emptiness-gate
+pattern) — steady-state rounds pay the gate only.  Gauges are sampled
+once per run/window from the FINAL carry (a gauge is by definition
+last-value, so per-round sampling would be dead work).  The bench pins
+the metered/unmetered ratio on the smoke path
+(``bench.py --metrics``; artifacts/metrics_smoke.json).
+
+Multichip: under the row-sharded mesh (parallel/mesh.shard_run_metered)
+each device accumulates a LOCAL registry; tick-level counters that are
+already psum-global inside ``swim_tick`` are added on the lead device
+only (``lead`` weight), and the whole registry is psum-combined once
+across the mesh via ``parallel/compat.psum_tree`` before offload —
+counters and histogram counts are additive, gauges are assembled from
+already-global numerators.
+
+Windowed flush: :func:`stream_metered_run` drives ``run_metered`` in
+windows and writes one ``metrics_window`` JSONL record per window
+(``TelemetrySink.write_metrics_window``); records carry
+``round_start``/``round_end`` so the PR-4 journal cursor
+(``sink.covered_upto(path, kind="metrics_window")``) dedups resumed
+runs exactly like the resilient supervisor's segments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalecube_cluster_tpu import records
+
+INT32_MAX = jnp.iinfo(jnp.int32).max
+
+# Suspicion lifetimes span refutations (a few probe cycles) through the
+# full suspicion timeout; the geometric grid matches the latency
+# histogram convention (telemetry/trace.DEFAULT_LATENCY_EDGES).
+DEFAULT_SUSPICION_EDGES = (0, 1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64,
+                           96, 128)
+
+# The default protocol-health registry.  Counters are WINDOW totals
+# (int32; the windowed flush resets them, so a counter's headroom is
+# per-window, not per-run), gauges are last-sampled values, histograms
+# are bucketed counts with the declared edges (bucket i covers
+# [edges[i], edges[i+1]), last bucket open).
+DEFAULT_COUNTERS = (
+    "fd_probes_sent",            # PINGs issued by live members
+    "fd_ping_req_sent",          # PING_REQ fan-out messages
+    "fd_tracked_verdicts",       # probe verdicts on tracked subjects
+    "gossip_messages",           # wire gossip messages sent
+    "refutations",               # self-refutation incarnation bumps
+    "suspicions_started",        # cells newly turned SUSPECT
+    "suspicions_refuted",        # SUSPECT resolved back to ALIVE
+    "suspicions_fired",          # SUSPECT matured to DEAD
+    "false_suspicion_onsets",    # new SUSPECT about a live subject
+    "false_positive_rounds",     # observer-rounds holding FP views
+    "live_observer_rounds",      # sum of live members over rounds
+    "chaos_violations",          # invariant-monitor trips (monitored)
+)
+DEFAULT_GAUGES = (
+    "live_members",              # ground-truth live count
+    "suspect_entries",           # suspicion queue depth (live observers)
+    "dead_entries",              # tombstones held by live observers
+    "gossip_piggyback_occupancy",  # hot records / live tracked records
+    "wire_saturation",           # gossip messages / send-slot capacity
+)
+DEFAULT_HISTOGRAMS = (
+    ("suspicion_lifetime_rounds", DEFAULT_SUSPICION_EDGES),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricsSpec:
+    """The fixed registry declaration (module docstring).
+
+    Frozen + tuples only, so instances hash — the spec is a STATIC jit
+    argument; changing the declared metrics recompiles, updating their
+    values never does.
+    """
+
+    counters: Tuple[str, ...] = DEFAULT_COUNTERS
+    gauges: Tuple[str, ...] = DEFAULT_GAUGES
+    histograms: Tuple[Tuple[str, Tuple[int, ...]], ...] = DEFAULT_HISTOGRAMS
+
+    def __post_init__(self):
+        for kind in ("counters", "gauges"):
+            names = getattr(self, kind)
+            if len(set(names)) != len(names):
+                raise ValueError(f"duplicate {kind} names: {names}")
+        hnames = tuple(n for n, _ in self.histograms)
+        if len(set(hnames)) != len(hnames):
+            raise ValueError(f"duplicate histogram names: {hnames}")
+        for name, edges in self.histograms:
+            if len(edges) < 2 or list(edges) != sorted(set(edges)):
+                raise ValueError(
+                    f"histogram {name!r} needs >= 2 strictly increasing "
+                    f"edges (got {edges})")
+
+    @staticmethod
+    def default() -> "MetricsSpec":
+        return MetricsSpec()
+
+    def counter_index(self, name: str) -> int:
+        return self.counters.index(name)
+
+    def gauge_index(self, name: str) -> int:
+        return self.gauges.index(name)
+
+    def histogram_edges(self, name: str) -> Tuple[int, ...]:
+        for n, edges in self.histograms:
+            if n == name:
+                return edges
+        raise KeyError(f"histogram {name!r} not in spec")
+
+
+@dataclasses.dataclass
+class MetricsState:
+    """The carried registry values (one donated pytree).
+
+    ``counters`` [C] int32 / ``gauges`` [G] float32 in spec order;
+    ``hists`` maps histogram name -> [B] int32 bucket counts.
+    """
+
+    counters: jnp.ndarray
+    gauges: jnp.ndarray
+    hists: Dict[str, jnp.ndarray]
+
+    @staticmethod
+    def init(spec: MetricsSpec) -> "MetricsState":
+        return MetricsState(
+            counters=jnp.zeros((len(spec.counters),), dtype=jnp.int32),
+            gauges=jnp.zeros((len(spec.gauges),), dtype=jnp.float32),
+            hists={name: jnp.zeros((len(edges),), dtype=jnp.int32)
+                   for name, edges in spec.histograms},
+        )
+
+
+jax.tree_util.register_dataclass(
+    MetricsState, data_fields=["counters", "gauges", "hists"],
+    meta_fields=[],
+)
+
+
+# --------------------------------------------------------------------------
+# Pure update ops (jit-safe)
+# --------------------------------------------------------------------------
+
+
+def inc(ms: MetricsState, spec: MetricsSpec, name: str,
+        value) -> MetricsState:
+    """counter[name] += value (value: scalar, any int dtype)."""
+    idx = spec.counter_index(name)
+    return dataclasses.replace(
+        ms, counters=ms.counters.at[idx].add(
+            jnp.asarray(value, jnp.int32)),
+    )
+
+
+def inc_many(ms: MetricsState, spec: MetricsSpec,
+             updates: Dict[str, jnp.ndarray]) -> MetricsState:
+    """Batch counter adds: one delta vector, one tensor add.  Unknown
+    names raise at trace time (a registry mismatch is a bug, not data)."""
+    if not updates:
+        return ms
+    delta = jnp.zeros_like(ms.counters)
+    for name, value in updates.items():
+        delta = delta.at[spec.counter_index(name)].add(
+            jnp.asarray(value, jnp.int32))
+    return dataclasses.replace(ms, counters=ms.counters + delta)
+
+
+def set_gauge(ms: MetricsState, spec: MetricsSpec, name: str,
+              value) -> MetricsState:
+    """gauge[name] = value (last write wins — gauges are samples)."""
+    idx = spec.gauge_index(name)
+    return dataclasses.replace(
+        ms, gauges=ms.gauges.at[idx].set(jnp.asarray(value, jnp.float32)),
+    )
+
+
+def observe(ms: MetricsState, spec: MetricsSpec, name: str, values,
+            mask) -> MetricsState:
+    """Bucketize ``values`` where ``mask`` and add to histogram counts.
+
+    ``values``/``mask`` broadcast to a common shape; the whole pass runs
+    under a ``lax.cond`` on ``any(mask)`` — the identity when the round
+    observed nothing (the telemetry/trace.py emptiness-gate pattern), so
+    silent rounds pay one reduction instead of a searchsorted + scatter.
+    """
+    edges = jnp.asarray(spec.histogram_edges(name), jnp.int32)
+    b = edges.shape[0]
+    values = jnp.asarray(values, jnp.int32)
+    mask = jnp.asarray(mask, jnp.bool_)
+    values, mask = jnp.broadcast_arrays(values, mask)
+
+    def add(h):
+        bucket = jnp.clip(
+            jnp.searchsorted(edges, values, side="right") - 1, 0, b - 1
+        ).reshape(-1)
+        return h.at[bucket].add(mask.reshape(-1).astype(jnp.int32))
+
+    hists = dict(ms.hists)
+    hists[name] = jax.lax.cond(jnp.any(mask), add, lambda h: h,
+                               ms.hists[name])
+    return dataclasses.replace(ms, hists=hists)
+
+
+def reset_window(ms: MetricsState) -> MetricsState:
+    """Zero the additive lanes (counters, histograms) for the next flush
+    window; gauges carry (they are last-value samples, not totals)."""
+    return MetricsState(
+        counters=jnp.zeros_like(ms.counters),
+        gauges=ms.gauges,
+        hists={k: jnp.zeros_like(v) for k, v in ms.hists.items()},
+    )
+
+
+# --------------------------------------------------------------------------
+# The per-round observation (called inside the scan body)
+# --------------------------------------------------------------------------
+
+
+def observe_tick(ms: MetricsState, spec: MetricsSpec, params, kn,
+                 round_idx, prev_status, prev_deadline, new_status,
+                 tick_metrics, world, lead=None) -> MetricsState:
+    """Fold one tick's health signals into the registry.
+
+    ``prev_status``/``prev_deadline`` are the carry fields BEFORE the
+    tick in their WIDE decoding (absolute deadline rounds),
+    ``new_status`` after; ``tick_metrics`` is the tick's per-round
+    metrics dict (already psum-global under sharding).  ``lead`` is the
+    sharded-dedup weight for global quantities — 1 on the lead device,
+    0 elsewhere, None (=1) on a single device — so the end-of-run
+    registry psum (:func:`aggregate_across_devices`) counts them once.
+
+    Counter adds are a fused delta-vector add; the suspicion-transition
+    block (onset/refute/fire counters + the lifetime histogram, the
+    only [N, K] work beyond one compare-reduce) runs under a
+    ``lax.cond`` and is skipped on steady-state rounds.
+    """
+    from scalecube_cluster_tpu.models import fd as fd_model
+
+    lead_w = jnp.int32(1) if lead is None else jnp.asarray(lead, jnp.int32)
+
+    def total(x):
+        return jnp.sum(jnp.asarray(x), dtype=jnp.int32)
+
+    # Global per-tick counters (lead-weighted under sharding).
+    updates = {}
+    for name, value in fd_model.probe_outcome_updates(tick_metrics).items():
+        if name in spec.counters:
+            updates[name] = jnp.asarray(value, jnp.int32) * lead_w
+    for name, key in (("gossip_messages", "messages_gossip"),
+                      ("refutations", "refutations"),
+                      ("false_suspicion_onsets", "false_suspicion_onsets"),
+                      ("false_positive_rounds", "false_positives")):
+        if name in spec.counters and key in tick_metrics:
+            updates[name] = total(tick_metrics[key]) * lead_w
+    if "live_observer_rounds" in spec.counters:
+        updates["live_observer_rounds"] = (
+            jnp.sum(world.alive_at(round_idx), dtype=jnp.int32) * lead_w
+        )
+    ms = inc_many(ms, spec, updates)
+
+    # Suspicion-transition block: local-state derivation (NOT
+    # lead-weighted — rows are per-device under sharding), gated on any
+    # status change at all (every transition below implies one).
+    track = tuple(n for n in ("suspicions_started", "suspicions_refuted",
+                              "suspicions_fired") if n in spec.counters)
+    has_hist = any(n == "suspicion_lifetime_rounds"
+                   for n, _ in spec.histograms)
+    if not track and not has_hist:
+        return ms
+
+    def active(m):
+        started = ((new_status == records.SUSPECT)
+                   & (prev_status != records.SUSPECT))
+        resolved = ((prev_status == records.SUSPECT)
+                    & (new_status != records.SUSPECT))
+        upd = {}
+        if "suspicions_started" in track:
+            upd["suspicions_started"] = total(started)
+        if "suspicions_refuted" in track:
+            upd["suspicions_refuted"] = total(
+                resolved & (new_status == records.ALIVE))
+        if "suspicions_fired" in track:
+            upd["suspicions_fired"] = total(
+                resolved & (new_status == records.DEAD))
+        m = inc_many(m, spec, upd)
+        if has_hist:
+            # The timer was armed at onset as onset + suspicion_rounds
+            # (models/swim._merge_and_timers), so the deadline encodes
+            # the onset round exactly; lifetime = resolution - onset.
+            # Guard the no-timer sentinel (the TIMER_BOUND invariant
+            # says it can't co-occur with SUSPECT, but a garbage
+            # lifetime must not reach the buckets if it ever did).
+            had_timer = resolved & (prev_deadline != INT32_MAX)
+            lifetime = round_idx - (prev_deadline - kn.suspicion_rounds)
+            m = observe(m, spec, "suspicion_lifetime_rounds", lifetime,
+                        had_timer)
+        return m
+
+    return jax.lax.cond(jnp.any(prev_status != new_status), active,
+                        lambda m: m, ms)
+
+
+def sample_gauges(ms: MetricsState, spec: MetricsSpec, params, kn,
+                  status, spread_until_wide, alive_here, round_idx,
+                  world, last_tick_metrics=None,
+                  axis_name=None) -> MetricsState:
+    """Sample every gauge from the FINAL carry of a run/window.
+
+    ``status``/``spread_until_wide`` are the (possibly local-row) carry
+    fields decoded wide at cursor ``round_idx`` (the round the state
+    would run next); ``alive_here`` the matching ground-truth liveness
+    rows.  Under sharding, local numerators are psum'd over
+    ``axis_name`` (parallel/compat.psum_tree) so the stored gauge
+    values are global on every device.
+    """
+    from scalecube_cluster_tpu.parallel import compat
+
+    obs_alive = alive_here[:, None]
+    live = jnp.sum(world.alive_at(round_idx), dtype=jnp.int32)  # global
+
+    suspect, dead, hot = compat.psum_tree((
+        jnp.sum((status == records.SUSPECT) & obs_alive, dtype=jnp.int32),
+        jnp.sum((status == records.DEAD) & obs_alive, dtype=jnp.int32),
+        jnp.sum(_hot_records(status, spread_until_wide, round_idx)
+                & obs_alive, dtype=jnp.int32),
+    ), axis_name)
+
+    from scalecube_cluster_tpu.models import gossip as gossip_model
+    from scalecube_cluster_tpu.ops import delivery as delivery_ops
+
+    values = {
+        "live_members": live,
+        "suspect_entries": suspect,
+        "dead_entries": dead,
+        "gossip_piggyback_occupancy": gossip_model.piggyback_occupancy(
+            hot, live * params.n_subjects),
+    }
+    if last_tick_metrics is not None and "messages_gossip" in last_tick_metrics:
+        values["wire_saturation"] = delivery_ops.wire_saturation(
+            jnp.sum(jnp.asarray(last_tick_metrics["messages_gossip"]),
+                    dtype=jnp.int32),
+            live, kn.fanout,
+        )
+    for name, value in values.items():
+        if name in spec.gauges:
+            ms = set_gauge(ms, spec, name, value)
+    return ms
+
+
+def _hot_records(status, spread_until_wide, round_idx):
+    """The gossip piggyback mask: records still inside their
+    retransmission window (models/swim._send_components' ``hot``,
+    evaluated at the NEXT round the state would run)."""
+    return (status != records.ABSENT) & (round_idx < spread_until_wide)
+
+
+def aggregate_across_devices(ms: MetricsState,
+                             axis_name: Optional[str]) -> MetricsState:
+    """Combine per-device registries into the global one (sharded runs).
+
+    Counters and histogram counts are additive — one psum over the mesh
+    (parallel/compat.psum_tree).  Gauges are NOT summed: they were
+    assembled from already-global numerators (:func:`sample_gauges`),
+    so every device holds the same value already.
+    """
+    from scalecube_cluster_tpu.parallel import compat
+
+    if axis_name is None:
+        return ms
+    return dataclasses.replace(
+        ms,
+        counters=compat.psum_tree(ms.counters, axis_name),
+        hists=compat.psum_tree(ms.hists, axis_name),
+    )
+
+
+# --------------------------------------------------------------------------
+# Host-side decode + the windowed flush driver
+# --------------------------------------------------------------------------
+
+
+def to_json(ms: MetricsState, spec: MetricsSpec) -> dict:
+    """Device registry -> the JSONL-ready ``metrics_window`` payload.
+
+    Counters are int32 WINDOW totals (module docstring); a negative
+    lane means the window outgrew the int32 headroom and wrapped
+    in-device — the value is garbage, so warn (the fix is a shorter
+    flush window, not a wider dtype: int32 keeps the carry cheap on
+    accelerators).
+    """
+    counters = np.asarray(ms.counters)
+    gauges = np.asarray(ms.gauges)
+    if (counters < 0).any():
+        import warnings
+
+        wrapped = [n for i, n in enumerate(spec.counters) if counters[i] < 0]
+        warnings.warn(
+            f"metrics window counters wrapped int32 (negative totals): "
+            f"{wrapped} — shorten the flush window (stream_metered_run "
+            f"window_rounds) to keep per-window totals under 2**31",
+            stacklevel=2,
+        )
+    return {
+        "counters": {n: int(counters[i])
+                     for i, n in enumerate(spec.counters)},
+        "gauges": {n: round(float(gauges[i]), 6)
+                   for i, n in enumerate(spec.gauges)},
+        "histograms": {
+            name: {"edges": list(edges),
+                   "counts": np.asarray(ms.hists[name]).tolist()}
+            for name, edges in spec.histograms
+        },
+    }
+
+
+def stream_metered_run(base_key, params, world, n_rounds: int, *,
+                       sink=None, window_rounds: int = 64,
+                       spec: Optional[MetricsSpec] = None,
+                       state=None, knobs=None, shift_key=None,
+                       start_round: int = 0, skip_covered: bool = True):
+    """Drive ``models/swim.run_metered`` in flush windows.
+
+    After each ``window_rounds``-round window the registry is fetched,
+    written as one ``metrics_window`` record (when ``sink`` is given)
+    and reset (gauges carry).  Records carry ``round_start`` /
+    ``round_end``, so an append-mode journal sink dedups a resumed run
+    through the PR-4 cursor: windows whose ``round_end`` is already
+    covered are recomputed (the carry must advance) but not re-written
+    (``skip_covered``) — no duplicate rows after any kill/relaunch
+    sequence, the resilient supervisor's segment semantics.
+
+    Returns ``(final_state, window_rows)`` where ``window_rows`` is the
+    host-side list of every window payload (including skipped-write
+    ones), each ``{"round_start", "round_end", "counters", "gauges",
+    "histograms"}``.
+    """
+    from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+
+    spec = spec or MetricsSpec.default()
+    window_rounds = max(1, int(window_rounds))
+    covered = 0
+    if sink is not None and skip_covered:
+        covered = tsink.covered_upto(sink.path, kind="metrics_window")
+
+    ms = MetricsState.init(spec)
+    if state is None:
+        state = swim.initial_state(params, world)
+    rows: List[dict] = []
+    r = 0
+    while r < n_rounds:
+        step = min(window_rounds, n_rounds - r)
+        state, ms, _ = swim.run_metered(
+            base_key, params, world, step, spec=spec, state=state,
+            start_round=start_round + r, knobs=knobs, shift_key=shift_key,
+            metrics_state=ms,
+        )
+        w_start, w_end = start_round + r, start_round + r + step
+        row = {"round_start": w_start, "round_end": w_end,
+               **to_json(jax.device_get(ms), spec)}
+        rows.append(row)
+        if sink is not None and w_end > covered:
+            sink.write_metrics_window(row)
+        ms = reset_window(ms)
+        r += step
+    return state, rows

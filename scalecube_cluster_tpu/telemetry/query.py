@@ -1,0 +1,386 @@
+"""Cross-run health queries: load/merge manifests, SLOs, diff, regress.
+
+The host half of the always-on health plane (telemetry/metrics.py).
+JSONL run manifests accumulate ``metrics_window`` rows (windowed
+registry flushes), ``histogram`` rows (detection/removal latency
+buckets) and counter rows; this module folds them into one
+:class:`HealthReport` per run, computes the protocol's quantitative
+SLOs — the paper's headline guarantees as numbers —
+
+  - ``false_positive_observer_rate``: false-suspicion onsets per live
+    observer-round (the bounded-false-positive guarantee),
+  - ``detection_latency_p50/p99`` and ``removal_latency_p50/p99``
+    rounds (expected-detection-time, from the latency histograms),
+  - ``suspicion_lifetime_p50/p99`` rounds (Lifeguard's timeout-health
+    signal, from the registry histogram),
+  - ``dissemination_rounds`` (the O(log n) spread, from the
+    fraction-informed curve when present),
+
+and compares runs: :func:`diff_reports` for two manifests,
+:func:`regress` for a BENCH_*.json trajectory with a noise band —
+the regression gate ``python -m scalecube_cluster_tpu.telemetry
+regress`` runs in CI (tests/test_metrics_query.py pins it against the
+committed BENCH_r01..r05 series).
+
+Percentiles from buckets: counts in bucket i cover
+``[edges[i], edges[i+1])`` (last bucket open); the percentile
+interpolates linearly inside its bucket and clamps to the last edge
+for the open tail — a LOWER bound there (real latencies in the open
+bucket are >= the reported value), so declare edges past the tail
+you care about.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as globlib
+import json
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from scalecube_cluster_tpu.telemetry import sink as tsink
+
+THROUGHPUT_METRIC = "swim_member_rounds_per_sec_per_chip"
+DEFAULT_NOISE_BAND = 0.10
+# Dissemination is integer-quantized (rounds); allow the quantization
+# step on top of the relative band before calling it a regression.
+DISSEMINATION_SLACK_ROUNDS = 1
+
+
+# --------------------------------------------------------------------------
+# Loading + merging one run's manifest
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class HealthReport:
+    """One run's folded health state.
+
+    ``counters``/``gauges``: merged over every ``metrics_window`` row
+    (counters sum — they are window totals; gauges take the LAST
+    window's sample).  ``histograms``: bucket counts summed per name,
+    both the registry's windows and standalone ``histogram`` records
+    (detection/removal latency).  ``windows`` keeps the raw per-window
+    rows for time-resolved rendering.
+    """
+
+    path: str
+    run_id: Optional[str]
+    counters: Dict[str, int]
+    gauges: Dict[str, float]
+    histograms: Dict[str, Tuple[List[int], List[int]]]  # name -> (edges, counts)
+    windows: List[dict]
+    curves: Dict[str, dict]
+    summary: dict
+
+    @property
+    def rounds_covered(self) -> int:
+        return max((int(w["round_end"]) for w in self.windows), default=0)
+
+
+def _merge_hist(store: Dict[str, Tuple[List[int], List[int]]], name: str,
+                edges: Sequence[int], counts: Sequence[int]) -> None:
+    edges, counts = list(edges), [int(c) for c in counts]
+    if name not in store:
+        store[name] = (edges, counts)
+        return
+    old_edges, old_counts = store[name]
+    if old_edges != edges:
+        raise ValueError(
+            f"histogram {name!r}: incompatible edges across records "
+            f"({old_edges} vs {edges})")
+    store[name] = (old_edges,
+                   [a + b for a, b in zip(old_counts, counts)])
+
+
+def load_report(path: str) -> HealthReport:
+    """Fold one JSONL manifest into a :class:`HealthReport`."""
+    counters: Dict[str, int] = {}
+    gauges: Dict[str, float] = {}
+    hists: Dict[str, Tuple[List[int], List[int]]] = {}
+    windows: List[dict] = []
+    curves: Dict[str, dict] = {}
+    summary: dict = {}
+    run_id = None
+    for rec in tsink.iter_records(path):
+        run_id = run_id or rec.get("run_id")
+        kind = rec.get("kind")
+        if kind == "metrics_window":
+            windows.append(rec)
+            for k, v in rec.get("counters", {}).items():
+                counters[k] = counters.get(k, 0) + int(v)
+            for k, v in rec.get("gauges", {}).items():
+                gauges[k] = float(v)          # last window wins
+            for name, h in rec.get("histograms", {}).items():
+                _merge_hist(hists, name, h["edges"], h["counts"])
+        elif kind == "histogram":
+            _merge_hist(hists, rec["name"], rec["edges"], rec["counts"])
+        elif kind == "curve":
+            curves[rec["name"]] = rec
+        elif kind == "summary":
+            summary.update({k: v for k, v in rec.items()
+                            if k not in ("kind", "run_id")})
+    return HealthReport(path=path, run_id=run_id, counters=counters,
+                        gauges=gauges, histograms=hists, windows=windows,
+                        curves=curves, summary=summary)
+
+
+def merge_reports(reports: Sequence[HealthReport]) -> HealthReport:
+    """Fold several runs' reports into one (counters/histograms sum,
+    gauges take the last run's samples) — the cross-run aggregate the
+    CLI ``report`` command prints for multiple manifests."""
+    out = HealthReport(path=",".join(r.path for r in reports),
+                       run_id=None, counters={}, gauges={}, histograms={},
+                       windows=[], curves={}, summary={})
+    for r in reports:
+        for k, v in r.counters.items():
+            out.counters[k] = out.counters.get(k, 0) + v
+        out.gauges.update(r.gauges)
+        for name, (edges, counts) in r.histograms.items():
+            _merge_hist(out.histograms, name, edges, counts)
+        out.windows.extend(r.windows)
+        out.curves.update(r.curves)
+        out.summary.update(r.summary)
+    return out
+
+
+# --------------------------------------------------------------------------
+# SLOs
+# --------------------------------------------------------------------------
+
+
+def percentile_from_histogram(edges: Sequence[int], counts: Sequence[int],
+                              q: float) -> Optional[float]:
+    """q-th percentile (q in [0, 1]) from bucketed counts.
+
+    Linear interpolation within the bucket; the open last bucket clamps
+    to its lower edge (conservative: real latencies there are >= it).
+    None when the histogram is empty.
+    """
+    total = sum(counts)
+    if total == 0:
+        return None
+    target = q * total
+    if target == 0:  # p0 = the smallest observed bucket's lower edge
+        return float(edges[next(i for i, c in enumerate(counts) if c)])
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if cum + c >= target:
+            lo = edges[i]
+            hi = edges[i + 1] if i + 1 < len(edges) else edges[i]
+            frac = (target - cum) / c
+            return float(lo + (hi - lo) * frac)
+        cum += c
+    return float(edges[-1])
+
+
+def dissemination_rounds_from_curve(curve: dict) -> Optional[int]:
+    """First round the fraction-informed curve reaches 1.0 (upper bound
+    under downsampling: the stride makes this at most one stride late,
+    never early), relative to the curve's round offset."""
+    values = curve.get("values") or []
+    stride = int(curve.get("stride", 1))
+    for i, v in enumerate(values):
+        if v >= 1.0:
+            return i * stride
+    return None
+
+
+def compute_slos(report: HealthReport) -> dict:
+    """The protocol health SLOs of one (merged) report — module
+    docstring.  Missing inputs yield None, never a crash: a partial
+    manifest still reports what it can."""
+    c, g = report.counters, report.gauges
+    slos: dict = {}
+
+    onsets = c.get("false_suspicion_onsets")
+    obs_rounds = c.get("live_observer_rounds")
+    slos["false_positive_observer_rate"] = (
+        (onsets / obs_rounds) if onsets is not None and obs_rounds
+        else None)
+
+    for name, key in (("detection_latency", "detection_latency_rounds"),
+                      ("removal_latency", "removal_latency_rounds"),
+                      ("suspicion_lifetime", "suspicion_lifetime_rounds")):
+        h = report.histograms.get(key)
+        for q, tag in ((0.5, "p50"), (0.99, "p99")):
+            slos[f"{name}_{tag}"] = (
+                percentile_from_histogram(h[0], h[1], q) if h else None)
+
+    curve = report.curves.get("fraction_informed")
+    slos["dissemination_rounds"] = (
+        dissemination_rounds_from_curve(curve) if curve else None)
+
+    slos["chaos_violations"] = c.get("chaos_violations")
+    slos["suspect_entries"] = g.get("suspect_entries")
+    slos["wire_saturation"] = g.get("wire_saturation")
+    slos["gossip_piggyback_occupancy"] = g.get("gossip_piggyback_occupancy")
+    slos["rounds_covered"] = report.rounds_covered or None
+    return slos
+
+
+# --------------------------------------------------------------------------
+# diff
+# --------------------------------------------------------------------------
+
+
+def diff_reports(a: HealthReport, b: HealthReport) -> List[dict]:
+    """Per-SLO and per-counter comparison rows for two runs.
+
+    Each row: {"metric", "a", "b", "delta", "rel"} (rel None when a is
+    0/None).  Ordering: SLOs first, then counters, then gauges — the
+    stable rendering contract the CLI table prints.
+    """
+    rows: List[dict] = []
+
+    def add(name, va, vb):
+        delta = (vb - va) if (va is not None and vb is not None) else None
+        rel = (delta / va) if (delta is not None and va) else None
+        rows.append({"metric": name, "a": va, "b": vb, "delta": delta,
+                     "rel": rel})
+
+    sa, sb = compute_slos(a), compute_slos(b)
+    for name in sa:
+        add(f"slo/{name}", sa[name], sb.get(name))
+    for name in sorted(set(a.counters) | set(b.counters)):
+        add(f"counter/{name}", a.counters.get(name), b.counters.get(name))
+    for name in sorted(set(a.gauges) | set(b.gauges)):
+        add(f"gauge/{name}", a.gauges.get(name), b.gauges.get(name))
+    return rows
+
+
+def format_table(rows: List[dict], headers: Sequence[str]) -> str:
+    """Fixed-width text table (no dependencies; right-aligned numbers)."""
+    def fmt(v):
+        if v is None:
+            return "-"
+        if isinstance(v, float):
+            return f"{v:.6g}"
+        return str(v)
+
+    table = [[fmt(r.get(h)) for h in headers] for r in rows]
+    widths = [max(len(h), *(len(row[i]) for row in table)) if table
+              else len(h) for i, h in enumerate(headers)]
+    out = ["  ".join(h.ljust(widths[i]) for i, h in enumerate(headers))]
+    out.append("  ".join("-" * w for w in widths))
+    for row in table:
+        out.append("  ".join(row[i].rjust(widths[i]) if i else
+                             row[i].ljust(widths[i])
+                             for i in range(len(headers))))
+    return "\n".join(out)
+
+
+# --------------------------------------------------------------------------
+# regress: the BENCH_*.json trajectory gate
+# --------------------------------------------------------------------------
+
+
+def load_bench_payload(path: str) -> Optional[dict]:
+    """One BENCH artifact's measurement payload, or None when the run
+    recorded a failure (rc != 0 / parsed null) — skipped, not fatal:
+    the committed trajectory keeps failed rounds as provenance."""
+    with open(path) as f:
+        doc = json.load(f)
+    if "parsed" in doc or "rc" in doc:
+        if doc.get("rc") not in (0, None):
+            return None
+        payload = doc.get("parsed")
+    else:
+        payload = doc
+    if not isinstance(payload, dict) or payload.get("value") is None:
+        if not (isinstance(payload, dict)
+                and ("traced_overhead_ratio" in payload
+                     or "metrics_overhead_ratio" in payload)):
+            return None
+    return payload
+
+
+def regress(paths: Sequence[str],
+            band: float = DEFAULT_NOISE_BAND) -> Tuple[bool, List[dict]]:
+    """Walk a BENCH_*.json trajectory (sorted by filename = round
+    order); the LATEST measurement of each tracked metric must not
+    regress beyond the noise band against the best prior value.
+
+    Checks:
+      - throughput (``value`` of the headline metric): latest must be
+        >= best_prior * (1 - band);
+      - ``dissemination_rounds``: latest must be <= best_prior *
+        (1 + band) + 1 quantization round;
+      - overhead ratios (``traced_overhead_ratio``,
+        ``metrics_overhead_ratio``): latest must be <= 1 + band
+        (absolute — 1.0 means the observability plane is free).
+
+    Returns (ok, check rows); each row {"check", "latest", "reference",
+    "threshold", "ok", "source"}.  Unreadable/failed artifacts are
+    reported as skipped rows (ok=None) — a failed bench round is
+    provenance, not a regression.
+    """
+    rows: List[dict] = []
+    series: Dict[str, List[Tuple[str, dict]]] = {}
+    for path in sorted(paths):
+        try:
+            payload = load_bench_payload(path)
+        except (OSError, json.JSONDecodeError) as e:
+            rows.append({"check": "load", "source": os.path.basename(path),
+                         "ok": None,
+                         "note": f"unreadable: {type(e).__name__}: {e}"})
+            continue
+        if payload is None:
+            rows.append({"check": "load", "source": os.path.basename(path),
+                         "ok": None, "note": "failed run (skipped)"})
+            continue
+        metric = payload.get("metric", "unknown")
+        series.setdefault(metric, []).append((path, payload))
+
+    ok = True
+
+    def check(name, source, latest, reference, threshold, passed):
+        nonlocal ok
+        ok = ok and passed
+        rows.append({"check": name, "source": os.path.basename(source),
+                     "latest": latest, "reference": reference,
+                     "threshold": threshold, "ok": passed})
+
+    for metric, entries in sorted(series.items()):
+        values = [(p, pl["value"]) for p, pl in entries
+                  if isinstance(pl.get("value"), (int, float))]
+        if len(values) >= 2:
+            *prior, (last_path, last) = values
+            best = max(v for _, v in prior)
+            check(f"throughput/{metric}", last_path, last,
+                  best, best * (1.0 - band), last >= best * (1.0 - band))
+        dis = [(p, pl["dissemination_rounds"]) for p, pl in entries
+               if isinstance(pl.get("dissemination_rounds"), (int, float))
+               and pl["dissemination_rounds"] > 0]
+        if len(dis) >= 2:
+            *prior, (last_path, last) = dis
+            best = min(v for _, v in prior)
+            limit = best * (1.0 + band) + DISSEMINATION_SLACK_ROUNDS
+            check("slo/dissemination_rounds", last_path, last, best,
+                  limit, last <= limit)
+        for ratio_key in ("traced_overhead_ratio", "metrics_overhead_ratio"):
+            ratios = [(p, pl[ratio_key]) for p, pl in entries
+                      if isinstance(pl.get(ratio_key), (int, float))]
+            if ratios:
+                last_path, last = ratios[-1]
+                limit = 1.0 + band
+                check(f"slo/{ratio_key}", last_path, last, 1.0, limit,
+                      last <= limit and math.isfinite(last))
+    return ok, rows
+
+
+def expand_paths(patterns: Sequence[str]) -> List[str]:
+    """Globs + literal paths -> sorted unique file list."""
+    out: List[str] = []
+    for pat in patterns:
+        matches = sorted(globlib.glob(pat))
+        out.extend(matches if matches else [pat])
+    seen, uniq = set(), []
+    for p in out:
+        if p not in seen:
+            seen.add(p)
+            uniq.append(p)
+    return uniq

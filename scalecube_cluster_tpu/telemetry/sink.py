@@ -11,7 +11,13 @@ the dense runs' equivalent durable surface is one JSONL file per run:
             {"kind": "curve", ...}      per-round series (downsampled)
             {"kind": "summary", ...}    closing totals
 
-Chaos campaigns (chaos/campaign.py) reuse the same pipeline with two
+The always-on health registry (telemetry/metrics.py) flushes per
+window through :meth:`TelemetrySink.write_metrics_window`:
+``{"kind": "metrics_window", round_start, round_end, counters, gauges,
+histograms}`` — ``round_end`` makes the record resumable through
+:func:`covered_upto`, the same journal-cursor dedup the resilient
+supervisor's segments use.  Chaos campaigns (chaos/campaign.py) reuse
+the same pipeline with two
 more kinds via :meth:`TelemetrySink.write_record`:
 ``{"kind": "chaos_scenario", ...}`` — one verdict row per scenario
 (green flag, per-invariant-code violation counts + first rounds,
@@ -102,6 +108,12 @@ def device_info() -> dict:
         return {"backend": "unavailable", "error": f"{type(e).__name__}: {e}"}
 
 
+# Keys counters_row has already warned about (warn ONCE per key per
+# process — a non-numeric lane repeats every flush window, and one
+# warning per window would bury the signal it exists to raise).
+_WARNED_NON_NUMERIC: set = set()
+
+
 def counters_row(metrics: dict, round_offset: int = 0,
                  label: Optional[str] = None) -> dict:
     """Digest one chunk of per-round metric traces into a counters row.
@@ -110,6 +122,11 @@ def counters_row(metrics: dict, round_offset: int = 0,
     [n_rounds, ...] traces from models/swim.run.  Scalar-trace counters
     are summed over the chunk; per-subject traces sum over subjects too.
     An empty metrics dict produces an empty (but valid) row.
+
+    A counter lane whose values are NOT summable numbers (an object
+    array, strings, a malformed registry flush) is skipped from the row
+    — but never silently: the first time each key fails it warns, so a
+    registry/driver schema drift can't quietly lose a lane forever.
     """
     row: dict = {"label": label, "round_offset": round_offset}
     n_rounds = 0
@@ -119,7 +136,23 @@ def counters_row(metrics: dict, round_offset: int = 0,
     row["n_rounds"] = n_rounds
     for name in _COUNTER_NAMES:
         if name in metrics:
-            row[name] = int(np.asarray(metrics[name]).sum())
+            try:
+                v = np.asarray(metrics[name])
+                if not (np.issubdtype(v.dtype, np.number)
+                        or np.issubdtype(v.dtype, np.bool_)):
+                    raise TypeError(f"non-numeric dtype {v.dtype}")
+                row[name] = int(v.sum())
+            except (TypeError, ValueError) as e:
+                if name not in _WARNED_NON_NUMERIC:
+                    _WARNED_NON_NUMERIC.add(name)
+                    import warnings
+
+                    warnings.warn(
+                        f"counters_row: dropping non-numeric metric "
+                        f"{name!r} ({e}) — this lane will be missing "
+                        f"from counter rows (warned once per key)",
+                        stacklevel=2,
+                    )
     return row
 
 
@@ -274,6 +307,21 @@ class TelemetrySink:
 
     def write_summary(self, **fields) -> None:
         self._write("summary", fields)
+
+    def write_metrics_window(self, window: dict) -> None:
+        """One health-metrics flush window (telemetry/metrics.py):
+        ``{"round_start", "round_end", "counters", "gauges",
+        "histograms"}``.  ``round_end`` makes the record resumable
+        through the journal cursor — ``covered_upto(path,
+        kind="metrics_window")`` is the dedup cursor a relaunched
+        metered run consults, exactly the resilient supervisor's
+        segment semantics."""
+        for key in ("round_start", "round_end"):
+            if key not in window:
+                raise ValueError(
+                    f"metrics_window record needs {key!r} (the journal "
+                    f"cursor dedups on round_end)")
+        self._write("metrics_window", dict(window))
 
     def write_record(self, kind: str, payload: dict) -> None:
         """Generic typed row for schema extensions that don't warrant a

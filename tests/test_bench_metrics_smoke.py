@@ -1,0 +1,97 @@
+"""bench.py --metrics --smoke: the metrics-overhead JSON contract.
+
+Like tests/test_bench_smoke.py for tracing: the bench is the one entry
+point the measurements flow through, so this tier-1 test runs the real
+script in a subprocess and pins the published contract — one JSON line,
+a finite metrics_overhead_ratio over both measured rates, a
+BENCH_*-style artifact, and a manifest whose ``metrics_window`` rows
+round-trip through the sink reader and the query layer's SLO fold.
+"""
+
+import json
+import math
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.metrics
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_bench_metrics_smoke_contract(tmp_path):
+    artifact = tmp_path / "metrics_smoke.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_TPU_TELEMETRY_DIR=str(tmp_path),
+        SCALECUBE_METRICS_ARTIFACT=str(artifact),
+        SCALECUBE_XLA_CACHE_DIR="",           # no cache writes from tests
+    )
+    env.pop("SCALECUBE_TPU_PROFILE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--metrics", "--smoke"],
+        capture_output=True, text=True, timeout=540, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout      # exactly ONE JSON line
+    result = json.loads(lines[0])
+
+    assert "error" not in result, result
+    assert result["smoke"] is True
+    assert result["metric"] == "swim_metrics_overhead_ratio"
+
+    # Both rates measured, ratio consistent and finite.  No tight bound
+    # here (a loaded CI box can skew one 80-round window); the
+    # committed artifacts/metrics_smoke.json records the pinned <= 1.05
+    # measurement and the regress CLI gates future ones.
+    ratio = result["metrics_overhead_ratio"]
+    unmetered = result["unmetered_member_rounds_per_sec"]
+    metered = result["metered_member_rounds_per_sec"]
+    assert unmetered > 0 and metered > 0
+    assert math.isfinite(ratio) and ratio > 0
+    assert ratio == pytest.approx(unmetered / metered, rel=1e-3)
+    assert result["value"] == ratio
+
+    # Registry digest: the health counters moved.
+    counters = result["counters"]
+    assert counters["fd_probes_sent"] > 0
+    assert counters["gossip_messages"] > 0
+    assert counters["live_observer_rounds"] > 0
+    assert counters["suspicions_started"] > 0    # the crash-at-10 wave
+    assert result["slos"]["false_positive_observer_rate"] is not None
+    assert result["windows"] >= 2
+
+    # The artifact round-trips and carries the same measurement.
+    art = json.loads(artifact.read_text())
+    assert art["metric"] == "metered_vs_unmetered_member_rounds_per_sec"
+    assert art["metrics_overhead_ratio"] == ratio
+    assert art["counters"] == counters
+    assert art["smoke"] is True
+
+    # The manifest's metrics_window rows fold back through the query
+    # layer (the CLI's report path).
+    from scalecube_cluster_tpu.telemetry import query as tquery
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+
+    path = result["manifest"]
+    assert os.path.dirname(path) == str(tmp_path)
+    windows = tsink.read_records(path, kind="metrics_window")
+    assert len(windows) == result["windows"]
+    ends = [w["round_end"] for w in windows]
+    assert ends == sorted(ends) and ends[-1] == result["rounds_timed"]
+    report = tquery.load_report(path)
+    assert report.counters == counters
+    slos = tquery.compute_slos(report)
+    assert slos["rounds_covered"] == result["rounds_timed"]
+
+    # And the regress gate accepts the fresh artifact (ratio sane).
+    ok, rows = tquery.regress([str(artifact)])
+    ratio_rows = [r for r in rows
+                  if r.get("check") == "slo/metrics_overhead_ratio"]
+    assert len(ratio_rows) == 1

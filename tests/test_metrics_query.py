@@ -1,0 +1,238 @@
+"""The cross-run query layer + CLI (telemetry/query.py, __main__.py).
+
+Pins: manifest folding (windows merge, gauges last-write), SLO
+computation (FP observer-rate, bucket percentiles, dissemination from
+the curve), ``diff`` row semantics, and the ``regress`` gate — which
+must PASS on the committed BENCH_r01..r05 trajectory (r01 is a failed
+run and must be skipped, not fatal) and FAIL on a synthetic 20%
+throughput drop; both through the library API and the
+``python -m scalecube_cluster_tpu.telemetry`` entry point.
+"""
+
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from scalecube_cluster_tpu.telemetry import query
+from scalecube_cluster_tpu.telemetry import sink as tsink
+from scalecube_cluster_tpu.telemetry.__main__ import main as cli_main
+
+pytestmark = pytest.mark.metrics
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def write_manifest(path, windows, histograms=(), curve=None, summary=None):
+    with tsink.TelemetrySink(path=str(path)) as sink:
+        sink.write_manifest(params={"n": 8})
+        for w in windows:
+            sink.write_metrics_window(w)
+        for name, edges, counts in histograms:
+            sink.write_histogram(name, edges, counts)
+        if curve is not None:
+            sink.write_curve(*curve)
+        if summary:
+            sink.write_summary(**summary)
+    return str(path)
+
+
+def window(start, end, counters=None, gauges=None, hist=None):
+    return {
+        "round_start": start, "round_end": end,
+        "counters": {"false_suspicion_onsets": 0,
+                     "live_observer_rounds": (end - start) * 8,
+                     **(counters or {})},
+        "gauges": {"suspect_entries": 0.0, **(gauges or {})},
+        "histograms": {"suspicion_lifetime_rounds": {
+            "edges": [0, 4, 16], "counts": hist or [0, 0, 0]}},
+    }
+
+
+# --------------------------------------------------------------------------
+# Loading, merging, SLOs
+# --------------------------------------------------------------------------
+
+
+def test_load_report_merges_windows(tmp_path):
+    path = write_manifest(
+        tmp_path / "a.jsonl",
+        [window(0, 32, counters={"false_suspicion_onsets": 3},
+                gauges={"suspect_entries": 5.0}, hist=[1, 2, 0]),
+         window(32, 64, counters={"false_suspicion_onsets": 1},
+                gauges={"suspect_entries": 2.0}, hist=[0, 1, 1])],
+        histograms=[("detection_latency_rounds", [0, 2, 4], [0, 3, 1])],
+    )
+    r = query.load_report(path)
+    assert r.rounds_covered == 64
+    assert r.counters["false_suspicion_onsets"] == 4
+    assert r.counters["live_observer_rounds"] == 64 * 8
+    assert r.gauges["suspect_entries"] == 2.0          # last window wins
+    assert r.histograms["suspicion_lifetime_rounds"][1] == [1, 3, 1]
+    assert r.histograms["detection_latency_rounds"] == ([0, 2, 4],
+                                                        [0, 3, 1])
+    slos = query.compute_slos(r)
+    assert slos["false_positive_observer_rate"] \
+        == pytest.approx(4 / (64 * 8))
+    assert slos["rounds_covered"] == 64
+
+
+def test_percentile_from_histogram():
+    # 10 samples in [0,4), 10 in [4,16): p50 = upper edge of bucket 0.
+    assert query.percentile_from_histogram([0, 4, 16], [10, 10], 0.5) \
+        == pytest.approx(4.0)
+    # All mass in the OPEN last bucket clamps to its lower edge
+    # (conservative, never understated).
+    assert query.percentile_from_histogram([0, 4, 16], [0, 0, 7], 0.99) \
+        == pytest.approx(16.0)
+    assert query.percentile_from_histogram([0, 4], [0, 0], 0.5) is None
+
+
+def test_incompatible_histogram_edges_raise(tmp_path):
+    path = write_manifest(
+        tmp_path / "a.jsonl",
+        [window(0, 8)],
+        histograms=[("suspicion_lifetime_rounds", [0, 8, 32], [1, 0, 0])],
+    )
+    with pytest.raises(ValueError, match="incompatible edges"):
+        query.load_report(path)
+
+
+def test_dissemination_from_curve(tmp_path):
+    path = write_manifest(
+        tmp_path / "a.jsonl", [window(0, 16)],
+        curve=("fraction_informed", [0.0, 0.25, 0.75, 1.0, 1.0]),
+    )
+    r = query.load_report(path)
+    assert query.compute_slos(r)["dissemination_rounds"] == 3
+
+
+# --------------------------------------------------------------------------
+# diff
+# --------------------------------------------------------------------------
+
+
+def test_diff_reports(tmp_path):
+    a = query.load_report(write_manifest(
+        tmp_path / "a.jsonl",
+        [window(0, 32, counters={"false_suspicion_onsets": 4})]))
+    b = query.load_report(write_manifest(
+        tmp_path / "b.jsonl",
+        [window(0, 32, counters={"false_suspicion_onsets": 8})]))
+    rows = {r["metric"]: r for r in query.diff_reports(a, b)}
+    row = rows["counter/false_suspicion_onsets"]
+    assert (row["a"], row["b"], row["delta"]) == (4, 8, 4)
+    assert row["rel"] == pytest.approx(1.0)
+    slo = rows["slo/false_positive_observer_rate"]
+    assert slo["b"] == pytest.approx(2 * slo["a"])
+
+
+def test_cli_diff(tmp_path, capsys):
+    a = write_manifest(tmp_path / "a.jsonl", [window(0, 32)])
+    b = write_manifest(tmp_path / "b.jsonl",
+                       [window(0, 32, counters={"fd_probes_sent": 5})])
+    assert cli_main(["diff", a, b, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    rows = {r["metric"]: r for r in out["rows"]}
+    assert rows["counter/fd_probes_sent"]["b"] == 5
+
+
+def test_cli_report(tmp_path, capsys):
+    path = write_manifest(
+        tmp_path / "a.jsonl",
+        [window(0, 32, counters={"false_suspicion_onsets": 2})])
+    assert cli_main(["report", path, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["slos"]["false_positive_observer_rate"] \
+        == pytest.approx(2 / (32 * 8))
+    assert out["counters"]["false_suspicion_onsets"] == 2
+
+
+# --------------------------------------------------------------------------
+# regress: the committed trajectory + the synthetic drop
+# --------------------------------------------------------------------------
+
+
+def committed_bench_paths():
+    paths = sorted(str(p) for p in REPO.glob("BENCH_r0*.json"))
+    assert len(paths) >= 5, "committed BENCH_r01..r05 series missing"
+    return paths
+
+
+def test_regress_passes_on_committed_trajectory():
+    ok, rows = query.regress(committed_bench_paths())
+    assert ok, rows
+    # r01 is a failed run (rc=1): skipped with a note, never fatal.
+    skipped = [r for r in rows if r.get("ok") is None]
+    assert any("BENCH_r01" in r["source"] for r in skipped)
+    checks = [r for r in rows if r.get("ok") is not None]
+    assert any(r["check"].startswith("throughput/") for r in checks)
+    assert all(r["ok"] for r in checks)
+
+
+def synthetic_drop_dir(tmp_path, factor=0.8):
+    for p in committed_bench_paths():
+        shutil.copy(p, tmp_path)
+    with open(tmp_path / "BENCH_r05.json") as f:
+        last = json.load(f)
+    payload = dict(last["parsed"])
+    payload["value"] = round(payload["value"] * factor, 1)
+    with open(tmp_path / "BENCH_r06.json", "w") as f:
+        json.dump({"n": 6, "cmd": last["cmd"], "rc": 0, "tail": "",
+                   "parsed": payload}, f)
+    return sorted(str(p) for p in tmp_path.glob("BENCH_*.json"))
+
+
+def test_regress_fails_on_synthetic_20pct_drop(tmp_path):
+    ok, rows = query.regress(synthetic_drop_dir(tmp_path, factor=0.8))
+    assert not ok
+    bad = [r for r in rows if r.get("ok") is False]
+    assert len(bad) == 1
+    assert bad[0]["check"].startswith("throughput/")
+    assert "BENCH_r06" in bad[0]["source"]
+
+
+def test_regress_tolerates_drop_inside_noise_band(tmp_path):
+    ok, rows = query.regress(synthetic_drop_dir(tmp_path, factor=0.95))
+    assert ok, rows
+
+
+def test_regress_overhead_ratio_gate(tmp_path):
+    art = tmp_path / "BENCH_overhead.json"
+    with open(art, "w") as f:
+        json.dump({"metric": "traced_vs_untraced", "untraced": 100.0,
+                   "traced": 80.0, "traced_overhead_ratio": 1.25}, f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    (bad,) = [r for r in rows if r.get("ok") is False]
+    assert bad["check"] == "slo/traced_overhead_ratio"
+
+
+def test_cli_regress_exit_codes(tmp_path, capsys):
+    assert cli_main(["regress", str(REPO / "BENCH_r0*.json")]) == 0
+    capsys.readouterr()
+    synthetic_drop_dir(tmp_path)
+    assert cli_main(["regress", str(tmp_path / "BENCH_*.json"),
+                     "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] is False
+    assert cli_main(["regress", str(tmp_path / "no_such_*.json")]) == 2
+
+
+def test_cli_module_entry_point(tmp_path):
+    """python -m scalecube_cluster_tpu.telemetry really resolves (the
+    CLI contract the README documents)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "scalecube_cluster_tpu.telemetry",
+         "regress", "BENCH_r0*.json", "--json"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout)
+    assert out["ok"] is True

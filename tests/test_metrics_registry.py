@@ -1,0 +1,332 @@
+"""The in-jit health-metrics registry (telemetry/metrics.py).
+
+Pins the tentpole contracts: the registry only OBSERVES (metered runs
+are bit-identical to plain runs), counters agree with the per-round
+metric traces they digest, suspicion lifetimes land in the declared
+buckets, gauges sample the final carry, the windowed flush dedups on
+resume through the journal cursor, and the sharded path psums the
+registry across the mesh to the single-device-consistent totals.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+from scalecube_cluster_tpu.telemetry import sink as tsink
+
+pytestmark = pytest.mark.metrics
+
+N = 16
+VICTIM = 3
+
+CFG = ClusterConfig.default_local().replace(
+    gossip_interval=100, ping_interval=200, ping_timeout=100,
+    sync_interval=1_000, suspicion_mult=3,
+)
+
+
+def make_params(**overrides):
+    return swim.SwimParams.from_config(CFG, n_members=N, **overrides)
+
+
+def crash_world(params, at_round=10):
+    return swim.SwimWorld.healthy(params).with_crash(VICTIM,
+                                                     at_round=at_round)
+
+
+def registry_dict(ms, spec=None):
+    return tmetrics.to_json(jax.device_get(ms),
+                            spec or tmetrics.MetricsSpec.default())
+
+
+# --------------------------------------------------------------------------
+# Spec + pure ops
+# --------------------------------------------------------------------------
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="duplicate counters"):
+        tmetrics.MetricsSpec(counters=("a", "a"))
+    with pytest.raises(ValueError, match="increasing"):
+        tmetrics.MetricsSpec(histograms=(("h", (4, 2)),))
+    with pytest.raises(KeyError):
+        tmetrics.MetricsSpec.default().histogram_edges("nope")
+
+
+def test_inc_set_observe_ops():
+    spec = tmetrics.MetricsSpec(
+        counters=("c1", "c2"), gauges=("g1",),
+        histograms=(("h", (0, 2, 4)),),
+    )
+    ms = tmetrics.MetricsState.init(spec)
+    ms = tmetrics.inc(ms, spec, "c1", 3)
+    ms = tmetrics.inc_many(ms, spec, {"c1": 2, "c2": 7})
+    ms = tmetrics.set_gauge(ms, spec, "g1", 1.5)
+    ms = tmetrics.set_gauge(ms, spec, "g1", 2.5)      # last write wins
+    # values 0,1 -> bucket 0; 2,3 -> bucket 1; >=4 -> open bucket 2;
+    # masked-out samples don't count.
+    ms = tmetrics.observe(ms, spec, "h",
+                          jnp.asarray([0, 1, 2, 3, 4, 99, 5]),
+                          jnp.asarray([1, 1, 1, 1, 1, 1, 0], bool))
+    d = registry_dict(ms, spec)
+    assert d["counters"] == {"c1": 5, "c2": 7}
+    assert d["gauges"]["g1"] == 2.5
+    assert d["histograms"]["h"]["counts"] == [2, 2, 2]
+    # The all-masked observe is the identity (the emptiness gate).
+    ms2 = tmetrics.observe(ms, spec, "h", jnp.asarray([1, 2]),
+                           jnp.zeros(2, bool))
+    assert registry_dict(ms2, spec) == d
+    # Unknown names are trace-time errors, not silent drops.
+    with pytest.raises(ValueError):
+        tmetrics.inc(ms, spec, "nope", 1)
+
+
+def test_reset_window_keeps_gauges():
+    spec = tmetrics.MetricsSpec.default()
+    ms = tmetrics.MetricsState.init(spec)
+    ms = tmetrics.inc(ms, spec, "fd_probes_sent", 9)
+    ms = tmetrics.set_gauge(ms, spec, "suspect_entries", 4.0)
+    ms = tmetrics.reset_window(ms)
+    d = registry_dict(ms)
+    assert d["counters"]["fd_probes_sent"] == 0
+    assert d["gauges"]["suspect_entries"] == 4.0
+
+
+# --------------------------------------------------------------------------
+# run_metered
+# --------------------------------------------------------------------------
+
+
+class TestRunMetered:
+    def test_observes_only_bit_identical_state_and_metrics(self):
+        params = make_params(delivery="shift")
+        world = crash_world(params)
+        st_p, m_p = swim.run(jax.random.key(0), params, world, 90)
+        st_m, _, m_m = swim.run_metered(jax.random.key(0), params, world,
+                                        90)
+        for f in dataclasses.fields(swim.SwimState):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(st_p, f.name)),
+                np.asarray(getattr(st_m, f.name)), err_msg=f.name)
+        for k in m_p:
+            np.testing.assert_array_equal(np.asarray(m_p[k]),
+                                          np.asarray(m_m[k]), err_msg=k)
+
+    def test_counters_agree_with_metric_traces(self):
+        params = make_params(delivery="shift")
+        world = crash_world(params)
+        _, ms, m = swim.run_metered(jax.random.key(1), params, world, 90)
+        d = registry_dict(ms)
+        for counter, key in (("fd_probes_sent", "messages_ping_sent"),
+                             ("fd_ping_req_sent", "messages_ping_req_sent"),
+                             ("fd_tracked_verdicts", "messages_ping"),
+                             ("gossip_messages", "messages_gossip"),
+                             ("refutations", "refutations")):
+            assert d["counters"][counter] == int(np.asarray(m[key]).sum()), \
+                counter
+
+    def test_crash_lifecycle_counts_and_lifetime_histogram(self):
+        """One permanent crash: every live observer suspects the victim
+        once and the suspicion fires at exactly the timeout — the
+        lifetime histogram holds N-1 samples in the suspicion_rounds
+        bucket."""
+        params = make_params(delivery="shift")
+        world = crash_world(params)
+        _, ms, _ = swim.run_metered(jax.random.key(2), params, world, 90)
+        d = registry_dict(ms)
+        c = d["counters"]
+        assert c["suspicions_started"] == N - 1
+        assert c["suspicions_fired"] == N - 1
+        assert c["suspicions_refuted"] == 0
+        assert c["false_suspicion_onsets"] == 0   # the victim IS dead
+        h = d["histograms"]["suspicion_lifetime_rounds"]
+        assert sum(h["counts"]) == N - 1
+        edges = h["edges"]
+        bucket = np.searchsorted(edges, params.suspicion_rounds,
+                                 side="right") - 1
+        assert h["counts"][bucket] == N - 1
+        # Gauges sample the final carry: everyone holds the tombstone.
+        assert d["gauges"]["dead_entries"] == N - 1
+        assert d["gauges"]["suspect_entries"] == 0
+        assert d["gauges"]["live_members"] == N - 1
+
+    def test_refutation_lifecycle_under_revival(self):
+        """Crash + revive before the timeout: suspicions resolve by
+        refutation, with lifetimes strictly below suspicion_rounds."""
+        params = make_params(delivery="shift")
+        world = swim.SwimWorld.healthy(params).with_crash(
+            VICTIM, at_round=10, until_round=14)
+        _, ms, _ = swim.run_metered(jax.random.key(3), params, world, 120)
+        d = registry_dict(ms)
+        c = d["counters"]
+        assert c["suspicions_refuted"] >= 1
+        assert c["refutations"] >= 1
+        h = d["histograms"]["suspicion_lifetime_rounds"]
+        assert sum(h["counts"]) == c["suspicions_refuted"] \
+            + c["suspicions_fired"]
+        # At least one refutation resolved before the full timeout.
+        edges = h["edges"]
+        fire_bucket = np.searchsorted(edges, params.suspicion_rounds,
+                                      side="right") - 1
+        assert sum(h["counts"][:fire_bucket]) >= 1
+
+    def test_healthy_run_is_silent(self):
+        params = make_params(delivery="shift")
+        world = swim.SwimWorld.healthy(params)
+        _, ms, _ = swim.run_metered(jax.random.key(4), params, world, 60)
+        d = registry_dict(ms)
+        for k in ("suspicions_started", "suspicions_fired",
+                  "false_suspicion_onsets", "false_positive_rounds"):
+            assert d["counters"][k] == 0, k
+        assert d["counters"]["live_observer_rounds"] == N * 60
+        assert d["gauges"]["live_members"] == N
+
+    def test_round_fusion_matches_unfused(self):
+        params = make_params(delivery="shift", rounds_per_step=4)
+        base = make_params(delivery="shift")
+        world = crash_world(params)
+        _, ms_f, _ = swim.run_metered(jax.random.key(5), params, world, 90)
+        _, ms_1, _ = swim.run_metered(jax.random.key(5), base, world, 90)
+        assert registry_dict(ms_f) == registry_dict(ms_1)
+
+    def test_compact_carry_matches_wide(self):
+        params = make_params(delivery="shift", compact_carry=True)
+        wide = make_params(delivery="shift")
+        world = crash_world(params)
+        _, ms_c, _ = swim.run_metered(jax.random.key(6), params, world, 90)
+        _, ms_w, _ = swim.run_metered(jax.random.key(6), wide, world, 90)
+        assert registry_dict(ms_c) == registry_dict(ms_w)
+
+    def test_custom_spec_subset(self):
+        spec = tmetrics.MetricsSpec(
+            counters=("fd_probes_sent",), gauges=("live_members",),
+            histograms=(),
+        )
+        params = make_params(delivery="shift")
+        world = crash_world(params)
+        _, ms, m = swim.run_metered(jax.random.key(7), params, world, 40,
+                                    spec=spec)
+        d = registry_dict(ms, spec)
+        assert set(d["counters"]) == {"fd_probes_sent"}
+        assert d["counters"]["fd_probes_sent"] \
+            == int(np.asarray(m["messages_ping_sent"]).sum())
+        assert d["histograms"] == {}
+
+
+# --------------------------------------------------------------------------
+# Monitored + metered (chaos shape)
+# --------------------------------------------------------------------------
+
+
+class TestMonitoredMetered:
+    def test_chaos_violations_counter_tracks_monitor_totals(self):
+        from scalecube_cluster_tpu import chaos
+        from scalecube_cluster_tpu.chaos import campaign as ccampaign
+        from scalecube_cluster_tpu.chaos import monitor as cmonitor
+
+        scen = chaos.generate_scenario(seed=3, n=24, severity="moderate")
+        params = ccampaign.campaign_params(scen)
+        world, mon_spec = scen.build(params)
+        st, mon, ms, m = cmonitor.run_monitored_metered(
+            jax.random.key(0), params, world, mon_spec, scen.horizon)
+        st_r, mon_r, m_r = cmonitor.run_monitored(
+            jax.random.key(0), params, world, mon_spec, scen.horizon)
+        np.testing.assert_array_equal(np.asarray(mon.code_counts),
+                                      np.asarray(mon_r.code_counts))
+        np.testing.assert_array_equal(np.asarray(st.status),
+                                      np.asarray(st_r.status))
+        d = registry_dict(ms)
+        assert d["counters"]["chaos_violations"] \
+            == int(np.asarray(mon.code_counts).sum())
+
+
+# --------------------------------------------------------------------------
+# Windowed flush + resume dedup
+# --------------------------------------------------------------------------
+
+
+class TestStreamMetered:
+    def test_windows_partition_the_run(self, tmp_path):
+        params = make_params(delivery="shift")
+        world = crash_world(params)
+        path = str(tmp_path / "run.jsonl")
+        with tsink.TelemetrySink(path=path) as sink:
+            _, rows = tmetrics.stream_metered_run(
+                jax.random.key(0), params, world, 90, sink=sink,
+                window_rounds=40)
+        recs = tsink.read_records(path, kind="metrics_window")
+        assert [(r["round_start"], r["round_end"]) for r in recs] \
+            == [(0, 40), (40, 80), (80, 90)]
+        # Written records == the driver's returned rows, modulo the
+        # sink's record envelope.
+        assert [{k: r[k] for k in rows[0]} for r in recs] == rows
+        # Window counters sum to the monolithic run's totals (counters
+        # are window totals; the reset between windows loses nothing).
+        _, ms_mono, _ = swim.run_metered(jax.random.key(0), params,
+                                         world, 90)
+        mono = registry_dict(ms_mono)["counters"]
+        for name in mono:
+            assert sum(r["counters"][name] for r in recs) == mono[name], \
+                name
+        # Gauges: the LAST window's sample equals the monolithic one's.
+        assert recs[-1]["gauges"] == registry_dict(ms_mono)["gauges"]
+
+    def test_resume_skips_covered_windows(self, tmp_path):
+        params = make_params(delivery="shift")
+        world = crash_world(params)
+        path = str(tmp_path / "run.jsonl")
+        with tsink.TelemetrySink(path=path) as sink:
+            tmetrics.stream_metered_run(jax.random.key(0), params, world,
+                                        90, sink=sink, window_rounds=40)
+        before = tsink.read_records(path, kind="metrics_window")
+        # Relaunch appending to the same journal: covered windows are
+        # recomputed but not re-written — no duplicate rows.
+        with tsink.TelemetrySink(path=path, append=True) as sink:
+            tmetrics.stream_metered_run(jax.random.key(0), params, world,
+                                        90, sink=sink, window_rounds=40)
+        after = tsink.read_records(path, kind="metrics_window")
+        assert after == before
+        assert tsink.covered_upto(path, kind="metrics_window") == 90
+
+
+# --------------------------------------------------------------------------
+# Sharded: registry psum across the mesh
+# --------------------------------------------------------------------------
+
+
+from scalecube_cluster_tpu.parallel import compat  # noqa: E402
+
+
+@pytest.mark.skipif(not compat.HAS_SHARD_MAP, reason=compat.SKIP_REASON)
+class TestShardRunMetered:
+    def test_registry_consistent_with_sharded_metric_traces(self):
+        from scalecube_cluster_tpu.parallel import mesh as pmesh
+
+        params = swim.SwimParams.from_config(
+            CFG, n_members=64, delivery="scatter")
+        world = swim.SwimWorld.healthy(params).with_crash(5, at_round=5)
+        mesh = pmesh.make_mesh(8)
+        _, ms, m = pmesh.shard_run_metered(jax.random.key(1), params,
+                                           world, 80, mesh)
+        d = registry_dict(ms)
+        # The lead-device dedup + end-of-run psum must reproduce the
+        # (already psum-global) per-round traces exactly once.
+        for counter, key in (("fd_probes_sent", "messages_ping_sent"),
+                             ("gossip_messages", "messages_gossip"),
+                             ("fd_ping_req_sent", "messages_ping_req_sent")):
+            assert d["counters"][counter] == int(np.asarray(m[key]).sum()), \
+                counter
+        # Row-local lanes psum to the global lifecycle counts.
+        assert d["counters"]["suspicions_started"] == 63
+        assert d["counters"]["suspicions_fired"] == 63
+        assert sum(d["histograms"]["suspicion_lifetime_rounds"]["counts"]) \
+            == 63
+        assert d["gauges"]["dead_entries"] == 63.0
+        assert d["gauges"]["live_members"] == 63.0

@@ -276,3 +276,52 @@ def test_covered_upto_missing_and_empty(tmp_path):
 def test_sink_requires_out_dir_or_path():
     with pytest.raises(ValueError, match="out_dir or path"):
         tsink.TelemetrySink()
+
+
+def test_counters_row_warns_once_on_non_numeric_lane():
+    """A non-numeric counter lane is skipped from the row but NEVER
+    silently: the first failure per key warns (once per process — a
+    broken lane repeats every flush window and one warning per window
+    would bury the signal), so a registry/driver schema drift can't
+    quietly lose a lane forever."""
+    import warnings
+
+    tsink._WARNED_NON_NUMERIC.discard("messages_gossip")
+    bad = {
+        "messages_gossip": np.asarray(["a", "b", "c"]),   # non-numeric
+        "refutations": np.arange(3, dtype=np.int32),      # fine
+    }
+    with pytest.warns(UserWarning, match="non-numeric metric "
+                                         "'messages_gossip'"):
+        row = tsink.counters_row(bad)
+    assert "messages_gossip" not in row       # dropped, not garbage
+    assert row["refutations"] == 3            # numeric lanes unaffected
+    assert row["n_rounds"] == 3
+
+    # Second flush with the same broken lane: no second warning.
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        row2 = tsink.counters_row(bad)
+    assert "messages_gossip" not in row2
+
+
+def test_metrics_window_record_roundtrip(tmp_path):
+    """write_metrics_window -> read_records -> the same payload, with
+    the round_end cursor visible to covered_upto (the resumable-journal
+    contract the windowed registry flush rides)."""
+    window = {
+        "round_start": 0, "round_end": 32,
+        "counters": {"fd_probes_sent": 7},
+        "gauges": {"suspect_entries": 2.0},
+        "histograms": {"suspicion_lifetime_rounds":
+                       {"edges": [0, 1, 2], "counts": [0, 1, 0]}},
+    }
+    with tsink.TelemetrySink(str(tmp_path)) as sink:
+        sink.write_metrics_window(window)
+        with pytest.raises(ValueError, match="round_end"):
+            sink.write_metrics_window({"round_start": 32,
+                                       "counters": {}})
+    (rec,) = tsink.read_records(sink.path, kind="metrics_window")
+    for k, v in window.items():
+        assert rec[k] == v
+    assert tsink.covered_upto(sink.path, kind="metrics_window") == 32

@@ -364,3 +364,48 @@ def test_latency_histograms_undetected_accounting():
         == N - 1
     assert np.asarray(hists["removal"])[VICTIM].sum() == 0
     assert int(np.asarray(hists["removal_undetected"])[VICTIM]) == N - 1
+
+
+def test_latency_histograms_empty_observation_window():
+    """A telemetry state that observed NOTHING (fresh matrices, no
+    transitions): every bucket zero, and — because the subject IS
+    faulted — every live observer counts as undetected.  The edge the
+    windowed/segmented drivers hit when a fault lands after the last
+    observed round."""
+    params = make_params()
+    world = swim.SwimWorld.healthy(params).with_crash(VICTIM, at_round=10)
+    tel = ttrace.TelemetryState.init(N, params.n_subjects)
+    hists = ttrace.latency_histograms(tel, world)
+    for name in ("detection", "removal"):
+        counts = np.asarray(hists[name])
+        assert counts.shape == (params.n_subjects,
+                                len(ttrace.DEFAULT_LATENCY_EDGES))
+        assert counts.sum() == 0
+        undet = np.asarray(hists[name + "_undetected"])
+        assert int(undet[VICTIM]) == N - 1      # faulted, never seen
+        others = [k for k in range(N) if k != VICTIM]
+        assert undet[others].sum() == 0         # unfaulted: not "missed"
+
+
+def test_latency_histograms_all_overflow_buckets():
+    """Latencies past the last edge all land in the OPEN last bucket —
+    counted, never dropped (the never-silent-truncation contract,
+    histogram flavor)."""
+    crash_at = 10
+    params = make_params()
+    world = swim.SwimWorld.healthy(params).with_crash(VICTIM, at_round=crash_at)
+    tel = ttrace.TelemetryState.init(N, params.n_subjects)
+    # Every observer "detected" the victim absurdly late: beyond the
+    # last finite edge by construction.
+    beyond = crash_at + int(ttrace.DEFAULT_LATENCY_EDGES[-1]) + 123
+    first_suspect = np.full((N, params.n_subjects), ttrace.INT32_MAX,
+                            dtype=np.int32)
+    first_suspect[:, VICTIM] = beyond
+    tel = ttrace.TelemetryState(trace=tel.trace,
+                                first_suspect=jax.numpy.asarray(first_suspect),
+                                first_removed=tel.first_removed)
+    hists = ttrace.latency_histograms(tel, world)
+    det = np.asarray(hists["detection"])[VICTIM]
+    assert det[-1] == N - 1                     # all in the open bucket
+    assert det[:-1].sum() == 0
+    assert int(np.asarray(hists["detection_undetected"])[VICTIM]) == 0
