@@ -343,13 +343,37 @@ def timed_traced_run(jax, n_members, rounds, label):
     return rate
 
 
+def interleaved_best_of(run_a, run_b, reps):
+    """Best-of wall-times of two measurement callables, with their
+    windows INTERLEAVED (a window, b window, repeat) and the order
+    ALTERNATED each rep: host-speed drift — frequency scaling, a noisy
+    neighbor calming down — then biases both rates equally instead of
+    whichever path happened to run second (which a back-to-back
+    measurement mis-reads as a negative overhead), and alternation
+    cancels the residual whoever-runs-second-is-warmer bias within a
+    rep pair.  ``run_a(rep)`` / ``run_b(rep)`` each execute one full
+    timed window (including any completion barrier).  Returns
+    ``(best_a_seconds, best_b_seconds)``.
+
+    The one timing discipline every paired comparison shares:
+    traced-vs-untraced (timed_both), metered-vs-unmetered
+    (run_metrics_bench), pipelined-vs-serial (run_multichip_bench).
+    """
+    best = {"a": None, "b": None}
+    for rep in range(reps):
+        pair = ((("a", run_a), ("b", run_b)) if rep % 2 == 0
+                else (("b", run_b), ("a", run_a)))
+        for tag, fn in pair:
+            t0 = time.perf_counter()
+            fn(rep)
+            dt = time.perf_counter() - t0
+            best[tag] = dt if best[tag] is None else min(best[tag], dt)
+    return best["a"], best["b"]
+
+
 def timed_both(jax, n_members, rounds, label):
-    """Both timed paths with their windows INTERLEAVED (untraced window,
-    traced window, repeat): host-speed drift — frequency scaling, a
-    noisy neighbor calming down — then biases both rates equally
-    instead of whichever path happened to run second, which a
-    back-to-back measurement mis-read as a (negative!) trace overhead.
-    Returns (untraced_rate, untraced_metrics, traced_rate).
+    """Both timed paths on the ``interleaved_best_of`` window
+    discipline.  Returns (untraced_rate, untraced_metrics, traced_rate).
     """
     from scalecube_cluster_tpu.models import swim
     from scalecube_cluster_tpu.telemetry import sink as tsink
@@ -378,42 +402,27 @@ def timed_both(jax, n_members, rounds, label):
         f"{time.perf_counter() - t0:.1f}s")
 
     reps = 6 if SMOKE else 1
-    u_best = t_best = None
     u_metrics, res = None, None
-    for rep in range(reps):
-        start = rounds * (1 + rep)
 
-        def run_untraced():
-            nonlocal u_state, u_metrics, u_best
-            t0 = time.perf_counter()
-            with runlog.profiled(rlog):
-                u_state, u_metrics = swim.run(
-                    key, params, world, rounds, state=u_state,
-                    start_round=start,
-                )
-                force(u_state)
-            dt = time.perf_counter() - t0
-            u_best = dt if u_best is None else min(u_best, dt)
-
-        def run_traced_seg():
-            nonlocal t_state, res, t_best
-            t0 = time.perf_counter()
-            t_state, res = tsink.stream_traced_run(
-                key, params, world, rounds, state=t_state,
-                start_round=start, segment_rounds=seg,
-                trace_capacity=cap, decode=False,
+    def run_untraced(rep):
+        nonlocal u_state, u_metrics
+        with runlog.profiled(rlog):
+            u_state, u_metrics = swim.run(
+                key, params, world, rounds, state=u_state,
+                start_round=rounds * (1 + rep),
             )
-            force(t_state)
-            dt = time.perf_counter() - t0
-            t_best = dt if t_best is None else min(t_best, dt)
+            force(u_state)
 
-        # Alternate which path goes first each rep: interleaving cancels
-        # slow host-speed drift, alternation cancels the residual
-        # whoever-runs-second-is-warmer bias within a rep pair.
-        pair = ((run_untraced, run_traced_seg) if rep % 2 == 0
-                else (run_traced_seg, run_untraced))
-        for f in pair:
-            f()
+    def run_traced_seg(rep):
+        nonlocal t_state, res
+        t_state, res = tsink.stream_traced_run(
+            key, params, world, rounds, state=t_state,
+            start_round=rounds * (1 + rep), segment_rounds=seg,
+            trace_capacity=cap, decode=False,
+        )
+        force(t_state)
+
+    u_best, t_best = interleaved_best_of(run_untraced, run_traced_seg, reps)
     u_rate = n_members * rounds / u_best
     t_rate = n_members * rounds / t_best
     log(f"{label}: untraced {u_best:.3f}s vs traced {t_best:.3f}s per "
@@ -780,37 +789,26 @@ def run_metrics_bench():
             f"{time.perf_counter() - t0:.1f}s")
 
         reps = 6 if SMOKE else 3
-        u_best = m_best = None
-        for rep in range(reps):
-            start = rounds * (1 + rep)
 
-            def run_plain():
-                nonlocal u_state, u_best
-                t0 = time.perf_counter()
-                u_state, _ = swim.run(key, params, world, rounds,
-                                      state=u_state, start_round=start)
-                force(u_state)
-                dt = time.perf_counter() - t0
-                u_best = dt if u_best is None else min(u_best, dt)
+        def run_plain(rep):
+            nonlocal u_state
+            u_state, _ = swim.run(key, params, world, rounds,
+                                  state=u_state,
+                                  start_round=rounds * (1 + rep))
+            force(u_state)
 
-            def run_metered():
-                nonlocal m_state, ms, m_best
-                t0 = time.perf_counter()
-                m_state, ms, _ = swim.run_metered(
-                    key, params, world, rounds, spec=spec, state=m_state,
-                    start_round=start, metrics_state=ms,
-                )
-                force(m_state)
-                dt = time.perf_counter() - t0
-                m_best = dt if m_best is None else min(m_best, dt)
+        def run_metered(rep):
+            nonlocal m_state, ms
+            m_state, ms, _ = swim.run_metered(
+                key, params, world, rounds, spec=spec, state=m_state,
+                start_round=rounds * (1 + rep), metrics_state=ms,
+            )
+            force(m_state)
 
-            # Interleave + alternate order per rep — the timed_both
-            # host-drift discipline, so the ratio measures the registry,
-            # not whichever path ran on the warmer core.
-            pair = ((run_plain, run_metered) if rep % 2 == 0
-                    else (run_metered, run_plain))
-            for f in pair:
-                f()
+        # The shared interleave + order-alternation window discipline
+        # (interleaved_best_of), so the ratio measures the registry,
+        # not whichever path ran on the warmer core.
+        u_best, m_best = interleaved_best_of(run_plain, run_metered, reps)
         u_rate = N_MEMBERS * rounds / u_best
         m_rate = N_MEMBERS * rounds / m_best
         ratio = round(u_rate / m_rate, 4)
@@ -903,6 +901,188 @@ def run_metrics_bench():
     print(json.dumps(result), flush=True)
 
 
+def run_multichip_bench():
+    """The --multichip mode: the sharded scatter run on a real device
+    mesh, pipelined ICI delivery (parallel/mesh._pipelined_rounds)
+    measured against the serial in-round combine on the
+    ``interleaved_best_of`` window discipline, plus a bit-identity
+    probe of the two paths.  One JSON line out with REAL per-chip
+    throughput (member-rounds/sec/chip), the mesh shape and the
+    pipelined-vs-serial ratio, and a MULTICHIP_*-style artifact
+    (default ``MULTICHIP_r06.json``; override with
+    SCALECUBE_MULTICHIP_ARTIFACT) — replacing the contentless
+    ``{"rc":0,"ok":true}`` stubs of rounds 1-5.  The regress gate
+    (telemetry/query.py) then walks the MULTICHIP trajectory like the
+    BENCH one.
+
+    ``--smoke`` forces CPU with a virtual 8-device mesh (the
+    tests/conftest.py trick) so the full pipeline — both compiled
+    paths, parity probe, artifact, regress gate — runs anywhere; env
+    overrides: SCALECUBE_MULTICHIP_DEVICES, SCALECUBE_MULTICHIP_N,
+    SCALECUBE_MULTICHIP_ROUNDS, SCALECUBE_MULTICHIP_ARTIFACT.
+    """
+    result = {
+        "metric": "swim_multichip_member_rounds_per_sec_per_chip",
+        "value": None,
+        "unit": "member-rounds/sec/chip",
+        "smoke": SMOKE,
+    }
+    artifact = (os.environ.get("SCALECUBE_MULTICHIP_ARTIFACT")
+                or "MULTICHIP_r06.json")
+    try:
+        # Device-count resolution must happen BEFORE the first jax
+        # import: a CPU backend only exposes multiple devices through
+        # xla_force_host_platform_device_count.
+        want_dev = int(os.environ.get("SCALECUBE_MULTICHIP_DEVICES",
+                                      "8" if SMOKE else "0") or 0)
+        if SMOKE:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        if want_dev and os.environ.get("JAX_PLATFORMS",
+                                       "").startswith("cpu"):
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count={want_dev}"
+                ).strip()
+        jax, platform = init_backend()
+        result["platform"] = platform
+
+        import numpy as np
+
+        from scalecube_cluster_tpu.config import ClusterConfig
+        from scalecube_cluster_tpu.models import swim
+        from scalecube_cluster_tpu.parallel import compat, traffic
+        from scalecube_cluster_tpu.parallel import mesh as pmesh
+        from scalecube_cluster_tpu.utils import runlog
+
+        if not compat.HAS_SHARD_MAP:
+            raise NotImplementedError(compat.SKIP_REASON)
+
+        def force(state):
+            return runlog.completion_barrier(state.status)
+
+        n_dev = want_dev or len(jax.devices())
+        mesh = pmesh.make_mesh(n_dev)
+        n_members = int(os.environ.get(
+            "SCALECUBE_MULTICHIP_N", 1024 if SMOKE else N_MEMBERS))
+        # Rows must divide the mesh: round down to a multiple of it.
+        n_members = max(n_dev, n_members - n_members % n_dev)
+        rounds = int(os.environ.get(
+            "SCALECUBE_MULTICHIP_ROUNDS", 48 if SMOKE else BENCH_ROUNDS))
+        # Scatter delivery: the mode whose single inbox pmax the
+        # pipeline double-buffers (sharded shift mode already overlaps
+        # per-channel ppermutes; SwimParams docstring).
+        params = swim.SwimParams.from_config(
+            ClusterConfig.default(), n_members=n_members,
+            n_subjects=N_SUBJECTS, loss_probability=0.02,
+            delivery="scatter",
+        )
+        world = swim.SwimWorld.healthy(params).with_crash(3, at_round=10)
+        key = jax.random.key(0)
+        log(f"multichip: mesh {list(mesh.devices.shape)} on {platform}, "
+            f"N={n_members}, {rounds}-round windows, "
+            f"per-round ICI bytes/device ~ "
+            f"{traffic.scatter_ici_bytes_per_device_round(params, n_dev)}")
+
+        # Compile + first run of both paths doubles as the bit-identity
+        # probe: the pipelined combine must be a pure scheduling change
+        # (the test suite pins this exhaustively; the bench re-checks
+        # its own exact config over the full timed window), and reusing
+        # the first-run outputs as the probe inputs means two XLA
+        # compilations instead of four.
+        t0 = time.perf_counter()
+        s_state, m_ser = pmesh.shard_run(key, params, world, rounds, mesh,
+                                         pipelined=False)
+        force(s_state)
+        p_state, m_pip = pmesh.shard_run(key, params, world, rounds, mesh,
+                                         pipelined=True)
+        force(p_state)
+        log(f"multichip: compile+first-run (both paths) took "
+            f"{time.perf_counter() - t0:.1f}s")
+        bit_identical = bool(
+            all(np.array_equal(np.asarray(m_ser[k2]), np.asarray(m_pip[k2]))
+                for k2 in m_ser)
+            and np.array_equal(np.asarray(s_state.status),
+                               np.asarray(p_state.status))
+            and np.array_equal(np.asarray(s_state.inc),
+                               np.asarray(p_state.inc))
+        )
+        log(f"multichip: pipelined-vs-serial parity probe "
+            f"{'OK' if bit_identical else 'DIVERGED'}")
+
+        reps = 6 if SMOKE else 3
+
+        def run_serial(rep):
+            nonlocal s_state
+            s_state, _ = pmesh.shard_run(
+                key, params, world, rounds, mesh, state=s_state,
+                start_round=rounds * (1 + rep), pipelined=False)
+            force(s_state)
+
+        def run_pipelined(rep):
+            nonlocal p_state
+            p_state, _ = pmesh.shard_run(
+                key, params, world, rounds, mesh, state=p_state,
+                start_round=rounds * (1 + rep), pipelined=True)
+            force(p_state)
+
+        s_best, p_best = interleaved_best_of(run_serial, run_pipelined,
+                                             reps)
+        s_rate = n_members * rounds / s_best / n_dev
+        p_rate = n_members * rounds / p_best / n_dev
+        ratio = round(p_rate / s_rate, 4)
+        log(f"multichip: serial {s_best:.3f}s vs pipelined {p_best:.3f}s "
+            f"per {rounds}-round window (best of {reps}, interleaved) -> "
+            f"{s_rate:.3e} / {p_rate:.3e} member-rounds/sec/chip "
+            f"(pipelined speedup x{ratio})")
+        result.update(
+            value=round(p_rate, 1),
+            pipelined_member_rounds_per_sec_per_chip=round(p_rate, 1),
+            serial_member_rounds_per_sec_per_chip=round(s_rate, 1),
+            pipelined_speedup_ratio=ratio,
+            bit_identical=bit_identical,
+            n_devices=n_dev,
+            mesh_shape=list(mesh.devices.shape),
+            n_members=n_members,
+            rounds_timed=rounds,
+            delivery="scatter",
+            ici_bytes_per_device_round=(
+                traffic.scatter_ici_bytes_per_device_round(params, n_dev)),
+        )
+
+        art = dict(result)
+        os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        result["artifact"] = artifact
+        log(f"multichip artifact written to {artifact}")
+
+        # The cross-run regression gate over BOTH committed
+        # trajectories + the artifact just written — a multichip
+        # regression is reported in the JSON line, it does not void
+        # the measurement (never-ship-empty).
+        from scalecube_cluster_tpu.telemetry import query as tquery
+
+        gate_paths = tquery.expand_paths(
+            ["BENCH_*.json", "MULTICHIP_*.json", artifact])
+        gate_paths = [p for p in gate_paths if os.path.exists(p)]
+        ok, checks = tquery.regress(gate_paths)
+        failed = [c for c in checks if c.get("ok") is False]
+        log(f"regress gate over {len(gate_paths)} artifacts: "
+            f"{'PASS' if ok else 'REGRESSION ' + json.dumps(failed)}")
+        result["regress"] = {
+            "ok": ok,
+            "artifacts": len(gate_paths),
+            "failed_checks": failed,
+        }
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -930,6 +1110,14 @@ def main():
              "SIGKILL + relaunch over rotated checksummed checkpoints, "
              "all three run shapes) instead of the throughput bench; "
              "combine with --smoke for the tier-1-safe mini drill",
+    )
+    parser.add_argument(
+        "--multichip", action="store_true",
+        help="measure the sharded scatter run on the device mesh: "
+             "pipelined ICI delivery vs the serial in-round combine, "
+             "real member-rounds/sec/chip + mesh shape + speedup ratio "
+             "into a MULTICHIP_* artifact; combine with --smoke for "
+             "the CPU-safe virtual-8-device pass",
     )
     mode = parser.add_mutually_exclusive_group()
     mode.add_argument(
@@ -971,6 +1159,13 @@ def main():
             parser.error(
                 "--metrics measures the metered-vs-unmetered gap on its "
                 "own interleaved windows — drop the other mode flags")
+        if args.multichip and (args.chaos or args.resilience or args.metrics
+                               or args.traced or args.untraced
+                               or args.gap_artifact):
+            parser.error(
+                "--multichip measures the pipelined-vs-serial sharded gap "
+                "on its own interleaved windows — drop the other mode "
+                "flags")
     except SystemExit as e:
         # The one-JSON-line contract holds even for a bad argv: argparse
         # already printed its usage message to stderr; ship the error
@@ -991,6 +1186,8 @@ def main():
         return run_chaos_campaign()
     if args.metrics:
         return run_metrics_bench()
+    if args.multichip:
+        return run_multichip_bench()
 
     result = {
         "metric": "swim_member_rounds_per_sec_per_chip",

@@ -1103,46 +1103,22 @@ def _entry_at_slot(mat, slot, k):
     return jnp.max(jnp.where(onehot, mat, mat.dtype.type(0)), axis=1)
 
 
-def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
-              world: SwimWorld, offset=0, axis_name: Optional[str] = None,
-              knobs: Optional[Knobs] = None, n_devices: int = 1,
-              shift_key=None):
-    """One protocol round.  Pure: (state, r, key) -> (state', metrics).
+def _round_context(state: SwimState, round_idx, base_key,
+                   params: SwimParams, world: SwimWorld, offset=0,
+                   knobs: Optional[Knobs] = None, shift_key=None):
+    """Shared per-round preamble of ``swim_tick`` and its pipelined
+    halves (``swim_tick_send`` / ``swim_tick_recv``): carry decode,
+    per-round PRNG keys, world liveness/partition slices, the
+    self-record pin, user-gossip injection, and the phase gates.
 
-    Phases (matching the reference's periodic loops, SURVEY.md §3.2-3.4):
-      1. FD probe (every ping_every rounds): pick target, direct ping with
-         ping_timeout, else ping-req via k proxies — collapsed in closed
-         form over the loss/delay model; SUSPECT verdicts merge locally,
-         ALIVE-on-suspected pushes the record to the subject (SYNC analog).
-      2. Gossip send: every node pushes its hot records to fanout targets.
-      3. SYNC (every sync_every rounds): push the full row to one random
-         member (anti-entropy, MembershipProtocolImpl.java:439-454).
-      4. Merge all inboxes through the is_overrides lattice; self-records
-         refute (incarnation bump); suspicion timers set/cancel/fire.
-
-    Delivery is either exact-uniform scatter or cyclic-shift mixing
-    (module docstring); per-link faults apply in both via link_eval.
-
-    Sharding (scatter mode): ``state`` rows may be a contiguous slice of
-    the global member axis (``offset`` = first global row).  Senders
-    scatter into a global-height inbox contribution; under ``shard_map``
-    the contributions combine with one ``lax.pmax`` over ``axis_name`` —
-    the ICI collective that replaces the reference's point-to-point TCP
-    (SURVEY.md §5.8) — and each device keeps its own row slice.  With
-    ``axis_name=None`` and ``offset=0`` this is the single-device path
-    unchanged.  Sharded shift mode exchanges payload blocks with
-    block-rotation ppermutes instead (ops/shift.ShiftEngine); its
-    per-round traffic is O(n_local*K) per channel vs the pmax's O(N*K).
-    ``n_devices`` must be the static mesh size when ``axis_name`` is set.
+    Both halves of a pipelined round derive the SAME context from the
+    same (state, round_idx) — recomputing it is a handful of elementwise
+    ops, and it is what makes the send/recv split bit-identical to the
+    monolithic tick without carrying pinned temporaries between rounds.
     """
     kn = knobs if knobs is not None else Knobs.from_params(params)
-    n, k = params.n_members, params.n_subjects
+    n = params.n_members
     n_local = state.status.shape[0]
-    if params.link_counters and axis_name is not None:
-        raise NotImplementedError(
-            "link_counters is a single-device measurement substrate "
-            "(per-sender [N] rows don't cross shard_map metric combining)"
-        )
     # k_block keeps the carry in its stored layout end-to-end: a global
     # decode would materialize three wide int32 [N, N] temps (measured
     # 6x 4G at 32,768 — the decode can't fuse through a fori_loop's
@@ -1155,8 +1131,7 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     # device must agree on the round's global shifts.
     key_global = prng.round_key(base_key, round_idx)
     key = prng.round_key(key_global, offset)
-    (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t, k_gossip_drop,
-     k_sync_t, k_sync_drop) = jax.random.split(key, 8)
+    keys = tuple(jax.random.split(key, 8))
     # ``shift_key`` (default: the base key) sources ONLY the per-round
     # channel shifts.  Under a vmapped knob sweep, passing one UNBATCHED
     # shift key makes the round's shifts batch-invariant, so the payload
@@ -1171,9 +1146,6 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
                        round_idx),
         0x5317,
     )
-
-    def global_sum(x):
-        return jax.lax.psum(x, axis_name) if axis_name is not None else x
 
     alive = world.alive_at(round_idx)                       # [N] ground truth
     part = world.partition_at(round_idx)                    # [N]
@@ -1244,6 +1216,66 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
             target_ids[..., None] == world.seed_ids[None, :], axis=-1
         )
 
+    return dict(
+        kn=kn, state=state, status=status, inc=inc, keys=keys,
+        k_shifts=k_shifts, alive=alive, part=part, node_ids=node_ids,
+        alive_here=alive_here, part_here=part_here, is_self=is_self,
+        fd_round=fd_round, sync_round=sync_round,
+        gate_contacts=gate_contacts, known_live=known_live,
+        is_seed=is_seed,
+    )
+
+
+def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
+              world: SwimWorld, offset=0, axis_name: Optional[str] = None,
+              knobs: Optional[Knobs] = None, n_devices: int = 1,
+              shift_key=None):
+    """One protocol round.  Pure: (state, r, key) -> (state', metrics).
+
+    Phases (matching the reference's periodic loops, SURVEY.md §3.2-3.4):
+      1. FD probe (every ping_every rounds): pick target, direct ping with
+         ping_timeout, else ping-req via k proxies — collapsed in closed
+         form over the loss/delay model; SUSPECT verdicts merge locally,
+         ALIVE-on-suspected pushes the record to the subject (SYNC analog).
+      2. Gossip send: every node pushes its hot records to fanout targets.
+      3. SYNC (every sync_every rounds): push the full row to one random
+         member (anti-entropy, MembershipProtocolImpl.java:439-454).
+      4. Merge all inboxes through the is_overrides lattice; self-records
+         refute (incarnation bump); suspicion timers set/cancel/fire.
+
+    Delivery is either exact-uniform scatter or cyclic-shift mixing
+    (module docstring); per-link faults apply in both via link_eval.
+
+    Sharding (scatter mode): ``state`` rows may be a contiguous slice of
+    the global member axis (``offset`` = first global row).  Senders
+    scatter into a global-height inbox contribution; under ``shard_map``
+    the contributions combine with one ``lax.pmax`` over ``axis_name`` —
+    the ICI collective that replaces the reference's point-to-point TCP
+    (SURVEY.md §5.8) — and each device keeps its own row slice.  With
+    ``axis_name=None`` and ``offset=0`` this is the single-device path
+    unchanged.  Sharded shift mode exchanges payload blocks with
+    block-rotation ppermutes instead (ops/shift.ShiftEngine); its
+    per-round traffic is O(n_local*K) per channel vs the pmax's O(N*K).
+    ``n_devices`` must be the static mesh size when ``axis_name`` is set.
+    """
+    if params.link_counters and axis_name is not None:
+        raise NotImplementedError(
+            "link_counters is a single-device measurement substrate "
+            "(per-sender [N] rows don't cross shard_map metric combining)"
+        )
+    ctx = _round_context(state, round_idx, base_key, params, world,
+                         offset=offset, knobs=knobs, shift_key=shift_key)
+    kn, state, status, inc = ctx["kn"], ctx["state"], ctx["status"], ctx["inc"]
+    (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t, k_gossip_drop,
+     k_sync_t, k_sync_drop) = ctx["keys"]
+    k_shifts = ctx["k_shifts"]
+    alive, part, node_ids = ctx["alive"], ctx["part"], ctx["node_ids"]
+    alive_here, part_here = ctx["alive_here"], ctx["part_here"]
+    is_self = ctx["is_self"]
+    fd_round, sync_round = ctx["fd_round"], ctx["sync_round"]
+    gate_contacts = ctx["gate_contacts"]
+    known_live, is_seed = ctx["known_live"], ctx["is_seed"]
+
     if params.k_block:
         if axis_name is not None:
             raise NotImplementedError(
@@ -1281,7 +1313,31 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
             offset, axis_name,
         )
 
-    # ---- Metrics (the per-round observability tensors, SURVEY.md §5.1) ---
+    metrics = _round_metrics(new_state, status, aux, params, world,
+                             alive, alive_here, axis_name)
+    if params.compact_carry and not params.k_block:
+        new_state = _carry_encode(new_state, round_idx)
+    return new_state, metrics
+
+
+def _round_metrics(new_state: SwimState, status, aux, params: SwimParams,
+                   world: SwimWorld, alive, alive_here,
+                   axis_name: Optional[str]):
+    """The per-round observability tensors (SURVEY.md §5.1), from the
+    post-merge state + the tick's send-side counters (``aux``).  Shared
+    by the monolithic tick and the pipelined recv half — under
+    pipelining a round's metrics are emitted one scan body later, from
+    identical inputs, so the stacked traces stay bit-identical.
+
+    ``status`` is the PRE-merge pinned status (for the suspicion-onset
+    delta); ``alive``/``alive_here`` are the round's ground-truth
+    liveness.
+    """
+    k = params.n_subjects
+
+    def global_sum(x):
+        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+
     # Restructured in round 4 from seven [N, K] pred masks (each ANDing in
     # the per-column subject-liveness and the one-hot self mask) to FOUR
     # row-space reductions plus per-column post-processing:
@@ -1403,9 +1459,7 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
         metrics["user_gossip_infected"] = global_sum(
             jnp.sum(new_state.g_infected, axis=0, dtype=jnp.int32)
         )
-    if params.compact_carry and not params.k_block:
-        new_state = _carry_encode(new_state, round_idx)
-    return new_state, metrics
+    return metrics
 
 
 # --------------------------------------------------------------------------
@@ -1655,22 +1709,22 @@ def _send_payloads(state, status, inc, round_idx, params, world,
 # --------------------------------------------------------------------------
 
 
-def _tick_scatter(state, status, inc, round_idx, params, kn, world,
-                  alive, part, node_ids, alive_here, part_here, is_self,
-                  fd_round, sync_round, gate_contacts, known_live, is_seed,
-                  keys, offset, axis_name):
+def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
+                        alive, part, node_ids, alive_here, part_here,
+                        is_self, fd_round, sync_round, gate_contacts,
+                        known_live, is_seed, keys, offset):
+    """Phases 1-3 of the scatter tick: FD probe verdicts + gossip/SYNC
+    sends — everything up to (but excluding) the cross-device inbox
+    combine.  Returns a dict of per-channel payloads/targets/drop masks
+    plus the send-side signals, consumed either serially (combine in
+    the same round body — ``_tick_scatter``) or double-buffered (the
+    combine deferred to the NEXT round body — ``swim_tick_send`` /
+    ``swim_tick_recv``, the pipelined ICI path of parallel/mesh.py).
+    """
     n, k = params.n_members, params.n_subjects
     n_local = status.shape[0]
     (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t, k_gossip_drop,
      k_sync_t, k_sync_drop) = keys
-
-    def combine_max(buf):
-        """Cross-device inbox combine + own-row slice."""
-        if axis_name is not None:
-            buf = jax.lax.pmax(buf, axis_name)
-        if n_local == n and axis_name is None:
-            return buf
-        return jax.lax.dynamic_slice_in_dim(buf, offset, n_local, axis=0)
 
     def same_partition(a_ids, b_ids):
         return part[a_ids] == part[b_ids]
@@ -1829,29 +1883,106 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     sync_ok = alive[sync_target[:, 0]] & part_ok_s & ~wire_drop_s
     sync_drop = (~(do_sync & sync_ok))[:, None]
 
+    alive_flags = delivery.is_alive_key(gossip_keys, compact=compact)
+    sync_alive_flags = delivery.is_alive_key(sync_keys, compact=compact)
+    hot_any = jnp.any(gossip_keys >= 0, axis=1)
+    hot_g = None
+    if params.n_user_gossips > 0:
+        hot_g = (state.g_infected & alive_here[:, None]
+                 & (round_idx < state.g_spread_until))
+        # A wire gossip message exists when EITHER family has content.
+        hot_any = hot_any | jnp.any(hot_g, axis=1)
+    return dict(
+        gossip_keys=gossip_keys, sync_keys=sync_keys,
+        gossip_targets=gossip_targets, gossip_drop=gossip_drop,
+        sync_target=sync_target, sync_drop=sync_drop,
+        alive_flags=alive_flags, sync_alive_flags=sync_alive_flags,
+        fd_inbox=fd_inbox, hot_any=hot_any, hot_g=hot_g,
+        delay_g=delay_g, delay_s=delay_s,
+        probe_active=probe_active, probes_sent=probes_sent,
+        ping_req_launches=ping_req_launches,
+        # link_counters attribution components (single-device serial path).
+        contact_ok_g=contact_ok_g, chan_off=chan_off,
+        wire_drop_g=wire_drop_g, part_ok_g=part_ok_g,
+        wire_drop_s=wire_drop_s, part_ok_s=part_ok_s, do_sync=do_sync,
+        k_gossip_drop=k_gossip_drop, k_sync_drop=k_sync_drop,
+    )
+
+
+def _scatter_channel_bufs(s, params, gossip_extra_drop, sync_extra_drop):
+    """The UNCOMBINED global-height inbox contribution of one scatter
+    round: the max-folded packed-key buffer and the int8 ALIVE-flag
+    buffer (both [N, K]).  The serial tick pmax-combines these in the
+    same round body; the pipelined path carries them to the next one.
+    """
+    n = params.n_members
+    g_drop = s["gossip_drop"] | gossip_extra_drop
+    s_drop = s["sync_drop"] | sync_extra_drop
+    buf = jnp.maximum(
+        delivery.scatter_max(s["gossip_keys"], s["gossip_targets"],
+                             g_drop, n),
+        delivery.scatter_max(s["sync_keys"], s["sync_target"], s_drop, n),
+    )
+    fbuf = (
+        delivery.scatter_or(s["alive_flags"], s["gossip_targets"],
+                            g_drop, n)
+        | delivery.scatter_or(s["sync_alive_flags"], s["sync_target"],
+                              s_drop, n)
+    )
+    return buf, fbuf.astype(jnp.int8)
+
+
+def _scatter_send_aux(s, params):
+    """Send-side counters of one scatter round — merge-independent, so
+    the pipelined path can carry them across the round boundary and
+    psum them together with the round's metrics one body later."""
+    return dict(
+        messages_gossip=jnp.sum(
+            s["hot_any"][:, None] & ~s["gossip_drop"], dtype=jnp.int32
+        ),
+        messages_ping=jnp.sum(s["probe_active"], dtype=jnp.int32),
+        messages_ping_sent=jnp.sum(s["probes_sent"], dtype=jnp.int32),
+        messages_ping_req_sent=(
+            jnp.sum(s["ping_req_launches"], dtype=jnp.int32)
+            * params.ping_req_members
+        ),
+    )
+
+
+def _tick_scatter(state, status, inc, round_idx, params, kn, world,
+                  alive, part, node_ids, alive_here, part_here, is_self,
+                  fd_round, sync_round, gate_contacts, known_live, is_seed,
+                  keys, offset, axis_name):
+    n, k = params.n_members, params.n_subjects
+    n_local = status.shape[0]
+    s = _scatter_send_phase(state, status, inc, round_idx, params, kn,
+                            world, alive, part, node_ids, alive_here,
+                            part_here, is_self, fd_round, sync_round,
+                            gate_contacts, known_live, is_seed, keys,
+                            offset)
+    delay_g, delay_s = s["delay_g"], s["delay_s"]
+
+    def combine_max(buf):
+        """Cross-device inbox combine + own-row slice."""
+        if axis_name is not None:
+            buf = jax.lax.pmax(buf, axis_name)
+        if n_local == n and axis_name is None:
+            return buf
+        return jax.lax.dynamic_slice_in_dim(buf, offset, n_local, axis=0)
+
     # Accumulate all send channels into one global-height contribution,
     # then one cross-device combine per delay bin (a single pmax per round
     # in the default max_delay_rounds=0 configuration; the delay path is a
     # small-N validation mode, so its extra per-bin combines are
     # acceptable — the 1M shift path bins receiver-side instead).
-    alive_flags = delivery.is_alive_key(gossip_keys, compact=compact)
-    sync_alive_flags = delivery.is_alive_key(sync_keys, compact=compact)
     inbox_now, flags_now, g_now, ring, fring, gring, slot0 = _ring_open(
         state, params, round_idx
     )
 
     def channel_bufs(gossip_extra_drop, sync_extra_drop):
-        g_drop = gossip_drop | gossip_extra_drop
-        s_drop = sync_drop | sync_extra_drop
-        buf = jnp.maximum(
-            delivery.scatter_max(gossip_keys, gossip_targets, g_drop, n),
-            delivery.scatter_max(sync_keys, sync_target, s_drop, n),
-        )
-        fbuf = (
-            delivery.scatter_or(alive_flags, gossip_targets, g_drop, n)
-            | delivery.scatter_or(sync_alive_flags, sync_target, s_drop, n)
-        )
-        return combine_max(buf), combine_max(fbuf.astype(jnp.int8))
+        buf, fbuf = _scatter_channel_bufs(s, params, gossip_extra_drop,
+                                          sync_extra_drop)
+        return combine_max(buf), combine_max(fbuf)
 
     if params.max_delay_rounds == 0:
         inbox, inbox_alive8 = channel_bufs(False, False)
@@ -1860,12 +1991,12 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
         # delay None = statically zero (link_eval docstring): bin 0 always.
         q_g = (jnp.zeros((n_local, params.fanout), jnp.int32)
                if delay_g is None else ring_ops.delay_bins(
-                   jax.random.fold_in(k_gossip_drop, 7), delay_g,
+                   jax.random.fold_in(s["k_gossip_drop"], 7), delay_g,
                    params.round_ms, params.max_delay_rounds,
                    (n_local, params.fanout)))
         q_s = (jnp.zeros((n_local,), jnp.int32)
                if delay_s is None else ring_ops.delay_bins(
-                   jax.random.fold_in(k_sync_drop, 7), delay_s,
+                   jax.random.fold_in(s["k_sync_drop"], 7), delay_s,
                    params.round_ms, params.max_delay_rounds,
                    (n_local,)))[:, None]
         inbox, inbox_alive8 = channel_bufs(q_g != 0, q_s != 0)
@@ -1878,16 +2009,17 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
                                      buf_j, fbuf_j.astype(jnp.bool_))
 
     # FD local verdicts fold into the same inbox (observer-local, no comm).
-    inbox = jnp.maximum(inbox, fd_inbox)
+    inbox = jnp.maximum(inbox, s["fd_inbox"])
 
     # Joiner <-> seed SYNC round trip (the reference's join protocol;
     # inert once no row holds ABSENT entries).
     ss_sent = ss_lost = jnp.int32(0)
     if gate_contacts:
         inbox, inbox_alive, ss_sent, ss_lost = _seed_anti_entropy(
-            status, sync_keys, inbox, inbox_alive, sync_round, round_idx,
-            params, kn, world, node_ids, alive_here, alive, part,
-            jax.random.fold_in(k_sync_drop, 29), axis_name=axis_name,
+            status, s["sync_keys"], inbox, inbox_alive, sync_round,
+            round_idx, params, kn, world, node_ids, alive_here, alive,
+            part, jax.random.fold_in(s["k_sync_drop"], 29),
+            axis_name=axis_name,
         )
 
     # User-gossip bits ride the same gossip channels, targets, and drop
@@ -1895,12 +2027,11 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     # (GossipProtocolImpl.java:211-237).
     g_delivered, g_ring_new = None, None
     if params.n_user_gossips > 0:
-        hot_g = (state.g_infected & alive_here[:, None]
-                 & (round_idx < state.g_spread_until))
 
         def g_buf(extra_drop):
             gb = delivery.scatter_or(
-                hot_g, gossip_targets, gossip_drop | extra_drop, n
+                s["hot_g"], s["gossip_targets"],
+                s["gossip_drop"] | extra_drop, n
             )
             return combine_max(gb.astype(jnp.int8)).astype(jnp.bool_)
 
@@ -1921,33 +2052,24 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
         node_ids, alive_here, is_self, inbox_ring=ring, flag_ring=fring,
         g_delivered=g_delivered, g_ring=g_ring_new,
     )
-    hot_any = jnp.any(gossip_keys >= 0, axis=1)
-    if params.n_user_gossips > 0:
-        # A wire gossip message exists when EITHER family has content.
-        hot_any = hot_any | jnp.any(hot_g, axis=1)
     aux = dict(
-        messages_gossip=jnp.sum(
-            hot_any[:, None] & ~gossip_drop, dtype=jnp.int32
-        ),
-        messages_ping=jnp.sum(probe_active, dtype=jnp.int32),
-        messages_ping_sent=jnp.sum(probes_sent, dtype=jnp.int32),
-        messages_ping_req_sent=(
-            jnp.sum(ping_req_launches, dtype=jnp.int32) * r_proxies
-        ),
+        _scatter_send_aux(s, params),
         refutations=jnp.sum(refuted & alive_here, dtype=jnp.int32),
     )
     if params.link_counters:
         # Per-sender wire accounting (SwimParams.link_counters docstring).
         # A gossip message exists per active channel when the sender is
         # live, has hot records, and its peer-list gate admits the target.
-        g_attempt = (alive_here & hot_any)[:, None] & contact_ok_g & ~chan_off
-        g_lost = g_attempt & (wire_drop_g | ~part_ok_g)
-        s_lost = do_sync & (wire_drop_s | ~part_ok_s)
+        g_attempt = ((alive_here & s["hot_any"])[:, None]
+                     & s["contact_ok_g"] & ~s["chan_off"])
+        g_lost = g_attempt & (s["wire_drop_g"] | ~s["part_ok_g"])
+        s_lost = s["do_sync"] & (s["wire_drop_s"] | ~s["part_ok_s"])
         aux["sent_by_node"] = (
             jnp.sum(g_attempt, axis=1, dtype=jnp.int32)
-            + do_sync.astype(jnp.int32)
-            + probes_sent.astype(jnp.int32)
-            + ping_req_launches.astype(jnp.int32) * r_proxies
+            + s["do_sync"].astype(jnp.int32)
+            + s["probes_sent"].astype(jnp.int32)
+            + s["ping_req_launches"].astype(jnp.int32)
+            * params.ping_req_members
             + ss_sent
         )
         aux["lost_by_node"] = (
@@ -1955,6 +2077,147 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
             + s_lost.astype(jnp.int32) + ss_lost
         )
     return new_state, aux
+
+
+# --------------------------------------------------------------------------
+# Pipelined delivery: the scatter tick split across the round boundary
+# --------------------------------------------------------------------------
+
+
+def pipelined_delivery_unsupported_reason(params: SwimParams,
+                                          world: SwimWorld) -> Optional[str]:
+    """Why this config cannot run the double-buffered (pipelined) inbox
+    combine, or None when it can.
+
+    The pipeline defers the cross-device pmax of round r's contribution
+    into round r+1's scan body, so any feature that must read a COMBINED
+    inbox within its own round body is incompatible.  Every predicate
+    here is a static trace-time fact (params fields / world array
+    shapes), so the check costs nothing inside jit.
+    """
+    if params.delivery != "scatter":
+        return ("pipelined delivery targets the scatter-mode inbox pmax; "
+                "sharded shift mode already exchanges payload blocks with "
+                "per-channel ppermutes (ops/shift.ShiftEngine)")
+    if params.max_delay_rounds != 0:
+        return ("delay modeling combines one buffer per delay bin and "
+                "pushes future bins into the carried ring within the "
+                "round body (small-N validation mode)")
+    if params.link_counters:
+        return ("link_counters is the single-device measurement "
+                "substrate; pipelining is a cross-device scheduling "
+                "optimisation")
+    if params.full_view and world.seed_ids.shape[0] > 0:
+        return ("the joiner<->seed anti-entropy round trip (push + ack) "
+                "completes within one round, so its combines cannot be "
+                "deferred")
+    return None
+
+
+def swim_tick_send(state: SwimState, round_idx, base_key,
+                   params: SwimParams, world: SwimWorld, offset=0,
+                   axis_name: Optional[str] = None,
+                   knobs: Optional[Knobs] = None, n_devices: int = 1):
+    """First half of the PIPELINED scatter round: phases 1-3 only.
+
+    Returns ``(pending, send_aux)``: ``pending`` is the device's
+    UNCOMBINED global-height inbox contribution (packed-key buffer +
+    int8 ALIVE-flag buffer + optional user-gossip bits, with the FD
+    verdicts max-folded into the owner's local row block), and
+    ``send_aux`` the send-side counters.  Both are consumed by
+    :func:`swim_tick_recv` — in the NEXT scan body under the pipelined
+    runner (parallel/mesh.shard_run) — which is where the cross-device
+    ``pmax`` actually runs.
+
+    Deferring the combine is a pure SCHEDULING change: the merge is the
+    tick's last phase, so the combined inbox of round r is first read
+    by round r+1's sends either way.  Folding the FD verdicts before
+    the pmax instead of after it is bit-identical too — max is
+    associative and only the owning device contributes FD values to its
+    own rows.  Pinned by tests/test_pipelined_delivery.py.
+    """
+    reason = pipelined_delivery_unsupported_reason(params, world)
+    if reason is not None:
+        raise NotImplementedError(f"pipelined delivery: {reason}")
+    ctx = _round_context(state, round_idx, base_key, params, world,
+                         offset=offset, knobs=knobs)
+    n_local = ctx["status"].shape[0]
+    s = _scatter_send_phase(ctx["state"], ctx["status"], ctx["inc"],
+                            round_idx, params, ctx["kn"], world,
+                            ctx["alive"], ctx["part"], ctx["node_ids"],
+                            ctx["alive_here"], ctx["part_here"],
+                            ctx["is_self"], ctx["fd_round"],
+                            ctx["sync_round"], ctx["gate_contacts"],
+                            ctx["known_live"], ctx["is_seed"],
+                            ctx["keys"], offset)
+    buf, fbuf = _scatter_channel_bufs(s, params, False, False)
+    # FD verdicts are observer-local: fold them into the owner's row
+    # block of the pending buffer (serial folds after the combine; max
+    # commutes with the pmax because no other device writes fd values
+    # into these rows).
+    local = jax.lax.dynamic_slice(buf, (offset, 0), (n_local, buf.shape[1]))
+    buf = jax.lax.dynamic_update_slice(
+        buf, jnp.maximum(local, s["fd_inbox"]), (offset, 0)
+    )
+    pending = dict(keys=buf, flags=fbuf)
+    if params.n_user_gossips > 0:
+        pending["g_bits"] = delivery.scatter_or(
+            s["hot_g"], s["gossip_targets"], s["gossip_drop"],
+            params.n_members,
+        ).astype(jnp.int8)
+    return pending, _scatter_send_aux(s, params)
+
+
+def swim_tick_recv(state: SwimState, pending, send_aux, round_idx,
+                   base_key, params: SwimParams, world: SwimWorld,
+                   offset=0, axis_name: Optional[str] = None,
+                   knobs: Optional[Knobs] = None, n_devices: int = 1):
+    """Second half of the PIPELINED scatter round: combine the pending
+    contribution from :func:`swim_tick_send` (the one cross-device
+    ``pmax`` per buffer), merge, run the suspicion timers, and emit the
+    round's metrics.
+
+    MUST be called with the SAME ``(state, round_idx)`` the send half
+    saw — it rederives the pinned/injected round context from them, so
+    the pair composes to exactly :func:`swim_tick`.  Under the
+    pipelined scan the call happens one body later, which puts the
+    combine's collective next to the FOLLOWING round's state-independent
+    draw compute in one program — the overlap window XLA's latency-
+    hiding scheduler needs (a collective start/done pair cannot span a
+    scan iteration boundary).
+    """
+    ctx = _round_context(state, round_idx, base_key, params, world,
+                         offset=offset, knobs=knobs)
+    n = params.n_members
+    n_local = ctx["status"].shape[0]
+
+    def combine_max(buf):
+        if axis_name is not None:
+            buf = jax.lax.pmax(buf, axis_name)
+        if n_local == n and axis_name is None:
+            return buf
+        return jax.lax.dynamic_slice_in_dim(buf, offset, n_local, axis=0)
+
+    inbox = combine_max(pending["keys"])
+    inbox_alive = combine_max(pending["flags"]).astype(jnp.bool_)
+    g_delivered = None
+    if params.n_user_gossips > 0:
+        g_delivered = combine_max(pending["g_bits"]).astype(jnp.bool_)
+
+    new_state, refuted = _merge_and_timers(
+        ctx["state"], ctx["status"], ctx["inc"], inbox, inbox_alive,
+        round_idx, params, ctx["kn"], world, ctx["node_ids"],
+        ctx["alive_here"], ctx["is_self"], g_delivered=g_delivered,
+    )
+    aux = dict(
+        send_aux,
+        refutations=jnp.sum(refuted & ctx["alive_here"], dtype=jnp.int32),
+    )
+    metrics = _round_metrics(new_state, ctx["status"], aux, params, world,
+                             ctx["alive"], ctx["alive_here"], axis_name)
+    if params.compact_carry:
+        new_state = _carry_encode(new_state, round_idx)
+    return new_state, metrics
 
 
 # --------------------------------------------------------------------------

@@ -13,6 +13,17 @@ mesh over DCN-connected hosts and the identical collective lowers to
 ICI-within-slice / DCN-across-slices.  Nothing in the model code changes —
 that is the point of designing delivery as one associative reduction.
 
+Pipelined delivery (the default where supported): because delivery is
+"send this round, listen next round", the combine's result is first read
+by the FOLLOWING round's body — so the scatter path double-buffers the
+contribution and defers each round's pmax into the next scan body
+(``_pipelined_rounds``), placing the ICI transfer next to that round's
+state-independent draw compute where XLA's latency-hiding scheduler can
+overlap them.  Bit-identical to the serial combine (a scheduling change,
+not a semantics change — pinned by tests/test_pipelined_delivery.py);
+``shard_run(..., pipelined=False)`` keeps the serial path as the
+comparison baseline (``bench.py --multichip`` reports the ratio).
+
 Randomness under sharding: each device folds its global row offset into the
 per-round key (models/swim.swim_tick), so draws are independent across
 devices but the trace is only bit-reproducible for a fixed mesh size (the
@@ -37,9 +48,23 @@ NODE_AXIS = "nodes"
 
 
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = NODE_AXIS) -> Mesh:
-    """1-D device mesh over the first ``n_devices`` available devices."""
+    """1-D device mesh over the first ``n_devices`` available devices.
+
+    Asking for more devices than exist raises instead of silently
+    truncating: a silently shrunk mesh would run the whole workload on
+    fewer chips and report per-chip numbers for a mesh shape that was
+    never built (tests/test_parallel.py pins the error).
+    """
     devices = jax.devices()
     if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"make_mesh: requested {n_devices} devices but only "
+                f"{len(devices)} are available ({[str(d) for d in devices]}); "
+                f"a silently truncated mesh would misreport per-chip "
+                f"throughput — pass n_devices <= {len(devices)} or None "
+                f"for all of them"
+            )
         devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (axis_name,))
 
@@ -49,20 +74,11 @@ def state_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(mesh.axis_names[0]))
 
 
-@partial(jax.jit, static_argnames=("params", "n_rounds", "mesh"))
-def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
-              n_rounds: int, mesh: Mesh,
-              state: Optional[swim.SwimState] = None, start_round: int = 0):
-    """models/swim.run, row-sharded over ``mesh``.
-
-    The scan lives *inside* shard_map, so the per-round pmax is the only
-    collective XLA emits and the whole n_rounds loop compiles to one
-    per-device program.  World arrays ([N] ground truth / fault schedule)
-    are replicated — they are O(N) scalars, not O(N·K) state.
-
-    Returns (final_state, metrics) with state rows sharded over the mesh
-    and metrics replicated (already psum-combined inside the tick).
-    """
+def _shard_prelude(params: swim.SwimParams, mesh: Mesh):
+    """The (axis, n_dev, n_local, state_specs, metric out_specs) every
+    sharded run shape derives — hoisted so ``shard_run`` and
+    ``shard_run_metered`` share one divisibility check and one spec
+    block (the duplication CHANGES.md PR 5 flagged)."""
     axis = mesh.axis_names[0]
     n_dev = mesh.devices.size
     if params.n_members % n_dev != 0:
@@ -70,10 +86,6 @@ def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
             f"n_members ({params.n_members}) must divide the mesh size ({n_dev})"
         )
     n_local = params.n_members // n_dev
-
-    if state is None:
-        state = swim.initial_state(params, world)
-
     state_specs = swim.SwimState(
         status=P(axis), inc=P(axis), spread_until=P(axis),
         suspect_deadline=P(axis), self_inc=P(axis),
@@ -81,11 +93,147 @@ def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
         inbox_ring=P(None, axis), flag_ring=P(None, axis),
         g_infected=P(axis), g_spread_until=P(axis), g_ring=P(None, axis),
     )
+    metric_names = ["alive", "suspect", "dead", "absent", "false_positives",
+                    "false_suspicion_onsets", "false_suspect_rounds",
+                    "stale_view_rounds",
+                    "messages_gossip", "messages_ping",
+                    "messages_ping_sent", "messages_ping_req_sent",
+                    "refutations"]
+    if params.n_user_gossips > 0:
+        metric_names.append("user_gossip_infected")
+    out_metric_specs = {name: P() for name in metric_names}
+    return axis, n_dev, n_local, state_specs, out_metric_specs
+
+
+def _resolve_pipelined(pipelined: Optional[bool], params: swim.SwimParams,
+                       world: swim.SwimWorld, n_rounds: int) -> bool:
+    """``pipelined=None`` auto-selects: pipeline whenever the config
+    supports it (scatter delivery, no delay rings, no seed gate — see
+    swim.pipelined_delivery_unsupported_reason) and there is at least
+    one round to overlap.  ``True`` insists and raises with the reason
+    when unsupported; ``False`` forces the serial combine (the bench's
+    comparison baseline)."""
+    if pipelined is False:
+        return False
+    reason = swim.pipelined_delivery_unsupported_reason(params, world)
+    if reason is None and n_rounds >= 1:
+        return True
+    if pipelined:
+        raise NotImplementedError(
+            f"pipelined delivery: {reason or 'needs n_rounds >= 1'}"
+        )
+    return False
+
+
+def _pipelined_rounds(base_key, params: swim.SwimParams,
+                      world: swim.SwimWorld, state: swim.SwimState,
+                      n_rounds: int, start_round, offset, axis: str,
+                      n_dev: int, on_round=None, carry0=None):
+    """Software-pipelined scatter round loop (runs INSIDE shard_map).
+
+    Round structure: scan body j combines + merges round j-1's carried
+    contribution (swim.swim_tick_recv) and then computes round j's
+    sends (swim.swim_tick_send); the first send runs as a prologue and
+    the last combine+merge as an epilogue.  The cross-device pmax of a
+    round therefore sits in the SAME program body as the next round's
+    state-independent draw compute (targets, drop masks, FD chains),
+    which is what lets XLA's latency-hiding scheduler run the ICI
+    transfer under it — in the serial body the pmax's only in-body
+    consumers follow it immediately, and an async collective pair
+    cannot span the scan iteration boundary.
+
+    Because delivery is already "send this round, listen next round"
+    (the merge is the tick's last phase), this is a scheduling change
+    only: outputs are BIT-IDENTICAL to the serial scan
+    (tests/test_pipelined_delivery.py), at the cost of double-buffering
+    one [N, K] contribution pair in the carry.
+
+    ``on_round(extra, prev_state, round_idx, new_state, metrics)`` is
+    the per-round observation hook (the metered twin's registry fold),
+    applied after each round's merge with the round's OWN index and
+    pre-merge state — exactly the serial ordering; ``carry0`` is its
+    initial value.  Returns (final_state, extra, stacked metrics).
+    """
+    if n_rounds < 1:
+        raise ValueError("pipelined delivery needs n_rounds >= 1")
+
+    def send(st, r):
+        return swim.swim_tick_send(st, r, base_key, params, world,
+                                   offset=offset, axis_name=axis,
+                                   n_devices=n_dev)
+
+    def recv(st, pend, aux, r):
+        return swim.swim_tick_recv(st, pend, aux, r, base_key, params,
+                                   world, offset=offset, axis_name=axis,
+                                   n_devices=n_dev)
+
+    start = jnp.asarray(start_round, jnp.int32)
+    pending, send_aux = send(state, start)
+
+    def body(carry, round_idx):
+        st, pend, aux, extra = carry
+        new_st, metrics = recv(st, pend, aux, round_idx - 1)
+        if on_round is not None:
+            extra = on_round(extra, st, round_idx - 1, new_st, metrics)
+        new_pend, new_aux = send(new_st, round_idx)
+        return (new_st, new_pend, new_aux, extra), metrics
+
+    rounds = jnp.arange(1, n_rounds, dtype=jnp.int32) + start
+    (st, pend, aux, extra), ms = jax.lax.scan(
+        body, (state, pending, send_aux, carry0), rounds
+    )
+    last = start + jnp.int32(n_rounds - 1)
+    final_state, last_metrics = recv(st, pend, aux, last)
+    if on_round is not None:
+        extra = on_round(extra, st, last, final_state, last_metrics)
+    metrics = jax.tree.map(
+        lambda rows, tail: jnp.concatenate([rows, tail[None]], axis=0),
+        ms, last_metrics,
+    )
+    return final_state, extra, metrics
+
+
+@partial(jax.jit, static_argnames=("params", "n_rounds", "mesh", "pipelined"))
+def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
+              n_rounds: int, mesh: Mesh,
+              state: Optional[swim.SwimState] = None, start_round: int = 0,
+              pipelined: Optional[bool] = None):
+    """models/swim.run, row-sharded over ``mesh``.
+
+    The scan lives *inside* shard_map, so the per-round pmax is the only
+    collective XLA emits and the whole n_rounds loop compiles to one
+    per-device program.  World arrays ([N] ground truth / fault schedule)
+    are replicated — they are O(N) scalars, not O(N·K) state.
+
+    ``pipelined`` (static): ``None`` (default) auto-selects the
+    double-buffered delivery pipeline when the config supports it —
+    scatter mode's round-r inbox pmax is issued against the carried
+    contribution and consumed by round r+1's body, overlapping the ICI
+    transfer with the next round's draw compute (``_pipelined_rounds``;
+    bit-identical to the serial combine).  ``False`` forces the serial
+    in-round combine; ``True`` insists and raises when unsupported.
+
+    Returns (final_state, metrics) with state rows sharded over the mesh
+    and metrics replicated (already psum-combined inside the tick).
+    """
+    axis, n_dev, n_local, state_specs, out_metric_specs = _shard_prelude(
+        params, mesh
+    )
+    use_pipeline = _resolve_pipelined(pipelined, params, world, n_rounds)
+
+    if state is None:
+        state = swim.initial_state(params, world)
     world_specs = jax.tree.map(lambda _: P(), world)
-    metric_spec = P()
 
     def sharded_body(base_key, world, state):
         offset = jax.lax.axis_index(axis) * n_local
+
+        if use_pipeline:
+            final_state, _, metrics = _pipelined_rounds(
+                base_key, params, world, state, n_rounds, start_round,
+                offset, axis, n_dev,
+            )
+            return final_state, metrics
 
         def body(carry, round_idx):
             return swim.swim_tick(
@@ -96,15 +244,6 @@ def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
         rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
         return jax.lax.scan(body, state, rounds)
 
-    metric_names = ["alive", "suspect", "dead", "absent", "false_positives",
-                    "false_suspicion_onsets", "false_suspect_rounds",
-                    "stale_view_rounds",
-                    "messages_gossip", "messages_ping",
-                    "messages_ping_sent", "messages_ping_req_sent",
-                    "refutations"]
-    if params.n_user_gossips > 0:
-        metric_names.append("user_gossip_infected")
-    out_metric_specs = {name: metric_spec for name in metric_names}
     return compat.shard_map(
         sharded_body,
         mesh=mesh,
@@ -114,11 +253,13 @@ def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
     )(base_key, world, state)
 
 
-@partial(jax.jit, static_argnames=("params", "n_rounds", "mesh", "spec"))
+@partial(jax.jit, static_argnames=("params", "n_rounds", "mesh", "spec",
+                                   "pipelined"))
 def shard_run_metered(base_key, params: swim.SwimParams,
                       world: swim.SwimWorld, n_rounds: int, mesh: Mesh,
                       spec=None, state: Optional[swim.SwimState] = None,
-                      start_round: int = 0):
+                      start_round: int = 0,
+                      pipelined: Optional[bool] = None):
     """``shard_run`` with the health-metrics registry carried per device
     and psum-combined across the mesh before offload
     (telemetry/metrics.py; the combine rides
@@ -133,6 +274,11 @@ def shard_run_metered(base_key, params: swim.SwimParams,
     per-round collective beyond what the tick already pays.  Gauges are
     assembled from psum'd numerators and come back replicated.
 
+    ``pipelined``: same contract as :func:`shard_run` — the registry
+    hook observes each round after its (deferred) merge with the same
+    pre-merge state and round index the serial body sees, so the
+    registry totals stay bit-identical too.
+
     Returns ``(final_state, metrics_state, metrics)`` with the state
     rows sharded, the registry and metrics replicated.
     """
@@ -140,25 +286,16 @@ def shard_run_metered(base_key, params: swim.SwimParams,
 
     if spec is None:
         spec = tmetrics.MetricsSpec.default()
-    axis = mesh.axis_names[0]
-    n_dev = mesh.devices.size
-    if params.n_members % n_dev != 0:
-        raise ValueError(
-            f"n_members ({params.n_members}) must divide the mesh size ({n_dev})"
-        )
-    n_local = params.n_members // n_dev
+    axis, n_dev, n_local, state_specs, out_metric_specs = _shard_prelude(
+        params, mesh
+    )
+    use_pipeline = _resolve_pipelined(pipelined, params, world, n_rounds)
     kn = swim.Knobs.from_params(params)
 
     if state is None:
         state = swim.initial_state(params, world)
     ms0 = tmetrics.MetricsState.init(spec)
 
-    state_specs = swim.SwimState(
-        status=P(axis), inc=P(axis), spread_until=P(axis),
-        suspect_deadline=P(axis), self_inc=P(axis),
-        inbox_ring=P(None, axis), flag_ring=P(None, axis),
-        g_infected=P(axis), g_spread_until=P(axis), g_ring=P(None, axis),
-    )
     world_specs = jax.tree.map(lambda _: P(), world)
     ms_specs = jax.tree.map(lambda _: P(), ms0)
 
@@ -166,24 +303,32 @@ def shard_run_metered(base_key, params: swim.SwimParams,
         offset = jax.lax.axis_index(axis) * n_local
         lead = (jax.lax.axis_index(axis) == 0).astype(jnp.int32)
 
-        def body(carry, round_idx):
-            st, ms = carry
-            prev_status = st.status
-            prev_deadline, _ = swim._wide_timer_fields(st, params,
+        def observe(ms, prev_st, round_idx, new_st, m):
+            prev_deadline, _ = swim._wide_timer_fields(prev_st, params,
                                                        round_idx)
-            new_st, m = swim.swim_tick(
-                st, round_idx, base_key, params, world,
-                offset=offset, axis_name=axis, n_devices=n_dev,
-            )
-            ms = tmetrics.observe_tick(
-                ms, spec, params, kn, round_idx, prev_status,
+            return tmetrics.observe_tick(
+                ms, spec, params, kn, round_idx, prev_st.status,
                 prev_deadline, new_st.status, m, world, lead=lead,
             )
-            return (new_st, ms), m
 
-        rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
-        (final_state, ms), metrics = jax.lax.scan(body, (state, ms),
-                                                  rounds)
+        if use_pipeline:
+            final_state, ms, metrics = _pipelined_rounds(
+                base_key, params, world, state, n_rounds, start_round,
+                offset, axis, n_dev, on_round=observe, carry0=ms,
+            )
+        else:
+            def body(carry, round_idx):
+                st, ms = carry
+                new_st, m = swim.swim_tick(
+                    st, round_idx, base_key, params, world,
+                    offset=offset, axis_name=axis, n_devices=n_dev,
+                )
+                ms = observe(ms, st, round_idx, new_st, m)
+                return (new_st, ms), m
+
+            rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
+            (final_state, ms), metrics = jax.lax.scan(body, (state, ms),
+                                                      rounds)
         end = start_round + n_rounds
         _, spread_wide = swim._wide_timer_fields(final_state, params, end)
         alive_here = jax.lax.dynamic_slice_in_dim(
@@ -200,15 +345,6 @@ def shard_run_metered(base_key, params: swim.SwimParams,
         ms = tmetrics.aggregate_across_devices(ms, axis)
         return final_state, ms, metrics
 
-    metric_names = ["alive", "suspect", "dead", "absent", "false_positives",
-                    "false_suspicion_onsets", "false_suspect_rounds",
-                    "stale_view_rounds",
-                    "messages_gossip", "messages_ping",
-                    "messages_ping_sent", "messages_ping_req_sent",
-                    "refutations"]
-    if params.n_user_gossips > 0:
-        metric_names.append("user_gossip_infected")
-    out_metric_specs = {name: P() for name in metric_names}
     return compat.shard_map(
         sharded_body,
         mesh=mesh,

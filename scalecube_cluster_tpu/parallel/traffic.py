@@ -37,6 +37,21 @@ all-reduce sends ``2 * (D-1)/D * size`` per device, i.e. per-device ICI
 bytes are **O(N * K) — constant in D**.  Scatter mode is the validation
 path; at scale the shift path's advantage grows linearly in D.
 
+Pipelined scatter (parallel/mesh._pipelined_rounds)
+---------------------------------------------------
+The default sharded scatter path double-buffers the contribution: round
+r's combine pair is carried into round r+1's scan body and combined
+there, next to r+1's state-independent draw compute.  Per-round
+collective COUNT and BYTES are identical to the serial path (the same
+two buffers cross ICI once per round); what changes is placement — the
+compiled program holds the per-round pair in the loop body (operand: the
+carried buffer) plus one epilogue pair for the final round, so the
+non-tuple full-height all-reduce instruction count doubles
+(``pipelined_scatter_hlo_collectives``) while the per-round wire cost
+(``scatter_ici_bytes_per_device_round``) is unchanged.  The payoff is
+scheduling: XLA may now start the transfer under the next round's
+compute instead of stalling the scan body on it.
+
 DCN note: block rotations are neighbor exchanges on the device ring, so
 on a multi-slice mesh only the rotations that cross a slice boundary pay
 DCN — 2 boundary crossings per exchange regardless of D, giving per-device
@@ -107,6 +122,17 @@ def scatter_collectives_per_round(params) -> int:
     key buffer + the ALIVE-flag buffer; each delay bin doubles that)."""
     bins = params.max_delay_rounds + 1 if params.max_delay_rounds > 0 else 1
     return 2 * bins
+
+
+def pipelined_scatter_hlo_collectives(params) -> int:
+    """Full-height combine instructions in the compiled PIPELINED
+    scatter program: the per-round pair rides the scan body (combining
+    the PREVIOUS round's carried contribution) and the final round's
+    pair runs in the loop epilogue — so the instruction count doubles
+    while per-round collectives (``scatter_collectives_per_round``) and
+    per-round ICI bytes are unchanged.  Pipelining moves the combine,
+    it does not add traffic."""
+    return 2 * scatter_collectives_per_round(params)
 
 
 def scatter_ici_bytes_per_device_round(params, n_devices: int) -> int:

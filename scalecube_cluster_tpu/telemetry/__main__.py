@@ -9,10 +9,12 @@ Three subcommands over the JSONL manifests and BENCH artifacts
                                     time series)
   diff     <a.jsonl> <b.jsonl>      per-SLO/counter/gauge comparison
                                     of two runs
-  regress  [paths/globs ...]        walk a BENCH_*.json trajectory
-                                    (default glob: BENCH_*.json) and
-                                    exit 1 on throughput or SLO
-                                    regressions beyond ``--band``
+  regress  [paths/globs ...]        walk the BENCH_*.json +
+                                    MULTICHIP_*.json trajectories
+                                    (the default globs) and exit 1 on
+                                    throughput or SLO regressions
+                                    beyond ``--band``; legacy stub
+                                    rounds skip as provenance
 
 Exit codes: 0 ok, 1 regression detected (regress), 2 usage/input error
 — stable for CI gating (tests/test_metrics_query.py).
@@ -81,7 +83,8 @@ def _cmd_diff(args) -> int:
 
 
 def _cmd_regress(args) -> int:
-    paths = query.expand_paths(args.paths or ["BENCH_*.json"])
+    paths = query.expand_paths(args.paths
+                               or ["BENCH_*.json", "MULTICHIP_*.json"])
     readable = [p for p in paths if os.path.exists(p)]
     if not readable:
         print("regress: no artifacts matched", file=sys.stderr)
@@ -120,9 +123,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.set_defaults(fn=_cmd_diff)
 
     p = sub.add_parser(
-        "regress", help="fail on regressions along a BENCH trajectory")
+        "regress",
+        help="fail on regressions along the BENCH/MULTICHIP trajectories")
     p.add_argument("paths", nargs="*",
-                   help="artifact files/globs (default: BENCH_*.json)")
+                   help="artifact files/globs (default: BENCH_*.json "
+                        "MULTICHIP_*.json)")
     p.add_argument("--band", type=float, default=query.DEFAULT_NOISE_BAND,
                    help="relative noise band (default 0.10)")
     p.add_argument("--json", action="store_true")

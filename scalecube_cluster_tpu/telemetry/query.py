@@ -17,7 +17,9 @@ SLOs — the paper's headline guarantees as numbers —
     fraction-informed curve when present),
 
 and compares runs: :func:`diff_reports` for two manifests,
-:func:`regress` for a BENCH_*.json trajectory with a noise band —
+:func:`regress` for the BENCH_*.json + MULTICHIP_*.json trajectories
+with a noise band (single-chip and multichip per-chip throughput gate
+as independent series; legacy MULTICHIP stubs skip as provenance) —
 the regression gate ``python -m scalecube_cluster_tpu.telemetry
 regress`` runs in CI (tests/test_metrics_query.py pins it against the
 committed BENCH_r01..r05 series).
@@ -278,51 +280,78 @@ def format_table(rows: List[dict], headers: Sequence[str]) -> str:
 # --------------------------------------------------------------------------
 
 
-def load_bench_payload(path: str) -> Optional[dict]:
-    """One BENCH artifact's measurement payload, or None when the run
-    recorded a failure (rc != 0 / parsed null) — skipped, not fatal:
-    the committed trajectory keeps failed rounds as provenance."""
+def load_bench_payload(path: str) -> Tuple[Optional[dict], Optional[str]]:
+    """One BENCH/MULTICHIP artifact's measurement payload as
+    ``(payload, skip_note)``.
+
+    ``payload`` is None — with the reason in ``skip_note`` — when the
+    round recorded a failure (rc != 0 / parsed null) or is a legacy
+    stub with no measurement fields (the MULTICHIP_r01..r05
+    ``{"rc":0,"ok":true}`` era): both are kept in the committed
+    trajectory as provenance and skipped, never failed."""
     with open(path) as f:
         doc = json.load(f)
     if "parsed" in doc or "rc" in doc:
         if doc.get("rc") not in (0, None):
-            return None
+            return None, "failed run (skipped)"
         payload = doc.get("parsed")
+        stub_note = "legacy stub round — no measurement payload (skipped)"
     else:
         payload = doc
+        stub_note = "no measurement fields (skipped)"
     if not isinstance(payload, dict) or payload.get("value") is None:
         if not (isinstance(payload, dict)
                 and ("traced_overhead_ratio" in payload
-                     or "metrics_overhead_ratio" in payload)):
-            return None
-    return payload
+                     or "metrics_overhead_ratio" in payload
+                     or "pipelined_speedup_ratio" in payload)):
+            return None, stub_note
+    return payload, None
 
 
 def regress(paths: Sequence[str],
             band: float = DEFAULT_NOISE_BAND) -> Tuple[bool, List[dict]]:
-    """Walk a BENCH_*.json trajectory (sorted by filename = round
-    order); the LATEST measurement of each tracked metric must not
-    regress beyond the noise band against the best prior value.
+    """Walk a BENCH_*.json / MULTICHIP_*.json trajectory (sorted by
+    filename = round order); the LATEST measurement of each tracked
+    metric must not regress beyond the noise band against the best
+    prior value.  Artifacts group into series by their ``metric``
+    field, so the single-chip and multichip per-chip trajectories gate
+    independently in one walk.
 
     Checks:
-      - throughput (``value`` of the headline metric): latest must be
-        >= best_prior * (1 - band);
+      - throughput (``value`` of each headline metric — including the
+        multichip per-chip rate): latest must be >= best_prior *
+        (1 - band).  Rounds marked ``"smoke": true`` are excluded from
+        this comparison (recorded as skipped rows): a smoke window's
+        absolute rate depends on whatever host/load ran it, so only
+        real bench rounds form the throughput trajectory — smoke
+        rounds still contribute their machine-independent ratio
+        checks below;
       - ``dissemination_rounds``: latest must be <= best_prior *
         (1 + band) + 1 quantization round;
       - overhead ratios (``traced_overhead_ratio``,
         ``metrics_overhead_ratio``): latest must be <= 1 + band
-        (absolute — 1.0 means the observability plane is free).
+        (absolute — 1.0 means the observability plane is free);
+      - ``pipelined_speedup_ratio`` (multichip pipelined/serial rate):
+        latest must be >= 1 - band — the delivery pipeline must never
+        cost throughput.
 
     Returns (ok, check rows); each row {"check", "latest", "reference",
-    "threshold", "ok", "source"}.  Unreadable/failed artifacts are
-    reported as skipped rows (ok=None) — a failed bench round is
-    provenance, not a regression.
+    "threshold", "ok", "source"}.  Unreadable/failed artifacts — and
+    the legacy MULTICHIP stub rounds that carry no throughput fields —
+    are reported as skipped rows (ok=None): provenance, not a
+    regression.
     """
     rows: List[dict] = []
     series: Dict[str, List[Tuple[str, dict]]] = {}
-    for path in sorted(paths):
+    # Round order is carried by the FILENAME (BENCH_r01 < BENCH_r02...):
+    # sort on basenames so an artifact passed by absolute path (the
+    # bench gates the one it just wrote, often under a tmp dir) still
+    # lands at its round position instead of wherever its directory
+    # happens to sort — '/tmp/...' < 'MULTICHIP_r06.json' would have
+    # made the stale committed round the "latest" one.
+    for path in sorted(paths, key=lambda p: (os.path.basename(p), p)):
         try:
-            payload = load_bench_payload(path)
+            payload, skip_note = load_bench_payload(path)
         except (OSError, json.JSONDecodeError) as e:
             rows.append({"check": "load", "source": os.path.basename(path),
                          "ok": None,
@@ -330,7 +359,7 @@ def regress(paths: Sequence[str],
             continue
         if payload is None:
             rows.append({"check": "load", "source": os.path.basename(path),
-                         "ok": None, "note": "failed run (skipped)"})
+                         "ok": None, "note": skip_note})
             continue
         metric = payload.get("metric", "unknown")
         series.setdefault(metric, []).append((path, payload))
@@ -346,7 +375,16 @@ def regress(paths: Sequence[str],
 
     for metric, entries in sorted(series.items()):
         values = [(p, pl["value"]) for p, pl in entries
-                  if isinstance(pl.get("value"), (int, float))]
+                  if isinstance(pl.get("value"), (int, float))
+                  and not pl.get("smoke")]
+        for p, pl in entries:
+            if isinstance(pl.get("value"), (int, float)) and pl.get("smoke"):
+                rows.append({
+                    "check": f"throughput/{metric}",
+                    "source": os.path.basename(p), "ok": None,
+                    "note": "smoke round — host-dependent rate, not a "
+                            "trajectory datum (ratio checks still apply)",
+                })
         if len(values) >= 2:
             *prior, (last_path, last) = values
             best = max(v for _, v in prior)
@@ -369,6 +407,17 @@ def regress(paths: Sequence[str],
                 limit = 1.0 + band
                 check(f"slo/{ratio_key}", last_path, last, 1.0, limit,
                       last <= limit and math.isfinite(last))
+        # The delivery pipeline's floor: pipelined must not run slower
+        # than the serial combine beyond noise (ratio = pipelined/serial,
+        # >= 1 means the overlap pays).
+        speedups = [(p, pl["pipelined_speedup_ratio"]) for p, pl in entries
+                    if isinstance(pl.get("pipelined_speedup_ratio"),
+                                  (int, float))]
+        if speedups:
+            last_path, last = speedups[-1]
+            floor = 1.0 - band
+            check("slo/pipelined_speedup_ratio", last_path, last, 1.0,
+                  floor, last >= floor and math.isfinite(last))
     return ok, rows
 
 

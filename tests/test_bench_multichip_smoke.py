@@ -1,0 +1,100 @@
+"""bench.py --multichip --smoke: the multichip throughput JSON contract.
+
+Like tests/test_bench_metrics_smoke.py for the health plane: the bench
+is the one entry point the per-chip measurements flow through, so this
+tier-1 test runs the real script in a subprocess (CPU, virtual 8-device
+mesh) and pins the published contract — one JSON line with REAL
+per-chip throughput fields (never a ``{"rc":0,"ok":true}`` stub), the
+mesh shape, a finite pipelined-vs-serial ratio over both measured
+rates, the bit-identity probe, a MULTICHIP_*-style artifact, and the
+regress gate walking it.
+"""
+
+import json
+import math
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.multichip
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_bench_multichip_smoke_contract(tmp_path):
+    artifact = tmp_path / "MULTICHIP_smoke.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_TPU_TELEMETRY_DIR=str(tmp_path),
+        SCALECUBE_MULTICHIP_ARTIFACT=str(artifact),
+        SCALECUBE_XLA_CACHE_DIR="",           # no cache writes from tests
+    )
+    env.pop("SCALECUBE_TPU_PROFILE_DIR", None)
+    # The subprocess must size its own virtual mesh (conftest's 8-device
+    # XLA_FLAGS hack applies to THIS process, not children).
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--multichip", "--smoke"],
+        capture_output=True, text=True, timeout=540, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout      # exactly ONE JSON line
+    result = json.loads(lines[0])
+
+    assert "error" not in result, result
+    assert result["smoke"] is True
+    assert result["metric"] == "swim_multichip_member_rounds_per_sec_per_chip"
+
+    # A real mesh, never a silently-truncated one.
+    assert result["n_devices"] >= 2
+    assert result["mesh_shape"] == [result["n_devices"]]
+    assert result["n_members"] % result["n_devices"] == 0
+    assert result["delivery"] == "scatter"
+
+    # Real throughput fields (the stub-replacement contract): both paths
+    # measured, ratio consistent and finite.  No floor on the ratio here
+    # (a loaded CI box can skew one smoke window); the committed
+    # MULTICHIP_r06.json records the pinned >= 1.0 measurement and the
+    # regress gate bounds future ones at 1 - band.
+    pipelined = result["pipelined_member_rounds_per_sec_per_chip"]
+    serial = result["serial_member_rounds_per_sec_per_chip"]
+    ratio = result["pipelined_speedup_ratio"]
+    assert pipelined > 0 and serial > 0
+    assert math.isfinite(ratio) and ratio > 0
+    assert ratio == pytest.approx(pipelined / serial, rel=1e-3)
+    assert result["value"] == pipelined
+    assert result["rounds_timed"] > 0
+    assert result["ici_bytes_per_device_round"] > 0
+
+    # The scheduling change is semantics-free: the in-bench parity probe
+    # must agree with what tests/test_pipelined_delivery.py pins.
+    assert result["bit_identical"] is True
+
+    # The artifact round-trips and carries the same measurement —
+    # loadable by the query layer as a real (non-stub) payload.
+    art = json.loads(artifact.read_text())
+    assert art["metric"] == result["metric"]
+    assert art["pipelined_speedup_ratio"] == ratio
+    assert art["value"] == pipelined
+
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    payload, skip_note = tquery.load_bench_payload(str(artifact))
+    assert skip_note is None
+    assert payload["value"] == pipelined
+
+    # The in-bench regress gate ran over the BENCH + MULTICHIP
+    # trajectories (wired-in loud failure for future regressions) and
+    # the fresh artifact's ratio check is present and green.
+    assert result["regress"]["ok"] is True
+    assert result["regress"]["artifacts"] >= 1
+    ok, rows = tquery.regress([str(artifact)])
+    ratio_rows = [r for r in rows
+                  if r.get("check") == "slo/pipelined_speedup_ratio"]
+    assert len(ratio_rows) == 1

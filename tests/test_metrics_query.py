@@ -212,6 +212,132 @@ def test_regress_overhead_ratio_gate(tmp_path):
     assert bad["check"] == "slo/traced_overhead_ratio"
 
 
+def committed_multichip_paths():
+    paths = sorted(str(p) for p in REPO.glob("MULTICHIP_r0*.json"))
+    assert len(paths) >= 6, "committed MULTICHIP series missing"
+    return paths
+
+
+def test_regress_walks_multichip_trajectory():
+    """BENCH + MULTICHIP gate in ONE walk: the legacy r01..r05 stubs
+    ({"rc":0,"ok":true}, no throughput fields) skip as provenance, the
+    real r06 artifact contributes the per-chip value and the
+    pipelined-speedup floor check."""
+    ok, rows = query.regress(committed_bench_paths()
+                             + committed_multichip_paths())
+    assert ok, rows
+    stubs = [r for r in rows
+             if r.get("ok") is None and r["check"] == "load"]
+    assert sum(1 for r in stubs if "MULTICHIP_r0" in r["source"]) >= 5
+    assert all("stub" in r["note"] for r in stubs
+               if "MULTICHIP_r0" in r["source"])
+    checks = [r for r in rows if r.get("ok") is not None]
+    (speedup,) = [r for r in checks
+                  if r["check"] == "slo/pipelined_speedup_ratio"]
+    assert speedup["ok"] and "MULTICHIP_r06" in speedup["source"]
+
+
+def test_regress_multichip_throughput_drop_fails(tmp_path):
+    """A future multichip round regressing per-chip throughput beyond
+    the band fails loudly — same trajectory discipline as BENCH."""
+    for p in committed_multichip_paths():
+        shutil.copy(p, tmp_path)
+    with open(tmp_path / "MULTICHIP_r06.json") as f:
+        real = json.load(f)
+    # Future real rounds are non-smoke (driver bench on the pinned
+    # host) — only those form the throughput trajectory.
+    real.pop("smoke", None)
+    with open(tmp_path / "MULTICHIP_r06.json", "w") as f:
+        json.dump(real, f)
+    worse = dict(real, value=round(real["value"] * 0.8, 1))
+    with open(tmp_path / "MULTICHIP_r07.json", "w") as f:
+        json.dump(worse, f)
+    ok, rows = query.regress(
+        sorted(str(p) for p in tmp_path.glob("MULTICHIP_*.json")))
+    assert not ok
+    bad = [r for r in rows if r.get("ok") is False]
+    assert len(bad) == 1
+    assert bad[0]["check"].startswith("throughput/swim_multichip")
+    assert "MULTICHIP_r07" in bad[0]["source"]
+
+
+def test_regress_smoke_rounds_skip_throughput_gate(tmp_path):
+    """A smoke round's absolute rate reflects whatever host/load ran
+    it — it neither gates nor anchors the throughput trajectory
+    (skipped provenance row), while its machine-independent ratio
+    checks still run.  This is what keeps bench --multichip --smoke's
+    in-bench gate green on a loaded or differently-sized CI box."""
+    base = {"metric": "swim_multichip_member_rounds_per_sec_per_chip"}
+    with open(tmp_path / "MULTICHIP_r06.json", "w") as f:
+        json.dump(dict(base, value=100.0), f)
+    with open(tmp_path / "MULTICHIP_r07.json", "w") as f:
+        json.dump(dict(base, value=50.0, smoke=True,
+                       pipelined_speedup_ratio=1.2), f)
+    ok, rows = query.regress(
+        sorted(str(p) for p in tmp_path.glob("MULTICHIP_*.json")))
+    assert ok, rows   # the 2x throughput drop is a smoke round: skipped
+    (skip,) = [r for r in rows if r.get("ok") is None]
+    assert "MULTICHIP_r07" in skip["source"] and "smoke" in skip["note"]
+    (speedup,) = [r for r in rows
+                  if r["check"] == "slo/pipelined_speedup_ratio"]
+    assert speedup["ok"] and "MULTICHIP_r07" in speedup["source"]
+    # A non-smoke round with the same drop DOES gate.
+    with open(tmp_path / "MULTICHIP_r08.json", "w") as f:
+        json.dump(dict(base, value=50.0), f)
+    ok, rows = query.regress(
+        sorted(str(p) for p in tmp_path.glob("MULTICHIP_*.json")))
+    assert not ok
+    (bad,) = [r for r in rows if r.get("ok") is False]
+    assert "MULTICHIP_r08" in bad["source"]
+
+
+def test_regress_orders_by_basename_not_directory(tmp_path):
+    """bench.py gates the artifact it just wrote by (often absolute,
+    tmp-dir) path: round order must come from the FILENAME, or the
+    fresh round would sort before the committed ones and be compared
+    as a prior instead of as the latest."""
+    sub = tmp_path / "aaa-sorts-first"
+    sub.mkdir()
+    base = {"metric": "swim_multichip_member_rounds_per_sec_per_chip"}
+    with open(tmp_path / "MULTICHIP_r06.json", "w") as f:
+        json.dump(dict(base, value=100.0), f)
+    with open(sub / "MULTICHIP_r07.json", "w") as f:
+        json.dump(dict(base, value=50.0), f)
+    ok, rows = query.regress([str(tmp_path / "MULTICHIP_r06.json"),
+                              str(sub / "MULTICHIP_r07.json")])
+    assert not ok
+    (bad,) = [r for r in rows if r.get("ok") is False]
+    assert "MULTICHIP_r07" in bad["source"], rows
+
+
+def test_regress_pipelined_speedup_floor(tmp_path):
+    """pipelined/serial below 1 - band = the pipeline costs throughput
+    somewhere — gate it like the overhead ratios, direction flipped."""
+    art = tmp_path / "MULTICHIP_slow.json"
+    with open(art, "w") as f:
+        json.dump({"metric": "swim_multichip_member_rounds_per_sec_per_chip",
+                   "value": 100.0, "pipelined_speedup_ratio": 0.85}, f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    (bad,) = [r for r in rows if r.get("ok") is False]
+    assert bad["check"] == "slo/pipelined_speedup_ratio"
+    ok, rows = query.regress([str(art)], band=0.2)  # inside a wider band
+    assert ok, rows
+
+
+def test_cli_regress_default_globs_include_multichip(tmp_path, capsys,
+                                                     monkeypatch):
+    """Bare ``regress`` walks BENCH_*.json AND MULTICHIP_*.json from
+    the working directory — the committed repo trajectory passes."""
+    monkeypatch.chdir(REPO)
+    assert cli_main(["regress", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] is True
+    sources = {r.get("source") for r in out["checks"]}
+    assert any(s and s.startswith("MULTICHIP_") for s in sources)
+    assert any(s and s.startswith("BENCH_") for s in sources)
+
+
 def test_cli_regress_exit_codes(tmp_path, capsys):
     assert cli_main(["regress", str(REPO / "BENCH_r0*.json")]) == 0
     capsys.readouterr()

@@ -1,0 +1,220 @@
+"""Pipelined ICI delivery (parallel/mesh._pipelined_rounds): the
+double-buffered cross-device inbox combine must be a pure SCHEDULING
+change — bit-identical protocol output vs the serial in-round combine
+on a fixed mesh, across layouts, run shapes, and fault schedules.
+
+The HLO-placement facts (combine pair carried into the next loop body,
+async start/done overlap on TPU lowerings) are pinned in
+tests/test_traffic.py; this file pins semantics.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.parallel import compat
+from scalecube_cluster_tpu.parallel import mesh as pmesh
+
+from tests.test_swim_model import fast_config
+
+pytestmark = pytest.mark.skipif(not compat.HAS_SHARD_MAP,
+                                reason=compat.SKIP_REASON)
+
+
+def make(n, k=None, loss=0.0, **overrides):
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, n_subjects=k, loss_probability=loss,
+        **overrides,
+    )
+    return params, swim.SwimWorld.healthy(params)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    return pmesh.make_mesh(8)
+
+
+def assert_states_equal(a, b, msg=""):
+    for field in dataclasses.fields(a):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field.name)),
+            np.asarray(getattr(b, field.name)),
+            err_msg=f"{msg}: state field {field.name} diverged",
+        )
+
+
+def assert_runs_identical(params, world, mesh, n_rounds, key_seed=0,
+                          start_round=0, msg=""):
+    key = jax.random.key(key_seed)
+    f_ser, m_ser = pmesh.shard_run(key, params, world, n_rounds, mesh,
+                                   start_round=start_round, pipelined=False)
+    f_pip, m_pip = pmesh.shard_run(key, params, world, n_rounds, mesh,
+                                   start_round=start_round, pipelined=True)
+    assert_states_equal(f_ser, f_pip, msg=msg)
+    assert set(m_ser) == set(m_pip)
+    for name in m_ser:
+        np.testing.assert_array_equal(
+            np.asarray(m_ser[name]), np.asarray(m_pip[name]),
+            err_msg=f"{msg}: metric {name} diverged",
+        )
+
+
+class TestBitIdenticalParity:
+    def test_fullview_crash_revive_loss(self, mesh8):
+        params, world = make(64, loss=0.15)
+        world = world.with_crash(5, at_round=4, until_round=60)
+        assert_runs_identical(params, world, mesh8, 100,
+                              msg="full-view crash/revive")
+
+    def test_focal_mode(self, mesh8):
+        """The 1M-member sharded configuration in miniature: K << N,
+        cluster-uniform probing."""
+        params, world = make(512, k=8, ping_known_only=False, loss=0.05)
+        world = world.with_crash(2, at_round=0)
+        assert_runs_identical(params, world, mesh8, 120, key_seed=1,
+                              msg="focal")
+
+    @pytest.mark.parametrize("layout", ["int16_wire", "compact_carry"])
+    def test_compact_layouts(self, mesh8, layout):
+        """The int16 wire (and the re-relativized compact carry) must
+        survive the extra round the pending buffers spend in the scan
+        carry without dtype promotion or encode drift."""
+        params, world = make(64, loss=0.1, **{layout: True})
+        world = world.with_crash(5, at_round=4, until_round=60)
+        assert_runs_identical(params, world, mesh8, 90, key_seed=2,
+                              msg=layout)
+
+    def test_user_gossips_ride_pipeline(self, mesh8):
+        """User-gossip infection bits share the carried contribution;
+        their delivery round (and so the infection curve) must not
+        shift by the deferred combine."""
+        params, world = make(64, n_user_gossips=3)
+        world = world.with_spread(0, origin=3, at_round=2)
+        world = world.with_spread(1, origin=9, at_round=5)
+        assert_runs_identical(params, world, mesh8, 60, key_seed=3,
+                              msg="user gossip")
+
+    def test_leave_and_partition(self, mesh8):
+        params, world = make(64, loss=0.05)
+        world = world.with_leave(7, at_round=6)
+        world = world.with_partition_schedule(
+            [[0] * 32 + [1] * 32, [0] * 64], phase_rounds=10
+        )
+        assert_runs_identical(params, world, mesh8, 80, key_seed=4,
+                              msg="leave+partition")
+
+    def test_single_round_window(self, mesh8):
+        """n_rounds=1 runs prologue + epilogue with an empty scan —
+        the resume-loop edge (segmented supervisors step one window at
+        a time)."""
+        params, world = make(32)
+        assert_runs_identical(params, world, mesh8, 1, key_seed=5,
+                              msg="one round")
+
+    def test_nonzero_start_round_resume(self, mesh8):
+        """Windowed execution: running [0, 30) then [30, 60) pipelined
+        must equal one serial [0, 60) window (the checkpoint-resume
+        contract under the pipeline)."""
+        params, world = make(32, loss=0.1)
+        world = world.with_crash(3, at_round=10, until_round=45)
+        key = jax.random.key(6)
+        f_ser, _ = pmesh.shard_run(key, params, world, 60, mesh8,
+                                   pipelined=False)
+        mid, _ = pmesh.shard_run(key, params, world, 30, mesh8,
+                                 pipelined=True)
+        f_pip, _ = pmesh.shard_run(key, params, world, 30, mesh8,
+                                   state=mid, start_round=30,
+                                   pipelined=True)
+        assert_states_equal(f_ser, f_pip, msg="resume")
+
+
+class TestMeteredParity:
+    def test_metered_registry_identical(self, mesh8):
+        """shard_run_metered through the pipeline: per-round metrics AND
+        the psum-combined registry must match the serial twin exactly
+        (the observe hook sees the same pre-merge state per round)."""
+        from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+
+        params, world = make(64, loss=0.1)
+        world = world.with_crash(5, at_round=4, until_round=60)
+        spec = tmetrics.MetricsSpec.default()
+        key = jax.random.key(7)
+        f_ser, ms_ser, m_ser = pmesh.shard_run_metered(
+            key, params, world, 90, mesh8, spec=spec, pipelined=False
+        )
+        f_pip, ms_pip, m_pip = pmesh.shard_run_metered(
+            key, params, world, 90, mesh8, spec=spec, pipelined=True
+        )
+        assert_states_equal(f_ser, f_pip, msg="metered")
+        for name in m_ser:
+            np.testing.assert_array_equal(
+                np.asarray(m_ser[name]), np.asarray(m_pip[name]),
+                err_msg=f"metered metric {name}",
+            )
+        for leaf_s, leaf_p in zip(jax.tree.leaves(ms_ser),
+                                  jax.tree.leaves(ms_pip)):
+            np.testing.assert_array_equal(
+                np.asarray(leaf_s), np.asarray(leaf_p),
+                err_msg="metered registry diverged",
+            )
+
+
+class TestResolutionAndGuards:
+    def test_auto_resolution_shift_falls_back(self, mesh8):
+        """pipelined=None on a shift config silently runs the serial
+        path (shift's ppermutes are already per-channel)."""
+        params, world = make(64, delivery="shift")
+        _, m = pmesh.shard_run(jax.random.key(8), params, world, 20, mesh8)
+        assert np.asarray(m["alive"]).shape[0] == 20
+
+    def test_pipelined_true_on_shift_raises(self, mesh8):
+        params, world = make(64, delivery="shift")
+        with pytest.raises(NotImplementedError, match="pipelined delivery"):
+            pmesh.shard_run(jax.random.key(9), params, world, 20, mesh8,
+                            pipelined=True)
+
+    def test_pipelined_true_on_delay_rings_raises(self, mesh8):
+        params, world = make(64, max_delay_rounds=2)
+        with pytest.raises(NotImplementedError, match="delay"):
+            pmesh.shard_run(jax.random.key(10), params, world, 20, mesh8,
+                            pipelined=True)
+
+    def test_seed_gated_fullview_falls_back(self, mesh8):
+        """Configured seeds enable the in-round anti-entropy round trip
+        — auto-resolution must fall back to serial, and the run still
+        work."""
+        params, world = make(64)
+        world = world.with_seeds([0, 1])
+        _, m = pmesh.shard_run(jax.random.key(11), params, world, 20, mesh8)
+        assert np.asarray(m["alive"]).shape[0] == 20
+        with pytest.raises(NotImplementedError, match="anti-entropy"):
+            pmesh.shard_run(jax.random.key(11), params, world, 20, mesh8,
+                            pipelined=True)
+
+    def test_make_mesh_too_few_devices_raises(self):
+        n_avail = len(jax.devices())
+        with pytest.raises(ValueError, match="requested"):
+            pmesh.make_mesh(n_avail + 1)
+
+    def test_make_mesh_all_devices_default(self):
+        mesh = pmesh.make_mesh()
+        assert mesh.devices.size == len(jax.devices())
+
+
+@pytest.mark.slow
+@pytest.mark.multichip
+class TestMeshSweepSlow:
+    """The scale ladder over the full virtual mesh: parity at every
+    rung (the cheap CI shadow of experiments/multichip_sweep.py, which
+    sweeps real meshes past the pinned single-chip ceiling)."""
+
+    @pytest.mark.parametrize("n,k", [(1024, 8), (4096, 8), (8192, 16)])
+    def test_ladder_parity(self, mesh8, n, k):
+        params, world = make(n, k=k, ping_known_only=False, loss=0.02)
+        world = world.with_crash(2, at_round=0)
+        assert_runs_identical(params, world, mesh8, 60, key_seed=12,
+                              msg=f"ladder {n}x{k}")

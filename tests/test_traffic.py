@@ -36,12 +36,12 @@ _DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
                 "s32": 4, "u32": 4, "f32": 4}
 
 
-def _compiled_hlo(params, world, n_rounds=4):
+def _compiled_hlo(params, world, n_rounds=4, pipelined=False):
     mesh = pmesh.make_mesh(N_DEV)
     state = swim.initial_state(params, world)
     return pmesh.shard_run.lower(
         jax.random.key(0), params, world, n_rounds, mesh,
-        state=state, start_round=0,
+        state=state, start_round=0, pipelined=pipelined,
     ).compile().as_text()
 
 
@@ -143,6 +143,89 @@ def test_scatter_hlo_collectives_match_traffic_model(compact):
         traffic.scatter_ici_bytes_per_device_round(params, N_DEV)
     )
     assert _op_operand_bytes(hlo, "collective-permute") == []
+
+
+@hlo_pinned
+@pytest.mark.parametrize("compact", [False, True])
+def test_pipelined_scatter_hlo_collectives_match_traffic_model(compact):
+    """The PIPELINED scatter program doubles the combine instruction
+    count (loop-body pair over the carried contribution + epilogue pair
+    for the final round) without adding per-round traffic — the
+    placement move is visible in the compiled text exactly as
+    traffic.pipelined_scatter_hlo_collectives models it."""
+    n, k = 256, 16
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, n_subjects=k, delivery="scatter",
+        compact_carry=compact,
+    )
+    world = swim.SwimWorld.healthy(params)
+    hlo = _compiled_hlo(params, world, pipelined=True)
+
+    ars = _op_operand_bytes(hlo, "all-reduce")
+    assert len(ars) == traffic.pipelined_scatter_hlo_collectives(params)
+    dims = sorted(d for _, d, _ in ars)
+    assert dims == [f"{n},{k}"] * 4
+    key_dtypes = {t for t, _, _ in ars}
+    assert key_dtypes == ({"s16", "s8"} if compact else {"s32", "s8"})
+    # Per-ROUND bytes are the serial figure — half the instructions run
+    # per iteration, the other half once at the epilogue.
+    loop_pair_bytes = sum(b for _, _, b in ars) // 2
+    assert int(2 * (N_DEV - 1) / N_DEV * loop_pair_bytes) == (
+        traffic.scatter_ici_bytes_per_device_round(params, N_DEV)
+    )
+    assert _op_operand_bytes(hlo, "collective-permute") == []
+
+
+def test_pipelined_combine_count_doubles_lowering_neutral():
+    """Lowering-neutral version of the instruction-count pin (runs on
+    the legacy per-psum lowering too): counting ONLY the full-height
+    [N, K] combines — metric psums are [K]/scalar shaped — the
+    pipelined program holds exactly twice the serial count, the
+    loop-body pair plus the epilogue pair."""
+    n, k = 256, 16
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, n_subjects=k, delivery="scatter",
+    )
+    world = swim.SwimWorld.healthy(params)
+
+    def full_height_combines(pipelined):
+        hlo = _compiled_hlo(params, world, pipelined=pipelined)
+        return [x for x in _op_operand_bytes(hlo, "all-reduce")
+                if x[1] == f"{n},{k}"]
+
+    serial = full_height_combines(False)
+    pipelined = full_height_combines(True)
+    assert len(serial) == traffic.scatter_collectives_per_round(params)
+    assert len(pipelined) == traffic.pipelined_scatter_hlo_collectives(params)
+    assert len(pipelined) == 2 * len(serial)
+
+
+def test_pipelined_async_collective_overlap():
+    """On backends that lower collectives to async start/done pairs
+    (TPU), the pipelined body must hold compute between a combine's
+    start and done — the overlap the pipeline exists for.  CPU lowers
+    collectives synchronously; skip there, like the other
+    lowering-specific pins."""
+    n, k = 256, 16
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, n_subjects=k, delivery="scatter",
+    )
+    world = swim.SwimWorld.healthy(params)
+    hlo = _compiled_hlo(params, world, pipelined=True)
+    if "all-reduce-start" not in hlo:
+        pytest.skip("backend lowers collectives synchronously "
+                    "(no all-reduce-start/done pairs in the compiled text)")
+    starts = [m.start() for m in re.finditer(r"all-reduce-start", hlo)]
+    dones = [m.start() for m in re.finditer(r"all-reduce-done", hlo)]
+    assert starts and len(starts) == len(dones)
+    # At least one start/done pair brackets real compute: the text
+    # between them contains non-collective instructions (the next
+    # round's draw pipeline the scheduler slid under the transfer).
+    overlapped = any(
+        len(hlo[s:d].splitlines()) > 2
+        for s, d in zip(starts, dones) if d > s
+    )
+    assert overlapped, "no compute scheduled between start/done pairs"
 
 
 def _tick_once(params, world, axis_name=None):
