@@ -83,6 +83,22 @@ overrides: SCALECUBE_RESILIENCE_N, SCALECUBE_RESILIENCE_ROUNDS,
 SCALECUBE_RESILIENCE_SEGMENT, SCALECUBE_RESILIENCE_KILLS,
 SCALECUBE_RESILIENCE_SEED, SCALECUBE_RESILIENCE_SHAPES (comma list).
 
+``--sync``: the partition-heal workload — the SYNC anti-entropy plane
+(models/sync.py) measured for its headline robustness claim: after a
+quiesced RollingPartition split, the plane re-converges every live
+membership table within a bounded window (``sync_rounds_to_converge``),
+while the gossip-only control demonstrably never does.  Two arms: a
+monitored chaos-campaign-scale heal (POST_HEAL_DIVERGENCE must be 0)
+and the focal-shift 1M-shape scale arm probed for the first
+divergence-free table.  Writes an ``artifacts/sync_heal.json``-style
+artifact the ``telemetry regress`` gate walks (absolute convergence
+gates + banded convergence-time series).  ``--sync --smoke`` is the
+tier-1-safe pass pinned by tests/test_bench_sync_smoke.py.  Env
+overrides: SCALECUBE_SYNC_N, SCALECUBE_SYNC_SUBJECTS,
+SCALECUBE_SYNC_INTERVAL, SCALECUBE_SYNC_PROBE_STEP,
+SCALECUBE_SYNC_MONITOR_N, SCALECUBE_SYNC_SEED,
+SCALECUBE_SYNC_ARTIFACT.
+
 Env overrides for debugging: SCALECUBE_BENCH_N, SCALECUBE_BENCH_ROUNDS,
 SCALECUBE_BENCH_DELIVERY, SCALECUBE_BENCH_SKIP_CANARY,
 SCALECUBE_BENCH_COMPACT (=1: the capacity-oriented compact carry layout,
@@ -616,6 +632,28 @@ def write_telemetry(scenario, main_metrics):
     return sink.path
 
 
+def apply_regress_gate(result, patterns):
+    """The in-bench cross-run regression gate, shared by --metrics /
+    --multichip / --sync (the same check ``python -m
+    scalecube_cluster_tpu.telemetry regress`` serves): walk the given
+    artifact files/globs and report the verdict in
+    ``result["regress"]`` — a regression is reported in the JSON line,
+    it never voids the measurement (never-ship-empty)."""
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    gate_paths = [p for p in tquery.expand_paths(patterns)
+                  if os.path.exists(p)]
+    ok, checks = tquery.regress(gate_paths)
+    failed = [c for c in checks if c.get("ok") is False]
+    log(f"regress gate over {len(gate_paths)} artifacts: "
+        f"{'PASS' if ok else 'REGRESSION ' + json.dumps(failed)}")
+    result["regress"] = {
+        "ok": ok,
+        "artifacts": len(gate_paths),
+        "failed_checks": failed,
+    }
+
+
 def run_chaos_campaign():
     """The --chaos mode: a seeded generated-scenario campaign through
     the in-jit invariant monitor, one JSON line out (the same
@@ -879,22 +917,7 @@ def run_metrics_bench():
         result["artifact"] = artifact
         log(f"metrics-overhead artifact written to {artifact}")
 
-        # The cross-run regression gate over the committed BENCH
-        # trajectory + the artifact just written (the same check
-        # `python -m scalecube_cluster_tpu.telemetry regress` serves):
-        # a throughput/SLO regression is reported in the JSON line, it
-        # does not void the measurement (never-ship-empty).
-        gate_paths = tquery.expand_paths(["BENCH_*.json", artifact])
-        gate_paths = [p for p in gate_paths if os.path.exists(p)]
-        ok, checks = tquery.regress(gate_paths)
-        failed = [c for c in checks if c.get("ok") is False]
-        log(f"regress gate over {len(gate_paths)} artifacts: "
-            f"{'PASS' if ok else 'REGRESSION ' + json.dumps(failed)}")
-        result["regress"] = {
-            "ok": ok,
-            "artifacts": len(gate_paths),
-            "failed_checks": failed,
-        }
+        apply_regress_gate(result, ["BENCH_*.json", artifact])
     except BaseException as e:  # noqa: BLE001 — partial result by contract
         log(traceback.format_exc())
         result["error"] = f"{type(e).__name__}: {e}"
@@ -1059,24 +1082,237 @@ def run_multichip_bench():
         result["artifact"] = artifact
         log(f"multichip artifact written to {artifact}")
 
-        # The cross-run regression gate over BOTH committed
-        # trajectories + the artifact just written — a multichip
-        # regression is reported in the JSON line, it does not void
-        # the measurement (never-ship-empty).
-        from scalecube_cluster_tpu.telemetry import query as tquery
+        apply_regress_gate(
+            result, ["BENCH_*.json", "MULTICHIP_*.json", artifact])
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
 
-        gate_paths = tquery.expand_paths(
-            ["BENCH_*.json", "MULTICHIP_*.json", artifact])
-        gate_paths = [p for p in gate_paths if os.path.exists(p)]
-        ok, checks = tquery.regress(gate_paths)
-        failed = [c for c in checks if c.get("ok") is False]
-        log(f"regress gate over {len(gate_paths)} artifacts: "
-            f"{'PASS' if ok else 'REGRESSION ' + json.dumps(failed)}")
-        result["regress"] = {
-            "ok": ok,
-            "artifacts": len(gate_paths),
-            "failed_checks": failed,
-        }
+
+def run_sync_bench():
+    """The --sync mode: partition-heal convergence of the SYNC
+    anti-entropy plane (models/sync.py) against the gossip-only
+    control, one JSON line out (never-ship-empty).
+
+    Two arms, both on the chaos-campaign timing preset (this is a
+    robustness workload, like --chaos):
+
+      1. *monitored* — a quiesced RollingPartition heal at the
+         chaos-campaign scale through ``chaos.run_monitored`` with the
+         plane ON and the POST_HEAL_DIVERGENCE agreement window armed
+         (green required), plus a divergence probe of the gossip-only
+         control at the same horizon (non-zero required — the control
+         demonstrably does not converge);
+      2. *scale* — the focal shift workload (the 1M bench shape) healed
+         after a quiesced split, probed every few rounds for the first
+         divergence-free table: ``sync_rounds_to_converge``.
+
+    Results land in an ``artifacts/sync_heal.json``-style artifact
+    (override SCALECUBE_SYNC_ARTIFACT) gated by ``telemetry regress``
+    (absolute convergence gates + the banded convergence-time series),
+    and a JSONL manifest summary row feeds the
+    ``sync_rounds_to_converge`` SLO (telemetry/query.compute_slos).
+    ``--sync --smoke`` is the tier-1-safe pass
+    (tests/test_bench_sync_smoke.py pins the contract).  Env overrides:
+    SCALECUBE_SYNC_N, SCALECUBE_SYNC_SUBJECTS, SCALECUBE_SYNC_INTERVAL,
+    SCALECUBE_SYNC_PROBE_STEP, SCALECUBE_SYNC_MONITOR_N,
+    SCALECUBE_SYNC_ARTIFACT.
+
+    ``value`` stays None by design: rounds-to-converge is
+    smaller-is-better, so it must not enter the generic
+    higher-is-better throughput walk — regress gates the dedicated
+    ``sync_rounds_to_converge`` series instead.
+    """
+    result = {
+        "metric": "sync_heal_rounds_to_converge",
+        "value": None,
+        "unit": "rounds",
+        "smoke": SMOKE,
+    }
+    # Smoke runs get their own default artifact (the metrics-mode
+    # convention): `--sync --smoke` must never overwrite the committed
+    # full-scale measurement, and the regress walk treats smoke heal
+    # artifacts as provenance, not trajectory data.
+    artifact = (os.environ.get("SCALECUBE_SYNC_ARTIFACT")
+                or os.path.join("artifacts",
+                                "sync_heal_smoke.json" if SMOKE
+                                else "sync_heal.json"))
+    try:
+        jax, platform = init_backend()
+        result["platform"] = platform
+
+        import dataclasses
+
+        from scalecube_cluster_tpu.chaos import campaign as ccampaign
+        from scalecube_cluster_tpu.chaos import monitor as cmonitor
+        from scalecube_cluster_tpu.chaos import scenarios as cscenarios
+        from scalecube_cluster_tpu.models import swim
+        from scalecube_cluster_tpu.models import sync as sync_plane
+        from scalecube_cluster_tpu.parallel import traffic
+        from scalecube_cluster_tpu.telemetry import sink as tsink
+        from scalecube_cluster_tpu.utils import runlog
+
+        def force(state):
+            return runlog.completion_barrier(state.status)
+
+        cfg = ccampaign.campaign_config()
+        sync_interval = int(os.environ.get("SCALECUBE_SYNC_INTERVAL", 32))
+        seed = int(os.environ.get("SCALECUBE_SYNC_SEED", 7))
+
+        # ---- Arm 1: chaos-campaign-scale monitored heal -----------------
+        n_mon = int(os.environ.get("SCALECUBE_SYNC_MONITOR_N",
+                                   24 if SMOKE else 32))
+        si_mon = 8
+        p_mon = swim.SwimParams.from_config(
+            cfg, n_members=n_mon, delivery="shift", sync_every=0,
+            sync_interval=si_mon,
+        )
+        scen = cscenarios.quiesced_heal_scenario(
+            p_mon, n_mon, name=f"sync-heal-{n_mon}")
+        phase_mon, horizon = scen.ops[0].phase_rounds, scen.horizon
+        world_mon, spec_mon = scen.build(p_mon)
+        t0 = time.time()
+        _, mon, _ = cmonitor.run_monitored(
+            jax.random.key(seed), p_mon, world_mon, spec_mon, horizon)
+        verdict = cmonitor.verdict(mon)
+        # Gossip-only control at the same schedule: divergence persists.
+        p_mon_off = dataclasses.replace(p_mon, sync_interval=0)
+        world_off, _ = scen.build(p_mon_off)
+        st_off, _ = swim.run(jax.random.key(seed), p_mon_off, world_off,
+                             horizon)
+        mon_control_div = int(sync_plane.divergence_probe(
+            st_off, p_mon_off, world_off, horizon))
+        log(f"sync monitored arm (n={n_mon}, split {phase_mon}, horizon "
+            f"{horizon}): {'green' if verdict['green'] else 'RED'}; "
+            f"gossip-only control divergent columns: {mon_control_div} "
+            f"({time.time() - t0:.1f}s)")
+        phd = verdict["codes"]["POST_HEAL_DIVERGENCE"]["violations"]
+
+        # ---- Arm 2: scale arm (the focal shift 1M shape) ----------------
+        n_scale = int(os.environ.get("SCALECUBE_SYNC_N",
+                                     2048 if SMOKE else 1_000_000))
+        k = int(os.environ.get("SCALECUBE_SYNC_SUBJECTS", 16))
+        probe_step = int(os.environ.get("SCALECUBE_SYNC_PROBE_STEP",
+                                        1 if SMOKE else 2))
+        params = swim.SwimParams.from_config(
+            cfg, n_members=n_scale, n_subjects=k, delivery="shift",
+            sync_every=0, sync_interval=sync_interval,
+            rounds_per_step=resolve_rounds_per_step(),
+        )
+        # Same canonical quiesced split/heal schedule as the monitored
+        # arm (ONE place for the bound arithmetic —
+        # cscenarios.quiesced_heal_scenario), applied to a FOCAL world:
+        # subjects spread over the id range so the split divides them
+        # (Scenario.build compiles full-view worlds only, so the op is
+        # applied to the focal world directly).
+        scen_scale = cscenarios.quiesced_heal_scenario(params, n_scale)
+        phase = scen_scale.ops[0].phase_rounds
+        window = scen_scale.horizon - 2 * phase
+        subject_ids = jax.numpy.arange(k, dtype=jax.numpy.int32) * (
+            n_scale // k)
+        world = swim.SwimWorld.healthy(params, subject_ids=subject_ids)
+        world = scen_scale.ops[0].apply(world, n_scale,
+                                        scen_scale.horizon)
+
+        key = jax.random.key(seed)
+        t0 = time.time()
+        state = swim.initial_state(params, world)
+        state, _ = swim.run(key, params, world, phase, state=state)
+        force(state)
+        split_div = int(sync_plane.divergence_probe(
+            state, params, world, phase))
+        log(f"sync scale arm: N={n_scale} K={k} split {phase} rounds "
+            f"(divergent columns at heal: {split_div}), probing every "
+            f"{probe_step} rounds over a {window}-round window "
+            f"(compile+split took {time.time() - t0:.1f}s)")
+
+        t0 = time.time()
+        converge_at = None
+        r = phase
+        while r < phase + window:
+            state, _ = swim.run(key, params, world, probe_step,
+                                state=state, start_round=r)
+            r += probe_step
+            if int(sync_plane.divergence_probe(state, params, world,
+                                               r)) == 0:
+                converge_at = r - phase
+                break
+        if converge_at is None:
+            log(f"sync scale arm: DID NOT converge within the "
+                f"{window}-round window ({time.time() - t0:.1f}s)")
+        else:
+            log(f"sync scale arm: converged at heal+{converge_at} "
+                f"rounds ({time.time() - t0:.1f}s)")
+
+        # Gossip-only control over the same window, probed at its end.
+        p_off = dataclasses.replace(params, sync_interval=0)
+        t0 = time.time()
+        st_off, _ = swim.run(key, p_off, world, phase + window)
+        gossip_only_div = int(sync_plane.divergence_probe(
+            st_off, p_off, world, phase + window))
+        log(f"sync scale control (gossip-only): divergent columns at "
+            f"heal+{window}: {gossip_only_div} ({time.time() - t0:.1f}s)")
+
+        result.update(
+            sync_rounds_to_converge=converge_at,
+            converged=converge_at is not None,
+            post_heal_divergence=int(phd),
+            monitored_green=bool(verdict["green"]),
+            monitored_n_members=n_mon,
+            monitored_control_divergence=mon_control_div,
+            gossip_only_divergence=gossip_only_div,
+            gossip_only_converged=bool(gossip_only_div == 0),
+            divergence_at_heal=split_div,
+            n_members=n_scale,
+            n_subjects=k,
+            delivery="shift",
+            sync_interval=sync_interval,
+            split_rounds=phase,
+            window_rounds=window,
+            probe_step=probe_step,
+            seed=seed,
+            sync_exchange_bytes_per_member=(
+                traffic.sync_exchange_bytes_per_member(params)),
+            piggyback_bytes_per_member_round=(
+                traffic.piggyback_bytes_per_member_round(params)),
+            value_note=("value stays null by design: rounds-to-converge "
+                        "is smaller-is-better and must not enter the "
+                        "throughput walk — regress gates "
+                        "sync_rounds_to_converge instead"),
+        )
+
+        # SLO surface: one manifest summary row the query layer folds
+        # into the sync_rounds_to_converge SLO.
+        with tsink.TelemetrySink.from_env(
+                default_dir=os.path.join("artifacts", "telemetry"),
+                prefix="sync-heal-smoke" if SMOKE else "sync-heal") as sink:
+            sink.write_manifest(
+                params=cfg,
+                workload={"kind": "sync_heal", "n_members": n_scale,
+                          "sync_interval": sync_interval,
+                          "split_rounds": phase,
+                          "window_rounds": window, "seed": seed},
+            )
+            sink.write_record("summary", {
+                "sync_rounds_to_converge": converge_at,
+                "post_heal_divergence": int(phd),
+                "gossip_only_divergence": gossip_only_div,
+            })
+            result["manifest"] = sink.path
+
+        art = dict(result)
+        os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        result["artifact"] = artifact
+        log(f"sync artifact written to {artifact}")
+
+        apply_regress_gate(
+            result, ["BENCH_*.json", "MULTICHIP_*.json",
+                     os.path.join("artifacts", "sync_heal*.json"),
+                     artifact])
     except BaseException as e:  # noqa: BLE001 — partial result by contract
         log(traceback.format_exc())
         result["error"] = f"{type(e).__name__}: {e}"
@@ -1118,6 +1354,14 @@ def main():
              "real member-rounds/sec/chip + mesh shape + speedup ratio "
              "into a MULTICHIP_* artifact; combine with --smoke for "
              "the CPU-safe virtual-8-device pass",
+    )
+    parser.add_argument(
+        "--sync", action="store_true",
+        help="measure SYNC anti-entropy partition-heal convergence "
+             "(rounds-to-converge after a quiesced split, plane vs "
+             "gossip-only control, monitored chaos-scale arm) into an "
+             "artifacts/sync_heal.json-style artifact; combine with "
+             "--smoke for the tier-1-safe pass",
     )
     mode = parser.add_mutually_exclusive_group()
     mode.add_argument(
@@ -1166,6 +1410,12 @@ def main():
                 "--multichip measures the pipelined-vs-serial sharded gap "
                 "on its own interleaved windows — drop the other mode "
                 "flags")
+        if args.sync and (args.chaos or args.resilience or args.metrics
+                          or args.multichip or args.traced
+                          or args.untraced or args.gap_artifact):
+            parser.error(
+                "--sync measures partition-heal convergence on its own "
+                "workload — drop the other mode flags")
     except SystemExit as e:
         # The one-JSON-line contract holds even for a bad argv: argparse
         # already printed its usage message to stderr; ship the error
@@ -1188,6 +1438,8 @@ def main():
         return run_metrics_bench()
     if args.multichip:
         return run_multichip_bench()
+    if args.sync:
+        return run_sync_bench()
 
     result = {
         "metric": "swim_member_rounds_per_sec_per_chip",

@@ -1,0 +1,78 @@
+"""SYNC anti-entropy partition-heal drill: the plane vs gossip-only.
+
+Drives ``bench.py --sync`` (the one entry point the heal measurement
+flows through, so the experiment and the driver bench cannot drift):
+a quiesced RollingPartition split, healed, then
+
+  - the monitored chaos-campaign-scale arm (``chaos.run_monitored``
+    with the POST_HEAL_DIVERGENCE agreement window armed) must come
+    back green while the gossip-only control's tables stay divergent;
+  - the focal-shift scale arm (the 1M bench shape) is probed every few
+    rounds after the heal for the first divergence-free membership
+    table: ``sync_rounds_to_converge``.
+
+Writes ``artifacts/sync_heal.json`` (override ``--artifact``) and runs
+the ``telemetry regress`` gate in-bench — the committed artifact is the
+pinned robustness claim: partitions HEAL, with a measured convergence
+bound, and without the plane they provably do not.
+
+CPU-safe; the design-target scale arm is N=1M on an accelerator
+(``--n 1000000``), default here is the CPU-feasible 65536.
+
+Usage:
+    python experiments/sync_heal.py                 # committed shape
+    python experiments/sync_heal.py --smoke         # tier-1-safe pass
+    python experiments/sync_heal.py --n 1000000     # accelerator scale
+    python experiments/sync_heal.py --sync-interval 64 --seed 11
+"""
+
+import argparse
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    parser.add_argument("--smoke", action="store_true",
+                        help="tier-1-safe fast pass (small N)")
+    parser.add_argument("--n", type=int, default=None,
+                        help="scale-arm member count "
+                             "(default 65536; 1000000 on an accelerator)")
+    parser.add_argument("--subjects", type=int, default=None,
+                        help="focal subject count (default 16)")
+    parser.add_argument("--sync-interval", type=int, default=None,
+                        help="anti-entropy exchange cadence in rounds "
+                             "(default 32)")
+    parser.add_argument("--monitor-n", type=int, default=None,
+                        help="monitored-arm member count (default 32)")
+    parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--artifact", default=None,
+                        help="artifact path (default "
+                             "artifacts/sync_heal.json)")
+    args = parser.parse_args()
+
+    env = dict(os.environ)
+    if not args.smoke and args.n is None:
+        env.setdefault("SCALECUBE_SYNC_N", "65536")
+    for flag, var in ((args.n, "SCALECUBE_SYNC_N"),
+                      (args.subjects, "SCALECUBE_SYNC_SUBJECTS"),
+                      (args.sync_interval, "SCALECUBE_SYNC_INTERVAL"),
+                      (args.monitor_n, "SCALECUBE_SYNC_MONITOR_N"),
+                      (args.seed, "SCALECUBE_SYNC_SEED"),
+                      (args.artifact, "SCALECUBE_SYNC_ARTIFACT")):
+        if flag is not None:
+            env[var] = str(flag)
+
+    cmd = [sys.executable, str(REPO / "bench.py"), "--sync"]
+    if args.smoke:
+        cmd.append("--smoke")
+    return subprocess.run(cmd, env=env, cwd=str(REPO)).returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

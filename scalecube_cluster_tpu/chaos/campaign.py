@@ -235,32 +235,13 @@ def _crash_leave_schedule(scenario: "cscenarios.Scenario"):
     return crashes, leaves
 
 
-def cross_validate(scenario: "cscenarios.Scenario", seed: int = 0,
-                   delivery: str = "shift",
-                   round_ms: int = 100) -> Optional[dict]:
-    """Replay an expressible scenario on the event-driven oracle and
-    diff SUSPECTED/REMOVED (and post-revival ADDED) key sets per victim
-    against the model's on-device trace, over continuously-live
-    observers.  Returns the diff digest (``agree`` bool + per-victim
-    only_model/only_oracle keys), or None when the scenario isn't
-    oracle-expressible.
-    """
-    import jax
-
+def _oracle_cluster(seed: int, n: int, cfg, round_ms: int):
+    """Warmed-up n-member oracle cluster + attached trace collector —
+    the shared bring-up of both cross-validations.  Returns
+    ``(sim, clusters, collector)``."""
     from scalecube_cluster_tpu.oracle import Cluster, Simulator
-    from scalecube_cluster_tpu.telemetry import trace as ttrace
-    from scalecube_cluster_tpu.telemetry.events import (
-        OracleTraceCollector, TraceEventType, event_key_set,
-    )
+    from scalecube_cluster_tpu.telemetry.events import OracleTraceCollector
 
-    sched = _crash_leave_schedule(scenario)
-    if sched is None:
-        return None
-    crashes, leaves = sched
-    n, horizon = scenario.n_members, scenario.horizon
-    cfg = campaign_config()
-
-    # --- oracle side: same schedule, crash = full link blockade -------
     sim = Simulator(seed=seed)
     clusters = [Cluster.join(sim, config=cfg, alias="m0")]
     for i in range(1, n):
@@ -273,6 +254,35 @@ def cross_validate(scenario: "cscenarios.Scenario", seed: int = 0,
                                      index_of=lambda m: int(m.id[1:]))
     for i, c in enumerate(clusters):
         collector.watch(c, observer_index=i)
+    return sim, clusters, collector
+
+
+def cross_validate(scenario: "cscenarios.Scenario", seed: int = 0,
+                   delivery: str = "shift",
+                   round_ms: int = 100) -> Optional[dict]:
+    """Replay an expressible scenario on the event-driven oracle and
+    diff SUSPECTED/REMOVED (and post-revival ADDED) key sets per victim
+    against the model's on-device trace, over continuously-live
+    observers.  Returns the diff digest (``agree`` bool + per-victim
+    only_model/only_oracle keys), or None when the scenario isn't
+    oracle-expressible.
+    """
+    import jax
+
+    from scalecube_cluster_tpu.telemetry import trace as ttrace
+    from scalecube_cluster_tpu.telemetry.events import (
+        TraceEventType, event_key_set,
+    )
+
+    sched = _crash_leave_schedule(scenario)
+    if sched is None:
+        return None
+    crashes, leaves = sched
+    n, horizon = scenario.n_members, scenario.horizon
+    cfg = campaign_config()
+
+    # --- oracle side: same schedule, crash = full link blockade -------
+    sim, clusters, collector = _oracle_cluster(seed, n, cfg, round_ms)
 
     def block(victim):
         rest = [c for c in clusters if c is not clusters[victim]]
@@ -331,5 +341,122 @@ def cross_validate(scenario: "cscenarios.Scenario", seed: int = 0,
     return {
         "agree": agree,
         "observers": len(observers),
+        "victims": {str(k): d for k, d in per_victim.items()},
+    }
+
+
+def _single_partition(scenario: "cscenarios.Scenario"):
+    """The scenario's one RollingPartition op when the partition/heal
+    schedule is oracle-expressible (exactly one split/heal cycle, no
+    other ops, no background loss); None otherwise."""
+    if scenario.loss_probability:
+        return None
+    if len(scenario.ops) != 1:
+        return None
+    op = scenario.ops[0]
+    if not isinstance(op, cscenarios.RollingPartition):
+        return None
+    if op.n_cycles != 1:
+        return None
+    return op
+
+
+def cross_validate_partition(scenario: "cscenarios.Scenario", seed: int = 0,
+                             delivery: str = "shift",
+                             round_ms: int = 100,
+                             sync_interval: Optional[int] = None
+                             ) -> Optional[dict]:
+    """Replay a single-cycle RollingPartition on the event-driven
+    oracle — split = blocking every cross-half link both ways, heal =
+    unblocking — and diff the timing-free SUSPECTED/REMOVED/ADDED key
+    sets per member against the model's on-device trace, over
+    opposite-half observers.  The model runs WITH the SYNC anti-entropy
+    plane (``sync_interval`` rounds; default = the campaign preset's
+    oracle sync interval quantized to rounds), so the post-heal ADDED
+    events are exactly the SYNC-recovered members on both layers: the
+    oracle re-adds removed members through its doSync/syncAck full-table
+    exchange (oracle/membership._sync_membership), the model through the
+    plane's paired exchange reopening the tombstone cells
+    (models/sync.py).  Returns the diff digest or None when the
+    scenario isn't expressible.
+
+    The split must be long enough to QUIESCE (chaos/scenarios.
+    quiesce_bound) — both layers then reach the same terminal key sets:
+    every opposite-half observer suspects, removes, and post-heal
+    re-adds every cross member at incarnation 0.
+    """
+    import jax
+
+    from scalecube_cluster_tpu.telemetry import trace as ttrace
+    from scalecube_cluster_tpu.telemetry.events import (
+        TraceEventType, event_key_set,
+    )
+
+    op = _single_partition(scenario)
+    if op is None:
+        return None
+    n, horizon = scenario.n_members, scenario.horizon
+    cfg = campaign_config()
+    if sync_interval is None:
+        sync_interval = max(1, int(round(cfg.sync_interval / round_ms)))
+    split_at = op.from_round
+    heal_at = op.from_round + op.phase_rounds
+    # Halves as RollingPartition.apply compiles cycle 0: partition id 1
+    # for ids below n//2 — two contiguous ranges.
+    half_a = list(range(n // 2))
+    half_b = list(range(n // 2, n))
+
+    # --- oracle side --------------------------------------------------
+    sim, clusters, collector = _oracle_cluster(seed, n, cfg, round_ms)
+
+    def set_split(active: bool):
+        for a in half_a:
+            for b in half_b:
+                if active:
+                    clusters[a].network_emulator.block(
+                        [clusters[b].address])
+                    clusters[b].network_emulator.block(
+                        [clusters[a].address])
+                else:
+                    clusters[a].network_emulator.unblock(
+                        clusters[b].address)
+                    clusters[b].network_emulator.unblock(
+                        clusters[a].address)
+
+    for r in range(horizon):
+        if r == split_at:
+            set_split(True)
+        if r == heal_at:
+            set_split(False)
+        sim.run_for(round_ms)
+
+    # --- model side (anti-entropy plane ON) ---------------------------
+    params = campaign_params(scenario, delivery=delivery,
+                             sync_interval=sync_interval)
+    world, _ = scenario.build(params)
+    _, tel, _ = swim.run_traced(jax.random.key(seed), params, world,
+                                horizon)
+    model_events = ttrace.decode_events(tel)
+
+    per_victim = {}
+    agree = True
+    for v in range(n):
+        observers = half_b if v in half_a else half_a
+        kw = dict(
+            types=[TraceEventType.SUSPECTED, TraceEventType.REMOVED,
+                   TraceEventType.ADDED],
+            subjects=[v], observers=observers, min_round=split_at,
+        )
+        mk = event_key_set(model_events, **kw)
+        ok = event_key_set(collector.events, **kw)
+        recovered = {k for k in mk if k[2] == int(TraceEventType.ADDED)}
+        per_victim[v] = {"only_model": sorted(mk - ok),
+                         "only_oracle": sorted(ok - mk),
+                         "sync_recovered_keys": len(recovered)}
+        agree &= mk == ok
+    return {
+        "agree": agree,
+        "halves": [len(half_a), len(half_b)],
+        "sync_interval": sync_interval,
         "victims": {str(k): d for k, d in per_victim.items()},
     }

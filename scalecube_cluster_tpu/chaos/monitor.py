@@ -43,6 +43,15 @@ Invariant codes (:class:`InvariantCode`; lane values are stable):
                     observer (continuously alive since the subject's
                     fault) still holds ALIVE/SUSPECT about a
                     permanently crashed/left subject.
+  POST_HEAL_DIVERGENCE  past the scenario's post-heal agreement round
+                    (``MonitorSpec.agree_from`` — last heal +
+                    sync_interval + dissemination bound), a live
+                    observer's record of some subject still differs
+                    from the live consensus: the SYNC anti-entropy
+                    plane's bounded re-convergence contract
+                    (models/sync.py).  Only promised when the plane is
+                    on and the scenario's faults quiesce before the
+                    heal (chaos/scenarios.Scenario.build).
 
 Evidence policy: per code, the LANES record the violating cells of the
 first round that code trips (with overflow counted in ``dropped``);
@@ -66,6 +75,7 @@ import numpy as np
 
 from scalecube_cluster_tpu import records
 from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.models import sync as msync
 
 INT32_MAX = jnp.iinfo(jnp.int32).max
 
@@ -87,6 +97,7 @@ class InvariantCode(enum.IntEnum):
     TIMER_BOUND = 2
     WIRE_SATURATION = 3
     COMPLETENESS = 4
+    POST_HEAL_DIVERGENCE = 5
 
 
 N_CODES = len(InvariantCode)
@@ -169,13 +180,28 @@ class MonitorSpec:
     by that round every eligible observer must have dropped the subject
     (INT32_MAX = completeness unchecked for that subject; scenarios
     compute deadlines from their fault/disruption schedules —
-    chaos/scenarios.Scenario.build).  ``check_false_suspicion`` is a
+    chaos/scenarios.Scenario.build).  ``agree_from`` int32 scalar: the
+    post-heal agreement deadline — from that round on, every live
+    observer's record of every subject must match the live consensus
+    (the SYNC anti-entropy plane's re-convergence contract,
+    models/sync.py; INT32_MAX = no agreement promise, the default —
+    scenarios only promise it when the plane is on and the heal is
+    quiesced).  ``check_agreement`` is ``agree_from``'s static
+    (treedef) twin: False compiles the per-round divergence reduction
+    out entirely — ``agree_from`` is traced data XLA cannot fold, so
+    without the static flag every plane-off monitored run would pay
+    the [N, K] consensus reduction for a check that can never trip
+    (the ``check_false_suspicion`` pattern).
+    ``check_false_suspicion`` is a
     static (treedef) flag: True only when the scenario's network is
     pristine, where any new suspicion of a live subject is a safety
     violation.
     """
 
     complete_by: jnp.ndarray
+    agree_from: jnp.ndarray = dataclasses.field(
+        default_factory=lambda: jnp.int32(INT32_MAX))
+    check_agreement: bool = False
     check_false_suspicion: bool = False
 
     @staticmethod
@@ -191,8 +217,8 @@ class MonitorSpec:
 
 jax.tree_util.register_dataclass(
     MonitorSpec,
-    data_fields=["complete_by"],
-    meta_fields=["check_false_suspicion"],
+    data_fields=["complete_by", "agree_from"],
+    meta_fields=["check_agreement", "check_false_suspicion"],
 )
 
 
@@ -285,13 +311,27 @@ def check_round(mon: MonitorState, spec: MonitorSpec,
     v_comp = (due & obs_alive & ~disturbed & ~is_self
               & ((ns == records.ALIVE) | (ns == records.SUSPECT)))
 
-    vio = jnp.stack([v_fs, v_inc, v_timer, v_sat, v_comp])  # [C', N, K]
+    # POST_HEAL_DIVERGENCE — past the agreement deadline, every live
+    # observer's (status, incarnation) record must equal the live
+    # consensus (the column's max packed record among live observers —
+    # models/sync.divergent_cells).  The SYNC anti-entropy plane's
+    # bounded re-convergence contract; the static ``check_agreement``
+    # flag folds the whole reduction to the zero mask when no promise
+    # is made (the check_false_suspicion pattern).
+    if spec.check_agreement:
+        div_due = jnp.asarray(round_idx, jnp.int32) >= spec.agree_from
+        div_cells, _ = msync.divergent_cells(ns, ni, alive_now)
+        v_div = div_cells & div_due
+    else:
+        v_div = zero
+
+    vio = jnp.stack([v_fs, v_inc, v_timer, v_sat, v_comp, v_div])
     details = jnp.stack([ni, ni, jnp.where(has_timer, dl, -1), ni,
-                         ns.astype(jnp.int32)])
+                         ns.astype(jnp.int32), ns.astype(jnp.int32)])
     cell_code_of = jnp.asarray([
         InvariantCode.FALSE_SUSPICION, InvariantCode.INC_REGRESSION,
         InvariantCode.TIMER_BOUND, InvariantCode.WIRE_SATURATION,
-        InvariantCode.COMPLETENESS,
+        InvariantCode.COMPLETENESS, InvariantCode.POST_HEAL_DIVERGENCE,
     ], dtype=jnp.int32)
 
     # Self-incarnation lanes (subject == observer): regression + cap.
@@ -348,6 +388,87 @@ def check_round(mon: MonitorState, spec: MonitorSpec,
 # --------------------------------------------------------------------------
 
 
+def _wide(params: "swim.SwimParams", st: "swim.SwimState", cursor):
+    """Any carry layout -> the WIDE form the checks read (lossless
+    below the caps the layouts already validate)."""
+    if params.compact_carry:
+        return swim._carry_decode(st, cursor)
+    if params.int16_wire:
+        return dataclasses.replace(st, inc=st.inc.astype(jnp.int32))
+    return st
+
+
+def _monitored_scan(base_key, params: "swim.SwimParams",
+                    world: "swim.SwimWorld", spec: MonitorSpec,
+                    n_rounds: int, capacity: int, state, start_round,
+                    knobs, shift_key, monitor, metrics_spec,
+                    metrics_state):
+    """The ONE monitored scan body behind ``run_monitored`` and
+    ``run_monitored_metered`` — the metered/unmetered duplication
+    CHANGES.md PR 5 flagged as deliberate debt, hoisted before the SYNC
+    anti-entropy plane would have made a fourth copy.  ``metrics_spec``
+    is None for the unmetered shape (no registry in the carry; the
+    returned ``ms`` is None); otherwise the registry folds the same
+    signals as ``swim.run_metered`` plus the ``chaos_violations``
+    counter (the delta of ``MonitorState.code_counts`` — exact totals,
+    not just recorded evidence lanes).
+
+    Returns ``(final_state, monitor_state, ms_or_None, metrics)``.
+    """
+    metered = metrics_spec is not None
+    if metered:
+        from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+
+    kn = knobs if knobs is not None else swim.Knobs.from_params(params)
+    if state is None:
+        state = swim.initial_state(params, world)
+    if monitor is None:
+        monitor = MonitorState.init(capacity)
+    if metered and metrics_state is None:
+        metrics_state = tmetrics.MetricsState.init(metrics_spec)
+
+    def tick(carry, round_idx):
+        st, mon, ms = carry if metered else (*carry, None)
+        prev = _wide(params, st, round_idx)
+        new_st, metrics = swim.swim_tick(st, round_idx, base_key, params,
+                                         world, knobs=kn,
+                                         shift_key=shift_key)
+        new_mon = check_round(mon, spec, params, kn, round_idx, prev,
+                              _wide(params, new_st, round_idx + 1), world)
+        if not metered:
+            return (new_st, new_mon), metrics
+        ms = tmetrics.observe_tick(
+            ms, metrics_spec, params, kn, round_idx, prev.status,
+            prev.suspect_deadline, new_st.status, metrics, world,
+        )
+        if "chaos_violations" in metrics_spec.counters:
+            ms = tmetrics.inc(
+                ms, metrics_spec, "chaos_violations",
+                jnp.sum(new_mon.code_counts - mon.code_counts,
+                        dtype=jnp.int32),
+            )
+        return (new_st, new_mon, ms), metrics
+
+    carry0 = ((state, monitor, metrics_state) if metered
+              else (state, monitor))
+    carry, metrics = swim._fused_scan(
+        tick, carry0, n_rounds, start_round, params.rounds_per_step,
+    )
+    if not metered:
+        final_state, monitor = carry
+        return final_state, monitor, None, metrics
+    final_state, monitor, ms = carry
+    end = start_round + n_rounds
+    _, spread_wide = swim._wide_timer_fields(final_state, params, end)
+    ms = tmetrics.sample_gauges(
+        ms, metrics_spec, params, kn, final_state.status, spread_wide,
+        world.alive_at(end), end, world,
+        last_tick_metrics={k: metrics[k][-1]
+                           for k in ("messages_gossip",) if k in metrics},
+    )
+    return final_state, monitor, ms, metrics
+
+
 @partial(jax.jit, static_argnames=("params", "n_rounds", "capacity"))
 def run_monitored(base_key, params: "swim.SwimParams",
                   world: "swim.SwimWorld", spec: MonitorSpec,
@@ -372,32 +493,9 @@ def run_monitored(base_key, params: "swim.SwimParams",
     the wide form for checking only (``swim._carry_decode`` — lossless
     below the caps the layouts already validate).
     """
-    kn = knobs if knobs is not None else swim.Knobs.from_params(params)
-    if state is None:
-        state = swim.initial_state(params, world)
-    if monitor is None:
-        monitor = MonitorState.init(capacity)
-
-    def wide(st, cursor):
-        if params.compact_carry:
-            return swim._carry_decode(st, cursor)
-        if params.int16_wire:
-            return dataclasses.replace(st, inc=st.inc.astype(jnp.int32))
-        return st
-
-    def tick(carry, round_idx):
-        st, mon = carry
-        prev = wide(st, round_idx)
-        new_st, metrics = swim.swim_tick(st, round_idx, base_key, params,
-                                         world, knobs=kn,
-                                         shift_key=shift_key)
-        mon = check_round(mon, spec, params, kn, round_idx, prev,
-                          wide(new_st, round_idx + 1), world)
-        return (new_st, mon), metrics
-
-    (final_state, monitor), metrics = swim._fused_scan(
-        tick, (state, monitor), n_rounds, start_round,
-        params.rounds_per_step,
+    final_state, monitor, _, metrics = _monitored_scan(
+        base_key, params, world, spec, n_rounds, capacity, state,
+        start_round, knobs, shift_key, monitor, None, None,
     )
     return final_state, monitor, metrics
 
@@ -417,72 +515,24 @@ def run_monitored_metered(base_key, params: "swim.SwimParams",
                           metrics_spec=None, metrics_state=None):
     """``run_monitored`` with the health-metrics registry riding along
     (telemetry/metrics.py): the chaos shape of the always-on numeric
-    health plane.
-
-    Per round the registry folds the same protocol health signals as
-    ``swim.run_metered`` PLUS the invariant monitor's violation stream:
-    the ``chaos_violations`` counter advances by the round's new
-    violation total (the delta of ``MonitorState.code_counts`` — exact
-    totals, not just recorded evidence lanes).  Monitor verdicts and
-    protocol state are bit-identical to ``run_monitored``.
+    health plane — the same scan body (``_monitored_scan``) with the
+    registry in the carry, so monitor verdicts and protocol state are
+    bit-identical to ``run_monitored``.
 
     Returns ``(final_state, monitor_state, metrics_state, metrics)``;
     ``metrics_state``/``metrics_spec`` resume/declare the registry like
     ``swim.run_metered`` (the registry carry is donated; the monitor
     carry is not, matching ``run_monitored``).
     """
-    from scalecube_cluster_tpu.telemetry import metrics as tmetrics
-
     if metrics_spec is None:
+        from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+
         metrics_spec = tmetrics.MetricsSpec.default()
-    kn = knobs if knobs is not None else swim.Knobs.from_params(params)
-    if state is None:
-        state = swim.initial_state(params, world)
-    if monitor is None:
-        monitor = MonitorState.init(capacity)
-    if metrics_state is None:
-        metrics_state = tmetrics.MetricsState.init(metrics_spec)
-
-    def wide(st, cursor):
-        if params.compact_carry:
-            return swim._carry_decode(st, cursor)
-        if params.int16_wire:
-            return dataclasses.replace(st, inc=st.inc.astype(jnp.int32))
-        return st
-
-    def tick(carry, round_idx):
-        st, mon, ms = carry
-        prev = wide(st, round_idx)
-        new_st, metrics = swim.swim_tick(st, round_idx, base_key, params,
-                                         world, knobs=kn,
-                                         shift_key=shift_key)
-        new_mon = check_round(mon, spec, params, kn, round_idx, prev,
-                              wide(new_st, round_idx + 1), world)
-        ms = tmetrics.observe_tick(
-            ms, metrics_spec, params, kn, round_idx, prev.status,
-            prev.suspect_deadline, new_st.status, metrics, world,
-        )
-        if "chaos_violations" in metrics_spec.counters:
-            ms = tmetrics.inc(
-                ms, metrics_spec, "chaos_violations",
-                jnp.sum(new_mon.code_counts - mon.code_counts,
-                        dtype=jnp.int32),
-            )
-        return (new_st, new_mon, ms), metrics
-
-    (final_state, monitor, ms), metrics = swim._fused_scan(
-        tick, (state, monitor, metrics_state), n_rounds, start_round,
-        params.rounds_per_step,
+    return _monitored_scan(
+        base_key, params, world, spec, n_rounds, capacity, state,
+        start_round, knobs, shift_key, monitor, metrics_spec,
+        metrics_state,
     )
-    end = start_round + n_rounds
-    _, spread_wide = swim._wide_timer_fields(final_state, params, end)
-    ms = tmetrics.sample_gauges(
-        ms, metrics_spec, params, kn, final_state.status, spread_wide,
-        world.alive_at(end), end, world,
-        last_tick_metrics={k: metrics[k][-1]
-                           for k in ("messages_gossip",) if k in metrics},
-    )
-    return final_state, monitor, ms, metrics
 
 
 # --------------------------------------------------------------------------

@@ -74,6 +74,56 @@ _RULE_PAD = 8
 _HORIZON_QUANTUM = 64
 
 
+def quiesce_bound(params: "swim.SwimParams", n: int) -> int:
+    """Rounds a fault's effects need to go COLD: cross-fault suspicions
+    detected and spread, suspicion timers matured to tombstones, and the
+    tombstones' gossip windows expired.  A partition healed (or a node
+    revived) after at least this many rounds re-converges monotonically
+    under the SYNC anti-entropy plane; a shorter window releases
+    freshly-hot tombstones into the healed cluster, a regime the merge
+    precedence cannot bound (models/sync.py "quiesced-heal
+    precondition")."""
+    log2n = math.ceil(math.log2(n + 1))
+    return (24 * max(1, params.ping_every)      # detection + verdict spread
+            + params.suspicion_rounds           # timers mature
+            + params.periods_to_spread + 1      # tombstone gossip expires
+            + 4 * log2n + 16)
+
+
+def post_heal_agreement_bound(params: "swim.SwimParams", n: int) -> int:
+    """Rounds after the last heal within which every live table must
+    agree (the POST_HEAL_DIVERGENCE window): one anti-entropy exchange
+    interval + the dissemination bound for the reopened records + probe
+    slack for in-flight FD refute pushes.  The ISSUE's
+    ``sync_interval + dissemination_bound`` contract, deliberately
+    generous — it is a convergence CONTRACT, not a latency benchmark
+    (``bench.py --sync`` measures the actual figure)."""
+    log2n = math.ceil(math.log2(n + 1))
+    return (params.sync_interval
+            + 4 * log2n + params.periods_to_spread
+            + 2 * max(1, params.ping_every) + 16)
+
+
+def quiesced_heal_scenario(params: "swim.SwimParams", n: int,
+                           name: str = "quiesced-heal",
+                           slack: int = 32) -> "Scenario":
+    """The canonical single split/heal cycle sized to QUIESCE: one
+    RollingPartition whose split clears :func:`quiesce_bound` (rounded
+    up to the 16-round phase quantum) and whose horizon covers the heal
+    plus one :func:`post_heal_agreement_bound` window plus ``slack`` —
+    the schedule ``bench.py --sync``, the monitor tests, and the oracle
+    partition cross-validation all measure, built in ONE place so the
+    bound arithmetic cannot drift between them.  The split length is
+    exposed as ``ops[0].phase_rounds`` (= the heal round)."""
+    phase = -(-quiesce_bound(params, n) // 16) * 16
+    horizon = 2 * phase + post_heal_agreement_bound(params, n) + slack
+    return Scenario(
+        name=name, n_members=n, horizon=horizon,
+        ops=(RollingPartition(from_round=0, phase_rounds=phase,
+                              n_cycles=1),),
+    )
+
+
 def completeness_bound(params: "swim.SwimParams", n: int) -> int:
     """Rounds within which a permanent crash/leave must be DEAD in every
     eligible observer's view: detection slack (FD probe discovery has a
@@ -425,11 +475,69 @@ class Scenario:
         complete_by = np.full(params.n_subjects, INT32_MAX, dtype=np.int64)
         tracked = slot >= 0
         complete_by[slot[tracked]] = deadline[tracked]
+
+        # Post-heal agreement promise (POST_HEAL_DIVERGENCE): made only
+        # when the SYNC anti-entropy plane is ON, the background network
+        # is pristine, and every fault quiesces before its heal — the
+        # preconditions under which bounded re-convergence actually
+        # holds (models/sync.py "quiesced-heal precondition").
+        agree_from = INT32_MAX
+        if (params.sync_interval > 0
+                and not permanent_disruption
+                and params.loss_probability == 0.0
+                and self.loss_probability == 0.0
+                and params.mean_delay_ms == 0.0
+                and all(self._op_quiesces(op, params, n)
+                        for op in self.ops)):
+            # Settling deadlines: a HEAL (disruption end, revive) needs
+            # one agreement window; a fault START (crash/leave round)
+            # additionally needs its own effects to mature first —
+            # detection, suspicion timers, tombstone spread
+            # (quiesce_bound) — before the agreement clock can run, or a
+            # legitimate mid-maturation ALIVE/SUSPECT/DEAD mixture trips
+            # the invariant.
+            qb = quiesce_bound(params, n)
+            settle = [disruption_end]
+            finite_du = du[du < INT32_MAX]
+            if finite_du.size:
+                settle.append(int(finite_du.max()))
+            for arr in (df, la):
+                finite = arr[arr < INT32_MAX]
+                if finite.size:
+                    settle.append(int(finite.max()) + qb)
+            agree_from = min(
+                max(settle) + post_heal_agreement_bound(params, n)
+                + self.extra_slack,
+                INT32_MAX,
+            )
+
         spec = MonitorSpec(
             complete_by=jnp.asarray(complete_by.astype(np.int32)),
+            agree_from=jnp.int32(agree_from),
+            check_agreement=agree_from < INT32_MAX,
             check_false_suspicion=pristine,
         )
         return world, spec
+
+    @staticmethod
+    def _op_quiesces(op, params: "swim.SwimParams", n: int) -> bool:
+        """Does this op's disturbance go cold before its own heal (the
+        agreement-promise precondition)?  Process faults must be
+        permanent or down for >= quiesce_bound; partitions must hold
+        each phase >= quiesce_bound; probabilistic network ops (loss,
+        flaps, brownouts) never promise — their false suspicions mature
+        on their own clocks."""
+        qb = quiesce_bound(params, n)
+        if isinstance(op, (Crash, CrashBurst)):
+            return (op.until_round >= INT32_MAX
+                    or op.until_round - op.at_round >= qb)
+        if isinstance(op, Leave):
+            return True                  # announces its own death
+        if isinstance(op, ChurnStorm):
+            return op.down_rounds == 0 or op.down_rounds >= qb
+        if isinstance(op, RollingPartition):
+            return op.phase_rounds >= qb
+        return False
 
 
 # --------------------------------------------------------------------------

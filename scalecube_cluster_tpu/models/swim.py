@@ -103,6 +103,7 @@ import jax
 import jax.numpy as jnp
 
 from scalecube_cluster_tpu import records
+from scalecube_cluster_tpu.models import sync as sync_plane
 from scalecube_cluster_tpu.ops import delivery, prng, ring as ring_ops, \
     shift as shift_ops
 from scalecube_cluster_tpu.telemetry import trace as telemetry_trace
@@ -265,10 +266,36 @@ class SwimParams:
     # remainder runs through an unfused tail scan, so any (n_rounds, K)
     # pair is legal.  1 = the classic one-tick-per-step scan.
     rounds_per_step: int = 1
+    # SYNC anti-entropy plane (models/sync.py): every ``sync_interval``
+    # rounds each live member exchanges its FULL syncable table — status
+    # + incarnation lanes — with a shared-offset partner pair
+    # ((i ± s) mod N; the doSync/syncAck round trip realized as two
+    # dense channels, models/sync.py module docstring for the deviation
+    # argument).  This is the partition-heal repair loop: stale
+    # divergence that aged out of the piggyback window re-enters the
+    # table merge and re-disseminates, so healed partitions re-converge
+    # within ~(sync_interval + dissemination bound) rounds.  0 (the
+    # default) compiles the plane OUT entirely — every run shape is
+    # bit-identical to the plane-less tick (tests/test_sync_plane.py).
+    # Distinct from ``sync_every``, the reference-faithful push-only
+    # per-round SYNC channel: the plane runs much less often and is
+    # bidirectional.  Enabled runs grow a ``messages_anti_entropy``
+    # per-round counter in the metrics dict.
+    sync_interval: int = 0
 
     def __post_init__(self):
         if self.delivery not in ("scatter", "shift"):
             raise ValueError(f"unknown delivery mode {self.delivery!r}")
+        if self.sync_interval < 0:
+            raise ValueError(
+                f"sync_interval must be >= 0 (0 = anti-entropy plane off; "
+                f"got {self.sync_interval})"
+            )
+        if self.sync_interval > 0 and self.n_members < 2:
+            raise ValueError(
+                "the anti-entropy exchange needs n_members >= 2 "
+                "(a single member has no partner to pair with)"
+            )
         if self.rounds_per_step < 1:
             raise ValueError(
                 f"rounds_per_step must be >= 1 (got {self.rounds_per_step})"
@@ -1310,7 +1337,7 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
             fd_round, sync_round, gate_contacts, known_live, is_seed,
             (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t,
              k_gossip_drop, k_sync_t, k_sync_drop),
-            offset, axis_name,
+            offset, axis_name, k_channel=k_shifts,
         )
 
     metrics = _round_metrics(new_state, status, aux, params, world,
@@ -1447,6 +1474,12 @@ def _round_metrics(new_state: SwimState, status, aux, params: SwimParams,
         messages_ping_req_sent=global_sum(aux["messages_ping_req_sent"]),
         refutations=global_sum(aux["refutations"]),
     )
+    if params.sync_interval > 0:
+        # Anti-entropy exchange messages issued by live members this
+        # round (2 per member on exchange rounds — models/sync.sent_count).
+        metrics["messages_anti_entropy"] = global_sum(
+            aux["messages_anti_entropy"]
+        )
     if params.link_counters:
         # Per-sender NetworkEmulator counters (single-device; validated
         # above) — [N] rows, stacked by the scan into [rounds, N] traces.
@@ -1712,7 +1745,7 @@ def _send_payloads(state, status, inc, round_idx, params, world,
 def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
                         alive, part, node_ids, alive_here, part_here,
                         is_self, fd_round, sync_round, gate_contacts,
-                        known_live, is_seed, keys, offset):
+                        known_live, is_seed, keys, offset, k_channel=None):
     """Phases 1-3 of the scatter tick: FD probe verdicts + gossip/SYNC
     sends — everything up to (but excluding) the cross-device inbox
     combine.  Returns a dict of per-channel payloads/targets/drop masks
@@ -1720,6 +1753,11 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
     the same round body — ``_tick_scatter``) or double-buffered (the
     combine deferred to the NEXT round body — ``swim_tick_send`` /
     ``swim_tick_recv``, the pipelined ICI path of parallel/mesh.py).
+
+    ``k_channel`` is the round's UN-device-folded channel key
+    (``_round_context``'s ``k_shifts``) — the anti-entropy plane's
+    shared partner offset must agree across shards; required when
+    ``params.sync_interval > 0``.
     """
     n, k = params.n_members, params.n_subjects
     n_local = status.shape[0]
@@ -1892,7 +1930,40 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
                  & (round_idx < state.g_spread_until))
         # A wire gossip message exists when EITHER family has content.
         hot_any = hot_any | jnp.any(hot_g, axis=1)
+
+    # ---- Anti-entropy plane: the paired full-table exchange --------------
+    # (models/sync.py module docstring).  Two extra scatter channels with
+    # deterministic shared-offset targets delivering the SAME sync_keys
+    # payload; they fold into the same contribution buffer as the regular
+    # channels (_scatter_channel_bufs), so the pipelined path carries
+    # them for free and the sharded combine stays one pmax per buffer.
+    ae = {}
+    if params.sync_interval > 0:
+        ae_due = sync_plane.due(round_idx, params.sync_interval)
+        s_off = sync_plane.partner_offset(k_channel, n)
+        ae_targets = sync_plane.exchange_targets(node_ids, s_off, n)
+        ae_do = ae_due & alive_here
+        ae_contact_ok = (known_live(ae_targets) | is_seed(ae_targets)
+                         if gate_contacts
+                         else jnp.ones((n_local, 2), dtype=jnp.bool_))
+        loss_ae, _ = link_eval(world.faults, round_idx, node_ids[:, None],
+                               ae_targets, kn.loss_probability,
+                               params.mean_delay_ms)
+        ae_wire_drop = prng.bernoulli_mask(
+            sync_plane.drop_key(k_sync_drop), loss_ae, (n_local, 2)
+        )
+        ae_part_ok = same_partition(node_ids[:, None], ae_targets)
+        ae_ok = (alive[ae_targets] & ae_part_ok & ae_contact_ok
+                 & ~ae_wire_drop)
+        ae = dict(
+            ae_targets=ae_targets,
+            ae_drop=~(ae_do[:, None] & ae_ok),
+            ae_attempt=ae_do[:, None] & ae_contact_ok,
+            ae_wire_drop=ae_wire_drop, ae_part_ok=ae_part_ok,
+            messages_anti_entropy=sync_plane.sent_count(ae_due, alive_here),
+        )
     return dict(
+        **ae,
         gossip_keys=gossip_keys, sync_keys=sync_keys,
         gossip_targets=gossip_targets, gossip_drop=gossip_drop,
         sync_target=sync_target, sync_drop=sync_drop,
@@ -1909,11 +1980,19 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
     )
 
 
-def _scatter_channel_bufs(s, params, gossip_extra_drop, sync_extra_drop):
+def _scatter_channel_bufs(s, params, gossip_extra_drop, sync_extra_drop,
+                          ae_suppress=False):
     """The UNCOMBINED global-height inbox contribution of one scatter
     round: the max-folded packed-key buffer and the int8 ALIVE-flag
     buffer (both [N, K]).  The serial tick pmax-combines these in the
     same round body; the pipelined path carries them to the next one.
+
+    The anti-entropy plane's paired exchange (``sync_interval > 0``)
+    folds its two channels into the SAME buffers — same payload as the
+    sync channel, deterministic shared-offset targets — so it adds no
+    collectives and rides the pipelined double-buffer unchanged.  Its
+    delivery is same-round only (models/sync.py docstring), so the
+    delay path passes ``ae_suppress=True`` for every bin after 0.
     """
     n = params.n_members
     g_drop = s["gossip_drop"] | gossip_extra_drop
@@ -1929,6 +2008,15 @@ def _scatter_channel_bufs(s, params, gossip_extra_drop, sync_extra_drop):
         | delivery.scatter_or(s["sync_alive_flags"], s["sync_target"],
                               s_drop, n)
     )
+    if params.sync_interval > 0 and not ae_suppress:
+        buf = jnp.maximum(
+            buf,
+            delivery.scatter_max(s["sync_keys"], s["ae_targets"],
+                                 s["ae_drop"], n),
+        )
+        fbuf = fbuf | delivery.scatter_or(
+            s["sync_alive_flags"], s["ae_targets"], s["ae_drop"], n
+        )
     return buf, fbuf.astype(jnp.int8)
 
 
@@ -1936,7 +2024,7 @@ def _scatter_send_aux(s, params):
     """Send-side counters of one scatter round — merge-independent, so
     the pipelined path can carry them across the round boundary and
     psum them together with the round's metrics one body later."""
-    return dict(
+    aux = dict(
         messages_gossip=jnp.sum(
             s["hot_any"][:, None] & ~s["gossip_drop"], dtype=jnp.int32
         ),
@@ -1947,19 +2035,22 @@ def _scatter_send_aux(s, params):
             * params.ping_req_members
         ),
     )
+    if params.sync_interval > 0:
+        aux["messages_anti_entropy"] = s["messages_anti_entropy"]
+    return aux
 
 
 def _tick_scatter(state, status, inc, round_idx, params, kn, world,
                   alive, part, node_ids, alive_here, part_here, is_self,
                   fd_round, sync_round, gate_contacts, known_live, is_seed,
-                  keys, offset, axis_name):
+                  keys, offset, axis_name, k_channel=None):
     n, k = params.n_members, params.n_subjects
     n_local = status.shape[0]
     s = _scatter_send_phase(state, status, inc, round_idx, params, kn,
                             world, alive, part, node_ids, alive_here,
                             part_here, is_self, fd_round, sync_round,
                             gate_contacts, known_live, is_seed, keys,
-                            offset)
+                            offset, k_channel=k_channel)
     delay_g, delay_s = s["delay_g"], s["delay_s"]
 
     def combine_max(buf):
@@ -1979,9 +2070,10 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
         state, params, round_idx
     )
 
-    def channel_bufs(gossip_extra_drop, sync_extra_drop):
+    def channel_bufs(gossip_extra_drop, sync_extra_drop, ae_suppress=False):
         buf, fbuf = _scatter_channel_bufs(s, params, gossip_extra_drop,
-                                          sync_extra_drop)
+                                          sync_extra_drop,
+                                          ae_suppress=ae_suppress)
         return combine_max(buf), combine_max(fbuf)
 
     if params.max_delay_rounds == 0:
@@ -2004,7 +2096,9 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
         inbox_alive = inbox_alive8.astype(jnp.bool_) | flags_now
         d = params.max_delay_rounds + 1
         for j in range(1, d):
-            buf_j, fbuf_j = channel_bufs(q_g != j, q_s != j)
+            # The anti-entropy exchange is same-round only (bin 0).
+            buf_j, fbuf_j = channel_bufs(q_g != j, q_s != j,
+                                         ae_suppress=True)
             ring, fring = _ring_push(ring, fring, (slot0 + j) % d,
                                      buf_j, fbuf_j.astype(jnp.bool_))
 
@@ -2076,6 +2170,16 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
             jnp.sum(g_lost, axis=1, dtype=jnp.int32)
             + s_lost.astype(jnp.int32) + ss_lost
         )
+        if params.sync_interval > 0:
+            # Anti-entropy exchange accounting: both directions count as
+            # sends at the sender; in-flight drops (wire loss, partition
+            # walls) count as lost, matching the gossip/sync attribution.
+            ae_lost = s["ae_attempt"] & (s["ae_wire_drop"]
+                                         | ~s["ae_part_ok"])
+            aux["sent_by_node"] += jnp.sum(s["ae_attempt"], axis=1,
+                                           dtype=jnp.int32)
+            aux["lost_by_node"] += jnp.sum(ae_lost, axis=1,
+                                           dtype=jnp.int32)
     return new_state, aux
 
 
@@ -2149,7 +2253,8 @@ def swim_tick_send(state: SwimState, round_idx, base_key,
                             ctx["is_self"], ctx["fd_round"],
                             ctx["sync_round"], ctx["gate_contacts"],
                             ctx["known_live"], ctx["is_seed"],
-                            ctx["keys"], offset)
+                            ctx["keys"], offset,
+                            k_channel=ctx["k_shifts"])
     buf, fbuf = _scatter_channel_bufs(s, params, False, False)
     # FD verdicts are observer-local: fold them into the owner's row
     # block of the pending buffer (serial folds after the combine; max
@@ -2616,6 +2721,53 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     )
     inbox_alive |= delivered_flags & ok_s_now[:, None]
 
+    # Anti-entropy plane: the paired full-table exchange (models/sync.py)
+    # as two extra syncable-payload channels at the shared offset ±s —
+    # receiver j hears partner (j - s) on the forward channel and
+    # (j + s) on the reverse one, so each unordered pair {i, i + s}
+    # swaps tables in full duplex.  Same-round delivery only (no delay
+    # ring — the _seed_anti_entropy precedent).
+    ae_sent_local = None
+    if params.sync_interval > 0:
+        ae_due = sync_plane.due(round_idx, params.sync_interval)
+        s_ae = sync_plane.partner_offset(k_shifts, n)
+        k_ae = sync_plane.drop_key(k_sync_drop)
+        ae_sent_local = sync_plane.sent_count(ae_due, alive_here)
+        for d_i, sft in enumerate((s_ae, jnp.int32(n) - s_ae)):
+            _, sa_ae, sp_ae, loss_ae, _ = _shift_sender_gate(
+                eng, d_ids, d_alive, d_part, sft, world, round_idx,
+                node_ids, kn, params,
+            )
+            part_ok_ae = sp_ae == part_here
+            wire_drop_ae = jax.random.uniform(
+                jax.random.fold_in(k_ae, d_i), (n_local,)) < loss_ae
+            ok_ae = (ae_due & sa_ae & alive_here & part_ok_ae
+                     & ~wire_drop_ae)
+            contact_ok_ae = None
+            if gate_contacts:
+                sender_knows = jnp.take_along_axis(
+                    eng.deliver(h_status, sft),
+                    node_ids[:, None], axis=1,
+                )[:, 0]
+                contact_ok_ae = (
+                    (sender_knows == records.ALIVE)
+                    | (sender_knows == records.SUSPECT)
+                    | is_seed(node_ids)
+                )
+                ok_ae &= contact_ok_ae
+            delivered_ae, flags_ae = deliver_sync(sft)
+            inbox = jnp.maximum(
+                inbox, jnp.where(ok_ae[:, None], delivered_ae, no_msg)
+            )
+            inbox_alive |= flags_ae & ok_ae[:, None]
+            if counters_on:
+                attempt_ae = ae_due & sa_ae
+                if contact_ok_ae is not None:
+                    attempt_ae &= contact_ok_ae
+                lost_ae = attempt_ae & (wire_drop_ae | ~part_ok_ae)
+                sent_acc += unshift(attempt_ae, sft).astype(jnp.int32)
+                lost_acc += unshift(lost_ae, sft).astype(jnp.int32)
+
     # Joiner <-> seed SYNC round trip (the reference's join protocol;
     # inert once no row holds ABSENT entries — the masked key copy only
     # materializes in seed-configured cold-start scenarios).
@@ -2640,6 +2792,8 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         messages_ping_req_sent=ping_req_n,
         refutations=jnp.sum(refuted & alive_here, dtype=jnp.int32),
     )
+    if ae_sent_local is not None:
+        aux["messages_anti_entropy"] = ae_sent_local
     if counters_on:
         aux["sent_by_node"] = (
             sent_acc + probes_sent.astype(jnp.int32)
@@ -2753,6 +2907,28 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
         sync_round & sender_alive_s & alive_here & ~sender_refuting
         & (sender_part_s == part_here) & (drop_u[:, f] >= loss_sy)
     )
+    # Anti-entropy plane channel gates (K-independent [N] vectors; the
+    # per-block loop below delivers the payload) — same draws, same
+    # order as _tick_shift's exchange block, which is what keeps the
+    # blocked tick bit-identical with the plane on.
+    ae_shifts, ok_ae, ae_sent_local = (), [], None
+    if params.sync_interval > 0:
+        ae_due = sync_plane.due(round_idx, params.sync_interval)
+        s_ae = sync_plane.partner_offset(k_shifts, n)
+        k_ae = sync_plane.drop_key(k_sync_drop)
+        ae_sent_local = sync_plane.sent_count(ae_due, alive_here)
+        ae_shifts = (s_ae, jnp.int32(n) - s_ae)
+        for d_i, sft in enumerate(ae_shifts):
+            _, sa_ae, sp_ae, loss_ae, _ = _shift_sender_gate(
+                eng, d_ids, d_alive, d_part, sft, world, round_idx,
+                node_ids, kn, params,
+            )
+            wire_drop_ae = jax.random.uniform(
+                jax.random.fold_in(k_ae, d_i), (n,)) < loss_ae
+            ok_ae.append(
+                ae_due & sa_ae & alive_here & (sp_ae == part_here)
+                & ~wire_drop_ae
+            )
 
     # ---- K-independent extras: message counts, user gossip --------------
     leaving = world.leave_at[node_ids] == round_idx          # [N]
@@ -2854,6 +3030,12 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
         oks = ok_sync[:, None]
         inbox_b = jnp.maximum(inbox_b, jnp.where(oks, payload, no_msg))
         inbox_alive_b |= aflags & oks
+        for d_i, sft in enumerate(ae_shifts):        # anti-entropy pair
+            payload, aflags = deliver_channel_b(sft, 2)
+            oka = ok_ae[d_i][:, None]
+            inbox_b = jnp.maximum(inbox_b,
+                                  jnp.where(oka, payload, no_msg))
+            inbox_alive_b |= aflags & oka
 
         new_blk, refuted_b = _merge_and_timers(
             blk, st_b, inc_b, inbox_b, inbox_alive_b, round_idx,
@@ -2955,6 +3137,8 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
         messages_ping_sent=jnp.sum(probes_sent, dtype=jnp.int32),
         messages_ping_req_sent=ping_req_n,
         refutations=jnp.sum(refuted & alive_here, dtype=jnp.int32),
+        **({"messages_anti_entropy": ae_sent_local}
+           if ae_sent_local is not None else {}),
         blocked_metrics=dict(
             hist_alive=h_alive, hist_suspect=h_suspect, hist_dead=h_dead,
             still_suspect=h_still, subject_alive_i=subject_alive_i,

@@ -1,0 +1,225 @@
+"""SYNC anti-entropy plane: periodic full-table exchange for partition heal.
+
+The reference's signature non-paper extension is MembershipProtocolImpl's
+periodic SYNC (doSync -> onSync -> SYNC_ACK, MembershipProtocolImpl.java:
+298-331,346-367): every ``syncInterval`` each member exchanges its FULL
+membership table with one peer drawn from seeds ∪ live members, and both
+sides merge by the incarnation-precedence rules.  Infection-style
+piggyback gossip (Das et al., 2002) only carries *recent* updates — two
+halves healed after a long partition can disagree forever about events
+that aged out of the spread window (the gossip payload mask in
+``models/swim._send_components``).  Anti-entropy epidemic repair
+(Demers et al., 1987) closes exactly that gap: the full-state exchange
+re-seeds the stale disagreements into the table merge, whose accepted
+records re-enter the hot gossip window and disseminate epidemically —
+so a healed partition re-converges within roughly one sync interval
+plus one dissemination bound.
+
+This module is the device-side form of that plane, composed into the
+SWIM tick (``SwimParams.sync_interval`` rounds; 0 — the default — is
+OFF and compiles the plane out entirely, leaving every run shape
+bit-identical to the plane-less tick).
+
+Exchange topology — the paired-offset deviation (documented)
+------------------------------------------------------------
+The reference's doSync draws one peer per member from seeds ∪ live
+candidates and completes a request/reply round trip.  A per-member
+random peer with a reply is a gather across the member axis — hostile
+to the sharded row layout (the reply's source rows live on other
+devices).  Instead the plane draws ONE shared ring offset ``s`` per
+exchange round (from the round key all devices agree on, like shift
+mode's channel shifts) and every live member sends its full syncable
+table to BOTH ``(i + s) mod N`` and ``(i - s) mod N``.  The unordered
+pair ``{i, i + s}`` therefore exchanges tables in full duplex — member
+``i``'s send on the ``+s`` channel is the SYNC, its partner's send on
+the ``-s`` channel is the SYNC_ACK — and both directions are plain
+shifted/scattered dense flows, so the exchange rides the existing
+delivery machinery in every mode (scatter, shift, blocked) and the
+sharded twins, including the pipelined double-buffer (the contribution
+folds into the same global-height inbox buffer the regular channels
+pmax).  Per-member peer choice is uniform over offsets, which is the
+statistical regime of the reference's uniform candidate draw; the
+seed-gated contact rule (known-live ∪ seeds) still applies when seeds
+are configured, matching doSync's candidate set.
+
+Payload and merge
+-----------------
+The payload is the sender's full table row — status + incarnation
+lanes packed as wire keys — masked by the same ``syncable`` rule as the
+in-tick SYNC channel (table-DEAD rows are never transmitted: the
+reference's table holds no DEAD records).  Delivery is subject to
+ground-truth liveness, partition walls, and per-link loss exactly like
+every other channel; it is same-round even under ``max_delay_rounds``
+(``sync_timeout`` >> link delays in the reference regime — the
+``_seed_anti_entropy`` precedent).  The receiver merges through the
+ordinary inbox max-fold + ``ops/delivery.merge_inbox`` gate, so the
+incarnation-precedence rules are the table's own: in particular a
+stored DEAD tombstone gates like ABSENT and REOPENS for an arriving
+ALIVE record — which is precisely how a healed half re-admits the
+members it declared dead during the partition (the dense analog of the
+reference's remove-then-re-add, MembershipProtocolTest.
+testNetworkPartitionThenRecovery).
+
+Convergence measurement
+-----------------------
+``divergent_cells`` / ``divergence_probe`` quantify table agreement:
+a subject column is DIVERGENT while two live observers hold different
+(status, incarnation) records about it.  ``chaos/monitor.py`` raises
+``POST_HEAL_DIVERGENCE`` when divergence persists past the scenario's
+post-heal agreement window; ``bench.py --sync`` measures
+``sync_rounds_to_converge`` — rounds from the heal until the first
+divergence-free table — for the plane against the gossip-only control
+(which provably never converges: stale tombstones are neither hot for
+gossip nor eligible FD targets, so nothing ever repairs them).
+
+The quiesced-heal precondition (measured, not assumed)
+------------------------------------------------------
+The bounded re-convergence claim holds for partitions whose fault
+effects went COLD before the heal: every cross-partition suspicion
+matured to a tombstone and the tombstones' gossip windows expired
+inside the split.  There the post-heal dynamics are monotone — ALIVE
+records reopen tombstone cells through the merge gate, the reopened
+records disseminate, and nothing re-arms the dead notices — and
+convergence lands within one exchange plus one dissemination bound.
+A heal arriving MID-SUSPICION (split shorter than detection +
+suspicion timeout + spread expiry) instead releases freshly-hot
+tombstones into the healed cluster, and the protocol's own merge
+precedence (a DEAD record overrides ANY live incarnation,
+records.is_overrides rule 3, while a stored tombstone reopens for any
+ALIVE) sustains a DEAD/ALIVE reinfection ping-pong that no amount of
+anti-entropy bounds — the subject burns incarnations refuting a
+death notice that keeps re-arming.  That regime is a faithful property
+of the reference's merge rules, not of this plane (the reference's
+partition-recovery test heals a quiesced split too); the scenario
+compiler therefore only PROMISES post-heal agreement
+(``chaos/monitor.POST_HEAL_DIVERGENCE``) when the split length clears
+``chaos/scenarios.quiesce_bound``, and ``bench.py --sync`` measures
+the quiesced-heal scenario.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu import records
+
+# Fold constants for the plane's PRNG streams — disjoint from every
+# existing fold (0x5317 shift channels, 29 seed anti-entropy, 7/11/13
+# delay bins), so enabling the plane never perturbs the base tick's
+# draws (the sync_interval=0 bit-identity contract).
+_OFFSET_FOLD = 0x53CA
+_DROP_FOLD = 41
+
+
+def due(round_idx, sync_interval: int):
+    """Is ``round_idx`` an anti-entropy exchange round?
+
+    Static ``sync_interval`` (a SwimParams field); callers gate the
+    whole phase out when it is 0, so the dynamic predicate only exists
+    in programs that carry the plane.  Fires at round 0 too — on a warm
+    converged table the exchange is a semantic no-op (every delivered
+    key equals the stored key, and the merge gate is strict), so the
+    phase's cadence needs no special-casing at the origin.
+    """
+    return (round_idx % jnp.int32(sync_interval)) == 0
+
+
+def partner_offset(channel_key, n_members: int):
+    """The round's shared exchange offset ``s`` in [1, n_members - 1].
+
+    Drawn from a dedicated fold of the round's CHANNEL key (the
+    un-device-folded stream every shard agrees on — models/swim.
+    _round_context's ``k_shifts``), so all devices pair the same rows.
+    ``s = n/2`` degenerates the two directions onto one partner; the
+    inbox max-fold dedups the double delivery, so the edge costs
+    nothing and needs no exclusion.
+    """
+    return jax.random.randint(
+        jax.random.fold_in(channel_key, _OFFSET_FOLD), (), 1, n_members,
+        dtype=jnp.int32,
+    )
+
+
+def drop_key(k_sync_drop):
+    """The per-device key sourcing the exchange's two in-flight loss
+    draws (one per direction, folded 0/1 by the caller)."""
+    return jax.random.fold_in(k_sync_drop, _DROP_FOLD)
+
+
+def exchange_targets(node_ids, s, n_members: int):
+    """[n_local, 2] global partner ids: column 0 = ``(i + s) mod N``
+    (the SYNC direction), column 1 = ``(i - s) mod N`` (the partner's
+    reply direction)."""
+    n = jnp.int32(n_members)
+    fwd = (node_ids + s) % n
+    bwd = (node_ids - s) % n          # jnp mod: non-negative for n > 0
+    return jnp.stack([fwd, bwd], axis=1)
+
+
+def sent_count(ae_due, alive_here):
+    """``messages_anti_entropy`` for one round: exchange messages
+    issued by live members (2 per member on exchange rounds).
+
+    The send-ATTEMPT convention, counted before partition walls, wire
+    loss, AND the seed-contact gate — deliberately, so the counter
+    means exactly the same thing in scatter and shift modes (the shift
+    tick evaluates the contact gate at the receiver; counting gated
+    attempts at the sender there would cost two extra unshift
+    exchanges per round on the hot path).  Per-link delivered/lost
+    attribution — including contact-gate suppression — is the
+    ``link_counters`` substrate's job, exactly as for the gossip
+    channels."""
+    return 2 * jnp.sum(ae_due & alive_here, dtype=jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# Table-agreement measurement (the convergence observable)
+# --------------------------------------------------------------------------
+
+
+def divergent_cells(status, inc, alive_rows):
+    """Cells where a live observer disagrees with the column consensus.
+
+    ``status``/``inc`` are WIDE [N, K] table lanes, ``alive_rows`` [N]
+    ground-truth observer liveness.  A column AGREES when every live
+    observer holds the same (status, incarnation) record about it; the
+    per-cell mask marks live observers whose packed record differs from
+    the column's maximum packed record — empty iff the live tables
+    agree exactly (the packed key is injective in (status, inc) below
+    the wire saturation cap, records.merge_key docstring).
+
+    Returns ``(cell_mask [N, K] bool, divergent_cols [K] bool)``.
+    Frozen (crashed/left) rows are excluded: their stale tables are
+    unreachable state, not live disagreement.
+    """
+    key = records.merge_key(status, jnp.asarray(inc, jnp.int32))
+    live = jnp.asarray(alive_rows, jnp.bool_)[:, None]
+    fill = jnp.iinfo(jnp.int32).min
+    col_max = jnp.max(jnp.where(live, key, fill), axis=0)
+    cell_mask = live & (key != col_max[None, :])
+    return cell_mask, jnp.any(cell_mask, axis=0)
+
+
+@partial(jax.jit, static_argnames=("params",))
+def divergence_probe(state, params, world, n_rounds):
+    """Divergent-column count of a carry encoded at cursor ``n_rounds``
+    (the number of rounds executed so far) — the host-side convergence
+    probe ``bench.py --sync`` polls between run segments.
+
+    Layout-neutral: compact/int16 carries decode first (the same rule
+    the monitor uses).  ``n_rounds`` is a DYNAMIC argument — the bench's
+    probe loop calls this with a new cursor every few rounds, and a
+    static cursor would recompile the [N, K] program per probe.
+    Returns an int32 scalar.
+    """
+    from scalecube_cluster_tpu.models import swim
+
+    cursor = jnp.asarray(n_rounds, jnp.int32)
+    if params.compact_carry:
+        state = swim._carry_decode(state, cursor)
+    _, cols = divergent_cells(state.status, state.inc,
+                              world.alive_at(cursor))
+    return jnp.sum(cols, dtype=jnp.int32)

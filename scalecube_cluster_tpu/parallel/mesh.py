@@ -101,6 +101,8 @@ def _shard_prelude(params: swim.SwimParams, mesh: Mesh):
                     "refutations"]
     if params.n_user_gossips > 0:
         metric_names.append("user_gossip_infected")
+    if params.sync_interval > 0:
+        metric_names.append("messages_anti_entropy")
     out_metric_specs = {name: P() for name in metric_names}
     return axis, n_dev, n_local, state_specs, out_metric_specs
 

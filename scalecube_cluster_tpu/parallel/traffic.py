@@ -87,10 +87,19 @@ def shift_exchanges_per_round(params, gate_contacts: bool = False):
     Returns a dict of exchange-name -> row_bytes; the exchange count is
     its length.  Pinned to models/swim._tick_shift by tests/test_traffic.py
     (trace-time call counts AND the compiled HLO's collective operands).
+
+    The SYNC anti-entropy plane (``params.sync_interval > 0``) adds two
+    payload channels — the ``±s`` paired full-table exchange
+    (models/sync.py) — that execute every round with their delivery
+    masked off non-exchange rounds (the same no-``cond`` discipline as
+    the FD probe), so the per-round exchange count and wire bytes grow
+    by exactly two (keys + txmask, plus the status gate when contacts
+    are seed-gated).
     """
     k = params.n_subjects
     kb = _key_bytes(params)
-    channels = params.fanout + 2            # gossip channels + SYNC + refute
+    ae = 2 if params.sync_interval > 0 else 0
+    channels = params.fanout + 2 + ae   # gossip + SYNC + refute (+ plane)
     exchanges = {}
     for c in range(channels):
         exchanges[f"keys[{c}]"] = k * kb
@@ -138,8 +147,41 @@ def pipelined_scatter_hlo_collectives(params) -> int:
 def scatter_ici_bytes_per_device_round(params, n_devices: int) -> int:
     """Bytes each device sends over ICI per round, scatter mode: ring
     all-reduce cost 2*(D-1)/D * buffer over the [N,K] key + int8 flag
-    buffers."""
+    buffers.
+
+    The anti-entropy plane adds NO scatter-mode ICI traffic: its two
+    exchange channels scatter into the SAME full-height contribution
+    buffers the regular channels pmax (models/swim._scatter_channel_bufs),
+    so collective count and operand bytes are unchanged — pinned by
+    tests/test_traffic.py's sync-plane HLO test.
+    """
     n, k = params.n_members, params.n_subjects
     bins = params.max_delay_rounds + 1 if params.max_delay_rounds > 0 else 1
     buffer_bytes = n * k * (_key_bytes(params) + INT8) * bins
     return int(2 * (n_devices - 1) / n_devices * buffer_bytes)
+
+
+# --------------------------------------------------------------------------
+# SYNC anti-entropy plane: full-table bytes per interval vs piggyback
+# --------------------------------------------------------------------------
+
+
+def sync_exchange_bytes_per_member(params) -> int:
+    """Wire bytes ONE member sends per anti-entropy exchange round
+    (``sync_interval`` cadence, models/sync.py): its full syncable
+    table row — K packed record keys — to each of the two paired
+    partners.  The per-interval cost of the repair plane; amortized
+    per round it is this / sync_interval."""
+    return 2 * params.n_subjects * _key_bytes(params)
+
+
+def piggyback_bytes_per_member_round(params) -> int:
+    """Upper-bound wire bytes one member's piggyback gossip moves per
+    round: ``fanout`` targets x the K-record payload (hot-masked in
+    practice, so the real figure is occupancy x this — the
+    ``gossip_piggyback_occupancy`` gauge).  The comparison figure for
+    the anti-entropy plane's amortized cost: with the default
+    ``sync_interval`` orders of magnitude above 1, the repair plane's
+    per-round bytes are a small fraction of the piggyback budget
+    (``bench.py --sync`` reports both)."""
+    return params.fanout * params.n_subjects * _key_bytes(params)

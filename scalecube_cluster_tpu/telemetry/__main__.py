@@ -83,8 +83,10 @@ def _cmd_diff(args) -> int:
 
 
 def _cmd_regress(args) -> int:
-    paths = query.expand_paths(args.paths
-                               or ["BENCH_*.json", "MULTICHIP_*.json"])
+    paths = query.expand_paths(
+        args.paths
+        or ["BENCH_*.json", "MULTICHIP_*.json",
+            os.path.join("artifacts", "sync_heal*.json")])
     readable = [p for p in paths if os.path.exists(p)]
     if not readable:
         print("regress: no artifacts matched", file=sys.stderr)
@@ -127,7 +129,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="fail on regressions along the BENCH/MULTICHIP trajectories")
     p.add_argument("paths", nargs="*",
                    help="artifact files/globs (default: BENCH_*.json "
-                        "MULTICHIP_*.json)")
+                        "MULTICHIP_*.json artifacts/sync_heal*.json)")
     p.add_argument("--band", type=float, default=query.DEFAULT_NOISE_BAND,
                    help="relative noise band (default 0.10)")
     p.add_argument("--json", action="store_true")

@@ -216,6 +216,13 @@ def compute_slos(report: HealthReport) -> dict:
     slos["dissemination_rounds"] = (
         dissemination_rounds_from_curve(curve) if curve else None)
 
+    # SYNC anti-entropy plane: rounds from the partition heal to the
+    # first divergence-free membership table (bench.py --sync writes
+    # this into the run's summary row; models/sync.py defines the
+    # divergence observable).
+    slos["sync_rounds_to_converge"] = report.summary.get(
+        "sync_rounds_to_converge")
+
     slos["chaos_violations"] = c.get("chaos_violations")
     slos["suspect_entries"] = g.get("suspect_entries")
     slos["wire_saturation"] = g.get("wire_saturation")
@@ -303,7 +310,8 @@ def load_bench_payload(path: str) -> Tuple[Optional[dict], Optional[str]]:
         if not (isinstance(payload, dict)
                 and ("traced_overhead_ratio" in payload
                      or "metrics_overhead_ratio" in payload
-                     or "pipelined_speedup_ratio" in payload)):
+                     or "pipelined_speedup_ratio" in payload
+                     or "sync_rounds_to_converge" in payload)):
             return None, stub_note
     return payload, None
 
@@ -333,7 +341,13 @@ def regress(paths: Sequence[str],
         (absolute — 1.0 means the observability plane is free);
       - ``pipelined_speedup_ratio`` (multichip pipelined/serial rate):
         latest must be >= 1 - band — the delivery pipeline must never
-        cost throughput.
+        cost throughput;
+      - SYNC heal artifacts (``sync_rounds_to_converge`` present):
+        the latest must have ``converged`` true with
+        ``post_heal_divergence`` 0 (and the gossip-only control still
+        diverging, when recorded) — absolute gates — and the
+        convergence-time series stays <= best_prior * (1 + band) + 1
+        quantization round.
 
     Returns (ok, check rows); each row {"check", "latest", "reference",
     "threshold", "ok", "source"}.  Unreadable/failed artifacts — and
@@ -418,6 +432,65 @@ def regress(paths: Sequence[str],
             floor = 1.0 - band
             check("slo/pipelined_speedup_ratio", last_path, last, 1.0,
                   floor, last >= floor and math.isfinite(last))
+        # SYNC anti-entropy heal artifacts (bench.py --sync): the latest
+        # round's headline claims gate ABSOLUTELY — the plane must have
+        # converged with zero post-heal divergence while the gossip-only
+        # control demonstrably did not — and the convergence-time series
+        # gates within the band (smaller is better; +1 quantization
+        # round, like dissemination).  Smoke heal artifacts are
+        # provenance, not trajectory data (their tiny N converges on a
+        # different scale), UNLESS the walk holds only smoke rounds —
+        # then they gate themselves, so `--sync --smoke`'s in-bench
+        # check of its own fresh artifact still bites.
+        heals_all = [(p, pl) for p, pl in entries
+                     if "sync_rounds_to_converge" in pl]
+        heals = [(p, pl) for p, pl in heals_all
+                 if not pl.get("smoke")] or heals_all
+        if heals is not heals_all:
+            for p, pl in heals_all:
+                if pl.get("smoke"):
+                    rows.append({
+                        "check": "slo/sync_heal", "source":
+                        os.path.basename(p), "ok": None,
+                        "note": "smoke heal round — different scale, "
+                                "not a trajectory datum",
+                    })
+        if heals:
+            last_path, last = heals[-1]
+            converged = bool(last.get("converged"))
+            check("slo/sync_heal_converged", last_path, converged, True,
+                  True, converged)
+            phd = last.get("post_heal_divergence")
+            check("slo/post_heal_divergence", last_path, phd, 0, 0,
+                  phd == 0)
+            if "gossip_only_converged" in last:
+                check("slo/gossip_only_diverges", last_path,
+                      last["gossip_only_converged"], False, False,
+                      last["gossip_only_converged"] is False)
+            # Absolute contract: convergence landed inside the
+            # scenario's promised window.
+            rounds_c = last.get("sync_rounds_to_converge")
+            window = last.get("window_rounds")
+            if isinstance(rounds_c, (int, float)) and isinstance(
+                    window, (int, float)):
+                check("slo/sync_converge_within_window", last_path,
+                      rounds_c, window, window, rounds_c <= window)
+        conv = [(p, pl) for p, pl in heals
+                if isinstance(pl.get("sync_rounds_to_converge"),
+                              (int, float))]
+        if len(conv) >= 2:
+            *prior, (last_path, last) = conv
+            best = min(pl["sync_rounds_to_converge"] for _, pl in prior)
+            # Floor the reference at one exchange interval: where the
+            # heal round lands relative to the exchange cadence is phase
+            # luck, so a prior run converging on the very first probe
+            # must not turn the band into a knife edge.
+            floor = last.get("sync_interval") or 0
+            limit = (max(best, floor) * (1.0 + band)
+                     + DISSEMINATION_SLACK_ROUNDS)
+            check("slo/sync_rounds_to_converge", last_path,
+                  last["sync_rounds_to_converge"], best, limit,
+                  last["sync_rounds_to_converge"] <= limit)
     return ok, rows
 
 

@@ -10,6 +10,8 @@ as the oracle's full link blockade and leaves as ``Cluster.shutdown``
 (the proven mapping of tests/test_telemetry_trace.py).
 """
 
+import dataclasses
+
 import pytest
 
 from scalecube_cluster_tpu.chaos import campaign as cc
@@ -53,6 +55,68 @@ def test_inexpressible_scenarios_return_none():
         scen = cs.Scenario(name="nope", n_members=N, horizon=96,
                            ops=ops, loss_probability=loss)
         assert cc.cross_validate(scen, seed=0) is None, ops
+
+
+def _quiesced_partition_scenario(sync_interval=10):
+    """One split/heal cycle long enough to quiesce (tombstones cold at
+    the heal — the bounded-re-convergence precondition, models/sync.py),
+    sized from the campaign preset's bounds."""
+    p = cc.campaign_params(
+        cs.Scenario(name="size-probe", n_members=N, horizon=8, ops=()),
+        sync_interval=sync_interval,
+    )
+    return dataclasses.replace(
+        cs.quiesced_heal_scenario(p, N), name="xval-partition-heal")
+
+
+@pytest.mark.sync
+def test_partition_heal_parity_with_oracle_sync_recovery():
+    """The SYNC anti-entropy acceptance leg: under an identical
+    partition/heal schedule, the model (anti-entropy plane ON) and the
+    oracle (doSync/syncAck full-table exchange) emit the SAME timing-free
+    event key sets per member over opposite-half observers — each half
+    suspects, removes, and post-heal RE-ADDS every cross member, and the
+    re-adds are exactly the SYNC-recovered members on both layers."""
+    scen = _quiesced_partition_scenario()
+    cv = cc.cross_validate_partition(scen, seed=3)
+    assert cv is not None
+    assert cv["agree"], {k: d for k, d in cv["victims"].items()
+                         if d["only_model"] or d["only_oracle"]}
+    assert cv["halves"] == [N // 2, N // 2]
+    # Every member was re-added by every opposite-half observer through
+    # the exchange: N/2 ADDED keys per victim, on both layers (the sets
+    # are equal, so counting the model side counts the oracle too).
+    for v, d in cv["victims"].items():
+        assert d["sync_recovered_keys"] == N // 2, (v, d)
+
+    # The same schedule's monitored green (incl. the armed
+    # POST_HEAL_DIVERGENCE window) is pinned by tests/test_monitor.py;
+    # here just check build() arms the promise for this scenario too.
+    params = cc.campaign_params(scen, sync_interval=10)
+    _, spec = scen.build(params)
+    assert int(spec.agree_from) < scen.horizon  # the promise was armed
+
+
+def test_partition_heal_inexpressible_variants_return_none():
+    """Multi-cycle or composed partition scenarios are declined, not
+    mis-compared."""
+    two_cycle = cs.Scenario(
+        name="nope", n_members=N, horizon=256,
+        ops=(cs.RollingPartition(from_round=0, phase_rounds=32,
+                                 n_cycles=2),))
+    assert cc.cross_validate_partition(two_cycle, seed=0) is None
+    composed = cs.Scenario(
+        name="nope", n_members=N, horizon=256,
+        ops=(cs.RollingPartition(from_round=0, phase_rounds=32,
+                                 n_cycles=1),
+             cs.Crash(3, at_round=2)))
+    assert cc.cross_validate_partition(composed, seed=0) is None
+    lossy = cs.Scenario(
+        name="nope", n_members=N, horizon=256,
+        ops=(cs.RollingPartition(from_round=0, phase_rounds=32,
+                                 n_cycles=1),),
+        loss_probability=0.05)
+    assert cc.cross_validate_partition(lossy, seed=0) is None
 
 
 def test_campaign_attaches_cross_validation(tmp_path):

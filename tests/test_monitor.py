@@ -5,8 +5,12 @@ MembershipProtocolImpl.JmxMonitorMBean (:693-749): member identity,
 incarnation, alive/suspected member lists, removal ring, metadata dump.
 """
 
+import dataclasses
+
 import jax
+import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from scalecube_cluster_tpu.models import swim
 from scalecube_cluster_tpu.oracle import Cluster, Simulator
@@ -66,3 +70,136 @@ def test_tick_snapshot_after_refutation_shows_bumped_incarnation():
     incs = [swim.node_snapshot(state, params, world, i)["incarnation"]
             for i in range(n)]
     assert max(incs) > 0
+
+
+# --------------------------------------------------------------------------
+# POST_HEAL_DIVERGENCE: the SYNC anti-entropy re-convergence contract
+# --------------------------------------------------------------------------
+
+
+def _heal_scenario(n, sync_interval):
+    """A quiesced single split/heal cycle + its params (plane on when
+    sync_interval > 0; the in-tick push channel off in both arms so the
+    control is honestly gossip-only)."""
+    from scalecube_cluster_tpu.chaos import scenarios as cs
+
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="scatter", sync_every=0,
+        sync_interval=sync_interval,
+    )
+    scen = cs.quiesced_heal_scenario(params, n, name="monitor-heal")
+    world, spec = scen.build(params)
+    return params, world, spec, scen
+
+
+@pytest.mark.sync
+def test_post_heal_divergence_trips_on_gossip_only_heal():
+    """Gossip-only control: the healed halves' stale tombstones are
+    never repaired, so past the agreement deadline the code trips —
+    exact totals every round plus first-trip evidence lanes."""
+    from scalecube_cluster_tpu.chaos import monitor as cm
+    from scalecube_cluster_tpu.chaos import scenarios as cs
+
+    n = 16
+    params, world, spec, scen = _heal_scenario(n, sync_interval=0)
+    # build() makes no agreement promise without the plane; arm the
+    # deadline manually to demonstrate the divergence is real.
+    assert int(spec.agree_from) == np.iinfo(np.int32).max
+    p_on = dataclasses.replace(params, sync_interval=8)
+    agree_from = (scen.ops[0].phase_rounds
+                  + cs.post_heal_agreement_bound(p_on, n))
+    spec = dataclasses.replace(spec, agree_from=jnp.int32(agree_from),
+                               check_agreement=True)
+
+    _, mon, _ = cm.run_monitored(jax.random.key(0), params, world, spec,
+                                 scen.horizon)
+    v = cm.verdict(mon)
+    code = v["codes"]["POST_HEAL_DIVERGENCE"]
+    assert not v["green"]
+    assert code["violations"] > 0
+    assert code["first_round"] == agree_from      # trips the moment due
+    # Every OTHER safety code stays green: the divergence is the only
+    # contract the gossip-only heal breaks.
+    assert all(d["violations"] == 0 for name, d in v["codes"].items()
+               if name != "POST_HEAL_DIVERGENCE")
+    # First-trip evidence lanes carry the divergent cells.
+    lanes = [x for x in cm.decode_violations(mon)
+             if x.code == cm.InvariantCode.POST_HEAL_DIVERGENCE]
+    assert lanes and all(x.round == agree_from for x in lanes)
+    assert all(0 <= x.observer < n and 0 <= x.subject < n for x in lanes)
+
+
+@pytest.mark.sync
+def test_post_heal_divergence_green_with_sync_plane():
+    """Same schedule with the plane on: build() arms the agreement
+    promise itself and the monitored run is green — the bounded
+    re-convergence contract holds."""
+    from scalecube_cluster_tpu.chaos import monitor as cm
+
+    n = 16
+    params, world, spec, scen = _heal_scenario(n, sync_interval=8)
+    assert int(spec.agree_from) < scen.horizon    # promise armed
+    _, mon, _ = cm.run_monitored(jax.random.key(0), params, world, spec,
+                                 scen.horizon)
+    v = cm.verdict(mon)
+    assert v["green"], v["codes"]
+
+
+@pytest.mark.sync
+def test_agreement_promise_needs_quiesced_heal():
+    """A split shorter than quiesce_bound releases hot tombstones into
+    the heal — a regime the merge precedence cannot bound — so build()
+    must NOT promise agreement for it even with the plane on."""
+    from scalecube_cluster_tpu.chaos import scenarios as cs
+
+    n = 16
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="scatter", sync_every=0,
+        sync_interval=8,
+    )
+    short = cs.quiesce_bound(params, n) // 2
+    short -= short % 16
+    scen = cs.Scenario(
+        name="mid-flight-heal", n_members=n, horizon=256,
+        ops=(cs.RollingPartition(from_round=0, phase_rounds=max(short, 16),
+                                 n_cycles=1),),
+    )
+    _, spec = scen.build(params)
+    assert int(spec.agree_from) == np.iinfo(np.int32).max
+    # Background loss also voids the promise (transient false suspicions
+    # legitimately break agreement at any time).
+    lossy = dataclasses.replace(scen, loss_probability=0.05)
+    _, spec = lossy.build(params)
+    assert int(spec.agree_from) == np.iinfo(np.int32).max
+
+
+@pytest.mark.sync
+def test_agreement_window_accounts_for_crash_maturation():
+    """A permanent crash's suspicion timers mature INSIDE any naive
+    fault-round + dissemination window: the agreement deadline must sit
+    past detection + suspicion + tombstone spread (quiesce_bound), or a
+    legitimate run trips POST_HEAL_DIVERGENCE while observers hold the
+    mid-maturation ALIVE/SUSPECT/DEAD mixture."""
+    from scalecube_cluster_tpu.chaos import monitor as cm
+    from scalecube_cluster_tpu.chaos import scenarios as cs
+
+    n = 16
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="scatter", sync_every=0,
+        sync_interval=8,
+    )
+    crash_at = 8
+    horizon = (crash_at + cs.quiesce_bound(params, n)
+               + cs.post_heal_agreement_bound(params, n) + 32)
+    scen = cs.Scenario(name="crash-agree", n_members=n, horizon=horizon,
+                       ops=(cs.Crash(3, at_round=crash_at),))
+    world, spec = scen.build(params)
+    agree_from = int(spec.agree_from)
+    # Armed (plane on, pristine, permanent crash quiesces) and past the
+    # maturation window.
+    assert agree_from < horizon
+    assert agree_from >= crash_at + params.suspicion_rounds
+    _, mon, _ = cm.run_monitored(jax.random.key(0), params, world, spec,
+                                 horizon)
+    v = cm.verdict(mon)
+    assert v["green"], v["codes"]

@@ -1,0 +1,270 @@
+"""The SYNC anti-entropy plane (models/sync.py + SwimParams.sync_interval).
+
+Three contracts, each pinned across carry layouts and run shapes:
+
+  1. *off = bit-identical*: ``sync_interval=0`` (the default) compiles
+     the plane out — the tick's draws, tables, and metrics tree are
+     exactly the plane-less program's;
+  2. *on + converged table = semantic no-op*: on a healthy warm world
+     the exchange delivers keys equal to the stored keys, the strict
+     merge gate accepts nothing, and the tables stay bit-identical to
+     the plane-off run (only the ``messages_anti_entropy`` counter is
+     new) — enabling the repair plane costs no protocol perturbation;
+  3. *quiesced heal converges; gossip-only does not*: after a split
+     long enough for tombstones to go cold (chaos/scenarios.
+     quiesce_bound), the plane's exchange reopens the stale tombstones
+     and the tables re-converge, while the gossip-only control stays
+     divergent forever — the acceptance claim ``bench.py --sync``
+     measures.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.models import sync as sync_plane
+
+from tests.test_swim_model import fast_config
+
+pytestmark = pytest.mark.sync
+
+STATE_FIELDS = ("status", "inc", "spread_until", "suspect_deadline",
+                "self_inc")
+
+
+def _assert_states_equal(a, b, fields=STATE_FIELDS):
+    for f in fields:
+        assert np.array_equal(np.asarray(getattr(a, f)),
+                              np.asarray(getattr(b, f))), f
+
+
+def _heal_world(params, n, phase, n_phases=4):
+    """One split phase of ``phase`` rounds over contiguous halves, then
+    healed for the rest of the schedule."""
+    world = swim.SwimWorld.healthy(params)
+    part = np.zeros((n_phases, n), np.int8)
+    part[0, : n // 2] = 1
+    return world.with_partition_schedule(part, phase)
+
+
+# --------------------------------------------------------------------------
+# 1 + 2: disabled default == baseline; enabled on warm state == no-op
+# --------------------------------------------------------------------------
+
+
+def test_sync_interval_defaults_off():
+    params = swim.SwimParams.from_config(fast_config(), n_members=8)
+    assert params.sync_interval == 0
+    explicit = dataclasses.replace(params, sync_interval=0)
+    assert explicit == params          # same static params, same program
+
+
+@pytest.mark.parametrize("delivery,subjects,layout", [
+    ("scatter", None, "wide"),
+    ("shift", None, "wide"),
+    ("shift", 8, "wide"),              # focal
+    ("shift", None, "compact"),
+    ("scatter", None, "wire16"),
+])
+def test_plane_on_warm_world_is_table_noop(delivery, subjects, layout):
+    """On a healthy converged table the exchange accepts nothing: the
+    plane-on run's carry is bit-identical to plane-off, and the metrics
+    tree differs ONLY by the new counter.  This is the strong form of
+    the off-switch pin: the plane's draws come from dedicated key folds,
+    so enabling it perturbs no existing stream."""
+    n = 24
+    p_off = swim.SwimParams.from_config(
+        fast_config(), n_members=n, n_subjects=subjects,
+        delivery=delivery,
+        compact_carry=layout == "compact", int16_wire=layout == "wire16",
+    )
+    p_on = dataclasses.replace(p_off, sync_interval=4)
+    world = swim.SwimWorld.healthy(p_off)
+    s_off, m_off = swim.run(jax.random.key(0), p_off, world, 20)
+    s_on, m_on = swim.run(jax.random.key(0), p_on, world, 20)
+    _assert_states_equal(s_off, s_on)
+    assert "messages_anti_entropy" not in m_off
+    assert set(m_on) == set(m_off) | {"messages_anti_entropy"}
+    for k in m_off:
+        assert np.array_equal(np.asarray(m_off[k]), np.asarray(m_on[k])), k
+
+
+def test_exchange_counter_cadence():
+    """2 messages per live member, exactly on exchange rounds."""
+    n = 16
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="scatter", sync_interval=5)
+    world = swim.SwimWorld.healthy(params).with_crash(3, at_round=0)
+    _, m = swim.run(jax.random.key(1), params, world, 12)
+    ae = np.asarray(m["messages_anti_entropy"])
+    expect = np.where(np.arange(12) % 5 == 0, 2 * (n - 1), 0)
+    assert np.array_equal(ae, expect)
+
+
+def test_param_validation():
+    params = swim.SwimParams.from_config(fast_config(), n_members=8)
+    with pytest.raises(ValueError, match="sync_interval"):
+        dataclasses.replace(params, sync_interval=-1)
+    solo = swim.SwimParams.from_config(fast_config(), n_members=1)
+    with pytest.raises(ValueError, match="n_members >= 2"):
+        dataclasses.replace(solo, sync_interval=4)
+
+
+# --------------------------------------------------------------------------
+# 3: the heal claim
+# --------------------------------------------------------------------------
+
+
+def _heal_setup(delivery, n=24, sync_interval=8, **overrides):
+    from scalecube_cluster_tpu.chaos import scenarios as cs
+
+    p_off = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery=delivery, sync_every=0,
+        **overrides)
+    p_on = dataclasses.replace(p_off, sync_interval=sync_interval)
+    phase = -(-cs.quiesce_bound(p_on, n) // 16) * 16
+    rounds = phase + cs.post_heal_agreement_bound(p_on, n)
+    return p_off, p_on, _heal_world(p_on, n, phase), rounds
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+def test_quiesced_heal_converges_only_with_plane(delivery):
+    p_off, p_on, world, rounds = _heal_setup(delivery)
+    s_off, _ = swim.run(jax.random.key(1), p_off, world, rounds)
+    s_on, _ = swim.run(jax.random.key(1), p_on, world, rounds)
+    assert int(sync_plane.divergence_probe(s_off, p_off, world,
+                                           rounds)) > 0
+    assert int(sync_plane.divergence_probe(s_on, p_on, world,
+                                           rounds)) == 0
+    # The healed table is accurate, not merely consistent: every member
+    # is ALIVE everywhere again.
+    assert (np.asarray(s_on.status) == 0).all()
+    # And the repair went through the stored-DEAD-reopens-for-ALIVE
+    # merge gate, not refutation storms: nobody burned incarnations.
+    assert int(np.asarray(s_on.self_inc).max()) == 0
+
+
+def test_blocked_and_compact_layouts_identical_with_plane():
+    """Blocked tick bit-identity + compact-carry trace-identity with
+    the plane on, through the split's tombstoning (identity pins need
+    the exchange ACTIVE, not a full convergence horizon — the heal
+    claim itself is pinned above)."""
+    n = 32
+    _, p_on, world, full_rounds = _heal_setup("shift", n=n)
+    rounds = min(full_rounds, 180)       # split + first exchanges
+    s_ref, m_ref = swim.run(jax.random.key(3), p_on, world, rounds)
+    p_blk = dataclasses.replace(p_on, k_block=8)
+    s_blk, m_blk = swim.run(jax.random.key(3), p_blk, world, rounds)
+    _assert_states_equal(s_ref, s_blk)
+    assert np.array_equal(np.asarray(m_ref["messages_anti_entropy"]),
+                          np.asarray(m_blk["messages_anti_entropy"]))
+    p_c = dataclasses.replace(p_on, compact_carry=True)
+    s_c, _ = swim.run(jax.random.key(3), p_c, world, rounds)
+    dec = swim._carry_decode(s_c, jnp.int32(rounds))
+    assert np.array_equal(np.asarray(s_ref.status), np.asarray(dec.status))
+    assert np.array_equal(np.asarray(s_ref.inc), np.asarray(dec.inc))
+
+
+def test_focal_heal_converges():
+    """Focal mode (the 1M bench shape): subjects spread over both
+    halves; the exchange repairs the focal columns."""
+    n, k = 64, 8
+    from scalecube_cluster_tpu.chaos import scenarios as cs
+
+    p_off = swim.SwimParams.from_config(
+        fast_config(), n_members=n, n_subjects=k, delivery="shift",
+        sync_every=0)
+    p_on = dataclasses.replace(p_off, sync_interval=8)
+    phase = -(-cs.quiesce_bound(p_on, n) // 16) * 16
+    rounds = phase + cs.post_heal_agreement_bound(p_on, n)
+    subject_ids = jnp.arange(k, dtype=jnp.int32) * (n // k)
+    world = swim.SwimWorld.healthy(p_on, subject_ids=subject_ids)
+    part = np.zeros((4, n), np.int8)
+    part[0, : n // 2] = 1
+    world = world.with_partition_schedule(part, phase)
+    s_off, _ = swim.run(jax.random.key(5), p_off, world, rounds)
+    s_on, _ = swim.run(jax.random.key(5), p_on, world, rounds)
+    assert int(sync_plane.divergence_probe(s_off, p_off, world,
+                                           rounds)) > 0
+    assert int(sync_plane.divergence_probe(s_on, p_on, world,
+                                           rounds)) == 0
+
+
+# --------------------------------------------------------------------------
+# Sharded twins (incl. the pipelined double-buffer)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.multichip
+def test_sharded_pipelined_equals_serial_with_plane_and_heals():
+    """The exchange rides the pipelined contribution buffer: sharded
+    pipelined == sharded serial bit for bit with the plane on, through
+    a real partition heal — and the sharded run converges."""
+    from scalecube_cluster_tpu.parallel import compat
+    from scalecube_cluster_tpu.parallel import mesh as pmesh
+
+    if not compat.HAS_SHARD_MAP:
+        pytest.skip(compat.SKIP_REASON)
+    n = 32
+    _, p_on, world, rounds = _heal_setup("scatter", n=n)
+    mesh = pmesh.make_mesh(4)
+    s_ser, m_ser = pmesh.shard_run(jax.random.key(6), p_on, world,
+                                   rounds, mesh, pipelined=False)
+    s_pip, m_pip = pmesh.shard_run(jax.random.key(6), p_on, world,
+                                   rounds, mesh, pipelined=True)
+    _assert_states_equal(s_ser, s_pip)
+    for k in m_ser:
+        assert np.array_equal(np.asarray(m_ser[k]),
+                              np.asarray(m_pip[k])), k
+    assert "messages_anti_entropy" in m_ser
+    assert int(sync_plane.divergence_probe(s_ser, p_on, world,
+                                           rounds)) == 0
+
+
+@pytest.mark.multichip
+def test_sharded_metered_carries_plane_counter():
+    from scalecube_cluster_tpu.parallel import compat
+    from scalecube_cluster_tpu.parallel import mesh as pmesh
+
+    if not compat.HAS_SHARD_MAP:
+        pytest.skip(compat.SKIP_REASON)
+    n = 16
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="scatter", sync_interval=4)
+    world = swim.SwimWorld.healthy(params)
+    _, _, metrics = pmesh.shard_run_metered(
+        jax.random.key(7), params, world, 8, pmesh.make_mesh(4))
+    ae = np.asarray(metrics["messages_anti_entropy"])
+    expect = np.where(np.arange(8) % 4 == 0, 2 * n, 0)
+    assert np.array_equal(ae, expect)
+
+
+# --------------------------------------------------------------------------
+# Monitored / traced / metered shapes carry the plane unchanged
+# --------------------------------------------------------------------------
+
+
+def test_run_shapes_agree_with_plane_on():
+    """run / run_traced / run_metered / run_monitored /
+    run_monitored_metered all execute the identical tick with the plane
+    on — final tables agree bit for bit across every shape.  (Shape
+    parity needs the exchange active, not a convergence horizon.)"""
+    from scalecube_cluster_tpu.chaos import monitor as cm
+
+    _, p_on, world, rounds = _heal_setup("scatter", n=16)
+    rounds = min(rounds, 72)
+    ref, _ = swim.run(jax.random.key(8), p_on, world, rounds)
+    traced, _, _ = swim.run_traced(jax.random.key(8), p_on, world, rounds)
+    metered, _, _ = swim.run_metered(jax.random.key(8), p_on, world,
+                                     rounds)
+    spec = cm.MonitorSpec.passive(p_on)
+    monitored, _, _ = cm.run_monitored(jax.random.key(8), p_on, world,
+                                       spec, rounds)
+    mm, _, _, _ = cm.run_monitored_metered(jax.random.key(8), p_on,
+                                           world, spec, rounds)
+    for other in (traced, metered, monitored, mm):
+        _assert_states_equal(ref, other)

@@ -228,6 +228,70 @@ def test_pipelined_async_collective_overlap():
     assert overlapped, "no compute scheduled between start/done pairs"
 
 
+@hlo_pinned
+@pytest.mark.sync
+def test_sync_plane_shift_hlo_collectives_match_traffic_model():
+    """With the anti-entropy plane on, the compiled sharded shift
+    program grows exactly the two ±s payload-channel exchanges the
+    model adds (keys + txmask each) — and nothing else."""
+    base = swim.SwimParams.from_config(
+        fast_config(), n_members=256, n_subjects=16, delivery="shift",
+    )
+    params = dataclasses.replace(base, sync_interval=8)
+    world = swim.SwimWorld.healthy(params)
+    hlo = _compiled_hlo(params, world)
+
+    cps = _op_operand_bytes(hlo, "collective-permute")
+    exchanges = traffic.shift_exchanges_per_round(params)
+    assert len(cps) == len(exchanges) * 2 * N_DEV
+    base_exchanges = traffic.shift_exchanges_per_round(base)
+    assert len(exchanges) == len(base_exchanges) + 4    # 2x (keys+txmask)
+    hlo_bytes_per_device = sum(b for _, _, b in cps) // N_DEV
+    assert hlo_bytes_per_device == traffic.shift_ici_bytes_per_device_round(
+        params, N_DEV
+    )
+    assert _op_operand_bytes(hlo, "all-reduce") == []
+
+
+@hlo_pinned
+@pytest.mark.sync
+def test_sync_plane_scatter_hlo_adds_no_collectives():
+    """Scatter mode: the plane's exchange folds into the SAME
+    contribution buffers the regular channels pmax — collective count
+    and operand bytes in the compiled program are UNCHANGED with the
+    plane on (the scatter_ici_bytes_per_device_round docstring's
+    claim)."""
+    n, k = 256, 16
+    base = swim.SwimParams.from_config(
+        fast_config(), n_members=n, n_subjects=k, delivery="scatter",
+    )
+    params = dataclasses.replace(base, sync_interval=8)
+    world = swim.SwimWorld.healthy(params)
+    ars = _op_operand_bytes(_compiled_hlo(params, world), "all-reduce")
+    ars_base = _op_operand_bytes(
+        _compiled_hlo(base, swim.SwimWorld.healthy(base)), "all-reduce")
+    assert len(ars) == len(ars_base) == (
+        traffic.scatter_collectives_per_round(params))
+    assert sorted(b for _, _, b in ars) == sorted(b for _, _, b in ars_base)
+
+
+@pytest.mark.sync
+def test_sync_plane_bytes_model():
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=1024, n_subjects=16, delivery="shift",
+        sync_interval=64,
+    )
+    per_exchange = traffic.sync_exchange_bytes_per_member(params)
+    assert per_exchange == 2 * 16 * 4                 # both directions
+    # Amortized over the interval, the repair plane is a small fraction
+    # of the per-round piggyback budget.
+    amortized = per_exchange / params.sync_interval
+    assert amortized < traffic.piggyback_bytes_per_member_round(params) / 8
+    # int16 wire halves the exchange bytes like every key buffer.
+    compact = dataclasses.replace(params, int16_wire=True)
+    assert traffic.sync_exchange_bytes_per_member(compact) * 2 == per_exchange
+
+
 def _tick_once(params, world, axis_name=None):
     state = swim.initial_state(params, world)
     # Trace (not execute): the python-level deliver/pmax calls happen at
@@ -239,10 +303,12 @@ def _tick_once(params, world, axis_name=None):
 
 
 @pytest.mark.parametrize("gate", [False, True])
-def test_shift_exchange_count_matches_tick(gate):
+@pytest.mark.parametrize("sync_interval", [0, 8])
+def test_shift_exchange_count_matches_tick(gate, sync_interval):
     n = 16
     params = swim.SwimParams.from_config(
-        fast_config(), n_members=n, delivery="shift"
+        fast_config(), n_members=n, delivery="shift",
+        sync_interval=sync_interval,
     )
     world = swim.SwimWorld.healthy(params)
     if gate:
