@@ -99,6 +99,20 @@ SCALECUBE_SYNC_INTERVAL, SCALECUBE_SYNC_PROBE_STEP,
 SCALECUBE_SYNC_MONITOR_N, SCALECUBE_SYNC_SEED,
 SCALECUBE_SYNC_ARTIFACT.
 
+``--lifeguard``: the adaptivity workload — the Lifeguard health plane
+(models/lifeguard.py) measured A/B against its own control under the
+seeded ``chaos.asymmetric_degradation`` scenario (Brownout loss+delay
+on the inbound ranges of a degraded minority — an eighth of the ids,
+``chaos.asymmetric_degraded_range`` — + FlappingLink): the plane must at
+least HALVE the ``false_positive_observer_rate`` SLO while keeping
+crash-detection latency P99 within +1 round — both gated absolutely by
+``telemetry regress`` over the ``artifacts/lifeguard_fp.json``-style
+artifact this mode writes.  ``--lifeguard --smoke`` is the tier-1-safe
+single-scenario pass pinned by tests/test_bench_lifeguard_smoke.py.
+Env overrides: SCALECUBE_LIFEGUARD_N, SCALECUBE_LIFEGUARD_LHM_MAX,
+SCALECUBE_LIFEGUARD_SEED, SCALECUBE_LIFEGUARD_SCENARIOS,
+SCALECUBE_LIFEGUARD_ARTIFACT.
+
 Env overrides for debugging: SCALECUBE_BENCH_N, SCALECUBE_BENCH_ROUNDS,
 SCALECUBE_BENCH_DELIVERY, SCALECUBE_BENCH_SKIP_CANARY,
 SCALECUBE_BENCH_COMPACT (=1: the capacity-oriented compact carry layout,
@@ -1319,6 +1333,203 @@ def run_sync_bench():
     print(json.dumps(result), flush=True)
 
 
+def run_lifeguard_bench():
+    """The --lifeguard mode: the Lifeguard health plane's headline
+    robustness claim, measured A/B (never asserted) — one JSON line out
+    (never-ship-empty).
+
+    Workload: the seeded ``chaos.asymmetric_degradation`` composite
+    (Brownout loss+delay on the degraded minority's inbound ranges +
+    FlappingLink — the observer-side degradation Lifeguard targets)
+    with the DEGRADED RACK itself crashing permanently mid-hold for
+    detection-latency parity (the in-loop comment explains why healthy
+    crash targets would corrupt the comparison).  Each scenario seed
+    runs TWICE through ``swim.run_metered``
+    on the same key: the control (``lhm_max=0``, the plane compiled
+    out) and the plane (``lhm_max`` from SCALECUBE_LIFEGUARD_LHM_MAX,
+    default 8).  Aggregated over scenarios:
+
+      - ``false_positive_observer_rate`` per arm, from the registry's
+        false_suspicion_onsets / live_observer_rounds counters (the
+        PR-5 SLO definition);
+      - ``fp_ratio`` = on/off — the headline, gated ABSOLUTELY at
+        <= 0.5 by ``telemetry regress``;
+      - crash-detection latency P99 per arm (first round any live
+        observer holds SUSPECT/DEAD about a crashed node, from the
+        per-subject metric traces) and their delta, gated at <= +1
+        round — adaptivity must not buy its FP win with detection
+        latency.
+
+    Writes an ``artifacts/lifeguard_fp.json``-style artifact (smoke
+    runs get ``lifeguard_fp_smoke.json`` — provenance, not trajectory
+    data, the sync-heal convention).  ``--lifeguard --smoke`` is the
+    tier-1-safe single-scenario pass pinned by
+    tests/test_bench_lifeguard_smoke.py.  Env overrides:
+    SCALECUBE_LIFEGUARD_N, SCALECUBE_LIFEGUARD_LHM_MAX,
+    SCALECUBE_LIFEGUARD_SEED, SCALECUBE_LIFEGUARD_SCENARIOS,
+    SCALECUBE_LIFEGUARD_ARTIFACT.
+
+    ``value`` stays None by design: the headline is a smaller-is-better
+    ratio and must not enter the higher-is-better throughput walk —
+    regress gates the dedicated absolute checks instead.
+    """
+    result = {
+        "metric": "lifeguard_fp_observer_rate",
+        "value": None,
+        "unit": "ratio",
+        "smoke": SMOKE,
+    }
+    artifact = (os.environ.get("SCALECUBE_LIFEGUARD_ARTIFACT")
+                or os.path.join("artifacts",
+                                "lifeguard_fp_smoke.json" if SMOKE
+                                else "lifeguard_fp.json"))
+    try:
+        jax, platform = init_backend()
+        result["platform"] = platform
+
+        import dataclasses
+
+        import numpy as np
+
+        from scalecube_cluster_tpu.chaos import scenarios as cscenarios
+        from scalecube_cluster_tpu.chaos.campaign import campaign_config
+        from scalecube_cluster_tpu.models import swim
+        from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+
+        # The campaign timing preset at its finest probe cadence
+        # (ping_every = 1 round): detection latencies quantize to
+        # single rounds, which is what makes a +-1-round parity gate
+        # meaningful.
+        cfg = campaign_config().replace(ping_interval=100,
+                                        ping_timeout=50)
+        n = int(os.environ.get("SCALECUBE_LIFEGUARD_N",
+                               24 if SMOKE else 48))
+        lhm_max = int(os.environ.get("SCALECUBE_LIFEGUARD_LHM_MAX", 8))
+        seed = int(os.environ.get("SCALECUBE_LIFEGUARD_SEED", 11))
+        n_scen = int(os.environ.get("SCALECUBE_LIFEGUARD_SCENARIOS",
+                                    1 if SMOKE else 3))
+        spec = tmetrics.MetricsSpec.default()
+        # ping_known_only=False draws probe targets uniformly over the
+        # cluster (the focal-mode probe discipline, documented in
+        # models/swim.py) instead of from each observer's table: the
+        # target DRAWS are then shared between the two arms — common
+        # random numbers for the detection race — instead of being
+        # reshuffled by every table divergence (choose_eligible re-maps
+        # the whole draw when one cell's eligibility differs).  The
+        # arms still differ where the plane actually acts: suppressed
+        # degraded probers, and healthy observers whose own multiplier
+        # drifts above 1 from probing INTO the degraded rack — that
+        # residual adaptivity cost is precisely what the +-1-round
+        # parity gate measures.
+        p_off = swim.SwimParams.from_config(
+            cfg, n_members=n, delivery="scatter", ping_known_only=False)
+        p_on = dataclasses.replace(p_off, lhm_max=lhm_max)
+
+        totals = {"off": [0, 0], "on": [0, 0]}   # [onsets, observer-rounds]
+        latencies = {"off": [], "on": []}
+        scenario_rows = []
+        for s_i in range(n_scen):
+            scen = cscenarios.asymmetric_degradation(seed + s_i, n)
+            world, _mspec = scen.build(p_off)
+            # The degraded rack DIES mid-hold (the operationally real
+            # crash: browning-out members are the ones that fail).
+            # Detection of these crashes is the fair parity probe:
+            # pre-crash false suspicions about the hard-to-reach rack
+            # come from healthy observers under near-identical
+            # conditions in both arms (the plane's big lever — quieting
+            # the degraded observers' own verdicts — doesn't apply to
+            # suspicions OF the rack), and after the crash no degraded
+            # prober remains to suppress.  The residual asymmetry —
+            # healthy observers' multipliers drift above 1 from probing
+            # into the rack, thinning their probe rate and pre-crash
+            # suspicions in the on-arm — is a real adaptivity cost and
+            # is exactly what the +-1-round gate bounds.  Crashing
+            # healthy members instead would let the control arm "win"
+            # via its own false-alarm storm pre-suspecting every
+            # subject.
+            crash_nodes = list(range(
+                cscenarios.asymmetric_degraded_range(n)))
+            crash_at = 120
+            world = world.with_crash(crash_nodes, crash_at)
+            row = {"scenario": scen.name, "repro":
+                   f"chaos.asymmetric_degradation(seed={seed + s_i}, "
+                   f"n={n})", "horizon": scen.horizon}
+            for arm, p in (("off", p_off), ("on", p_on)):
+                t0 = time.time()
+                _, ms, metrics = swim.run_metered(
+                    jax.random.key(seed + s_i), p, world, scen.horizon)
+                digest = tmetrics.to_json(jax.device_get(ms), spec)
+                onsets = digest["counters"]["false_suspicion_onsets"]
+                obs_rounds = digest["counters"]["live_observer_rounds"]
+                totals[arm][0] += onsets
+                totals[arm][1] += obs_rounds
+                sus = np.asarray(metrics["suspect"])
+                dead = np.asarray(metrics["dead"])
+                lat = []
+                for c in crash_nodes:
+                    seen = np.nonzero(
+                        (sus[crash_at:, c] + dead[crash_at:, c]) > 0)[0]
+                    lat.append(int(seen[0]) if len(seen)
+                               else scen.horizon - crash_at)
+                latencies[arm].extend(lat)
+                row[f"fp_onsets_{arm}"] = int(onsets)
+                row[f"detection_latency_{arm}"] = sorted(lat)
+                if arm == "on":
+                    row["lhm_gauge"] = digest["gauges"].get("lhm")
+                log(f"lifeguard {scen.name} arm={arm}: onsets={onsets} "
+                    f"observer-rounds={obs_rounds} detection={sorted(lat)}"
+                    f" ({time.time() - t0:.1f}s)")
+            scenario_rows.append(row)
+
+        fp_off = totals["off"][0] / max(totals["off"][1], 1)
+        fp_on = totals["on"][0] / max(totals["on"][1], 1)
+        fp_ratio = (fp_on / fp_off) if fp_off > 0 else None
+        p99_off = float(np.percentile(latencies["off"], 99))
+        p99_on = float(np.percentile(latencies["on"], 99))
+        log(f"lifeguard headline: fp_rate off={fp_off:.6f} "
+            f"on={fp_on:.6f} ratio={fp_ratio} detection_p99 "
+            f"off={p99_off:.2f} on={p99_on:.2f}")
+        result.update(
+            false_positive_observer_rate_off=round(fp_off, 8),
+            false_positive_observer_rate_on=round(fp_on, 8),
+            fp_ratio=(round(fp_ratio, 6) if fp_ratio is not None
+                      else None),
+            detection_p99_off_rounds=p99_off,
+            detection_p99_on_rounds=p99_on,
+            detection_p99_delta_rounds=round(p99_on - p99_off, 2),
+            fp_onsets_off=int(totals["off"][0]),
+            fp_onsets_on=int(totals["on"][0]),
+            live_observer_rounds=int(totals["off"][1]),
+            n_members=n,
+            lhm_max=lhm_max,
+            seed=seed,
+            n_scenarios=n_scen,
+            delivery="scatter",
+            scenarios=scenario_rows,
+            value_note=("value stays null by design: fp_ratio is "
+                        "smaller-is-better and must not enter the "
+                        "throughput walk — regress gates the absolute "
+                        "lifeguard checks instead"),
+        )
+
+        art = dict(result)
+        os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        result["artifact"] = artifact
+        log(f"lifeguard artifact written to {artifact}")
+
+        apply_regress_gate(
+            result, ["BENCH_*.json", "MULTICHIP_*.json",
+                     os.path.join("artifacts", "lifeguard_fp*.json"),
+                     artifact])
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -1362,6 +1573,15 @@ def main():
              "gossip-only control, monitored chaos-scale arm) into an "
              "artifacts/sync_heal.json-style artifact; combine with "
              "--smoke for the tier-1-safe pass",
+    )
+    parser.add_argument(
+        "--lifeguard", action="store_true",
+        help="measure the Lifeguard health plane A/B under the seeded "
+             "asymmetric-degradation scenario (false-positive observer "
+             "rate plane-on vs control + crash-detection latency "
+             "parity) into an artifacts/lifeguard_fp.json-style "
+             "artifact; combine with --smoke for the tier-1-safe "
+             "single-scenario pass",
     )
     mode = parser.add_mutually_exclusive_group()
     mode.add_argument(
@@ -1416,6 +1636,13 @@ def main():
             parser.error(
                 "--sync measures partition-heal convergence on its own "
                 "workload — drop the other mode flags")
+        if args.lifeguard and (args.chaos or args.resilience
+                               or args.metrics or args.multichip
+                               or args.sync or args.traced
+                               or args.untraced or args.gap_artifact):
+            parser.error(
+                "--lifeguard measures the health-plane A/B on its own "
+                "workload — drop the other mode flags")
     except SystemExit as e:
         # The one-JSON-line contract holds even for a bad argv: argparse
         # already printed its usage message to stderr; ship the error
@@ -1440,6 +1667,8 @@ def main():
         return run_multichip_bench()
     if args.sync:
         return run_sync_bench()
+    if args.lifeguard:
+        return run_lifeguard_bench()
 
     result = {
         "metric": "swim_member_rounds_per_sec_per_chip",

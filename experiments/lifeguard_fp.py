@@ -1,0 +1,79 @@
+"""Lifeguard adaptivity drill: false-positive rate A/B under degradation.
+
+Drives ``bench.py --lifeguard`` (the one entry point the measurement
+flows through, so the experiment and the driver bench cannot drift):
+the seeded ``chaos.asymmetric_degradation`` composite — a Brownout
+(loss + mean delay) on the inbound link ranges of a degraded minority
+(an eighth of the ids, ``chaos.asymmetric_degraded_range``) plus a
+FlappingLink — run
+twice per scenario seed on the same key,
+
+  - control: ``lhm_max=0`` (the health plane compiled out),
+  - plane:   ``lhm_max=8`` (LHA probe scaling, LHA suspicion, buddy
+    refutation — models/lifeguard.py),
+
+and compared on the ``false_positive_observer_rate`` SLO
+(false_suspicion_onsets / live_observer_rounds from the PR-5 registry)
+plus crash-detection latency P99 for the degraded rack itself crashing
+permanently mid-hold (bench.py explains why healthy crash targets
+would corrupt the A/B).  Writes ``artifacts/lifeguard_fp.json`` (override
+``--artifact``) and runs the ``telemetry regress`` gate in-bench — the
+committed artifact is the pinned robustness claim: the plane at least
+HALVES the false-positive observer rate at equal (within +1 round P99)
+crash-detection latency, and regress exits 1 if that ever rots.
+
+CPU-safe (the workload is a small-N full-view A/B, not a throughput
+measurement).
+
+Usage:
+    python experiments/lifeguard_fp.py              # committed shape
+    python experiments/lifeguard_fp.py --smoke      # tier-1-safe pass
+    python experiments/lifeguard_fp.py --n 48 --scenarios 5 --seed 23
+"""
+
+import argparse
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    parser.add_argument("--smoke", action="store_true",
+                        help="tier-1-safe fast pass (one scenario)")
+    parser.add_argument("--n", type=int, default=None,
+                        help="member count (default 48; 24 under "
+                             "--smoke)")
+    parser.add_argument("--lhm-max", type=int, default=None,
+                        help="Local Health Multiplier ceiling "
+                             "(default 8)")
+    parser.add_argument("--scenarios", type=int, default=None,
+                        help="scenario seeds per arm (default 3; 1 "
+                             "under --smoke)")
+    parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--artifact", default=None,
+                        help="artifact path (default "
+                             "artifacts/lifeguard_fp.json)")
+    args = parser.parse_args()
+
+    env = dict(os.environ)
+    for flag, var in ((args.n, "SCALECUBE_LIFEGUARD_N"),
+                      (args.lhm_max, "SCALECUBE_LIFEGUARD_LHM_MAX"),
+                      (args.scenarios, "SCALECUBE_LIFEGUARD_SCENARIOS"),
+                      (args.seed, "SCALECUBE_LIFEGUARD_SEED"),
+                      (args.artifact, "SCALECUBE_LIFEGUARD_ARTIFACT")):
+        if flag is not None:
+            env[var] = str(flag)
+
+    cmd = [sys.executable, str(REPO / "bench.py"), "--lifeguard"]
+    if args.smoke:
+        cmd.append("--smoke")
+    return subprocess.run(cmd, env=env, cwd=str(REPO)).returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

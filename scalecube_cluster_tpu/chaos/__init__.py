@@ -44,6 +44,8 @@ from scalecube_cluster_tpu.chaos.scenarios import (  # noqa: F401
     RollingPartition,
     SEVERITIES,
     Scenario,
+    asymmetric_degradation,
+    asymmetric_degraded_range,
     completeness_bound,
     generate_campaign,
     generate_scenario,

@@ -286,14 +286,30 @@ def check_round(mon: MonitorState, spec: MonitorSpec,
     v_inc = (((ps == records.ALIVE) | (ps == records.SUSPECT))
              & (ns != records.DEAD) & (ni < pi))
 
-    # TIMER_BOUND — live observers' suspicion-timer contract.
+    # TIMER_BOUND — live observers' suspicion-timer contract.  With the
+    # Lifeguard plane on the deadline an observer may arm stretches to
+    # suspicion_rounds * lhm_max (LHA Suspicion's ceiling —
+    # models/lifeguard.suspicion_deadline_rounds); with the dead-member
+    # suppression window on, a DEAD cell legitimately holds its
+    # suppression expiry in the deadline lane (bounded by
+    # dead_suppress_rounds).  Both features off reduces this to the
+    # original contract exactly.
     susp = ns == records.SUSPECT
     has_timer = dl != INT32_MAX
+    if params.dead_suppress_rounds > 0:
+        dead_hold = (ns == records.DEAD) & has_timer
+        v_dead_hold = dead_hold & (
+            dl > round_idx + params.dead_suppress_rounds)
+    else:
+        dead_hold = zero
+        v_dead_hold = zero
+    max_susp_rounds = kn.suspicion_rounds * max(1, params.lhm_max)
     v_timer = obs_alive & (
-        (has_timer & ~susp)
+        (has_timer & ~susp & ~dead_hold)
         | (susp & ~has_timer)
         | (susp & has_timer & (dl <= round_idx))
-        | (has_timer & (dl > round_idx + kn.suspicion_rounds))
+        | (has_timer & ~dead_hold & (dl > round_idx + max_susp_rounds))
+        | v_dead_hold
     )
 
     # WIRE_SATURATION — the carry must never exceed the wire cap.
@@ -465,6 +481,7 @@ def _monitored_scan(base_key, params: "swim.SwimParams",
         world.alive_at(end), end, world,
         last_tick_metrics={k: metrics[k][-1]
                            for k in ("messages_gossip",) if k in metrics},
+        lhm=final_state.lhm if params.lhm_max > 0 else None,
     )
     return final_state, monitor, ms, metrics
 

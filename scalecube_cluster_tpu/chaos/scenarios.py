@@ -288,11 +288,18 @@ class FlappingLink:
 
 @dataclasses.dataclass(frozen=True)
 class Brownout:
-    """Asymmetric range-to-range loss ramp: loss steps
-    ``peak_loss/steps .. peak_loss`` over ``ramp_rounds``, holds at the
-    peak for ``hold_rounds`` (0 = ramp straight back down), then steps
-    back down — up to 2*steps+1 rules (zero-length windows are
-    skipped, not emitted)."""
+    """Asymmetric range-to-range degradation ramp: loss (and optionally
+    mean link delay) steps ``peak/steps .. peak`` over ``ramp_rounds``,
+    holds at the peak for ``hold_rounds`` (0 = ramp straight back
+    down), then steps back down — up to 2*steps+1 rules (zero-length
+    windows are skipped, not emitted).
+
+    ``peak_delay_ms`` (default 0 = pure loss, the original op) ramps a
+    mean exponential per-hop delay alongside the loss — the slow-link /
+    slow-host brownout whose probe failures are TIMEOUTS rather than
+    drops (the regime Lifeguard's LHA timeout scaling targets,
+    models/lifeguard.py; link delay enters the FD hop budgets exactly,
+    models/swim._chain_ok)."""
 
     src: Tuple[int, int]
     dst: Tuple[int, int]
@@ -301,35 +308,44 @@ class Brownout:
     ramp_rounds: int
     hold_rounds: int
     steps: int = 3
+    peak_delay_ms: float = 0.0
 
     def __post_init__(self):
         if self.steps < 1 or self.ramp_rounds < 1:
             raise ValueError(
                 f"Brownout needs steps >= 1 and ramp_rounds >= 1 (got "
                 f"steps={self.steps}, ramp_rounds={self.ramp_rounds})")
+        if self.peak_delay_ms < 0:
+            raise ValueError(
+                f"Brownout peak_delay_ms must be >= 0 "
+                f"(got {self.peak_delay_ms})")
 
     def _windows(self):
         step_len = max(1, self.ramp_rounds // self.steps)
         t = self.from_round
         for i in range(1, self.steps + 1):          # ramp up
-            yield (t, t + step_len, self.peak_loss * i / self.steps)
+            yield (t, t + step_len, self.peak_loss * i / self.steps,
+                   self.peak_delay_ms * i / self.steps)
             t += step_len
         if self.hold_rounds > 0:                    # hold at the peak
-            yield (t, t + self.hold_rounds, self.peak_loss)
+            yield (t, t + self.hold_rounds, self.peak_loss,
+                   self.peak_delay_ms)
             t += self.hold_rounds
         for i in range(self.steps - 1, 0, -1):      # ramp down
-            yield (t, t + step_len, self.peak_loss * i / self.steps)
+            yield (t, t + step_len, self.peak_loss * i / self.steps,
+                   self.peak_delay_ms * i / self.steps)
             t += step_len
 
     def apply(self, world, n, horizon):
-        for lo, hi, loss in self._windows():
+        for lo, hi, loss, delay in self._windows():
             world = world.with_link_fault(tuple(self.src), tuple(self.dst),
-                                          loss, from_round=lo,
+                                          loss, delay_ms=delay,
+                                          from_round=lo,
                                           until_round=hi)
         return world
 
     def disruption(self, n, horizon):
-        end = max(hi for _, hi, _ in self._windows())
+        end = max(hi for _, hi, _, _ in self._windows())
         return (self.from_round, end)
 
 
@@ -538,6 +554,71 @@ class Scenario:
         if isinstance(op, RollingPartition):
             return op.phase_rounds >= qb
         return False
+
+
+def asymmetric_degraded_range(n: int) -> int:
+    """Size of :func:`asymmetric_degradation`'s degraded observer range
+    (ids ``[0, q)``) — ONE place, consumed by the scenario builder AND
+    ``bench.py --lifeguard`` (which crashes exactly this rack for its
+    detection-parity probe; a drifted copy would silently crash healthy
+    members and corrupt the A/B)."""
+    return max(2, n // 8)
+
+
+def asymmetric_degradation(seed: int, n: int = 32,
+                           peak_loss: float = 0.3,
+                           peak_delay_ms: float = 300.0,
+                           hold_rounds: int = 200,
+                           params: Optional["swim.SwimParams"] = None
+                           ) -> Scenario:
+    """Seeded composite for the Lifeguard headline experiment
+    (bench.py --lifeguard): observer-side asymmetric degradation.
+
+    A small minority of the id range (``max(2, n // 8)`` members —
+    Lifeguard's operating regime: degraded members are rare, a cluster
+    losing a quarter of its probe capacity cannot keep detection
+    latency flat under ANY adaptivity) are the DEGRADED OBSERVERS: a
+    :class:`Brownout` ramps loss AND mean link delay on their INBOUND
+    links (src = the healthy majority, dst = the degraded range) up to
+    the peaks and holds — their probes of perfectly healthy peers drop
+    or time out on the ack hop, which is exactly the observer-local
+    unreliability Lifeguard's LHM detects (the outbound direction
+    stays clean, so their false SUSPECT verdicts still disseminate at
+    full rate — the worst case for cluster-wide false positives).  The
+    delay component is the regime the LHA *timeout* scaling repairs
+    outright (a stretched budget lets the slow acks land) while true
+    crash detection is untouched (a crashed target never acks, at any
+    budget).  A seeded :class:`FlappingLink` into the same range rides
+    along for non-stationary flap noise.  The rest of the network is
+    pristine.
+
+    Pure in ``(seed, n)`` like :func:`generate_scenario` — one-line
+    repro: ``chaos.asymmetric_degradation(seed=S, n=N)``.
+    """
+    if n < 16:
+        raise ValueError(
+            f"asymmetric_degradation needs n >= 16 (got {n}) — the "
+            f"degraded range must stay a strict minority")
+    if params is None:
+        from scalecube_cluster_tpu.chaos.campaign import campaign_config
+        params = swim.SwimParams.from_config(campaign_config(), n_members=n)
+    rng = np.random.default_rng(np.random.SeedSequence([seed, 0x11F6]))
+    q = asymmetric_degraded_range(n)            # degraded observer range
+    ops = (
+        Brownout(src=(q, n), dst=(0, q), peak_loss=float(peak_loss),
+                 peak_delay_ms=float(peak_delay_ms),
+                 from_round=0, ramp_rounds=12,
+                 hold_rounds=int(hold_rounds), steps=3),
+        FlappingLink(src=int(rng.integers(q, n)),
+                     dst=int(rng.integers(0, q)),
+                     from_round=int(rng.integers(0, 9)),
+                     n_cycles=4, down_rounds=6, up_rounds=10),
+    )
+    ends = [op.disruption(n, 10 ** 9)[1] for op in ops]
+    horizon = _quantize_horizon(
+        max(ends) + completeness_bound(params, n) // 2 + 24)
+    return Scenario(name=f"asym-deg-{seed}-n{n}", n_members=n,
+                    horizon=horizon, ops=ops, seed=seed)
 
 
 # --------------------------------------------------------------------------

@@ -38,12 +38,38 @@ def fd_only_knobs(params: swim.SwimParams) -> swim.Knobs:
     that push is a SYNC issued by *membership*
     (MembershipProtocolImpl.java:379-391), which this isolation stubs out,
     so verdicts stay strictly observer-local.
+
+    Caveat: with the Lifeguard plane on (``SwimParams.lhm_max > 0``) the
+    buddy-system refute push rides the FD ACK PATH itself
+    (models/lifeguard.py) and is therefore NOT silenced by
+    ``sync_every=0`` — an FD isolation that must stay verdict-local
+    should keep ``lhm_max = 0``.
     """
     return dataclasses.replace(
         swim.Knobs.from_params(params),
         sync_every=jnp.int32(0),
         fanout=jnp.int32(0),
     )
+
+
+def effective_probe_budgets(params: swim.SwimParams, lhm):
+    """Per-member FD budgets under the Lifeguard health plane
+    (models/lifeguard.py): ``(ping_budget_ms, ping_req_budget_ms)``,
+    each the base budget scaled by the member's Local Health Multiplier
+    — Lifeguard's LHA Probe timeout scaling (a member that suspects its
+    own slowness gives its peers more time to answer before issuing a
+    SUSPECT verdict).
+
+    ``ping_budget_ms`` [n] scales ``ping_timeout_ms`` (the direct-ping
+    round trip's budget); ``ping_req_budget_ms`` [n] scales the
+    remaining-interval budget of the k-proxy fan-out.  With ``lhm == 1``
+    both equal the base values exactly (the healthy-member no-op the
+    plane's bit-identity tests pin); they never drop below base
+    (lhm >= 1 by clamp).
+    """
+    m = jnp.asarray(lhm, jnp.float32)
+    return (params.ping_timeout_ms * m,
+            (params.ping_interval_ms - params.ping_timeout_ms) * m)
 
 
 def probe_outcome_updates(tick_metrics: dict) -> dict:

@@ -1,0 +1,162 @@
+"""Lifeguard health plane: local health-aware probing and suspicion.
+
+SWIM's false-positive rate is dominated by *observer-side* degradation:
+a slow or browning-out member times out healthy peers, and the plain
+protocol gives it no way to notice its own unreliability.  Lifeguard
+(Dadgar, Hashemi & Currie, arXiv:1707.00788) fixes this with three
+local mechanisms, all driven by one per-member integer — the Local
+Health Multiplier (LHM):
+
+  - **LHA Probe** (Local Health Aware Probe): a member's effective
+    probe interval and probe timeout scale with its own LHM, so a
+    member that keeps failing probes slows down and stops seeding
+    false suspicions at full rate (``probe_gate`` /
+    ``models/fd.effective_probe_budgets``);
+  - **LHA Suspicion**: the suspicion deadline a member arms scales
+    with its LHM and with ``log(n_live)`` (``suspicion_deadline_rounds``
+    — the reference's ``suspicionMult * ceilLog2(n)`` schedule made
+    live-count- and health-aware), giving falsely suspected peers more
+    time to refute when the *observer* is the unhealthy party;
+  - **Buddy System**: a probed member that is currently suspected by
+    its prober learns this in the probe's ack path — the refute push in
+    ``models/swim`` rides the FD ack channel whenever the plane is on,
+    independent of the membership SYNC channel — and its
+    self-refutation bump re-enters dissemination immediately.  (The
+    dense wire model has no piggyback budget: every hot record already
+    transmits on every gossip send, so Lifeguard's "refutations jump
+    the piggyback queue" priority is the default here; the ack-path
+    delivery is the part that needs mechanism.)
+
+The LHM lane
+------------
+``SwimState.lhm`` [N] int32, clamped to ``[1, SwimParams.lhm_max]``
+(1 = healthy).  Per round, for each live member that issued a probe:
+
+  - clean ACK (direct ping answered within the scaled timeout): **-1**
+    — the only decay path, mirroring Lifeguard's successful-probe
+    decrement;
+  - probe timeout (no ack at all) **or** a proxy-rescued probe whose
+    direct ping timed out: **+1**.  (The collapsed probe chains of the
+    dense tick — ``models/swim._chain_ok`` — don't expose individual
+    missed nacks; a failed direct ping inside a rescued probe is this
+    model's observable for Lifeguard's missed-nack event and carries
+    the same self-degradation signal.)
+  - refuting its own suspicion (the self-refutation incarnation bump):
+    **+1**.
+
+``SwimParams.lhm_max = 0`` (the default) compiles the whole plane out:
+the lane is a zero-size array, no extra PRNG stream is drawn, and every
+run shape is bit-identical to the plane-less tick (the
+``sync_interval`` off-switch contract; tests/test_lifeguard.py).  With
+the plane ON but every member healthy (lhm pinned at 1) the scaled
+budgets and deadlines equal their base values and the probe gate always
+passes, so warm no-fault runs are table- and metrics-identical too —
+enabling the plane perturbs nothing until degradation actually occurs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu import swim_math
+
+# Fold constant for the LHA probe gate's uniform draw — disjoint from
+# every existing fold (0x5317 shift channels, 0x53CA anti-entropy
+# offset, 41 anti-entropy drop, 29 seed anti-entropy, 7/11/13 delay
+# bins, 11+c gossip bins), so enabling the plane never perturbs the
+# base tick's draws (the lhm_max=0 bit-identity contract).
+_PROBE_GATE_FOLD = 0x11F6
+
+
+def initial_lhm(params) -> jnp.ndarray:
+    """The carry lane: all-healthy (1) when the plane is on, a
+    zero-size array when ``lhm_max == 0`` (costs nothing, keeps the
+    pytree structure uniform)."""
+    n = params.n_members if params.lhm_max > 0 else 0
+    return jnp.ones((n,), dtype=jnp.int32)
+
+
+def probe_gate(k_ping_net, lhm, n_local: int) -> jnp.ndarray:
+    """[n_local] bool: does each member issue its probe this fd round?
+
+    LHA Probe's interval scaling: a member at multiplier ``m`` probes
+    with probability ``1/m`` per fd round — its *effective* probe
+    interval is ``ping_every * m`` in expectation, without the
+    per-member modular bookkeeping a deterministic stagger would need.
+    At ``m == 1`` the gate always passes (``u < 1`` for u in [0, 1)),
+    so healthy members probe exactly on the base schedule.
+
+    The draw comes from a dedicated fold of the round's ping-chain key,
+    so the probe chains' own draws are untouched.
+    """
+    u = jax.random.uniform(
+        jax.random.fold_in(k_ping_net, _PROBE_GATE_FOLD), (n_local,)
+    )
+    return u * lhm.astype(jnp.float32) < 1.0
+
+
+def lha_probe_setup(params, lhm, k_ping_net, n_local: int):
+    """The LHA Probe ingredients of one tick's FD phase:
+    ``(ping_budget_ms, ping_req_budget_ms, probe_gate)`` — health-scaled
+    chain budgets (models/fd.effective_probe_budgets) plus the 1/lhm
+    probe gate, or ``(None, None, None)`` when the plane is compiled
+    out.  ONE place for the block all three tick bodies (scatter,
+    shift, blocked) share, so the budgets/gate cannot drift apart and
+    break the pinned shift==blocked bit-identity.
+    """
+    if params.lhm_max == 0:
+        return None, None, None
+    from scalecube_cluster_tpu.models import fd as fd_model
+
+    ping_budget, ping_req_budget = fd_model.effective_probe_budgets(
+        params, lhm)
+    return ping_budget, ping_req_budget, probe_gate(k_ping_net, lhm,
+                                                    n_local)
+
+
+def suspicion_deadline_rounds(kn_suspicion_rounds, lhm, n_live,
+                              n_members: int):
+    """LHA Suspicion: the rounds-until-DEAD a member arms for a new
+    SUSPECT entry, scaled by its own health and the live count.
+
+    ``base + base * (lhm - 1) * ceil_log2(n_live) / ceil_log2(N)``
+    (integer arithmetic, static denominator): the reference's
+    ``suspicionMult * ceilLog2(n) * pingInterval`` schedule
+    (ClusterMath.java:123-125) already folded ``ceil_log2(N)`` into
+    ``base``; the health-scaled extension re-shapes that term with the
+    CURRENT live count and multiplies it by the observer's excess
+    multiplier.  Properties (pinned by tests/test_lifeguard.py):
+
+      - never below ``base`` (lhm >= 1 makes the extra term >= 0) —
+        a healthy observer's deadline is exactly the reference's;
+      - monotone in ``lhm`` and in ``n_live``;
+      - at most ``base * lhm_max`` (n_live <= N), the bound the
+        TIMER_BOUND invariant enforces (chaos/monitor.py).
+
+    ``n_live`` is the GROUND-TRUTH live count (one [N] reduction per
+    round) — the reference uses each member's local list size; in the
+    warm regime the two track each other, and using the shared truth
+    keeps the schedule identical across focal mode (where an observer
+    tracks only K subjects and has no local estimate of N_live).
+    """
+    base = jnp.asarray(kn_suspicion_rounds, jnp.int32)
+    log_live = swim_math.ceil_log2_jnp(n_live)
+    log_n = max(swim_math.ceil_log2(n_members), 1)
+    extra = (base * (jnp.asarray(lhm, jnp.int32) - 1) * log_live) // log_n
+    return base + extra
+
+
+def update(lhm, probe_fail, probe_clean, refuted, alive_here,
+           lhm_max: int):
+    """One round's LHM transition (module docstring): +1 per failed /
+    proxy-rescued probe, +1 per self-refutation, -1 per clean ACK,
+    clamped to [1, lhm_max].  Frozen (crashed/left) members keep their
+    multiplier — a stopped JVM updates nothing; on revival the stale
+    health decays through its own probes.
+    """
+    delta = (probe_fail.astype(jnp.int32)
+             - probe_clean.astype(jnp.int32)
+             + refuted.astype(jnp.int32))
+    bumped = jnp.clip(lhm + delta, 1, lhm_max)
+    return jnp.where(alive_here, bumped, lhm)

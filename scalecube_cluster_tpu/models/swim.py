@@ -103,6 +103,7 @@ import jax
 import jax.numpy as jnp
 
 from scalecube_cluster_tpu import records
+from scalecube_cluster_tpu.models import lifeguard
 from scalecube_cluster_tpu.models import sync as sync_plane
 from scalecube_cluster_tpu.ops import delivery, prng, ring as ring_ops, \
     shift as shift_ops
@@ -282,6 +283,33 @@ class SwimParams:
     # bidirectional.  Enabled runs grow a ``messages_anti_entropy``
     # per-round counter in the metrics dict.
     sync_interval: int = 0
+    # Lifeguard health plane (models/lifeguard.py): per-member Local
+    # Health Multiplier lane, clamped to [1, lhm_max] — incremented on
+    # probe timeout / proxy-rescued probe / refuting own suspicion,
+    # decayed on clean ACK.  Scales the member's effective probe
+    # interval + timeout (LHA Probe, models/fd.effective_probe_budgets)
+    # and the suspicion deadlines it arms (LHA Suspicion,
+    # lifeguard.suspicion_deadline_rounds), and routes the buddy-system
+    # refute push over the FD ack path independent of ``sync_every``.
+    # 0 (the default) compiles the plane OUT entirely — zero-size lane,
+    # no extra draws, every run shape bit-identical to the plane-less
+    # tick (the sync_interval off-switch contract;
+    # tests/test_lifeguard.py).
+    lhm_max: int = 0
+    # Dead-member suppression window (the PR-7 mid-suspicion-heal debt,
+    # models/sync.py "quiesced-heal precondition"): for this many rounds
+    # after a tombstone is stored, the cell does NOT reopen for an
+    # arriving ALIVE — it gates by its true DEAD key instead of the
+    # reference's delete-like ABSENT gate — which breaks the DEAD/ALIVE
+    # reinfection ping-pong a mid-suspicion heal otherwise sustains
+    # (each reopen re-hots the death notice and burns another
+    # incarnation; tests/test_dead_suppression.py pins termination).
+    # The window expiry is tracked in the cell's ``suspect_deadline``
+    # lane (unused for DEAD cells otherwise); size it past the
+    # tombstone's gossip expiry (periods_to_spread + 1) so the notice
+    # goes cold before the cell can reopen.  0 (the default) keeps the
+    # reference's immediate-reopen behavior, bit-identical.
+    dead_suppress_rounds: int = 0
 
     def __post_init__(self):
         if self.delivery not in ("scatter", "shift"):
@@ -299,6 +327,16 @@ class SwimParams:
         if self.rounds_per_step < 1:
             raise ValueError(
                 f"rounds_per_step must be >= 1 (got {self.rounds_per_step})"
+            )
+        if self.lhm_max < 0:
+            raise ValueError(
+                f"lhm_max must be >= 0 (0 = Lifeguard plane off; got "
+                f"{self.lhm_max})"
+            )
+        if self.dead_suppress_rounds < 0:
+            raise ValueError(
+                f"dead_suppress_rounds must be >= 0 (0 = immediate "
+                f"tombstone reopen; got {self.dead_suppress_rounds})"
             )
         if self.delivery == "shift" and self.ping_known_only != self.full_view:
             # Shift mode has no known-only probe path at K < N (its FD
@@ -341,6 +379,21 @@ class SwimParams:
                     f"compact_carry stores remaining suspicion rounds as "
                     f"int16; suspicion_rounds={self.suspicion_rounds} "
                     f"exceeds 32765 (also applies to Knobs overrides)"
+                )
+            if (self.lhm_max > 0
+                    and self.suspicion_rounds * self.lhm_max >= 32766):
+                raise ValueError(
+                    f"compact_carry stores remaining suspicion rounds as "
+                    f"int16 and the Lifeguard plane arms deadlines up to "
+                    f"suspicion_rounds * lhm_max = "
+                    f"{self.suspicion_rounds * self.lhm_max} rounds out "
+                    f"(exceeds 32765)"
+                )
+            if self.dead_suppress_rounds >= 32766:
+                raise ValueError(
+                    f"compact_carry stores the dead-suppression expiry in "
+                    f"the int16 deadline lane; dead_suppress_rounds="
+                    f"{self.dead_suppress_rounds} exceeds 32765"
                 )
 
     @property
@@ -820,6 +873,12 @@ class SwimState:
     ``g_ring``          [D, N, G] bool: delayed user-gossip bits, sharing
                         the membership payload's delay bins (one wire
                         message carries both).
+    ``lhm``             [N] int32: Lifeguard Local Health Multiplier,
+                        clamped to [1, params.lhm_max]
+                        (models/lifeguard.py); zero-size when
+                        ``lhm_max == 0`` (the plane compiled out).
+                        Always int32 absolute — [N] is small next to
+                        [N, K], so compact_carry doesn't narrow it.
     """
 
     status: jnp.ndarray
@@ -832,13 +891,14 @@ class SwimState:
     g_infected: jnp.ndarray
     g_spread_until: jnp.ndarray
     g_ring: jnp.ndarray
+    lhm: jnp.ndarray
 
 
 jax.tree_util.register_dataclass(
     SwimState,
     data_fields=["status", "inc", "spread_until", "suspect_deadline",
                  "self_inc", "inbox_ring", "flag_ring",
-                 "g_infected", "g_spread_until", "g_ring"],
+                 "g_infected", "g_spread_until", "g_ring", "lhm"],
     meta_fields=[],
 )
 
@@ -880,6 +940,7 @@ def initial_state(params: SwimParams, world: SwimWorld,
         g_infected=jnp.zeros((n, g), dtype=jnp.bool_),
         g_spread_until=jnp.zeros((n, g), dtype=jnp.int32),
         g_ring=jnp.zeros((gd_slots, n, g), dtype=jnp.bool_),
+        lhm=lifeguard.initial_lhm(params),
     )
     # The ring stores wire-format keys; the int16 wire (compact_carry or
     # int16_wire) makes its delayed slots int16 (records.merge_key16).
@@ -1503,17 +1564,31 @@ def _round_metrics(new_state: SwimState, status, aux, params: SwimParams,
 def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
                       params, kn, world, node_ids, alive_here, is_self,
                       inbox_ring=None, flag_ring=None,
-                      g_delivered=None, g_ring=None):
+                      g_delivered=None, g_ring=None, lhm_signals=None):
     """Inbox merge, self-refutation, suspicion timers, crash/leave freeze.
 
     Shared tail of both delivery modes; all elementwise on [n_local, K].
     ``g_delivered`` [n_local, G] bool: user-gossip bits arriving this
     round (OR-merged; newly infected rows open a fresh spread window —
     onGossipReq, GossipProtocolImpl.java:171-183).
+    ``lhm_signals``: ``(probe_fail, probe_clean)`` [n_local] bool from
+    the round's FD phase (Lifeguard plane on) — None leaves the lhm
+    lane untouched (the blocked tick updates it once outside its block
+    loop; the plane-off path has a zero-size lane either way).
     Returns (new_state, refuted[n_local] bool).
     """
+    # Dead-member suppression window (SwimParams.dead_suppress_rounds):
+    # a freshly stored tombstone gates by its TRUE DEAD key — it does
+    # not reopen for an arriving ALIVE — until its expiry (tracked in
+    # the cell's deadline lane) passes.  Static 0 compiles this out.
+    suppress = None
+    if params.dead_suppress_rounds > 0:
+        suppress = ((status == records.DEAD)
+                    & (state.suspect_deadline != INT32_MAX)
+                    & (round_idx < state.suspect_deadline))
     new_status, new_inc, changed = delivery.merge_inbox(
-        status, inc, inbox, inbox_alive, compact=params.compact_wire
+        status, inc, inbox, inbox_alive, compact=params.compact_wire,
+        suppress=suppress,
     )
 
     # Self-refutation (updateMembership about-self branch, :488-509): if the
@@ -1548,11 +1623,30 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
     # semantics: an accepted SUSPECT update does NOT reset a pending timer;
     # any accepted non-SUSPECT update cancels it.
     no_timer = state.suspect_deadline == INT32_MAX
+    if suppress is not None:
+        # With suppression on, a DEAD cell's deadline lane holds the
+        # suppression expiry, not a suspicion timer — a reopened cell
+        # going straight to SUSPECT must still arm a fresh timer.
+        no_timer = no_timer | (status == records.DEAD)
+    # Lifeguard LHA Suspicion (models/lifeguard.py): the deadline an
+    # observer arms scales with its own health multiplier and the
+    # current live count; lhm=1 arms exactly the base schedule.
+    if params.lhm_max > 0:
+        n_live = jnp.sum(world.alive_at(round_idx), dtype=jnp.int32)
+        armed_rounds = lifeguard.suspicion_deadline_rounds(
+            kn.suspicion_rounds, state.lhm, n_live, params.n_members
+        )[:, None]
+    else:
+        armed_rounds = kn.suspicion_rounds
     start_timer = changed & (new_status == records.SUSPECT) & no_timer
     cancel_timer = changed & (new_status != records.SUSPECT)
+    if suppress is not None:
+        # An accepted DEAD record must not clear the cell's suppression
+        # expiry (set below); only live-record acceptance cancels.
+        cancel_timer = cancel_timer & (new_status != records.DEAD)
     deadline = jnp.where(
         start_timer,
-        round_idx + kn.suspicion_rounds,
+        round_idx + armed_rounds,
         jnp.where(cancel_timer, INT32_MAX, state.suspect_deadline),
     )
     # Timer fires -> DEAD at the same incarnation (onSuspicionTimeout,
@@ -1561,6 +1655,14 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
     new_status = jnp.where(fired, records.DEAD, new_status)
     deadline = jnp.where(fired, INT32_MAX, deadline)
     changed = changed | fired
+    if suppress is not None:
+        # Arm/refresh the suppression expiry on every newly stored (or
+        # re-armed) tombstone: accepted DEAD records and fired timers
+        # (``changed`` already includes ``fired`` by this point).
+        became_dead = (new_status == records.DEAD) & changed
+        deadline = jnp.where(
+            became_dead, round_idx + params.dead_suppress_rounds, deadline
+        )
 
     # Crashed/left nodes are frozen (a stopped JVM): no state updates.
     frozen = ~alive_here[:, None]
@@ -1585,6 +1687,17 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
         g_spread_until = jnp.where(frozen[:, :1], state.g_spread_until,
                                    g_spread_until)
 
+    # Lifeguard LHM transition (models/lifeguard.update): the refuted
+    # bump plus the FD phase's probe evidence, clamped; frozen members
+    # keep their multiplier (handled inside update via alive_here).
+    new_lhm = state.lhm
+    if params.lhm_max > 0 and lhm_signals is not None:
+        probe_fail, probe_clean = lhm_signals
+        new_lhm = lifeguard.update(
+            state.lhm, probe_fail, probe_clean, refuted & alive_here,
+            alive_here, params.lhm_max,
+        )
+
     new_state = SwimState(
         status=new_status.astype(jnp.int8),
         inc=new_inc.astype(jnp.int32),
@@ -1596,6 +1709,7 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
         g_infected=g_infected,
         g_spread_until=g_spread_until,
         g_ring=state.g_ring if g_ring is None else g_ring,
+        lhm=new_lhm,
     )
     return new_state, refuted
 
@@ -1790,6 +1904,17 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
         has_target &= (eligible_t == records.ALIVE) | (eligible_t == records.SUSPECT)
 
     t = ping_target
+    # Lifeguard LHA Probe (models/lifeguard.py): a member's effective
+    # probe interval and timeout scale with its own health multiplier —
+    # the probe gate suppresses the send (1/lhm probability per fd
+    # round) and the chain budgets stretch.  Compiled out entirely at
+    # lhm_max=0; at lhm=1 the gate always passes and the budgets equal
+    # the base values, so healthy runs stay bit-identical.
+    ping_budget, ping_req_budget, lhm_gate = lifeguard.lha_probe_setup(
+        params, state.lhm, k_ping_net, n_local)
+    if lhm_gate is None:
+        ping_budget = params.ping_timeout_ms
+        ping_req_budget = params.ping_interval_ms - params.ping_timeout_ms
     # Direct ping: 2 hops within ping_timeout (FailureDetectorImpl.java:128-176).
     loss_it, delay_it = link_eval(world.faults, round_idx, node_ids, t,
                                   kn.loss_probability, params.mean_delay_ms)
@@ -1797,7 +1922,7 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
                                   kn.loss_probability, params.mean_delay_ms)
     direct_ok = (
         _chain_ok(k_ping_net, [loss_it, loss_ti], [delay_it, delay_ti],
-                  params.ping_timeout_ms, (n_local,))
+                  ping_budget, (n_local,))
         & alive[t] & same_partition(node_ids, t)
     )
     # Ping-req through R proxies: 4 hops within (ping_interval - ping_timeout)
@@ -1820,7 +1945,8 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
         hop_delays.append(de)
     proxy_ok = (
         _chain_ok(k_proxy_net, hop_losses, hop_delays,
-                  params.ping_interval_ms - params.ping_timeout_ms,
+                  (ping_req_budget[:, None] if lhm_gate is not None
+                   else ping_req_budget),
                   (n_local, r_proxies))
         & alive[proxies] & alive[t][:, None]
         & same_partition(node_ids[:, None], proxies)
@@ -1829,6 +1955,8 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
     )
     ack_ok = direct_ok | jnp.any(proxy_ok, axis=1)
     probe_active = fd_round & has_target & alive_here       # [n_local]
+    if lhm_gate is not None:
+        probe_active = probe_active & lhm_gate
     verdict_suspect = probe_active & ~ack_ok
     verdict_alive = probe_active & ack_ok
     # True wire-message accounting (the reference logs per-period probe
@@ -1839,6 +1967,8 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
     # members they know live (the reference's pingMembers list).
     probes_sent = (probe_active if params.ping_known_only
                    else fd_round & alive_here)
+    if lhm_gate is not None and not params.ping_known_only:
+        probes_sent = probes_sent & lhm_gate
     ping_req_launches = probes_sent & ~direct_ok
 
     # SUSPECT verdict -> local record (SUSPECT, entry inc) for the target
@@ -1904,8 +2034,12 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
     # suspected member itself.
     # The refute push rides the sync channel (it IS a SYNC to the
     # suspected member, MembershipProtocolImpl.java:379-391), so disabling
-    # the channel (sync_every <= 0) disables it too.
-    push_refute = push_refute & (kn.sync_every > 0)
+    # the channel (sync_every <= 0) disables it too — UNLESS the
+    # Lifeguard buddy system is on (static lhm_max > 0): there the
+    # suspected member learns of its suspicion in the probe's ACK path
+    # itself (models/lifeguard.py), independent of the membership SYNC.
+    if params.lhm_max == 0:
+        push_refute = push_refute & (kn.sync_every > 0)
     sync_target = jnp.where(push_refute[:, None], t[:, None], sync_target)
     do_sync = (sync_round & alive_here) | push_refute
     if gate_contacts:
@@ -1962,8 +2096,15 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
             ae_wire_drop=ae_wire_drop, ae_part_ok=ae_part_ok,
             messages_anti_entropy=sync_plane.sent_count(ae_due, alive_here),
         )
+    # Lifeguard LHM transition evidence (models/lifeguard.update): a
+    # clean direct ACK decays, a timed-out or proxy-rescued probe bumps.
+    lg = {}
+    if params.lhm_max > 0:
+        lg = dict(lhm_fail=probes_sent & ~direct_ok,
+                  lhm_clean=probes_sent & direct_ok)
     return dict(
         **ae,
+        **lg,
         gossip_keys=gossip_keys, sync_keys=sync_keys,
         gossip_targets=gossip_targets, gossip_drop=gossip_drop,
         sync_target=sync_target, sync_drop=sync_drop,
@@ -2145,6 +2286,8 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
         state, status, inc, inbox, inbox_alive, round_idx, params, kn, world,
         node_ids, alive_here, is_self, inbox_ring=ring, flag_ring=fring,
         g_delivered=g_delivered, g_ring=g_ring_new,
+        lhm_signals=((s["lhm_fail"], s["lhm_clean"])
+                     if params.lhm_max > 0 else None),
     )
     aux = dict(
         _scatter_send_aux(s, params),
@@ -2270,6 +2413,12 @@ def swim_tick_send(state: SwimState, round_idx, base_key,
             s["hot_g"], s["gossip_targets"], s["gossip_drop"],
             params.n_members,
         ).astype(jnp.int8)
+    if params.lhm_max > 0:
+        # Lifeguard probe evidence crosses the round boundary with the
+        # contribution: the deferred recv half applies the SAME lhm
+        # transition the serial tick would (local rows, no combine).
+        pending["lhm_fail"] = s["lhm_fail"]
+        pending["lhm_clean"] = s["lhm_clean"]
     return pending, _scatter_send_aux(s, params)
 
 
@@ -2313,6 +2462,8 @@ def swim_tick_recv(state: SwimState, pending, send_aux, round_idx,
         ctx["state"], ctx["status"], ctx["inc"], inbox, inbox_alive,
         round_idx, params, ctx["kn"], world, ctx["node_ids"],
         ctx["alive_here"], ctx["is_self"], g_delivered=g_delivered,
+        lhm_signals=((pending["lhm_fail"], pending["lhm_clean"])
+                     if params.lhm_max > 0 else None),
     )
     aux = dict(
         send_aux,
@@ -2332,7 +2483,8 @@ def swim_tick_recv(state: SwimState, pending, send_aux, round_idx,
 
 def _shift_fd_chains(eng, d_ids, d_alive, d_part, fd_shift, proxy_shifts,
                      k_ping_net, k_proxy_net, params, kn, world, round_idx,
-                     node_ids, part_here, out_shape):
+                     node_ids, part_here, out_shape,
+                     ping_budget=None, ping_req_budget=None):
     """Shift-mode FD network outcomes as [n_local] vectors: the direct
     ping round trip and the ping-req proxy chains
     (FailureDetectorImpl.java:128-213), collapsed per _chain_ok.
@@ -2341,9 +2493,17 @@ def _shift_fd_chains(eng, d_ids, d_alive, d_part, fd_shift, proxy_shifts,
     protocol fix lands in one place; both callers pass the same keys in
     the same order, which is what keeps the blocked tick bit-identical.
 
+    ``ping_budget``/``ping_req_budget`` override the static millisecond
+    budgets (scalars or [n] vectors — the Lifeguard LHA Probe scaling,
+    models/fd.effective_probe_budgets); None = the params base values.
+
     Returns ``(t, alive_t, part_t, direct_ok, ack_ok)`` where ``t`` is
     each prober's target id and ``ack_ok`` includes the proxy rescues.
     """
+    if ping_budget is None:
+        ping_budget = params.ping_timeout_ms
+    if ping_req_budget is None:
+        ping_req_budget = params.ping_interval_ms - params.ping_timeout_ms
     t = eng.look_replicated(d_ids, fd_shift)
     alive_t = eng.look_replicated(d_alive, fd_shift)
     part_t = eng.look_replicated(d_part, fd_shift)
@@ -2353,7 +2513,7 @@ def _shift_fd_chains(eng, d_ids, d_alive, d_part, fd_shift, proxy_shifts,
                                   kn.loss_probability, params.mean_delay_ms)
     direct_ok = (
         _chain_ok(k_ping_net, [loss_it, loss_ti], [delay_it, delay_ti],
-                  params.ping_timeout_ms, out_shape)
+                  ping_budget, out_shape)
         & alive_t & (part_here == part_t)
     )
     # Ping-req via proxy shifts; proxy r for node i is (i + ps_r) % n.
@@ -2373,8 +2533,7 @@ def _shift_fd_chains(eng, d_ids, d_alive, d_part, fd_shift, proxy_shifts,
             hop_delays.append(de)
         ok_pr = (
             _chain_ok(jax.random.fold_in(k_proxy_net, r),
-                      hop_losses, hop_delays,
-                      params.ping_interval_ms - params.ping_timeout_ms,
+                      hop_losses, hop_delays, ping_req_budget,
                       out_shape)
             & p_alive & alive_t
             & (part_here == p_part) & (p_part == part_t)
@@ -2440,11 +2599,18 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     # when the branch never fires, while the probe body itself (uniform
     # draws + [N]-vector chains) is ~0.3 ms — and under vmap sweeps a cond
     # lowers to select-both-branches anyway.
+    # Lifeguard LHA Probe (the scatter tick's block, shared semantics):
+    # health-scaled budgets + the 1/lhm probe gate; compiled out at
+    # lhm_max=0 (None budgets = _shift_fd_chains' base defaults).
+    lhm_ping_budget, lhm_pr_budget, lhm_gate = lifeguard.lha_probe_setup(
+        params, state.lhm, k_ping_net, n_local)
+
     def fd_phase(_):
         t, _alive_t, _part_t, direct_ok, ack_ok = _shift_fd_chains(
             eng, d_ids, d_alive, d_part, fd_shift, proxy_shifts,
             k_ping_net, k_proxy_net, params, kn, world, round_idx,
             node_ids, part_here, (n_local,),
+            ping_budget=lhm_ping_budget, ping_req_budget=lhm_pr_budget,
         )
         if params.full_view:
             slot = t
@@ -2465,6 +2631,8 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
                 | (entry_t_status == records.SUSPECT)
             )
         active = fd_round & has_target & alive_here
+        if lhm_gate is not None:
+            active = active & lhm_gate
         suspect_v = active & ~ack_ok
         refute_v = active & ack_ok & (entry_t_status == records.SUSPECT)
         # True wire-message accounting — see _tick_scatter's probes_sent
@@ -2474,13 +2642,15 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         # validated for shift delivery in SwimParams.__post_init__).
         probes_sent = (active if params.ping_known_only
                        else fd_round & alive_here)
+        if lhm_gate is not None and not params.ping_known_only:
+            probes_sent = probes_sent & lhm_gate
         ping_req_launches = probes_sent & ~direct_ok
         return (suspect_v, refute_v, active,
                 jnp.maximum(slot, 0), entry_t_inc, probes_sent,
-                ping_req_launches)
+                ping_req_launches, probes_sent & direct_ok)
 
     (verdict_suspect, push_refute, probe_active, slot_safe,
-     entry_t_inc, probes_sent, ping_req_launches) = fd_phase(0)
+     entry_t_inc, probes_sent, ping_req_launches, lhm_clean) = fd_phase(0)
     ping_req_n = jnp.sum(ping_req_launches, dtype=jnp.int32) * r_proxies
 
     compact = params.compact_wire
@@ -2639,8 +2809,11 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     # below can suppress them — in scatter mode the refute push REPLACES
     # the sender's regular sync target (do_sync override), and without the
     # suppression shift mode would emit one extra message per refuting
-    # sender.
-    push_refute = push_refute & (kn.sync_every > 0)
+    # sender.  With the Lifeguard buddy system on (static lhm_max > 0)
+    # the push rides the FD ack path regardless of the SYNC channel —
+    # the scatter tick's gate, kept in lockstep.
+    if params.lhm_max == 0:
+        push_refute = push_refute & (kn.sync_every > 0)
 
     def refute_deliver(rf):
         ring_, fring_ = rf
@@ -2784,6 +2957,8 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         state, status, inc, inbox, inbox_alive, round_idx, params, kn, world,
         node_ids, alive_here, is_self, inbox_ring=ring, flag_ring=fring,
         g_delivered=g_delivered, g_ring=g_ring_acc,
+        lhm_signals=((ping_req_launches, lhm_clean)
+                     if params.lhm_max > 0 else None),
     )
     aux = dict(
         messages_gossip=n_gossip_sent,
@@ -2855,10 +3030,15 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
     # (a well-formed carry is already diagonal-pinned, and t != i for
     # every shift) — in compact layout the per-entry decode is just the
     # int32 upcast.
+    # Lifeguard LHA Probe — the same shared setup as _tick_shift, drawn
+    # from the same keys so the blocked tick stays bit-identical.
+    lhm_ping_budget, lhm_pr_budget, lhm_gate = lifeguard.lha_probe_setup(
+        params, state.lhm, k_ping_net, n)
     t, _alive_t, _part_t, direct_ok, ack_ok = _shift_fd_chains(
         eng, d_ids, d_alive, d_part, fd_shift, proxy_shifts,
         k_ping_net, k_proxy_net, params, kn, world, round_idx,
         node_ids, part_here, (n,),
+        ping_budget=lhm_ping_budget, ping_req_budget=lhm_pr_budget,
     )
     entry_t_status = jnp.take_along_axis(status, t[:, None], 1)[:, 0]
     entry_t_inc = jnp.take_along_axis(inc, t[:, None], 1)[:, 0] \
@@ -2866,6 +3046,8 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
     has_target = ((entry_t_status == records.ALIVE)
                   | (entry_t_status == records.SUSPECT))
     probe_active = fd_round & has_target & alive_here
+    if lhm_gate is not None:
+        probe_active = probe_active & lhm_gate
     verdict_suspect = probe_active & ~ack_ok
     push_refute = (probe_active & ack_ok
                    & (entry_t_status == records.SUSPECT))
@@ -2889,7 +3071,8 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
             sender_alive & alive_here & (sender_part == part_here)
             & (drop_u[:, c] >= loss_c) & (jnp.int32(c) < kn.fanout)
         )
-    push_refute = push_refute & (kn.sync_every > 0)
+    if params.lhm_max == 0:            # buddy: ack-path push (see _tick_shift)
+        push_refute = push_refute & (kn.sync_every > 0)
     h_pushers = eng.prep(push_refute)
     _, sender_alive_r, sender_part_r, loss_r, _ = _shift_sender_gate(
         eng, d_ids, d_alive, d_part, fd_shift, world, round_idx,
@@ -2988,6 +3171,10 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
             suspect_deadline=blk_of(state.suspect_deadline),
             self_inc=state.self_inc,
             inbox_ring=state.inbox_ring, flag_ring=state.flag_ring,
+            # K-independent [N] lane: the real values ride into every
+            # block (the LHS deadline arming reads them); the update
+            # itself happens ONCE outside the loop (lhm_signals=None).
+            lhm=state.lhm,
             **zero_g,
         )
         blk = _carry_decode(blk_raw, round_idx) if compact else blk_raw
@@ -3120,12 +3307,22 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
         g_infected = jnp.where(frozen1, g_infected, g_infected2)
         g_spread_until = jnp.where(frozen1, g_spread_until, g_spread2)
 
+    # Lifeguard LHM transition, once for the whole round (K-independent;
+    # mirrors _merge_and_timers' tail with the accumulated refutations).
+    new_lhm = state.lhm
+    if params.lhm_max > 0:
+        new_lhm = lifeguard.update(
+            state.lhm, ping_req_launches, probes_sent & direct_ok,
+            refuted & alive_here, alive_here, params.lhm_max,
+        )
+
     new_state = SwimState(
         status=st_acc, inc=inc_acc, spread_until=spr_acc,
         suspect_deadline=dl_acc, self_inc=self_inc_acc,
         inbox_ring=state.inbox_ring, flag_ring=state.flag_ring,
         g_infected=g_infected, g_spread_until=g_spread_until,
         g_ring=state.g_ring,
+        lhm=new_lhm,
     )
     subject_alive_i = (alive[world.subject_ids].astype(jnp.int32)
                        if per_subject
@@ -3281,6 +3478,7 @@ def run_metered(base_key, params: SwimParams, world: SwimWorld,
         world.alive_at(end), end, world,
         last_tick_metrics={k: metrics[k][-1]
                            for k in ("messages_gossip",) if k in metrics},
+        lhm=final_state.lhm if params.lhm_max > 0 else None,
     )
     return final_state, ms, metrics
 

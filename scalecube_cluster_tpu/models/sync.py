@@ -95,6 +95,14 @@ compiler therefore only PROMISES post-heal agreement
 (``chaos/monitor.POST_HEAL_DIVERGENCE``) when the split length clears
 ``chaos/scenarios.quiesce_bound``, and ``bench.py --sync`` measures
 the quiesced-heal scenario.
+
+``SwimParams.dead_suppress_rounds`` (default 0 = the reference
+behavior above) BOUNDS the mid-suspicion regime: for that many rounds
+after a tombstone is stored the cell holds (no reopen), so the death
+notice's retransmission windows expire against closed cells and the
+eventual reopens meet a cold network — the oscillation terminates
+within one window sized past the suspicion + spread tail
+(tests/test_dead_suppression.py pins both regimes).
 """
 
 from __future__ import annotations

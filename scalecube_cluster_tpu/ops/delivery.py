@@ -161,7 +161,7 @@ def wire_saturation(messages_sent, live_senders, fanout):
 
 
 def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
-                compact: bool = False):
+                compact: bool = False, suppress=None):
     """Merge one round's inbox into the membership table rows.
 
     Equivalent to one valid arrival-order serialization of the reference's
@@ -185,6 +185,17 @@ def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
     spreading for their remaining gossip periods (the reference's gossip
     component retransmits independently of the table,
     GossipProtocolImpl.java:239-250); transmission masks decide visibility.
+
+    ``suppress`` (optional [..] bool, None = off): cells inside their
+    dead-member suppression window (models/swim.SwimParams.
+    dead_suppress_rounds) gate by their TRUE DEAD key instead of the
+    ABSENT gate — nothing but a strictly higher DEAD key overrides, so
+    a freshly stored tombstone does not reopen for an arriving ALIVE
+    (of any incarnation: a suppressed reopen would re-hot the death
+    notice and re-burn an incarnation, the exact ping-pong the window
+    exists to break — models/sync.py "quiesced-heal precondition").
+    After the window the cell gates like ABSENT again (the reference's
+    remove-then-re-add recovery).
 
     Returns (status int8, inc int32, changed bool).
     """
@@ -217,6 +228,11 @@ def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
     accepts = jnp.where(
         absent, inbox_any_alive & (inbox_key >= 0), inbox_key > entry_key
     )
+    if suppress is not None:
+        # Suppressed tombstones keep their DEAD key in the gate: only a
+        # strictly higher DEAD key overrides during the window.
+        true_key = pack_record(entry_status, entry_inc, compact=compact)
+        accepts = jnp.where(suppress, inbox_key > true_key, accepts)
 
     new_status = jnp.where(accepts, win_status, entry_status).astype(jnp.int8)
     new_inc = jnp.where(accepts, win_inc, entry_inc).astype(jnp.int32)

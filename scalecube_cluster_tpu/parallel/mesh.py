@@ -92,6 +92,7 @@ def _shard_prelude(params: swim.SwimParams, mesh: Mesh):
         # Delay rings are [D, rows, K]: receiver rows on axis 1.
         inbox_ring=P(None, axis), flag_ring=P(None, axis),
         g_infected=P(axis), g_spread_until=P(axis), g_ring=P(None, axis),
+        lhm=P(axis),
     )
     metric_names = ["alive", "suspect", "dead", "absent", "false_positives",
                     "false_suspicion_onsets", "false_suspect_rounds",
@@ -343,6 +344,7 @@ def shard_run_metered(base_key, params: swim.SwimParams,
                                for k in ("messages_gossip",)
                                if k in metrics},
             axis_name=axis,
+            lhm=final_state.lhm if params.lhm_max > 0 else None,
         )
         ms = tmetrics.aggregate_across_devices(ms, axis)
         return final_state, ms, metrics

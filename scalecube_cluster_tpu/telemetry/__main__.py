@@ -86,7 +86,8 @@ def _cmd_regress(args) -> int:
     paths = query.expand_paths(
         args.paths
         or ["BENCH_*.json", "MULTICHIP_*.json",
-            os.path.join("artifacts", "sync_heal*.json")])
+            os.path.join("artifacts", "sync_heal*.json"),
+            os.path.join("artifacts", "lifeguard_fp*.json")])
     readable = [p for p in paths if os.path.exists(p)]
     if not readable:
         print("regress: no artifacts matched", file=sys.stderr)
@@ -129,7 +130,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="fail on regressions along the BENCH/MULTICHIP trajectories")
     p.add_argument("paths", nargs="*",
                    help="artifact files/globs (default: BENCH_*.json "
-                        "MULTICHIP_*.json artifacts/sync_heal*.json)")
+                        "MULTICHIP_*.json artifacts/sync_heal*.json "
+                        "artifacts/lifeguard_fp*.json)")
     p.add_argument("--band", type=float, default=query.DEFAULT_NOISE_BAND,
                    help="relative noise band (default 0.10)")
     p.add_argument("--json", action="store_true")

@@ -97,6 +97,9 @@ DEFAULT_GAUGES = (
     "dead_entries",              # tombstones held by live observers
     "gossip_piggyback_occupancy",  # hot records / live tracked records
     "wire_saturation",           # gossip messages / send-slot capacity
+    "lhm",                       # mean Lifeguard health multiplier over
+                                 # live members (models/lifeguard.py;
+                                 # 0 = plane off, 1 = all healthy)
 )
 DEFAULT_HISTOGRAMS = (
     ("suspicion_lifetime_rounds", DEFAULT_SUSPICION_EDGES),
@@ -330,6 +333,16 @@ def observe_tick(ms: MetricsState, spec: MetricsSpec, params, kn,
             # lifetime must not reach the buckets if it ever did).
             had_timer = resolved & (prev_deadline != INT32_MAX)
             lifetime = round_idx - (prev_deadline - kn.suspicion_rounds)
+            if getattr(params, "lhm_max", 0) > 0:
+                # Lifeguard LHA Suspicion stretches armed deadlines past
+                # the base schedule (models/lifeguard.py), so the
+                # deadline-derived onset is late for health-extended
+                # timers: the recovered lifetime is measured against the
+                # BASE schedule (exact for healthy observers, an
+                # underestimate by the health extension otherwise) and
+                # clamped at 0 so a stretched timer can't go negative
+                # into the buckets.
+                lifetime = jnp.maximum(lifetime, 0)
             m = observe(m, spec, "suspicion_lifetime_rounds", lifetime,
                         had_timer)
         return m
@@ -341,7 +354,7 @@ def observe_tick(ms: MetricsState, spec: MetricsSpec, params, kn,
 def sample_gauges(ms: MetricsState, spec: MetricsSpec, params, kn,
                   status, spread_until_wide, alive_here, round_idx,
                   world, last_tick_metrics=None,
-                  axis_name=None) -> MetricsState:
+                  axis_name=None, lhm=None) -> MetricsState:
     """Sample every gauge from the FINAL carry of a run/window.
 
     ``status``/``spread_until_wide`` are the (possibly local-row) carry
@@ -350,6 +363,12 @@ def sample_gauges(ms: MetricsState, spec: MetricsSpec, params, kn,
     rows.  Under sharding, local numerators are psum'd over
     ``axis_name`` (parallel/compat.psum_tree) so the stored gauge
     values are global on every device.
+
+    ``lhm``: the carry's Lifeguard health lane ([local rows] int32,
+    models/lifeguard.py) — when given (plane on), the ``lhm`` gauge
+    samples the mean multiplier over live members; None / plane off
+    leaves the gauge at its 0 init (a plane-off run reads 0, an
+    all-healthy plane-on run reads 1).
     """
     from scalecube_cluster_tpu.parallel import compat
 
@@ -379,6 +398,13 @@ def sample_gauges(ms: MetricsState, spec: MetricsSpec, params, kn,
                     dtype=jnp.int32),
             live, kn.fanout,
         )
+    if lhm is not None and lhm.shape[0]:
+        lhm_sum = compat.psum_tree(
+            jnp.sum(jnp.where(alive_here, lhm, 0), dtype=jnp.int32),
+            axis_name,
+        )
+        values["lhm"] = (lhm_sum.astype(jnp.float32)
+                         / jnp.maximum(live, 1).astype(jnp.float32))
     for name, value in values.items():
         if name in spec.gauges:
             ms = set_gauge(ms, spec, name, value)

@@ -311,7 +311,8 @@ def load_bench_payload(path: str) -> Tuple[Optional[dict], Optional[str]]:
                 and ("traced_overhead_ratio" in payload
                      or "metrics_overhead_ratio" in payload
                      or "pipelined_speedup_ratio" in payload
-                     or "sync_rounds_to_converge" in payload)):
+                     or "sync_rounds_to_converge" in payload
+                     or "fp_ratio" in payload)):
             return None, stub_note
     return payload, None
 
@@ -347,7 +348,12 @@ def regress(paths: Sequence[str],
         ``post_heal_divergence`` 0 (and the gossip-only control still
         diverging, when recorded) — absolute gates — and the
         convergence-time series stays <= best_prior * (1 + band) + 1
-        quantization round.
+        quantization round;
+      - Lifeguard A/B artifacts (``fp_ratio`` +
+        ``detection_p99_delta_rounds`` present, bench.py --lifeguard):
+        absolute gates — ``fp_ratio`` (plane-on FP observer rate over
+        its own control) <= 0.5 and the crash-detection latency P99
+        delta <= +1 round.
 
     Returns (ok, check rows); each row {"check", "latest", "reference",
     "threshold", "ok", "source"}.  Unreadable/failed artifacts — and
@@ -491,6 +497,51 @@ def regress(paths: Sequence[str],
             check("slo/sync_rounds_to_converge", last_path,
                   last["sync_rounds_to_converge"], best, limit,
                   last["sync_rounds_to_converge"] <= limit)
+        # Lifeguard A/B artifacts (bench.py --lifeguard): the headline
+        # adaptivity claims gate ABSOLUTELY — the plane must at least
+        # halve the false-positive observer rate of its own control
+        # while keeping crash-detection latency P99 within one round —
+        # so the committed win cannot silently rot.  Smoke artifacts
+        # are provenance unless the walk holds only smoke rounds (the
+        # sync-heal rule: `--lifeguard --smoke`'s in-bench check of its
+        # own fresh artifact still bites).
+        lg_all = [(p, pl) for p, pl in entries
+                  if "fp_ratio" in pl
+                  and "detection_p99_delta_rounds" in pl]
+        lg = [(p, pl) for p, pl in lg_all
+              if not pl.get("smoke")] or lg_all
+        if lg is not lg_all:
+            for p, pl in lg_all:
+                if pl.get("smoke"):
+                    rows.append({
+                        "check": "slo/lifeguard_fp", "source":
+                        os.path.basename(p), "ok": None,
+                        "note": "smoke lifeguard round — different "
+                                "scale, not a trajectory datum",
+                    })
+        if lg:
+            last_path, last = lg[-1]
+            ratio = last.get("fp_ratio")
+            if not isinstance(ratio, (int, float)):
+                # bench.py records fp_ratio: null when the CONTROL arm
+                # produced zero false-suspicion onsets — there was
+                # nothing to improve, so the run demonstrates neither a
+                # win nor a rot: provenance, not a regression.
+                rows.append({
+                    "check": "slo/lifeguard_fp", "source":
+                    os.path.basename(last_path), "ok": None,
+                    "note": "no FP signal (control recorded zero "
+                            "onsets) — nothing to gate",
+                })
+            else:
+                check("slo/lifeguard_fp_improvement", last_path, ratio,
+                      0.5, 0.5, math.isfinite(ratio) and ratio <= 0.5)
+                delta = last.get("detection_p99_delta_rounds")
+                check("slo/lifeguard_detection_parity", last_path,
+                      delta, 0.0, DISSEMINATION_SLACK_ROUNDS,
+                      isinstance(delta, (int, float))
+                      and math.isfinite(delta)
+                      and delta <= DISSEMINATION_SLACK_ROUNDS)
     return ok, rows
 
 

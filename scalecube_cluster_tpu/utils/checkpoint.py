@@ -41,8 +41,10 @@ def state_to_arrays(state: SwimState) -> dict:
 def state_from_arrays(fields: dict, origin: str = "checkpoint") -> SwimState:
     """Inverse of :func:`state_to_arrays` (keys WITHOUT the ``state/``
     prefix).  Checkpoints written before the user-gossip fields existed
-    load as G=0 (zero-width arrays) — the layout params.n_user_gossips=0
-    produces, so resume validation stays meaningful."""
+    load as G=0 (zero-width arrays), and ones written before the
+    Lifeguard health lane existed load with the plane-off zero-size
+    ``lhm`` — the layouts params.n_user_gossips=0 / params.lhm_max=0
+    produce, so resume validation stays meaningful."""
     fields = {k: jax.numpy.asarray(v) for k, v in fields.items()}
     missing = ({f.name for f in dataclasses.fields(SwimState)}
                - set(fields))
@@ -53,6 +55,7 @@ def state_from_arrays(fields: dict, origin: str = "checkpoint") -> SwimState:
             "g_spread_until": jax.numpy.zeros(
                 (n, 0), dtype=jax.numpy.int32),
             "g_ring": jax.numpy.zeros((0, n, 0), dtype=bool),
+            "lhm": jax.numpy.zeros((0,), dtype=jax.numpy.int32),
         }
         unknown = missing - set(g_defaults)
         if unknown:

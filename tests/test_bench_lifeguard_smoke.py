@@ -1,0 +1,170 @@
+"""bench.py --lifeguard --smoke: the Lifeguard A/B JSON contract.
+
+Like tests/test_bench_sync_smoke.py for the anti-entropy plane: the
+bench is the one entry point the adaptivity measurement flows through,
+so this tier-1 test runs the real script in a subprocess (CPU) and
+pins the published contract — one JSON line with the A/B fields (the
+plane's false-positive observer rate at most half the control's, crash
+detection latency P99 within one round), an
+artifacts/lifeguard_fp.json-style artifact the query layer loads as a
+real payload, and the regress gate walking it with the absolute
+lifeguard checks.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.lifeguard
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run_lifeguard_bench(tmp_path, extra_env=None, timeout=540):
+    artifact = tmp_path / "lifeguard_fp_smoke.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_TPU_TELEMETRY_DIR=str(tmp_path),
+        SCALECUBE_LIFEGUARD_ARTIFACT=str(artifact),
+        SCALECUBE_XLA_CACHE_DIR="",           # no cache writes from tests
+    )
+    env.pop("SCALECUBE_TPU_PROFILE_DIR", None)
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--lifeguard", "--smoke"],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout      # exactly ONE JSON line
+    return json.loads(lines[0]), artifact
+
+
+def test_bench_lifeguard_smoke_contract(tmp_path):
+    result, artifact = _run_lifeguard_bench(tmp_path)
+
+    assert "error" not in result, result
+    assert result["smoke"] is True
+    assert result["metric"] == "lifeguard_fp_observer_rate"
+    # value stays None BY DESIGN (smaller-is-better ratio must not
+    # enter the generic throughput walk); the payload says so.
+    assert result["value"] is None
+    assert "value_note" in result
+
+    # The headline acceptance: the plane at least halves the
+    # false-positive observer rate of its own control while keeping
+    # crash-detection latency P99 within one round.
+    assert result["false_positive_observer_rate_off"] > 0
+    assert result["fp_ratio"] is not None
+    assert result["fp_ratio"] <= 0.5
+    assert (result["false_positive_observer_rate_on"]
+            < result["false_positive_observer_rate_off"])
+    assert result["detection_p99_delta_rounds"] <= 1.0
+
+    # Workload provenance: the seeded scenario, its repro line, and
+    # the plane's knobs.
+    assert result["lhm_max"] > 0
+    assert result["n_scenarios"] >= 1
+    assert result["delivery"] == "scatter"
+    assert result["live_observer_rounds"] > 0
+    for row in result["scenarios"]:
+        assert "asymmetric_degradation" in row["repro"]
+        assert row["fp_onsets_off"] >= row["fp_onsets_on"]
+        assert row["lhm_gauge"] is not None
+
+    # The artifact round-trips and loads as a REAL (non-stub) payload.
+    art = json.loads(artifact.read_text())
+    assert art["metric"] == result["metric"]
+    assert art["fp_ratio"] == result["fp_ratio"]
+
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    payload, skip_note = tquery.load_bench_payload(str(artifact))
+    assert skip_note is None
+    assert payload["fp_ratio"] == result["fp_ratio"]
+
+    # The in-bench regress gate ran and the dedicated absolute checks
+    # are present and green for the fresh artifact.
+    assert result["regress"]["ok"] is True
+    assert result["regress"]["artifacts"] >= 1
+    ok, rows = tquery.regress([str(artifact)])
+    assert ok
+    names = {r["check"] for r in rows}
+    assert {"slo/lifeguard_fp_improvement",
+            "slo/lifeguard_detection_parity"} <= names
+
+
+def test_regress_fails_on_rotted_lifeguard_win(tmp_path):
+    """An artifact recording a lost FP win (or a detection-latency
+    cost) must fail the gate — the committed claim cannot silently
+    rot."""
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    bad = tmp_path / "lifeguard_fp_bad.json"
+    bad.write_text(json.dumps({
+        "metric": "lifeguard_fp_observer_rate", "value": None,
+        "fp_ratio": 0.8, "detection_p99_delta_rounds": 4.0,
+        "false_positive_observer_rate_off": 0.1,
+        "false_positive_observer_rate_on": 0.08,
+    }))
+    ok, rows = tquery.regress([str(bad)])
+    assert not ok
+    failed = {r["check"] for r in rows if r.get("ok") is False}
+    assert "slo/lifeguard_fp_improvement" in failed
+    assert "slo/lifeguard_detection_parity" in failed
+
+
+def test_regress_smoke_artifacts_are_provenance_next_to_full(tmp_path):
+    """A smoke lifeguard artifact sitting next to a full one is a
+    provenance row; the full round carries the gates."""
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    def art(path, smoke, ratio):
+        path.write_text(json.dumps({
+            "metric": "lifeguard_fp_observer_rate", "value": None,
+            "smoke": smoke, "fp_ratio": ratio,
+            "detection_p99_delta_rounds": 0.0,
+        }))
+        return str(path)
+
+    full = art(tmp_path / "lifeguard_fp.json", False, 0.3)
+    smoke = art(tmp_path / "lifeguard_fp_smoke.json", True, 0.9)
+    ok, rows = tquery.regress([full, smoke])
+    assert ok                              # the bad smoke round skips
+    notes = [r for r in rows if r.get("ok") is None
+             and r["check"] == "slo/lifeguard_fp"]
+    assert notes and "smoke" in notes[0]["note"]
+
+
+@pytest.mark.slow
+def test_bench_lifeguard_full_campaign(tmp_path):
+    """The full (non-smoke) A/B campaign: every scenario seed's A/B
+    pair through the real bench, the aggregate gates green."""
+    artifact = tmp_path / "lifeguard_fp_full.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_TPU_TELEMETRY_DIR=str(tmp_path),
+        SCALECUBE_LIFEGUARD_ARTIFACT=str(artifact),
+        SCALECUBE_XLA_CACHE_DIR="",
+    )
+    env.pop("SCALECUBE_TPU_PROFILE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--lifeguard"],
+        capture_output=True, text=True, timeout=3000, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "error" not in result, result
+    assert result["smoke"] is False
+    assert result["n_scenarios"] >= 3
+    assert result["fp_ratio"] <= 0.5
+    assert result["detection_p99_delta_rounds"] <= 1.0
+    assert result["regress"]["ok"] is True

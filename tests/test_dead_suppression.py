@@ -1,0 +1,159 @@
+"""Dead-member suppression window (SwimParams.dead_suppress_rounds).
+
+The PR-7 known debt: a partition healed MID-SUSPICION releases
+freshly-hot tombstones into the healed cluster, and the merge
+precedence (a DEAD record overrides any live incarnation, while a
+stored tombstone reopens for any ALIVE) sustains an unbounded
+DEAD/ALIVE reinfection ping-pong — the subject burns incarnations
+forever (documented in models/sync.py).  The suppression window makes
+each stored tombstone HOLD (no reopen) for ``dead_suppress_rounds``,
+so the death notice's retransmission windows all expire while every
+cell is closed, and the eventual reopens meet a cold network.
+
+Contracts:
+
+  1. default 0 = current behavior, bit-identical (param equality plus
+     a fault-bearing run equality pin);
+  2. the merge gate: a suppressed tombstone rejects ALIVE at ANY
+     incarnation (reopening would re-hot the notice) and
+     equal-or-lower DEAD keys, but still admits a strictly higher
+     DEAD; unsuppressed it reopens per the reference rule;
+  3. the headline: a mid-suspicion heal with the window OFF burns
+     incarnations without bound; with a window sized past the
+     suspicion + spread tail the burn STOPS within the window and
+     (with the SYNC plane on) the tables fully re-converge.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu import records
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.models import sync as sync_plane
+from scalecube_cluster_tpu.ops import delivery
+
+from tests.test_swim_model import fast_config
+
+pytestmark = pytest.mark.lifeguard
+
+
+def _mid_suspicion_heal_world(params, n, split):
+    """One split phase SHORTER than the quiesce bound: cross-half
+    suspicions are still maturing (or freshly tombstoned and hot) when
+    the heal lands."""
+    world = swim.SwimWorld.healthy(params)
+    part = np.zeros((8, n), np.int8)
+    part[0, : n // 2] = 1
+    return world.with_partition_schedule(part, split)
+
+
+def test_default_off_is_bit_identical():
+    params = swim.SwimParams.from_config(fast_config(), n_members=16)
+    assert params.dead_suppress_rounds == 0
+    explicit = dataclasses.replace(params, dead_suppress_rounds=0)
+    assert explicit == params
+    # A window on a run with real deaths changes nothing once the
+    # window is 0-length... and a NONZERO window on a fault-free world
+    # also changes nothing (nothing ever dies).
+    world = swim.SwimWorld.healthy(params)
+    p_w = dataclasses.replace(params, dead_suppress_rounds=24)
+    s0, _ = swim.run(jax.random.key(0), params, world, 30)
+    s1, _ = swim.run(jax.random.key(0), p_w, world, 30)
+    for f in ("status", "inc", "suspect_deadline", "self_inc"):
+        assert np.array_equal(np.asarray(getattr(s0, f)),
+                              np.asarray(getattr(s1, f))), f
+
+
+def test_merge_gate_suppression():
+    """Unit pin of the merge rule change (ops/delivery.merge_inbox):
+    suppressed tombstones reject every ALIVE and equal/lower DEAD;
+    a strictly higher DEAD still lands; unsuppressed reopens."""
+    entry_status = jnp.full((1, 4), records.DEAD, jnp.int8)
+    entry_inc = jnp.full((1, 4), 5, jnp.int32)
+    inbox = jnp.stack([
+        records.merge_key(jnp.int8(records.ALIVE), jnp.int32(5)),   # equal
+        records.merge_key(jnp.int8(records.ALIVE), jnp.int32(9)),   # higher
+        records.merge_key(jnp.int8(records.DEAD), jnp.int32(5)),    # same
+        records.merge_key(jnp.int8(records.DEAD), jnp.int32(6)),    # higher
+    ])[None, :]
+    alive_flags = jnp.asarray(
+        [[True, True, False, False]], jnp.bool_)
+
+    sup_status, sup_inc, sup_changed = delivery.merge_inbox(
+        entry_status, entry_inc, inbox, alive_flags,
+        suppress=jnp.ones((1, 4), jnp.bool_))
+    assert np.asarray(sup_status).tolist()[0] == [
+        records.DEAD, records.DEAD, records.DEAD, records.DEAD]
+    assert np.asarray(sup_inc).tolist()[0] == [5, 5, 5, 6]
+    assert np.asarray(sup_changed).tolist()[0] == [
+        False, False, False, True]
+
+    open_status, open_inc, _ = delivery.merge_inbox(
+        entry_status, entry_inc, inbox, alive_flags,
+        suppress=jnp.zeros((1, 4), jnp.bool_))
+    # Unsuppressed: the reference reopen — ALIVE at any incarnation.
+    assert np.asarray(open_status).tolist()[0][:2] == [
+        records.ALIVE, records.ALIVE]
+
+
+def test_suppression_expiry_rides_the_deadline_lane():
+    """A tombstone formed by a fired timer carries its suppression
+    expiry in ``suspect_deadline`` (and the monitor accepts it)."""
+    from scalecube_cluster_tpu.chaos import monitor as cm
+
+    n = 16
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="scatter",
+        dead_suppress_rounds=40)
+    world = swim.SwimWorld.healthy(params).with_crash(3, at_round=0)
+    spec = cm.MonitorSpec.passive(params)
+    rounds = 80
+    state, mon, _ = cm.run_monitored(
+        jax.random.key(1), params, world, spec, rounds)
+    assert cm.verdict(mon)["green"], cm.verdict(mon)
+    dl = np.asarray(state.suspect_deadline)
+    st = np.asarray(state.status)
+    held = dl[(st == records.DEAD) & (dl != swim.INT32_MAX)]
+    assert held.size > 0                      # expiries were armed
+    assert (held <= rounds + 40).all()        # bounded by the window
+
+
+@pytest.mark.parametrize("supp,terminates", [(0, False), (64, True)])
+def test_mid_suspicion_heal_oscillation(supp, terminates):
+    """The headline pin: the mid-suspicion heal's incarnation burn is
+    unbounded without the window and STOPS within it with the window
+    sized past the suspicion + spread tail (suspicion_rounds=30,
+    periods_to_spread=15 on the fast config — 64 covers both), after
+    which the SYNC plane re-converges the tables."""
+    n = 16
+    split = 48              # < quiesce_bound: tombstones hot at heal
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="scatter",
+        sync_interval=8, dead_suppress_rounds=supp)
+    world = _mid_suspicion_heal_world(params, n, split)
+
+    # Settle through heal + window, then observe two later segments.
+    state, _ = swim.run(jax.random.key(1), params, world,
+                        split + supp + 40)
+    r = split + supp + 40
+    inc0 = int(np.asarray(state.self_inc).sum())
+    state, _ = swim.run(jax.random.key(1), params, world, 60,
+                        state=state, start_round=r)
+    inc1 = int(np.asarray(state.self_inc).sum())
+    state, _ = swim.run(jax.random.key(1), params, world, 60,
+                        state=state, start_round=r + 60)
+    inc2 = int(np.asarray(state.self_inc).sum())
+    div = int(sync_plane.divergence_probe(state, params, world, r + 120))
+    if terminates:
+        # Burn stopped inside the window and the tables re-converged.
+        assert inc1 == inc0 and inc2 == inc1, (inc0, inc1, inc2)
+        assert div == 0
+    else:
+        # The documented unbounded regime: still burning in BOTH later
+        # segments, tables still divergent.
+        assert inc1 > inc0 and inc2 > inc1, (inc0, inc1, inc2)
+        assert div > 0

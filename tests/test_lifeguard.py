@@ -1,0 +1,361 @@
+"""The Lifeguard health plane (models/lifeguard.py + SwimParams.lhm_max).
+
+Four contracts, mirroring tests/test_sync_plane.py's structure:
+
+  1. *off = bit-identical*: ``lhm_max=0`` (the default) compiles the
+     plane out — zero-size lane, no extra draws, the plane-less
+     program exactly;
+  2. *on + healthy = no-op*: with every member healthy the multiplier
+     pins at 1, the scaled budgets/deadlines equal their base values
+     and the probe gate always passes, so warm no-fault runs are
+     table- AND metrics-identical to plane-off across every layout,
+     both delivery modes, and the sharded pipelined path;
+  3. *the LHM contract*: the multiplier stays clamped to
+     ``[1, lhm_max]``, effective timeouts and suspicion deadlines
+     never drop below their base values (property-tested on the pure
+     schedule functions), a degraded observer ramps up and decays
+     back, and its probe rate drops accordingly;
+  4. *buddy refutation*: with the plane on, a falsely suspected member
+     learns of its suspicion in the probe ACK path and refutes even
+     with the membership SYNC channel off (``sync_every=0``) — without
+     the plane the suspicion matures to a false DEAD.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.models import fd as fd_model
+from scalecube_cluster_tpu.models import lifeguard
+from scalecube_cluster_tpu.models import swim
+
+from tests.test_swim_model import fast_config
+
+pytestmark = pytest.mark.lifeguard
+
+STATE_FIELDS = ("status", "inc", "spread_until", "suspect_deadline",
+                "self_inc")
+
+
+def _assert_states_equal(a, b, fields=STATE_FIELDS):
+    for f in fields:
+        assert np.array_equal(np.asarray(getattr(a, f)),
+                              np.asarray(getattr(b, f))), f
+
+
+def _degraded_world(params, node=0, loss=0.8, until=10 ** 6):
+    """Inbound loss on one observer: its probes of healthy peers lose
+    the ack hop — the observer-side degradation the LHM detects."""
+    n = params.n_members
+    return swim.SwimWorld.healthy(params).with_link_fault(
+        (1, n), node, loss=loss, until_round=until)
+
+
+# --------------------------------------------------------------------------
+# 1 + 2: disabled default == baseline; enabled on healthy world == no-op
+# --------------------------------------------------------------------------
+
+
+def test_lhm_defaults_off():
+    params = swim.SwimParams.from_config(fast_config(), n_members=8)
+    assert params.lhm_max == 0
+    explicit = dataclasses.replace(params, lhm_max=0)
+    assert explicit == params          # same static params, same program
+    state = swim.initial_state(params, swim.SwimWorld.healthy(params))
+    assert state.lhm.shape == (0,)     # the lane is compiled out
+
+
+def test_param_validation():
+    params = swim.SwimParams.from_config(fast_config(), n_members=8)
+    with pytest.raises(ValueError, match="lhm_max"):
+        dataclasses.replace(params, lhm_max=-1)
+    with pytest.raises(ValueError, match="dead_suppress_rounds"):
+        dataclasses.replace(params, dead_suppress_rounds=-1)
+    # compact_carry caps the scaled deadline horizon.
+    with pytest.raises(ValueError, match="lhm_max"):
+        swim.SwimParams.from_config(
+            fast_config(), n_members=8, delivery="shift",
+            compact_carry=True, lhm_max=3000)
+
+
+@pytest.mark.parametrize("delivery,subjects,layout", [
+    ("scatter", None, "wide"),
+    ("shift", None, "wide"),
+    ("shift", 8, "wide"),              # focal
+    ("shift", None, "compact"),
+    ("scatter", None, "wire16"),
+])
+def test_plane_on_healthy_world_is_noop(delivery, subjects, layout):
+    """All-healthy members pin lhm at 1: gate always passes, budgets
+    and deadlines equal base — tables AND the metrics tree are
+    bit-identical to plane-off (the strong off-switch pin: the plane's
+    draws come from a dedicated key fold, so enabling it perturbs no
+    existing stream)."""
+    n = 24
+    p_off = swim.SwimParams.from_config(
+        fast_config(), n_members=n, n_subjects=subjects,
+        delivery=delivery,
+        compact_carry=layout == "compact", int16_wire=layout == "wire16",
+    )
+    p_on = dataclasses.replace(p_off, lhm_max=8)
+    world = swim.SwimWorld.healthy(p_off)
+    s_off, m_off = swim.run(jax.random.key(0), p_off, world, 20)
+    s_on, m_on = swim.run(jax.random.key(0), p_on, world, 20)
+    _assert_states_equal(s_off, s_on)
+    assert np.all(np.asarray(s_on.lhm) == 1)
+    assert set(m_on) == set(m_off)
+    for k in m_off:
+        assert np.array_equal(np.asarray(m_off[k]), np.asarray(m_on[k])), k
+
+
+# --------------------------------------------------------------------------
+# 3: the LHM contract
+# --------------------------------------------------------------------------
+
+
+def test_deadline_schedule_never_below_base():
+    """Property: the LHA Suspicion schedule is >= base for every
+    (lhm, n_live) pair, monotone in both, equal to base at lhm=1, and
+    capped at base * lhm_max."""
+    base = jnp.int32(36)
+    n = 64
+    lhm = jnp.arange(1, 9, dtype=jnp.int32)
+    for n_live in (0, 1, 3, 17, 32, 64):
+        d = np.asarray(lifeguard.suspicion_deadline_rounds(
+            base, lhm, jnp.int32(n_live), n))
+        assert (d >= 36).all()
+        assert (np.diff(d) >= 0).all()          # monotone in lhm
+        assert d[0] == 36                       # lhm=1 -> exactly base
+        assert (d <= 36 * 8).all()
+    full = np.asarray(lifeguard.suspicion_deadline_rounds(
+        base, jnp.int32(8), jnp.int32(n), n))
+    assert full == 36 * 8                       # n_live=N -> full scale
+
+
+def test_probe_budgets_never_below_base():
+    params = swim.SwimParams.from_config(fast_config(), n_members=16,
+                                         lhm_max=8)
+    lhm = jnp.arange(1, 9, dtype=jnp.int32)
+    ping, ping_req = fd_model.effective_probe_budgets(params, lhm)
+    assert (np.asarray(ping) >= params.ping_timeout_ms).all()
+    assert (np.asarray(ping_req)
+            >= params.ping_interval_ms - params.ping_timeout_ms).all()
+    assert float(ping[0]) == params.ping_timeout_ms      # lhm=1 = base
+
+
+def test_lhm_update_clamps():
+    """The transition never leaves [1, lhm_max] and frozen members keep
+    their multiplier."""
+    lhm = jnp.asarray([1, 1, 8, 8, 4], jnp.int32)
+    fail = jnp.asarray([0, 1, 1, 0, 1], jnp.bool_)
+    clean = jnp.asarray([1, 0, 0, 1, 0], jnp.bool_)
+    refuted = jnp.asarray([0, 1, 1, 0, 0], jnp.bool_)
+    alive = jnp.asarray([1, 1, 1, 1, 0], jnp.bool_)
+    out = np.asarray(lifeguard.update(lhm, fail, clean, refuted, alive, 8))
+    assert out.tolist() == [1,   # 1 - 1 clamps up to 1
+                            3,   # 1 + 1 + 1
+                            8,   # 8 + 2 clamps down to 8
+                            7,   # 8 - 1
+                            4]   # frozen: unchanged
+    assert (out >= 1).all() and (out <= 8).all()
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+def test_degraded_observer_ramps_and_recovers(delivery):
+    """Inbound loss on one observer ramps ITS multiplier to the cap
+    while healthy members stay at ~1; after the fault lifts it decays
+    back down.  Resumes across run segments keep the clamp."""
+    n = 16
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery=delivery, lhm_max=8)
+    world = _degraded_world(params, node=0, loss=0.85, until=60)
+    state, _ = swim.run(jax.random.key(2), params, world, 60)
+    mid = np.asarray(state.lhm)
+    assert mid[0] == 8                       # degraded observer at cap
+    assert (mid >= 1).all() and (mid <= 8).all()
+    assert np.median(mid[1:]) <= 2           # healthy stay low
+    state, _ = swim.run(jax.random.key(2), params, world, 300,
+                        state=state, start_round=60)
+    final = np.asarray(state.lhm)
+    assert final[0] <= 2                     # decayed after the heal
+    assert (final >= 1).all() and (final <= 8).all()
+
+
+def test_probe_rate_scales_down_under_degradation():
+    """LHA Probe's interval scaling: the degraded observer issues
+    measurably fewer probes with the plane on (messages_ping_sent)."""
+    n = 16
+    p_on = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="scatter", lhm_max=8)
+    p_off = dataclasses.replace(p_on, lhm_max=0)
+    world = _degraded_world(p_on, node=0, loss=0.85)
+    _, m_on = swim.run(jax.random.key(3), p_on, world, 200)
+    _, m_off = swim.run(jax.random.key(3), p_off, world, 200)
+    sent_on = int(np.asarray(m_on["messages_ping_sent"]).sum())
+    sent_off = int(np.asarray(m_off["messages_ping_sent"]).sum())
+    assert sent_on < sent_off
+
+
+def test_armed_deadlines_respect_scaled_bound():
+    """Every pending suspicion timer in a plane-on run stays within
+    [base, base * lhm_max] rounds of arming — the TIMER_BOUND contract
+    the monitor enforces, checked here directly on the carry."""
+    from scalecube_cluster_tpu.chaos import monitor as cm
+
+    n = 16
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="scatter", lhm_max=4)
+    world = _degraded_world(params, node=0, loss=0.8)
+    spec = cm.MonitorSpec.passive(params)
+    _, mon, _ = cm.run_monitored(jax.random.key(4), params, world, spec,
+                                 120)
+    assert cm.verdict(mon)["green"], cm.verdict(mon)
+
+
+# --------------------------------------------------------------------------
+# 4: buddy refutation over the ack path
+# --------------------------------------------------------------------------
+
+
+def test_buddy_refutes_over_the_ack_path_alone():
+    """The FD-isolation configuration (gossip fanout 0 AND
+    sync_every=0 — models/fd.fd_only_knobs) leaves the probe ACK path
+    as the ONLY way a suspected member can learn of its suspicion.  A
+    transient all-acks block gets members falsely suspected; with the
+    plane on, a later successful probe's ack carries the suspicion
+    back (the buddy push) and the member self-refutes — plane off,
+    verdicts stay strictly observer-local and nobody ever bumps (the
+    fd.py caveat note)."""
+    n = 16
+    p_off = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="scatter", sync_every=0)
+    p_on = dataclasses.replace(p_off, lhm_max=8)
+    kn_off = dataclasses.replace(swim.Knobs.from_params(p_off),
+                                 fanout=jnp.int32(0))
+    kn_on = dataclasses.replace(swim.Knobs.from_params(p_on),
+                                fanout=jnp.int32(0))
+    # Block all acks for a window shorter than the suspicion timeout,
+    # then heal: probers suspect their targets meanwhile, and
+    # post-heal probes of still-suspected entries succeed.
+    world = swim.SwimWorld.healthy(p_off).with_block(
+        (0, n), (0, n), from_round=4, until_round=14)
+    rounds = 60
+    s_off, _ = swim.run(jax.random.key(5), p_off, world, rounds,
+                        knobs=kn_off)
+    s_on, _ = swim.run(jax.random.key(5), p_on, world, rounds,
+                       knobs=kn_on)
+    # Plane on: buddy pushes delivered suspicions back over the ack
+    # path; members learned and bumped.
+    assert int(np.asarray(s_on.self_inc).max()) > 0
+    # Plane off: no dissemination channel exists — nobody ever learned
+    # of any suspicion, so nobody bumped.
+    assert int(np.asarray(s_off.self_inc).max()) == 0
+
+
+# --------------------------------------------------------------------------
+# Sharded twins (incl. the pipelined double-buffer)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.multichip
+def test_sharded_pipelined_equals_serial_with_plane():
+    """The LHM lane and its probe evidence ride the pipelined carry:
+    sharded pipelined == sharded serial bit for bit with the plane on,
+    through real degradation + a crash."""
+    from scalecube_cluster_tpu.parallel import compat
+    from scalecube_cluster_tpu.parallel import mesh as pmesh
+
+    if not compat.HAS_SHARD_MAP:
+        pytest.skip(compat.SKIP_REASON)
+    n = 32
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="scatter", lhm_max=4)
+    world = swim.SwimWorld.healthy(params).with_link_fault(
+        (4, n), (0, 4), loss=0.8).with_crash(9, at_round=10)
+    mesh = pmesh.make_mesh(4)
+    s_ser, m_ser = pmesh.shard_run(jax.random.key(6), params, world, 50,
+                                   mesh, pipelined=False)
+    s_pip, m_pip = pmesh.shard_run(jax.random.key(6), params, world, 50,
+                                   mesh, pipelined=True)
+    _assert_states_equal(s_ser, s_pip, fields=STATE_FIELDS + ("lhm",))
+    for k in m_ser:
+        assert np.array_equal(np.asarray(m_ser[k]),
+                              np.asarray(m_pip[k])), k
+    assert int(np.asarray(s_ser.lhm).max()) > 1   # degradation was seen
+
+
+@pytest.mark.multichip
+def test_sharded_metered_samples_lhm_gauge():
+    from scalecube_cluster_tpu.parallel import compat
+    from scalecube_cluster_tpu.parallel import mesh as pmesh
+    from scalecube_cluster_tpu.telemetry import metrics as tm
+
+    if not compat.HAS_SHARD_MAP:
+        pytest.skip(compat.SKIP_REASON)
+    n = 32
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="scatter", lhm_max=4)
+    world = swim.SwimWorld.healthy(params).with_link_fault(
+        (4, n), (0, 4), loss=0.8)
+    _, ms, _ = pmesh.shard_run_metered(
+        jax.random.key(7), params, world, 40, pmesh.make_mesh(4))
+    d = tm.to_json(jax.device_get(ms), tm.MetricsSpec.default())
+    assert d["gauges"]["lhm"] >= 1.0         # plane on: mean over live
+
+
+# --------------------------------------------------------------------------
+# Run shapes + layouts carry the plane unchanged
+# --------------------------------------------------------------------------
+
+
+def test_run_shapes_agree_with_plane_on():
+    """run / run_traced / run_metered / run_monitored /
+    run_monitored_metered all execute the identical tick with the plane
+    on — final tables and lhm lanes agree bit for bit; the metered
+    shape samples the lhm gauge."""
+    from scalecube_cluster_tpu.chaos import monitor as cm
+    from scalecube_cluster_tpu.telemetry import metrics as tm
+
+    n = 16
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="scatter", lhm_max=4)
+    world = _degraded_world(params, node=0, loss=0.7)
+    rounds = 40
+    ref, _ = swim.run(jax.random.key(8), params, world, rounds)
+    traced, _, _ = swim.run_traced(jax.random.key(8), params, world,
+                                   rounds)
+    metered, ms, _ = swim.run_metered(jax.random.key(8), params, world,
+                                      rounds)
+    spec = cm.MonitorSpec.passive(params)
+    monitored, _, _ = cm.run_monitored(jax.random.key(8), params, world,
+                                       spec, rounds)
+    mm, _, _, _ = cm.run_monitored_metered(jax.random.key(8), params,
+                                           world, spec, rounds)
+    for other in (traced, metered, monitored, mm):
+        _assert_states_equal(ref, other, fields=STATE_FIELDS + ("lhm",))
+    d = tm.to_json(jax.device_get(ms), tm.MetricsSpec.default())
+    assert d["gauges"]["lhm"] >= 1.0
+
+
+def test_blocked_and_compact_layouts_identical_with_plane():
+    """k_block bit-identity + compact-carry trace-identity with the
+    plane on, through real degradation."""
+    n = 32
+    p_on = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="shift", lhm_max=4)
+    world = _degraded_world(p_on, node=0, loss=0.8)
+    rounds = 80
+    s_ref, m_ref = swim.run(jax.random.key(9), p_on, world, rounds)
+    p_blk = dataclasses.replace(p_on, k_block=8)
+    s_blk, _ = swim.run(jax.random.key(9), p_blk, world, rounds)
+    _assert_states_equal(s_ref, s_blk, fields=STATE_FIELDS + ("lhm",))
+    p_c = dataclasses.replace(p_on, compact_carry=True)
+    s_c, _ = swim.run(jax.random.key(9), p_c, world, rounds)
+    dec = swim._carry_decode(s_c, jnp.int32(rounds))
+    assert np.array_equal(np.asarray(s_ref.status), np.asarray(dec.status))
+    assert np.array_equal(np.asarray(s_ref.inc), np.asarray(dec.inc))
+    assert np.array_equal(np.asarray(s_ref.lhm), np.asarray(s_c.lhm))
+    assert int(np.asarray(s_ref.lhm)[0]) > 1
