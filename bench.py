@@ -113,6 +113,20 @@ Env overrides: SCALECUBE_LIFEGUARD_N, SCALECUBE_LIFEGUARD_LHM_MAX,
 SCALECUBE_LIFEGUARD_SEED, SCALECUBE_LIFEGUARD_SCENARIOS,
 SCALECUBE_LIFEGUARD_ARTIFACT.
 
+``--churn``: the open-world membership workload — mid-run JOIN admission
+into recycled slots (models/swim.SwimParams.open_world) measured A/B
+against naive slot reuse under the seeded
+``chaos.churn_growth_scenario`` net-positive arrival storm: the epoch
+guard must finish with ZERO NO_RESURRECTION / JOIN_COMPLETENESS
+violations and a ``join_propagation_p99`` inside the dissemination
+bound, while the naive control arm DEMONSTRATES the resurrection
+failure (violations > 0) — all gated absolutely by ``telemetry
+regress`` over the ``artifacts/churn_growth.json``-style artifact this
+mode writes.  ``--churn --smoke`` is the tier-1-safe single-scenario
+pass pinned by tests/test_bench_churn_smoke.py.  Env overrides:
+SCALECUBE_CHURN_N, SCALECUBE_CHURN_SEED, SCALECUBE_CHURN_SCENARIOS,
+SCALECUBE_CHURN_SUPPRESS, SCALECUBE_CHURN_ARTIFACT.
+
 Env overrides for debugging: SCALECUBE_BENCH_N, SCALECUBE_BENCH_ROUNDS,
 SCALECUBE_BENCH_DELIVERY, SCALECUBE_BENCH_SKIP_CANARY,
 SCALECUBE_BENCH_COMPACT (=1: the capacity-oriented compact carry layout,
@@ -1530,6 +1544,189 @@ def run_lifeguard_bench():
     print(json.dumps(result), flush=True)
 
 
+def run_churn_bench():
+    """The --churn mode: the open-world membership plane's headline
+    robustness claim, measured A/B (never asserted) — one JSON line out
+    (never-ship-empty).
+
+    Workload: the seeded ``chaos.churn_growth_scenario`` NET-POSITIVE
+    arrival storm — permanent crash waves recycled by mid-run JOINs
+    (plus a pre-dead arrivals pool, so the cluster GROWS), with each
+    join landing mid-suspicion of the previous occupant and the
+    occupants dying at incarnation >= 1 (the pre-death scare) — the
+    adversarial slot-recycling window.  Each scenario seed runs the
+    monitored scan TWICE on the same key:
+
+      - the PLANE (``open_world=True`` with the identity-epoch guard):
+        the committed claim is ZERO NO_RESURRECTION and ZERO
+        JOIN_COMPLETENESS violations, with every join globally known
+        within the dissemination bound — ``join_propagation_p99``
+        (rounds from the join to each observer's JOINED admission,
+        from the traced run's event stream) gated absolutely against
+        the scenario's join deadline offset;
+      - the NAIVE-reuse control (``epoch_guard=False`` — the
+        reference's epoch-blind wire): the monitor's incarnation
+        forensics count the resurrection failures
+        (NO_RESURRECTION > 0 required — the control arm must
+        DEMONSTRATE the hazard the guard kills) and the
+        identity-confusion refutation burn rides along.
+
+    Writes an ``artifacts/churn_growth.json``-style artifact (smoke
+    runs get ``churn_growth_smoke.json`` — provenance, the sync-heal
+    convention) and runs the regress gate in-bench.  ``--churn
+    --smoke`` is the tier-1-safe single-scenario pass pinned by
+    tests/test_bench_churn_smoke.py.  Env overrides: SCALECUBE_CHURN_N,
+    SCALECUBE_CHURN_SEED, SCALECUBE_CHURN_SCENARIOS,
+    SCALECUBE_CHURN_SUPPRESS (dead_suppress_rounds on both arms),
+    SCALECUBE_CHURN_ARTIFACT.
+
+    ``value`` stays None by design: the headline is a pair of absolute
+    zero/non-zero violation gates plus a latency SLO, none of which
+    belong in the higher-is-better throughput walk.
+    """
+    result = {
+        "metric": "churn_growth",
+        "value": None,
+        "unit": "violations/rounds",
+        "smoke": SMOKE,
+    }
+    artifact = (os.environ.get("SCALECUBE_CHURN_ARTIFACT")
+                or os.path.join("artifacts",
+                                "churn_growth_smoke.json" if SMOKE
+                                else "churn_growth.json"))
+    try:
+        jax, platform = init_backend()
+        result["platform"] = platform
+
+        import dataclasses
+
+        import numpy as np
+
+        from scalecube_cluster_tpu.chaos import monitor as cmonitor
+        from scalecube_cluster_tpu.chaos import scenarios as cscenarios
+        from scalecube_cluster_tpu.chaos.campaign import campaign_params
+        from scalecube_cluster_tpu.models import swim
+        from scalecube_cluster_tpu.telemetry import trace as ttrace
+        from scalecube_cluster_tpu.telemetry.events import TraceEventType
+
+        n = int(os.environ.get("SCALECUBE_CHURN_N", 24 if SMOKE else 48))
+        seed = int(os.environ.get("SCALECUBE_CHURN_SEED", 3))
+        n_scen = int(os.environ.get("SCALECUBE_CHURN_SCENARIOS",
+                                    1 if SMOKE else 3))
+        suppress = int(os.environ.get("SCALECUBE_CHURN_SUPPRESS", 0))
+
+        guard_counts = {"NO_RESURRECTION": 0, "JOIN_COMPLETENESS": 0}
+        naive_counts = {"NO_RESURRECTION": 0, "JOIN_COMPLETENESS": 0}
+        guard_green = True
+        latencies = []
+        refutes = {"guard": 0, "naive": 0}
+        joins_total = 0
+        growth_total = 0
+        bound = None
+        scenario_rows = []
+        for s_i in range(n_scen):
+            scen = cscenarios.churn_growth_scenario(seed + s_i, n)
+            p_guard = campaign_params(
+                scen, delivery="shift", dead_suppress_rounds=suppress)
+            p_naive = dataclasses.replace(p_guard, epoch_guard=False)
+            world, spec = scen.build(p_guard)
+            join_at = np.asarray(world.join_at)
+            known_by = np.asarray(spec.join_known_by)
+            joined = join_at < np.iinfo(np.int32).max
+            joins_total += int(joined.sum())
+            bound = int((known_by[joined] - join_at[joined]).max())
+            growth_total += int(
+                np.asarray(world.alive_at(scen.horizon - 1)).sum()
+                - np.asarray(world.alive_at(0)).sum())
+            row = {"scenario": scen.name, "horizon": scen.horizon,
+                   "repro": f"chaos.churn_growth_scenario("
+                            f"seed={seed + s_i}, n={n})"}
+            for arm, p in (("guard", p_guard), ("naive", p_naive)):
+                t0 = time.time()
+                w_arm, spec_arm = scen.build(p)
+                _, mon, metrics = cmonitor.run_monitored(
+                    jax.random.key(seed + s_i), p, w_arm, spec_arm,
+                    scen.horizon)
+                v = cmonitor.verdict(mon)
+                counts = {c: v["codes"][c]["violations"]
+                          for c in ("NO_RESURRECTION",
+                                    "JOIN_COMPLETENESS")}
+                target = guard_counts if arm == "guard" else naive_counts
+                for c, x in counts.items():
+                    target[c] += x
+                if arm == "guard":
+                    guard_green = guard_green and v["green"]
+                refutes[arm] += int(
+                    np.asarray(metrics["refutations"]).sum())
+                row[f"violations_{arm}"] = {
+                    c: d["violations"]
+                    for c, d in v["codes"].items() if d["violations"]}
+                log(f"churn {scen.name} arm={arm}: "
+                    f"green={v['green']} join_codes={counts} "
+                    f"({time.time() - t0:.1f}s)")
+            # Join-propagation latency from the GUARD arm's traced run
+            # (same key: bit-identical protocol trajectory — the trace
+            # plane only observes).
+            _, tel, _ = swim.run_traced(
+                jax.random.key(seed + s_i), p_guard, world, scen.horizon)
+            ev = [e for e in ttrace.decode_events(tel)
+                  if e.event_type == TraceEventType.JOINED]
+            lat = [int(e.round - join_at[e.subject]) for e in ev]
+            latencies.extend(lat)
+            row["joined_events"] = len(ev)
+            scenario_rows.append(row)
+
+        p99 = (float(np.percentile(latencies, 99)) if latencies
+               else None)
+        log(f"churn headline: guard {guard_counts} (green={guard_green})"
+            f" naive {naive_counts} join_p99={p99} bound={bound} "
+            f"refutes={refutes}")
+        result.update(
+            no_resurrection_violations=guard_counts["NO_RESURRECTION"],
+            join_completeness_violations=guard_counts[
+                "JOIN_COMPLETENESS"],
+            guard_green=guard_green,
+            naive_no_resurrection_violations=naive_counts[
+                "NO_RESURRECTION"],
+            naive_join_completeness_violations=naive_counts[
+                "JOIN_COMPLETENESS"],
+            join_propagation_p99_rounds=p99,
+            join_propagation_bound_rounds=bound,
+            joined_events=len(latencies),
+            joins_admitted=joins_total,
+            net_growth_members=growth_total,
+            refutations_guard=refutes["guard"],
+            refutations_naive=refutes["naive"],
+            n_members=n,
+            seed=seed,
+            n_scenarios=n_scen,
+            dead_suppress_rounds=suppress,
+            delivery="shift",
+            scenarios=scenario_rows,
+            value_note=("value stays null by design: the headline is "
+                        "absolute violation/latency gates, not a "
+                        "throughput — regress gates the dedicated "
+                        "churn checks instead"),
+        )
+
+        art = dict(result)
+        os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        result["artifact"] = artifact
+        log(f"churn artifact written to {artifact}")
+
+        apply_regress_gate(
+            result, ["BENCH_*.json", "MULTICHIP_*.json",
+                     os.path.join("artifacts", "churn_growth*.json"),
+                     artifact])
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -1573,6 +1770,15 @@ def main():
              "gossip-only control, monitored chaos-scale arm) into an "
              "artifacts/sync_heal.json-style artifact; combine with "
              "--smoke for the tier-1-safe pass",
+    )
+    parser.add_argument(
+        "--churn", action="store_true",
+        help="run the open-world membership A/B instead (seeded "
+             "net-positive arrival storm: epoch guard vs naive slot "
+             "reuse, NO_RESURRECTION/JOIN_COMPLETENESS verdicts + "
+             "join-propagation P99) into an artifacts/churn_growth"
+             ".json-style artifact; combine with --smoke for the "
+             "tier-1-safe single-scenario pass",
     )
     parser.add_argument(
         "--lifeguard", action="store_true",
@@ -1643,6 +1849,13 @@ def main():
             parser.error(
                 "--lifeguard measures the health-plane A/B on its own "
                 "workload — drop the other mode flags")
+        if args.churn and (args.chaos or args.resilience or args.metrics
+                           or args.multichip or args.sync
+                           or args.lifeguard or args.traced
+                           or args.untraced or args.gap_artifact):
+            parser.error(
+                "--churn measures the open-world membership A/B on its "
+                "own workload — drop the other mode flags")
     except SystemExit as e:
         # The one-JSON-line contract holds even for a bad argv: argparse
         # already printed its usage message to stderr; ship the error
@@ -1669,6 +1882,8 @@ def main():
         return run_sync_bench()
     if args.lifeguard:
         return run_lifeguard_bench()
+    if args.churn:
+        return run_churn_bench()
 
     result = {
         "metric": "swim_member_rounds_per_sec_per_chip",

@@ -58,10 +58,14 @@ def campaign_params(scenario: "cscenarios.Scenario",
                     **overrides) -> "swim.SwimParams":
     """SwimParams for one scenario: full view (every member a tracked
     subject — chaos verdicts are about the whole membership matrix),
-    the scenario's background wire loss baked in (explicit overrides
-    win)."""
+    the scenario's background wire loss baked in, and the open-world
+    plane enabled automatically when the scenario schedules JOINs
+    (without it the joins would degrade to same-identity revivals —
+    Scenario.has_joins).  Explicit overrides win."""
     kwargs = dict(loss_probability=scenario.loss_probability,
                   delivery=delivery)
+    if scenario.has_joins:
+        kwargs["open_world"] = True
     kwargs.update(overrides)
     return swim.SwimParams.from_config(
         campaign_config(), n_members=scenario.n_members, **kwargs)
@@ -342,6 +346,159 @@ def cross_validate(scenario: "cscenarios.Scenario", seed: int = 0,
         "agree": agree,
         "observers": len(observers),
         "victims": {str(k): d for k, d in per_victim.items()},
+    }
+
+
+def _churn_join_schedule(scenario: "cscenarios.Scenario"):
+    """(crashes [(node, at)], joins [(slot, at)]) when every op is a
+    PERMANENT crash schedule or an arrival storm (ChurnStorm with
+    joins, the churn_growth_scenario shape) or an explicit Join, on a
+    lossless network; None otherwise.  The oracle replay below models
+    crashes as permanent blockades and joins as brand-new Cluster.join
+    members, so revives and network ops are out of scope."""
+    if scenario.loss_probability:
+        return None
+    crashes, joins = [], []
+    for op in scenario.ops:
+        if isinstance(op, cscenarios.Crash):
+            if op.until_round < INT32_MAX:
+                return None
+            crashes.append((op.node, op.at_round))
+        elif isinstance(op, cscenarios.CrashBurst):
+            if op.until_round < INT32_MAX:
+                return None
+            crashes.extend((v, op.at_round) for v in op.nodes)
+        elif isinstance(op, cscenarios.Join):
+            joins.append((op.slot, op.at_round))
+        elif isinstance(op, cscenarios.ChurnStorm):
+            if op.down_rounds:
+                return None
+            for w in range(op.n_waves):
+                at = op.start_round + w * op.wave_every
+                crashes.extend(
+                    (v, at)
+                    for v in op.nodes[w * op.wave_size:
+                                      (w + 1) * op.wave_size])
+            crashes.extend((v, 0) for v in op.arrivals)
+            if op.join_wave_size:
+                joins.extend(op._join_schedule())
+        else:
+            return None
+    if not joins:
+        return None
+    return crashes, joins
+
+
+def cross_validate_churn(scenario: "cscenarios.Scenario", seed: int = 0,
+                         delivery: str = "shift",
+                         round_ms: int = 100) -> Optional[dict]:
+    """Replay a net-positive churn storm — permanent crashes plus
+    MID-RUN JOINS into the recycled slots — on the event-driven oracle
+    and diff the timing-free per-slot event key sets against the
+    model's on-device trace.
+
+    Oracle side: a crash is the permanent full link blockade (the
+    cross_validate convention); a JOIN is a genuine mid-run
+    ``Cluster.join`` of a BRAND-NEW member (fresh random identity —
+    aliased ``j<slot>`` so both identities of a slot map to the same
+    integer index) seeded at a stable member, exactly the reference's
+    arrival path.  Model side: the same scenario through the open-world
+    plane (``campaign_params`` auto-enables it), where the slot's
+    identity-epoch lane admits the new member; the model's JOINED
+    events are the oracle's ADDED events for the new identity, so the
+    diff NORMALIZES JOINED -> ADDED before comparing (the slot-level
+    trace cannot carry the oracle's random member ids; the epoch lane
+    is its identity axis — telemetry/events.TraceEventType docstring).
+
+    Per crashed slot the SUSPECTED/REMOVED key sets must match; per
+    joined slot the post-join ADDED key set must match (the new
+    identity at incarnation 0, learned by every continuously-live
+    observer).  Observers are restricted to members that never crash
+    or join.  Returns the diff digest, or None when the scenario isn't
+    expressible.
+    """
+    import jax
+
+    from scalecube_cluster_tpu.oracle import Cluster
+    from scalecube_cluster_tpu.telemetry import trace as ttrace
+    from scalecube_cluster_tpu.telemetry.events import (
+        TraceEventType, event_key_set,
+    )
+
+    sched = _churn_join_schedule(scenario)
+    if sched is None:
+        return None
+    crashes, joins = sched
+    n, horizon = scenario.n_members, scenario.horizon
+    cfg = campaign_config()
+
+    downers = {v for v, _ in crashes}
+    joiners = {s for s, _ in joins}
+    observers = [i for i in range(n) if i not in downers | joiners]
+    stable_seed = observers[0]
+
+    # --- oracle side --------------------------------------------------
+    # (_oracle_cluster's index_of strips the one-char alias prefix, so
+    # the joined "j<slot>" identities map to the same slot index as the
+    # original "m<slot>" members.)
+    sim, clusters, collector = _oracle_cluster(seed, n, cfg, round_ms)
+
+    def block(victim):
+        rest = [c for c in clusters if c is not clusters[victim]]
+        clusters[victim].network_emulator.block(
+            [c.address for c in rest])
+        for c in rest:
+            c.network_emulator.block(clusters[victim].address)
+
+    for r in range(horizon):
+        for v, at in crashes:
+            if r == at:
+                block(v)
+        for s, at in joins:
+            if r == at:
+                newcomer = Cluster.join(
+                    sim, seeds=[clusters[stable_seed].address],
+                    config=cfg, alias=f"j{s}")
+                collector.watch(newcomer, observer_index=s)
+                clusters[s] = newcomer
+        sim.run_for(round_ms)
+
+    # --- model side (open-world plane ON via campaign_params) ---------
+    params = campaign_params(scenario, delivery=delivery)
+    world, _ = scenario.build(params)
+    _, tel, _ = swim.run_traced(jax.random.key(seed), params, world,
+                                horizon)
+    model_events = [
+        (dataclasses.replace(e, event_type=TraceEventType.ADDED)
+         if e.event_type == TraceEventType.JOINED else e)
+        for e in ttrace.decode_events(tel)
+    ]
+
+    per_slot = {}
+    agree = True
+    for v, at in crashes:
+        types = [TraceEventType.SUSPECTED, TraceEventType.REMOVED]
+        kw = dict(types=types, subjects=[v], observers=observers,
+                  min_round=at)
+        mk = event_key_set(model_events, **kw)
+        ok = event_key_set(collector.events, **kw)
+        per_slot[f"crash:{v}"] = {"only_model": sorted(mk - ok),
+                                  "only_oracle": sorted(ok - mk)}
+        agree &= mk == ok
+    for s, at in joins:
+        kw = dict(types=[TraceEventType.ADDED], subjects=[s],
+                  observers=observers, min_round=at)
+        mk = event_key_set(model_events, **kw)
+        ok = event_key_set(collector.events, **kw)
+        per_slot[f"join:{s}"] = {"only_model": sorted(mk - ok),
+                                 "only_oracle": sorted(ok - mk)}
+        agree &= mk == ok
+    return {
+        "agree": agree,
+        "observers": len(observers),
+        "crashes": len(crashes),
+        "joins": len(joins),
+        "slots": per_slot,
     }
 
 

@@ -52,6 +52,26 @@ Invariant codes (:class:`InvariantCode`; lane values are stable):
                     (models/sync.py).  Only promised when the plane is
                     on and the scenario's faults quiesce before the
                     heal (chaos/scenarios.Scenario.build).
+  NO_RESURRECTION   past a recycled slot's join-propagation deadline
+                    (``MonitorSpec.join_known_by``), a live observer
+                    still holds an ALIVE/SUSPECT record attributed to
+                    a DEAD identity epoch (the carry's ``epoch`` lane
+                    < the slot's ground-truth epoch,
+                    ``SwimWorld.epoch_at``) — a dead epoch's record
+                    living in a table, the naive-slot-reuse
+                    resurrection hazard the open-world epoch guard
+                    exists to kill (models/swim.SwimParams.open_world;
+                    the instrumented naive arm keeps the lane so this
+                    code can COUNT its failures).
+  JOIN_COMPLETENESS past the same deadline, an eligible observer
+                    (continuously alive since the join) does NOT hold
+                    the joined member ALIVE/SUSPECT at its true epoch
+                    while the member is ground-truth alive: a joined
+                    member must become globally known within the
+                    dissemination bound (the ADDED-completeness dual
+                    of COMPLETENESS; in the naive arm the old
+                    occupant's tombstone killing the new member's
+                    records lands here).
 
 Evidence policy: per code, the LANES record the violating cells of the
 first round that code trips (with overflow counted in ``dropped``);
@@ -98,6 +118,8 @@ class InvariantCode(enum.IntEnum):
     WIRE_SATURATION = 3
     COMPLETENESS = 4
     POST_HEAL_DIVERGENCE = 5
+    NO_RESURRECTION = 6
+    JOIN_COMPLETENESS = 7
 
 
 N_CODES = len(InvariantCode)
@@ -196,6 +218,16 @@ class MonitorSpec:
     static (treedef) flag: True only when the scenario's network is
     pristine, where any new suspicion of a live subject is a safety
     violation.
+
+    ``join_known_by`` [K] int32: per-subject JOIN-propagation deadline
+    (INT32_MAX = unchecked) — past it the open-world codes
+    (NO_RESURRECTION / JOIN_COMPLETENESS) enforce that the joined
+    identity is globally known and no dead epoch's record survives as
+    live; scenarios derive it from the join schedule
+    (``Scenario.build``: join round + completeness bound).
+    ``check_joins`` is its static (treedef) twin, the
+    ``check_agreement`` pattern — False compiles both [N, K] join
+    reductions out entirely.
     """
 
     complete_by: jnp.ndarray
@@ -203,6 +235,9 @@ class MonitorSpec:
         default_factory=lambda: jnp.int32(INT32_MAX))
     check_agreement: bool = False
     check_false_suspicion: bool = False
+    join_known_by: jnp.ndarray = dataclasses.field(
+        default_factory=lambda: jnp.int32(INT32_MAX))
+    check_joins: bool = False
 
     @staticmethod
     def passive(params: "swim.SwimParams") -> "MonitorSpec":
@@ -217,8 +252,9 @@ class MonitorSpec:
 
 jax.tree_util.register_dataclass(
     MonitorSpec,
-    data_fields=["complete_by", "agree_from"],
-    meta_fields=["check_agreement", "check_false_suspicion"],
+    data_fields=["complete_by", "agree_from", "join_known_by"],
+    meta_fields=["check_agreement", "check_false_suspicion",
+                 "check_joins"],
 )
 
 
@@ -270,11 +306,36 @@ def check_round(mon: MonitorState, spec: MonitorSpec,
 
     zero = jnp.zeros((n, k), dtype=jnp.bool_)
 
+    # Open-world identity lane (zero-size when the plane is off — the
+    # guard arm carries it, the naive control arm does not): the wide
+    # epoch matrices and the slots' ground-truth epochs.  Separately,
+    # the rows whose JOIN fires this round (a join schedule exists with
+    # or without the lane): their reset legitimately rewinds
+    # incarnations/epochs — exempt from the monotonicity checks, like
+    # every other world-scheduled rebirth.
+    has_epoch = new.epoch.size > 0
+    if has_epoch:
+        ne_ep = new.epoch.astype(jnp.int32)
+        pe_ep = prev.epoch.astype(jnp.int32)
+        true_ep = world.epoch_at(round_idx)[subject_ids][None, :]
+    if world.join_at is not None:
+        joining_row = (world.join_at[node_ids] == round_idx)[:, None]
+        joining_vec = world.join_at[node_ids] == round_idx
+    else:
+        joining_row = zero
+        joining_vec = jnp.zeros((n,), dtype=jnp.bool_)
+
     # FALSE_SUSPICION — new SUSPECT onset about a live subject on a
     # pristine network (static flag: folds to the zero mask otherwise).
+    # With the identity lane present, only suspicions OF THE CURRENT
+    # identity count: a maturing suspicion of the slot's PREVIOUS (dead)
+    # occupant is not false merely because a new member now occupies
+    # the slot — the stale-identity codes below own that hazard.
     if spec.check_false_suspicion:
         v_fs = (obs_alive & subj_alive & ~is_self
                 & (ns == records.SUSPECT) & (ps != records.SUSPECT))
+        if has_epoch:
+            v_fs = v_fs & (ne_ep == true_ep)
     else:
         v_fs = zero
 
@@ -283,8 +344,19 @@ def check_round(mon: MonitorState, spec: MonitorSpec,
     # case 3), an ABSENT cell has no prior, and a stored DEAD tombstone
     # gates like ABSENT (records.py storage convention) so the
     # delete-then-re-add path may re-accept ALIVE at any incarnation.
+    # A cell whose identity EPOCH changed is a different member's
+    # record — incarnations restart at 0 across identities — and a
+    # joining observer's whole row is reborn: both exempt.  The NAIVE
+    # arm (joins without the lane) additionally rewinds cells when a
+    # new identity's inc-0 records overwrite the ghost's — exempt the
+    # joined columns there; the join codes own that chaos.
     v_inc = (((ps == records.ALIVE) | (ps == records.SUSPECT))
-             & (ns != records.DEAD) & (ni < pi))
+             & (ns != records.DEAD) & (ni < pi)) & ~joining_row
+    if has_epoch:
+        v_inc = v_inc & (ne_ep == pe_ep)
+    elif world.join_at is not None:
+        v_inc = v_inc & ~(
+            world.join_at[subject_ids] < INT32_MAX)[None, :]
 
     # TIMER_BOUND — live observers' suspicion-timer contract.  With the
     # Lifeguard plane on the deadline an observer may arm stretches to
@@ -341,17 +413,76 @@ def check_round(mon: MonitorState, spec: MonitorSpec,
     else:
         v_div = zero
 
-    vio = jnp.stack([v_fs, v_inc, v_timer, v_sat, v_comp, v_div])
+    # NO_RESURRECTION / JOIN_COMPLETENESS — the open-world join codes
+    # (module docstring).  Static ``check_joins`` folds both reductions
+    # to the zero mask.
+    #
+    # NO_RESURRECTION has two detectors, both exactly zero in any
+    # single-identity world:
+    #   - incarnation forensics (attribution-free — the NAIVE arm's
+    #     epoch-blind wire is precisely what it convicts): a live
+    #     ALIVE/SUSPECT record carrying an incarnation ABOVE the
+    #     subject's own current ``self_inc`` cannot describe the
+    #     current occupant (records only ever carry the member's own
+    #     announcements, which are <= self_inc and monotone within an
+    #     identity) — it is a dead identity's record living in the
+    #     table, counted from the instant the new identity exists.
+    #     With the epoch lane present it applies to cells CLAIMING the
+    #     current epoch (a guarded run's stale-epoch cells legitimately
+    #     hold the old identity's numbers until the join disseminates);
+    #     without the lane every live record claims the current
+    #     occupant — naive reuse's sin — so it applies everywhere.
+    #   - stale-epoch persistence (lane required): past the
+    #     join-propagation deadline, a live observer still holds an
+    #     ALIVE/SUSPECT record attributed to a dead epoch.
+    #
+    # JOIN_COMPLETENESS: past the deadline, an eligible observer
+    # (continuously alive since the join — the COMPLETENESS
+    # eligibility rule, which also excludes later joiners relearning
+    # on their own clock) must hold the ground-truth-alive joined
+    # member live — at its true epoch when the lane can say so.
+    if spec.check_joins:
+        live_rec = (ns == records.ALIVE) | (ns == records.SUSPECT)
+        join_due = spec.join_known_by[None, :] <= round_idx
+        joined_col = (world.join_at[subject_ids] < INT32_MAX)[None, :]
+        subj_self_inc = new.self_inc[subject_ids][None, :]
+        ghost_inc = (obs_alive & ~is_self & joined_col
+                     & live_rec & (ni > subj_self_inc))
+        if has_epoch:
+            v_res = (ghost_inc & (ne_ep == true_ep)) | (
+                join_due & obs_alive & ~is_self
+                & live_rec & (ne_ep < true_ep)
+            )
+        else:
+            v_res = ghost_inc
+        disturbed_j = (
+            (world.down_from[:, None] <= round_idx)
+            & (world.down_until[:, None]
+               > world.join_at[subject_ids][None, :])
+        )
+        known = live_rec & (ne_ep == true_ep) if has_epoch else live_rec
+        v_jc = (join_due & joined_col & subj_alive & obs_alive
+                & ~disturbed_j & ~is_self & ~known)
+    else:
+        v_res = zero
+        v_jc = zero
+
+    vio = jnp.stack([v_fs, v_inc, v_timer, v_sat, v_comp, v_div,
+                     v_res, v_jc])
+    ep_detail = ne_ep if has_epoch else ns.astype(jnp.int32)
     details = jnp.stack([ni, ni, jnp.where(has_timer, dl, -1), ni,
-                         ns.astype(jnp.int32), ns.astype(jnp.int32)])
+                         ns.astype(jnp.int32), ns.astype(jnp.int32),
+                         ep_detail, ns.astype(jnp.int32)])
     cell_code_of = jnp.asarray([
         InvariantCode.FALSE_SUSPICION, InvariantCode.INC_REGRESSION,
         InvariantCode.TIMER_BOUND, InvariantCode.WIRE_SATURATION,
         InvariantCode.COMPLETENESS, InvariantCode.POST_HEAL_DIVERGENCE,
+        InvariantCode.NO_RESURRECTION, InvariantCode.JOIN_COMPLETENESS,
     ], dtype=jnp.int32)
 
     # Self-incarnation lanes (subject == observer): regression + cap.
-    v_self_inc = new.self_inc < prev.self_inc            # [N]
+    # A joining node is REBORN at incarnation 0 — exempt.
+    v_self_inc = (new.self_inc < prev.self_inc) & ~joining_vec    # [N]
     v_self_sat = new.self_inc > sat
 
     totals = jnp.sum(vio, axis=(1, 2), dtype=jnp.int32)

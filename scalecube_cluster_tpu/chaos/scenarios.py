@@ -196,35 +196,115 @@ class ChurnStorm:
     ``nodes[w*wave_size:(w+1)*wave_size]`` at
     ``start_round + w*wave_every``, each down for ``down_rounds``
     (0 = permanent).  Node slices are disjoint by construction, so
-    waves never clobber each other's windows."""
+    waves never clobber each other's windows.
+
+    Arrival waves (the open-world extension — SwimParams.open_world
+    must be on for the joins to execute): ``join_wave_size > 0`` makes
+    each wave ALSO admit that many NEW members (fresh identities) into
+    recycled DEAD slots, ``join_lag`` rounds after the wave's crashes.
+    Join targets drain a FIFO of free slots: the ``arrivals`` pool
+    (slots crashed at round 0 — the pre-dead free capacity that makes
+    NET-POSITIVE growth possible: joins - permanent crashes =
+    n_waves*join_wave_size - len(nodes)) first, then each wave's own
+    crashed slots once they are eligible (dead strictly before the
+    join round).  Construction raises if a wave cannot fill its join
+    quota — a storm that silently joined fewer members than declared
+    would corrupt the growth arithmetic (scenarios stay exact, pure in
+    their fields).  ``join_wave_size > 0`` requires permanent crashes
+    (``down_rounds == 0``): a revive schedule and a join cannot share
+    a slot."""
 
     nodes: Tuple[int, ...]
     wave_size: int
     start_round: int
     wave_every: int
     down_rounds: int = 0
+    join_wave_size: int = 0
+    join_lag: int = 0
+    arrivals: Tuple[int, ...] = ()
 
     def __post_init__(self):
         if self.wave_size < 1 or len(self.nodes) % self.wave_size:
             raise ValueError(
                 f"wave_size {self.wave_size} must divide the pool size "
                 f"{len(self.nodes)}")
+        if self.join_wave_size:
+            if self.down_rounds:
+                raise ValueError(
+                    "ChurnStorm arrival waves need permanent crashes "
+                    f"(down_rounds=0; got {self.down_rounds}) — a revive "
+                    "schedule and a join cannot share a slot")
+            if set(self.arrivals) & set(self.nodes):
+                raise ValueError(
+                    f"arrivals pool overlaps the crash pool: "
+                    f"{sorted(set(self.arrivals) & set(self.nodes))}")
+            self._join_schedule()        # validates quota feasibility
 
     @property
     def n_waves(self) -> int:
         return len(self.nodes) // self.wave_size
 
+    def _join_schedule(self):
+        """[(slot, join_round)] for every arrival, FIFO over free slots
+        (class docstring); raises when a wave's quota cannot be met."""
+        free = [(s, 0) for s in self.arrivals]       # (slot, death round)
+        out = []
+        for w in range(self.n_waves):
+            at = self.start_round + w * self.wave_every
+            join_at = at + self.join_lag
+            free.extend(
+                (s, at)
+                for s in self.nodes[w * self.wave_size:
+                                    (w + 1) * self.wave_size])
+            taken = 0
+            while taken < self.join_wave_size and free:
+                slot, died = free[0]
+                if died >= join_at:      # not yet dead at the join round
+                    break
+                free.pop(0)
+                out.append((slot, join_at))
+                taken += 1
+            if taken < self.join_wave_size:
+                raise ValueError(
+                    f"ChurnStorm wave {w} can only fill {taken} of "
+                    f"{self.join_wave_size} join slots at round "
+                    f"{join_at} — grow the arrivals pool or the "
+                    f"join_lag (free-slot FIFO exhausted)")
+        return out
+
     def apply(self, world, n, horizon):
+        if self.arrivals:
+            world = world.with_crash(list(self.arrivals), 0)
         for w in range(self.n_waves):
             at = self.start_round + w * self.wave_every
             until = at + self.down_rounds if self.down_rounds else INT32_MAX
             world = world.with_crash(
                 list(self.nodes[w * self.wave_size:(w + 1) * self.wave_size]),
                 at, until)
+        if self.join_wave_size:
+            for slot, join_at in self._join_schedule():
+                world = world.with_join(slot, join_at)
         return world
 
     def disruption(self, n, horizon):
         return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Join:
+    """Admit a NEW member into recycled DEAD ``slot`` at ``at_round``
+    (``SwimWorld.with_join`` — the slot must be scheduled dead first;
+    op order matters, like every schedule-override op).  Requires
+    ``SwimParams.open_world`` to execute as an identity join."""
+
+    slot: int
+    at_round: int
+
+    def apply(self, world, n, horizon):
+        return world.with_join(self.slot, self.at_round)
+
+    def disruption(self, n, horizon):
+        return None                      # process-level, not network
 
 
 @dataclasses.dataclass(frozen=True)
@@ -448,6 +528,18 @@ class Scenario:
                     f"n={self.n_members}, severity={self.severity!r})")
         return f"<hand-built scenario {self.name!r}>"
 
+    @property
+    def has_joins(self) -> bool:
+        """True when any op schedules an open-world JOIN — the runner
+        must enable ``SwimParams.open_world`` or the joins degrade to
+        same-identity revivals (chaos/campaign.campaign_params does
+        this automatically)."""
+        return any(
+            isinstance(op, Join)
+            or (isinstance(op, ChurnStorm) and op.join_wave_size > 0)
+            for op in self.ops
+        )
+
     def build(self, params: "swim.SwimParams",
               rule_pad: int = _RULE_PAD):
         """Compile to ``(SwimWorld, MonitorSpec)`` for ``params``."""
@@ -492,6 +584,24 @@ class Scenario:
         tracked = slot >= 0
         complete_by[slot[tracked]] = deadline[tracked]
 
+        # JOIN-propagation deadlines (NO_RESURRECTION /
+        # JOIN_COMPLETENESS): a joined identity must be globally known
+        # — and no dead epoch's record survive as live — within the
+        # same generous completeness bound, measured from the join (or
+        # the end of the last network disruption).  No promise under a
+        # permanent disruption, the COMPLETENESS rule.
+        ja = np.asarray(world.join_at, dtype=np.int64)
+        join_known_by = np.full(params.n_subjects, INT32_MAX,
+                                dtype=np.int64)
+        joins_checkable = (ja < INT32_MAX) & (not permanent_disruption)
+        j_deadline = np.where(
+            joins_checkable,
+            np.minimum(np.maximum(ja, disruption_end) + bound, INT32_MAX),
+            INT32_MAX,
+        )
+        join_known_by[slot[tracked]] = j_deadline[tracked]
+        check_joins = bool(params.open_world and joins_checkable.any())
+
         # Post-heal agreement promise (POST_HEAL_DIVERGENCE): made only
         # when the SYNC anti-entropy plane is ON, the background network
         # is pristine, and every fault quiesces before its heal — the
@@ -532,6 +642,8 @@ class Scenario:
             agree_from=jnp.int32(agree_from),
             check_agreement=agree_from < INT32_MAX,
             check_false_suspicion=pristine,
+            join_known_by=jnp.asarray(join_known_by.astype(np.int32)),
+            check_joins=check_joins,
         )
         return world, spec
 
@@ -549,7 +661,14 @@ class Scenario:
                     or op.until_round - op.at_round >= qb)
         if isinstance(op, Leave):
             return True                  # announces its own death
+        if isinstance(op, Join):
+            return False                 # identity rebirth: join codes own it
         if isinstance(op, ChurnStorm):
+            if op.join_wave_size:
+                # Arrival storms rebirth slots mid-run; the live-consensus
+                # agreement clock has no settled meaning across identity
+                # epochs — the join codes own that contract instead.
+                return False
             return op.down_rounds == 0 or op.down_rounds >= qb
         if isinstance(op, RollingPartition):
             return op.phase_rounds >= qb
@@ -619,6 +738,99 @@ def asymmetric_degradation(seed: int, n: int = 32,
         max(ends) + completeness_bound(params, n) // 2 + 24)
     return Scenario(name=f"asym-deg-{seed}-n{n}", n_members=n,
                     horizon=horizon, ops=ops, seed=seed)
+
+
+def churn_growth_scenario(seed: int, n: int = 32, waves: int = 3,
+                          wave_size: int = 2, join_wave_size: int = 3,
+                          join_lag: Optional[int] = None,
+                          params: Optional["swim.SwimParams"] = None
+                          ) -> Scenario:
+    """The canonical NET-POSITIVE arrival storm — the ``bench.py
+    --churn`` A/B workload and the open-world monitor tests run this
+    one schedule, so the growth arithmetic cannot drift between them.
+    (The oracle mid-run-join cross-validation runs a separate QUIESCED
+    scare-free schedule instead — ``campaign.cross_validate_churn``
+    rejects network ops, and mid-suspicion joins make the two layers'
+    REMOVED key sets legitimately diverge, so this adversarial storm
+    is validated by the invariant monitor, not by oracle replay.)
+
+    ``waves`` crash waves of ``wave_size`` kill members permanently
+    while each wave admits ``join_wave_size`` NEW identities
+    (``join_wave_size > wave_size`` ⇒ net growth of
+    ``waves * (join_wave_size - wave_size)`` members, drawn from a
+    pre-dead arrivals pool of exactly that size — every free slot is
+    consumed and every crashed slot recycled).  ``join_lag`` defaults
+    to 10 rounds: joins land MID-SUSPICION of the previous occupant —
+    observers still hold its ALIVE/SUSPECT records and its tombstones
+    mature (hot) only after the new member is already in, the
+    adversarial recycling window where naive slot reuse demonstrably
+    shadows, kills and resurrects identities while the epoch guard
+    (plus its dead_suppress_rounds interplay) must hold.
+
+    Each wave victim additionally suffers a pre-death SCARE — a brief
+    inbound blockade that gets it falsely suspected, healed, and
+    self-refuted — so the occupants die at incarnation >= 1, the
+    operationally normal state of a long-lived member.  This is what
+    makes naive reuse's resurrection OBSERVABLE: the dead identity's
+    ALIVE@inc>=1 records outrank the new member's ALIVE@0 on an
+    epoch-blind wire (chaos/monitor.NO_RESURRECTION's incarnation
+    forensics), while the epoch guard drops them outright.
+
+    Pure in ``(seed, n)``: one-line repro
+    ``chaos.churn_growth_scenario(seed=S, n=N)``.
+    """
+    if n < 16:
+        raise ValueError(
+            f"churn_growth_scenario needs n >= 16 (got {n}) — the storm "
+            f"pools must stay a minority of the cluster")
+    if join_wave_size <= wave_size:
+        raise ValueError(
+            f"net-positive growth needs join_wave_size ({join_wave_size})"
+            f" > wave_size ({wave_size})")
+    if params is None:
+        from scalecube_cluster_tpu.chaos.campaign import campaign_config
+        params = swim.SwimParams.from_config(campaign_config(), n_members=n)
+    n_pool = waves * wave_size
+    n_arrivals = waves * (join_wave_size - wave_size)
+    if n_pool + n_arrivals > n - 2:
+        raise ValueError(
+            f"storm pools ({n_pool} crash + {n_arrivals} arrival slots) "
+            f"leave fewer than 2 stable members at n={n}")
+    if join_lag is None:
+        join_lag = 10
+    rng = np.random.default_rng(np.random.SeedSequence([seed, 0x10E6]))
+    pool = [int(x) for x in rng.permutation(n)]
+    nodes = tuple(pool[:n_pool])
+    arrivals = tuple(pool[n_pool:n_pool + n_arrivals])
+    wave_every = max(int(join_lag) + 2, 16)
+    # Scare geometry: blockade ends >= ~suspicion_rounds/2 before the
+    # crash so the refutation lands and goes cold pre-death, and starts
+    # late enough that the suspicion cannot mature DEAD mid-scare.
+    scare_len = 6
+    scare_gap = min(params.suspicion_rounds - scare_len - 2, 14)
+    scare_lead = scare_len + max(scare_gap, 6)
+    storm = ChurnStorm(
+        nodes=nodes, wave_size=wave_size,
+        start_round=scare_lead + int(rng.integers(4, 11)),
+        wave_every=wave_every,
+        join_wave_size=join_wave_size, join_lag=int(join_lag),
+        arrivals=arrivals,
+    )
+    scares = []
+    for w in range(storm.n_waves):
+        at = storm.start_round + w * wave_every
+        for v in nodes[w * wave_size:(w + 1) * wave_size]:
+            scares.append(LinkLoss(
+                src=(0, n), dst=v, loss=1.0,
+                from_round=at - scare_lead,
+                until_round=at - scare_lead + scare_len,
+            ))
+    last_join = (storm.start_round + (storm.n_waves - 1) * wave_every
+                 + storm.join_lag)
+    horizon = _quantize_horizon(
+        last_join + completeness_bound(params, n) + 24)
+    return Scenario(name=f"churn-growth-{seed}-n{n}", n_members=n,
+                    horizon=horizon, ops=(*scares, storm), seed=seed)
 
 
 # --------------------------------------------------------------------------
@@ -691,6 +903,21 @@ def generate_scenario(seed: int, n: int = 32, severity: str = "moderate",
                                 wave_every=int(rng.integers(6, 13)),
                                 down_rounds=0 if permanent else revive_down))
 
+    def op_churn_arrivals():
+        # Net-positive arrival storm: 2 waves kill 2 + 2 and admit
+        # 3 + 3 new identities (one-slot growth per wave from a
+        # pre-dead arrivals pool) — the open-world severity rung.
+        # Joins land as the previous occupants' tombstones mature
+        # (the adversarial recycling window, churn_growth_scenario).
+        nodes = tuple(take(4))
+        arrivals = tuple(take(2))
+        lag = int(params.suspicion_rounds) + int(rng.integers(4, 13))
+        add("churn_arrivals", ChurnStorm(
+            nodes, wave_size=2,
+            start_round=int(rng.integers(2, 7)),
+            wave_every=lag + int(rng.integers(2, 7)),
+            join_wave_size=3, join_lag=lag, arrivals=arrivals))
+
     def op_brownout():
         half = n // 2
         add("brownout", Brownout(
@@ -717,6 +944,15 @@ def generate_scenario(seed: int, n: int = 32, severity: str = "moderate",
         op_churn(permanent=bool(rng.integers(0, 2)))
         (op_brownout if rng.integers(0, 2) else op_flap)()
 
+    # Open-world rung (PR 10): moderate/severe tiers additionally emit
+    # a net-positive arrival storm for half the seeds.  The draw TRAILS
+    # every existing one, so the ops a pre-open-world seed generated are
+    # unchanged — the tier grows, it does not reshuffle (the campaign
+    # repro contract: generate_scenario stays pure in (seed, n,
+    # severity), and historical seeds keep their historical faults).
+    if severity != "mild" and n >= 24 and rng.integers(0, 2):
+        op_churn_arrivals()
+
     # Horizon: every fault/disruption resolved, plus the completeness
     # bound and a margin — quantized so campaigns share compilations.
     ends = [0]
@@ -730,7 +966,8 @@ def generate_scenario(seed: int, n: int = 32, severity: str = "moderate",
                 ends.append(int(v))
         if isinstance(op, ChurnStorm):
             ends.append(op.start_round
-                        + op.n_waves * op.wave_every + op.down_rounds)
+                        + op.n_waves * op.wave_every + op.down_rounds
+                        + op.join_lag)
     horizon = _quantize_horizon(max(ends) + bound + 24)
     name = f"{severity}-{seed}-" + "+".join(kinds)
     return Scenario(name=name, n_members=n, horizon=horizon,

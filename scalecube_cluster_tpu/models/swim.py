@@ -310,6 +310,39 @@ class SwimParams:
     # goes cold before the cell can reopen.  0 (the default) keeps the
     # reference's immediate-reopen behavior, bit-identical.
     dead_suppress_rounds: int = 0
+    # Open-world membership plane: JOIN admission into recycled DEAD
+    # slots mid-run (``SwimWorld.with_join``).  When on, every slot
+    # carries a per-record IDENTITY EPOCH lane (``SwimState.epoch``
+    # [N, K]; int16 under compact_carry, the lhm-lane pattern) and
+    # every wire key carries (slot, epoch, incarnation) — the epoch
+    # field sits directly under the dead bit (ops/delivery.py layout
+    # comment), so the inbox fold keeps the reference's DEAD-absorbs
+    # order while the merge gate resolves identities.  A join resets
+    # the slot's row (fresh cold table, self_inc 0, lhm 1) and bumps
+    # its ground-truth epoch (``SwimWorld.epoch_at``); the joiner
+    # announces itself hot and observers ADMIT the new identity through
+    # the epoch gate — the reference's Cluster.join / seed-sync arrival
+    # path (MembershipProtocolImpl.start0) for a recycled slot.  False
+    # (the default) compiles the plane out entirely: zero-size lane,
+    # the exact pre-open-world wire layout, every run shape
+    # bit-identical (tests/test_open_world.py).
+    open_world: bool = False
+    # Identity-epoch merge guard (meaningful only with ``open_world``):
+    # True (default) = the epoch lane + wire field exist and cross-epoch
+    # records DROP at the merge gate, with a new identity admitted only
+    # through its own ALIVE announcement (ops/delivery.merge_inbox
+    # docstring) — including through the SYNC anti-entropy exchange and
+    # OVER the dead_suppress_rounds window (a suppressed tombstone must
+    # not block a higher-epoch JOIN).  False = the NAIVE-reuse control
+    # arm (bench.py --churn): joins still recycle slots, but the wire
+    # and merge are the reference's EPOCH-BLIND legacy layout — the old
+    # occupant's hot tombstone kills the new member and its stale
+    # higher-incarnation ALIVE notices shadow/resurrect the dead
+    # identity, which the invariant monitor proves attribution-free by
+    # incarnation forensics (a live record with inc above the subject's
+    # own self_inc cannot be about the current occupant —
+    # chaos/monitor.NO_RESURRECTION / JOIN_COMPLETENESS).
+    epoch_guard: bool = True
 
     def __post_init__(self):
         if self.delivery not in ("scatter", "shift"):
@@ -404,6 +437,21 @@ class SwimParams:
         sentinel, alive-key bits, ring-slot dtype); carry-layout
         decisions gate on ``compact_carry`` alone."""
         return self.compact_carry or self.int16_wire
+
+    @property
+    def epoch_bits(self) -> int:
+        """Identity-epoch field width of the active wire key: 0 when the
+        open-world plane is off OR the epoch guard is disabled (the
+        exact legacy key layouts — the naive-reuse arm runs the
+        reference's epoch-blind wire, which is the point of the
+        control), else the fixed per-format width
+        (ops/delivery.EPOCH_BITS_*).  Gates every epoch decision — lane
+        allocation, pack/unpack, the merge gate — so one predicate
+        compiles the whole identity plane in or out."""
+        if not (self.open_world and self.epoch_guard):
+            return 0
+        return (delivery.EPOCH_BITS_COMPACT if self.compact_wire
+                else delivery.EPOCH_BITS_WIDE)
 
     @staticmethod
     def from_config(config, n_members: int, n_subjects: Optional[int] = None,
@@ -655,6 +703,12 @@ class SwimWorld:
         is injected at its origin node in round gossip_spread_at[g]
         (INT32_MAX = never) — the batched analog of
         Cluster.spreadGossip(msg) (GossipProtocolImpl.java:124-128).
+      - ``join_at`` [N] int32: slot i admits a NEW member (a fresh
+        identity at epoch 1, incarnation 0, cold table) at that round —
+        the open-world JOIN schedule (``SwimParams.open_world``;
+        INT32_MAX = never).  The slot must be scheduled dead strictly
+        before the join (``with_join`` validates); one join per slot
+        per run, so ``epoch_at`` is a single threshold per slot.
     """
 
     down_from: jnp.ndarray
@@ -668,6 +722,7 @@ class SwimWorld:
     slot_of_node: jnp.ndarray
     gossip_origin: jnp.ndarray
     gossip_spread_at: jnp.ndarray
+    join_at: jnp.ndarray = None
 
     @staticmethod
     def healthy(params: SwimParams,
@@ -693,6 +748,7 @@ class SwimWorld:
             slot_of_node=slot_of_node,
             gossip_origin=jnp.arange(g, dtype=jnp.int32) % max(n, 1),
             gossip_spread_at=jnp.full((g,), INT32_MAX, dtype=jnp.int32),
+            join_at=jnp.full((n,), INT32_MAX, dtype=jnp.int32),
         )
 
     def with_spread(self, gossip_idx: int, origin, at_round: int) -> "SwimWorld":
@@ -768,6 +824,92 @@ class SwimWorld:
             down_until=self.down_until.at[node].set(INT32_MAX),
         )
 
+    def with_join(self, slot, at_round: int):
+        """Admit a NEW member (fresh identity: epoch 1, incarnation 0,
+        cold table) into the recycled DEAD ``slot`` at ``at_round`` —
+        the open-world arrival schedule (``SwimParams.open_world``
+        executes it; a plane-off run treats the slot as an ordinary
+        revival of the OLD identity, which is exactly the naive-reuse
+        hazard, so schedule joins only on open-world runs).
+
+        Validation mirrors the ``with_crash``/``with_leave`` guards
+        (concrete ids only; traced values defer to runtime semantics):
+
+          - slot ids are range-checked like every other schedule;
+          - the slot must be scheduled DEAD strictly before the join:
+            joining a live slot would overwrite a living member's
+            identity, and a join at-or-before the scheduled death
+            (``at_round <= down_from`` / ``<= leave_at``) would admit
+            the new identity while the old one still runs — both raise
+            (tests/test_swim_world_validation.py pins the edges);
+          - the slot must still be down AT the join round: a crash
+            window that revives the old identity before ``at_round``
+            (``down_until <= at_round``) composes crash→revive→join,
+            i.e. two identities alive in sequence with no death between
+            the revival and the join — raise rather than guess.
+
+        One join per slot per run (a second ``with_join`` on the same
+        slot overwrites the first, like every other schedule write);
+        the slot's ground-truth epoch is therefore the single threshold
+        ``epoch_at`` evaluates.  Sets ``down_until = at_round`` — from
+        the join round on, the slot's occupant is the new identity.
+        """
+        import numpy as np
+
+        slot_ids = self._checked_node_ids(slot, "with_join")
+        at_round = int(at_round)
+        try:
+            concrete = np.asarray(slot_ids)
+            df = np.asarray(self.down_from)[concrete]
+            du = np.asarray(self.down_until)[concrete]
+            la = np.asarray(self.leave_at)[concrete]
+        except Exception:  # noqa: BLE001 — tracer: defer to runtime
+            pass
+        else:
+            fault = np.minimum(df, la)
+            live = fault >= INT32_MAX
+            if live.any():
+                raise ValueError(
+                    f"with_join: slot(s) "
+                    f"{concrete[live].tolist()} have no scheduled "
+                    f"death before round {at_round} — joining a LIVE "
+                    f"slot would overwrite a living member's identity; "
+                    f"schedule with_crash/with_leave first")
+            early = fault >= at_round
+            if (~live & early).any():
+                bad = concrete[~live & early]
+                raise ValueError(
+                    f"with_join: join at round {at_round} is not "
+                    f"strictly after slot(s) {bad.tolist()}'s scheduled "
+                    f"death (down_from/leave_at "
+                    f"{np.minimum(df, la)[~live & early].tolist()}) — "
+                    f"the old identity must die before the new one "
+                    f"joins")
+            revived = du <= at_round
+            if revived.any():
+                raise ValueError(
+                    f"with_join: slot(s) {concrete[revived].tolist()} "
+                    f"revive the OLD identity at "
+                    f"{du[revived].tolist()} before the join at "
+                    f"{at_round} — a revived member cannot be joined "
+                    f"over; crash it permanently (or until the join "
+                    f"round) first")
+        return dataclasses.replace(
+            self,
+            down_until=self.down_until.at[slot_ids].set(at_round),
+            join_at=self.join_at.at[slot_ids].set(at_round),
+        )
+
+    def epoch_at(self, round_idx):
+        """[N] int32 ground-truth identity epoch per slot at a round:
+        0 = the original occupant, 1 = the joined identity (one join
+        per slot per run — ``with_join``)."""
+        return (self.join_at <= round_idx).astype(jnp.int32)
+
+    def joining_at(self, round_idx):
+        """[N] bool: slots whose JOIN fires exactly this round."""
+        return self.join_at == round_idx
+
     def with_partition_schedule(self, partition_of, phase_rounds: int):
         partition_of = jnp.asarray(partition_of, dtype=jnp.int8)
         if partition_of.ndim == 1:
@@ -826,6 +968,7 @@ jax.tree_util.register_dataclass(
         "down_from", "down_until", "leave_at", "partition_of",
         "partition_phase_rounds", "faults", "seed_ids",
         "subject_ids", "slot_of_node", "gossip_origin", "gossip_spread_at",
+        "join_at",
     ],
     meta_fields=[],
 )
@@ -879,6 +1022,12 @@ class SwimState:
                         ``lhm_max == 0`` (the plane compiled out).
                         Always int32 absolute — [N] is small next to
                         [N, K], so compact_carry doesn't narrow it.
+    ``epoch``           [N, K]: the IDENTITY EPOCH of the record each
+                        cell holds (params.open_world — the slot-
+                        recycling lane; 0 = the original occupant).
+                        int16 under compact_carry (the lhm-lane dtype
+                        pattern), int32 otherwise; zero-size
+                        ([N, 0] int32) when the plane is compiled out.
     """
 
     status: jnp.ndarray
@@ -892,15 +1041,28 @@ class SwimState:
     g_spread_until: jnp.ndarray
     g_ring: jnp.ndarray
     lhm: jnp.ndarray
+    epoch: jnp.ndarray
 
 
 jax.tree_util.register_dataclass(
     SwimState,
     data_fields=["status", "inc", "spread_until", "suspect_deadline",
                  "self_inc", "inbox_ring", "flag_ring",
-                 "g_infected", "g_spread_until", "g_ring", "lhm"],
+                 "g_infected", "g_spread_until", "g_ring", "lhm", "epoch"],
     meta_fields=[],
 )
+
+
+def initial_epoch(params: SwimParams) -> jnp.ndarray:
+    """The identity-epoch carry lane: all-zero (original occupants) when
+    the open-world plane is on, a zero-size [N, 0] int32 array when off
+    (the lifeguard.initial_lhm pattern — costs nothing, keeps the
+    pytree structure uniform)."""
+    n = params.n_members
+    if params.epoch_bits == 0:
+        return jnp.zeros((n, 0), dtype=jnp.int32)
+    dtype = jnp.int16 if params.compact_carry else jnp.int32
+    return jnp.zeros((n, params.n_subjects), dtype=dtype)
 
 
 def initial_state(params: SwimParams, world: SwimWorld,
@@ -941,6 +1103,7 @@ def initial_state(params: SwimParams, world: SwimWorld,
         g_spread_until=jnp.zeros((n, g), dtype=jnp.int32),
         g_ring=jnp.zeros((gd_slots, n, g), dtype=jnp.bool_),
         lhm=lifeguard.initial_lhm(params),
+        epoch=initial_epoch(params),
     )
     # The ring stores wire-format keys; the int16 wire (compact_carry or
     # int16_wire) makes its delayed slots int16 (records.merge_key16).
@@ -996,8 +1159,14 @@ def _wire_inc_sat(params: "SwimParams") -> int:
     node can no longer refute (ALIVE@cap does not override SUSPECT@cap)
     — a loud, pinned degradation (tests/test_wire16.py boundary tests)
     instead of a silent wire/table divergence.
+
+    The open-world plane's epoch field is carved out of the TOP of the
+    incarnation field (ops/delivery.py layout comment), so the cap
+    drops by ``2^epoch_bits`` — 2^23-1 wide / 2^11-1 compact, still far
+    past any refutation-bump-reachable count.
     """
-    return _INC_SAT16 if params.compact_wire else _INC_SAT32
+    base_bits = 13 if params.compact_wire else 29
+    return (1 << (base_bits - params.epoch_bits)) - 1
 
 
 def _carry_decode(state: SwimState, round_idx) -> SwimState:
@@ -1016,6 +1185,12 @@ def _carry_decode(state: SwimState, round_idx) -> SwimState:
         suspect_deadline=jnp.where(
             dl == _DEADLINE_NONE16, INT32_MAX, round_idx + dl
         ),
+        # Identity-epoch lane (open-world plane): plain int16 -> int32
+        # upcast, no re-relativization (epochs are absolute counters).
+        # A zero-size lane (plane off) passes through untouched so its
+        # int32 dtype stays carry-stable.
+        epoch=(state.epoch if state.epoch.size == 0
+               else state.epoch.astype(jnp.int32)),
     )
 
 
@@ -1057,6 +1232,8 @@ def _carry_encode(state: SwimState, round_idx) -> SwimState:
     return dataclasses.replace(
         state,
         inc=jnp.minimum(state.inc, _INC_SAT16).astype(jnp.int16),
+        epoch=(state.epoch if state.epoch.size == 0
+               else state.epoch.astype(jnp.int16)),
         spread_until=jnp.clip(
             state.spread_until - nxt, 0, 127
         ).astype(jnp.int8),
@@ -1191,6 +1368,88 @@ def _entry_at_slot(mat, slot, k):
     return jnp.max(jnp.where(onehot, mat, mat.dtype.type(0)), axis=1)
 
 
+def _apply_joins(state: SwimState, round_idx, params: SwimParams,
+                 world: SwimWorld, node_ids, is_self) -> SwimState:
+    """Reset the rows of slots whose JOIN fires this round to the fresh-
+    identity cold-start shape, in the state's STORED layout.
+
+    Elementwise masked selects on the carry (compiled out entirely when
+    ``params.open_world`` is False — the caller gates).  The reset
+    mirrors ``initial_state(warm=False)`` for exactly the joining rows:
+    ABSENT except self (pinned ALIVE) and the configured seeds (the
+    joiner knows seeds a priori — MembershipProtocolImpl.start0's
+    contact list), incarnation 0, a hot self-announcement window, no
+    timers, cleared delay-ring rows and user-gossip bits, lhm back to
+    healthy, and zeroed epoch BELIEFS (the row learns current epochs
+    from the wire; its own cell is pinned to the world's ground-truth
+    epoch by the round context / merge tail).
+
+    Layout rule: the non-blocked compact path decodes the carry BEFORE
+    this runs (``_round_context`` order), so the reset writes wide
+    encodings there; only the k_block path sees the stored compact
+    form, where the relative encodings of a fresh row are written
+    directly (remaining-rounds spread, the int16 no-timer sentinel).
+    """
+    compact_layout = params.compact_carry and bool(params.k_block)
+    jvec = world.join_at[node_ids] == round_idx          # [n_local]
+    jrow = jvec[:, None]
+
+    reset_status = jnp.where(is_self, records.ALIVE, records.ABSENT)
+    if world.seed_ids.shape[0] > 0:
+        k = state.status.shape[1]
+        seed_slot = world.slot_of_node[world.seed_ids]   # [S] (-1 untracked)
+        is_seed_col = jnp.any(
+            (jnp.arange(k, dtype=jnp.int32)[None, :] == seed_slot[:, None])
+            & (seed_slot >= 0)[:, None],
+            axis=0,
+        )
+        reset_status = jnp.where(is_seed_col[None, :] & ~is_self,
+                                 records.ALIVE, reset_status)
+    status = jnp.where(jrow, reset_status, state.status).astype(jnp.int8)
+    inc = jnp.where(jrow, 0, state.inc).astype(state.inc.dtype)
+    if compact_layout:
+        spread_fresh = jnp.where(is_self, params.periods_to_spread + 1, 0)
+        deadline_fresh = _DEADLINE_NONE16
+    else:
+        spread_fresh = jnp.where(is_self,
+                                 round_idx + 1 + params.periods_to_spread, 0)
+        deadline_fresh = INT32_MAX
+    spread = jnp.where(jrow, spread_fresh, state.spread_until) \
+        .astype(state.spread_until.dtype)
+    deadline = jnp.where(jrow, deadline_fresh, state.suspect_deadline) \
+        .astype(state.suspect_deadline.dtype)
+    self_inc = jnp.where(jvec, 0, state.self_inc)
+    epoch = state.epoch
+    if params.epoch_bits:
+        epoch = jnp.where(jrow, 0, state.epoch).astype(state.epoch.dtype)
+    lhm = state.lhm
+    if params.lhm_max > 0:
+        lhm = jnp.where(jvec, 1, state.lhm)
+    inbox_ring, flag_ring = state.inbox_ring, state.flag_ring
+    if params.max_delay_rounds > 0:
+        # In-flight messages addressed to the OLD occupant die with it.
+        inbox_ring = jnp.where(
+            jrow[None], delivery.no_message(params.compact_wire),
+            state.inbox_ring,
+        )
+        flag_ring = jnp.where(jrow[None], jnp.int8(0), state.flag_ring)
+    g_infected, g_spread_until, g_ring = (state.g_infected,
+                                          state.g_spread_until,
+                                          state.g_ring)
+    if params.n_user_gossips > 0:
+        g_infected = jnp.where(jrow[:, :1], False, state.g_infected)
+        g_spread_until = jnp.where(jrow[:, :1], 0, state.g_spread_until)
+        if state.g_ring.shape[0] > 0:
+            g_ring = jnp.where(jrow[None, :, :1], False, state.g_ring)
+    return SwimState(
+        status=status, inc=inc, spread_until=spread,
+        suspect_deadline=deadline, self_inc=self_inc,
+        inbox_ring=inbox_ring, flag_ring=flag_ring,
+        g_infected=g_infected, g_spread_until=g_spread_until,
+        g_ring=g_ring, lhm=lhm, epoch=epoch,
+    )
+
+
 def _round_context(state: SwimState, round_idx, base_key,
                    params: SwimParams, world: SwimWorld, offset=0,
                    knobs: Optional[Knobs] = None, shift_key=None):
@@ -1245,6 +1504,21 @@ def _round_context(state: SwimState, round_idx, base_key,
         alive_here, part_here = alive, part
     is_self = world.subject_ids[None, :] == node_ids[:, None]   # [n_local, K]
 
+    # Open-world JOIN execution (SwimParams.open_world): a slot whose
+    # join fires this round is REBORN as a fresh identity — its row
+    # resets to the cold-start shape (ABSENT except self + configured
+    # seeds, incarnation 0, no timers, hot self-announcement, healthy
+    # lhm, epoch beliefs 0) before the tick's phases read it.  Shared
+    # by all three tick bodies and both pipelined halves through this
+    # one preamble, so the reset cannot drift between them; the
+    # joiner's own ground-truth epoch comes from the world schedule
+    # (``epoch_at``), never from the carry.
+    own_epoch = None
+    if params.open_world:
+        state = _apply_joins(state, round_idx, params, world, node_ids,
+                             is_self)
+        own_epoch = world.epoch_at(round_idx)[node_ids]     # [n_local]
+
     # Row i's record about itself is pinned (a node always believes itself
     # ALIVE at self_inc — MembershipProtocolImpl drops self-updates and
     # refutes instead, :488-509).  The blocked body pins per block — the
@@ -1253,9 +1527,14 @@ def _round_context(state: SwimState, round_idx, base_key,
     # raw fields the blocked FD pre-pass reads are identical.
     if params.k_block:
         status, inc = state.status, state.inc
+        epoch = state.epoch if params.epoch_bits else None
     else:
         status = jnp.where(is_self, records.ALIVE, state.status)
         inc = jnp.where(is_self, state.self_inc[:, None], state.inc)
+        epoch = None
+        if params.epoch_bits:
+            epoch = jnp.where(is_self, own_epoch[:, None],
+                              state.epoch.astype(jnp.int32))
 
     # User-gossip spread() injections (GossipProtocolImpl.createAndPutGossip,
     # :163-169): gossip g appears at its origin in its scheduled round and
@@ -1310,7 +1589,7 @@ def _round_context(state: SwimState, round_idx, base_key,
         alive_here=alive_here, part_here=part_here, is_self=is_self,
         fd_round=fd_round, sync_round=sync_round,
         gate_contacts=gate_contacts, known_live=known_live,
-        is_seed=is_seed,
+        is_seed=is_seed, epoch=epoch, own_epoch=own_epoch,
     )
 
 
@@ -1381,6 +1660,7 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
             fd_round, sync_round,
             (k_shifts, k_ping_net, k_proxy, k_proxy_net, k_gossip_t,
              k_gossip_drop, k_sync_t, k_sync_drop),
+            own_epoch=ctx["own_epoch"],
         )
     elif params.delivery == "shift":
         new_state, aux = _tick_shift(
@@ -1390,6 +1670,7 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
             (k_shifts, k_ping_net, k_proxy, k_proxy_net, k_gossip_t,
              k_gossip_drop, k_sync_t, k_sync_drop),
             offset=offset, axis_name=axis_name, n_devices=n_devices,
+            epoch=ctx["epoch"], own_epoch=ctx["own_epoch"],
         )
     else:
         new_state, aux = _tick_scatter(
@@ -1399,6 +1680,7 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
             (k_ping_t, k_ping_net, k_proxy, k_proxy_net, k_gossip_t,
              k_gossip_drop, k_sync_t, k_sync_drop),
             offset, axis_name, k_channel=k_shifts,
+            epoch=ctx["epoch"], own_epoch=ctx["own_epoch"],
         )
 
     metrics = _round_metrics(new_state, status, aux, params, world,
@@ -1564,7 +1846,8 @@ def _round_metrics(new_state: SwimState, status, aux, params: SwimParams,
 def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
                       params, kn, world, node_ids, alive_here, is_self,
                       inbox_ring=None, flag_ring=None,
-                      g_delivered=None, g_ring=None, lhm_signals=None):
+                      g_delivered=None, g_ring=None, lhm_signals=None,
+                      epoch=None, own_epoch=None):
     """Inbox merge, self-refutation, suspicion timers, crash/leave freeze.
 
     Shared tail of both delivery modes; all elementwise on [n_local, K].
@@ -1575,6 +1858,11 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
     the round's FD phase (Lifeguard plane on) — None leaves the lhm
     lane untouched (the blocked tick updates it once outside its block
     loop; the plane-off path has a zero-size lane either way).
+    ``epoch``/``own_epoch`` (open-world plane): the pinned identity-
+    epoch matrix and each row's own ground-truth epoch
+    (``_round_context``) — the merge gate resolves identities with
+    them and the updated lane lands in the carry; None (plane off)
+    leaves the zero-size lane untouched.
     Returns (new_state, refuted[n_local] bool).
     """
     # Dead-member suppression window (SwimParams.dead_suppress_rounds):
@@ -1586,20 +1874,39 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
         suppress = ((status == records.DEAD)
                     & (state.suspect_deadline != INT32_MAX)
                     & (round_idx < state.suspect_deadline))
-    new_status, new_inc, changed = delivery.merge_inbox(
-        status, inc, inbox, inbox_alive, compact=params.compact_wire,
-        suppress=suppress,
-    )
+    eb = params.epoch_bits
+    if eb:
+        new_status, new_inc, new_epoch, changed = delivery.merge_inbox(
+            status, inc, inbox, inbox_alive, compact=params.compact_wire,
+            suppress=suppress, entry_epoch=epoch, epoch_bits=eb,
+            epoch_guard=params.epoch_guard,
+        )
+    else:
+        new_epoch = None
+        new_status, new_inc, changed = delivery.merge_inbox(
+            status, inc, inbox, inbox_alive, compact=params.compact_wire,
+            suppress=suppress,
+        )
 
     # Self-refutation (updateMembership about-self branch, :488-509): if the
     # inbound winner about ME overrides my ALIVE@self_inc record, bump to
     # max(inc)+1 and gossip the refutation (spread reset via `changed`).
     win_status, win_inc = delivery.unpack_record(
-        inbox, compact=params.compact_wire
+        inbox, compact=params.compact_wire, epoch_bits=eb
     )
     self_overridden = is_self & records.is_overrides_array(
         win_status, win_inc, records.ALIVE, state.self_inc[:, None]
     )
+    if eb and params.epoch_guard:
+        # Identity check: a record about MY SLOT at another epoch is not
+        # about ME — a new member must not burn incarnations refuting
+        # the PREVIOUS occupant's death notice (the naive-reuse arm
+        # deliberately omits this, measuring exactly that burn).
+        win_ep = delivery.unpack_epoch(inbox, compact=params.compact_wire,
+                                       epoch_bits=eb)
+        self_overridden = self_overridden & (
+            win_ep == jnp.asarray(own_epoch, jnp.int32)[:, None]
+        )
     refuted = jnp.any(self_overridden, axis=1)
     # The bump saturates at the wire key's incarnation cap (8191 on the
     # int16 wire): the carry must never hold an incarnation the wire
@@ -1617,6 +1924,13 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
     new_status = jnp.where(is_self, records.ALIVE, new_status)
     new_inc = jnp.where(is_self, new_self_inc[:, None], new_inc)
     changed = jnp.where(is_self, self_overridden & alive_here[:, None], changed)
+    if new_epoch is not None:
+        # Own cell pinned at the slot's ground-truth epoch (a member
+        # always knows its own identity; the world schedule is the
+        # authority, never the wire).
+        new_epoch = jnp.where(
+            is_self, jnp.asarray(own_epoch, jnp.int32)[:, None], new_epoch
+        )
 
     # Suspicion timers (scheduleSuspicionTimeoutTask / cancel,
     # MembershipProtocolImpl.java:518-523,590-606).  ``computeIfAbsent``
@@ -1670,6 +1984,8 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
     new_inc = jnp.where(frozen, inc, new_inc)
     deadline = jnp.where(frozen, state.suspect_deadline, deadline)
     changed = changed & ~frozen
+    if new_epoch is not None:
+        new_epoch = jnp.where(frozen, epoch, new_epoch)
 
     spread_until = jnp.where(
         changed, round_idx + 1 + params.periods_to_spread, state.spread_until
@@ -1710,12 +2026,14 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
         g_spread_until=g_spread_until,
         g_ring=state.g_ring if g_ring is None else g_ring,
         lhm=new_lhm,
+        epoch=(state.epoch if new_epoch is None
+               else new_epoch.astype(jnp.int32)),
     )
     return new_state, refuted
 
 
 def _send_components(state, status, inc, round_idx, params, world,
-                     node_ids, is_self):
+                     node_ids, is_self, epoch=None):
     """(record_keys, hot, syncable) — one payload, two transmit masks.
 
     Gossip carries hot records (changed within the spread window; DEAD
@@ -1726,14 +2044,22 @@ def _send_components(state, status, inc, round_idx, params, world,
     DEAD records, so SYNC never carries them) — masked on the sender's
     TABLE status, not the key's DEAD bit: a leaver's key carries DEAD@inc+1
     while its table row is pinned ALIVE, and that record must still sync.
+
+    ``epoch`` (open-world plane): the PINNED identity-epoch matrix
+    (``_round_context``'s ``epoch``) — every transmitted key carries the
+    epoch of the record it describes, including the leaver's own DEAD
+    notice (the leaver dies at its own current epoch).
     """
     leaving_now = (world.leave_at[node_ids] == round_idx)[:, None] & is_self
     hot = (status != records.ABSENT) & (round_idx < state.spread_until)
     hot = hot | leaving_now
     compact = params.compact_wire
-    record_keys = delivery.pack_record(status, inc, compact=compact)
+    eb = params.epoch_bits
+    record_keys = delivery.pack_record(status, inc, compact=compact,
+                                       epoch=epoch, epoch_bits=eb)
     leave_key = delivery.pack_record(
-        jnp.int8(records.DEAD), state.self_inc[:, None] + 1, compact=compact
+        jnp.int8(records.DEAD), state.self_inc[:, None] + 1, compact=compact,
+        epoch=epoch, epoch_bits=eb,
     )
     record_keys = jnp.where(leaving_now, leave_key, record_keys)
     syncable = status != records.DEAD
@@ -1838,12 +2164,13 @@ def _seed_anti_entropy(status, sync_keys, inbox, inbox_alive, sync_round,
 
 
 def _send_payloads(state, status, inc, round_idx, params, world,
-                   node_ids, is_self):
+                   node_ids, is_self, epoch=None):
     """(gossip_keys, sync_keys) — the masked per-channel payload matrices
     (scatter mode materializes both; shift mode ships the shared key buffer
     plus the int8 masks instead — see _tick_shift)."""
     record_keys, hot, syncable = _send_components(
-        state, status, inc, round_idx, params, world, node_ids, is_self
+        state, status, inc, round_idx, params, world, node_ids, is_self,
+        epoch=epoch,
     )
     no_msg = delivery.no_message(params.compact_wire)
     gossip_keys = jnp.where(hot, record_keys, no_msg)
@@ -1859,7 +2186,8 @@ def _send_payloads(state, status, inc, round_idx, params, world,
 def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
                         alive, part, node_ids, alive_here, part_here,
                         is_self, fd_round, sync_round, gate_contacts,
-                        known_live, is_seed, keys, offset, k_channel=None):
+                        known_live, is_seed, keys, offset, k_channel=None,
+                        epoch=None):
     """Phases 1-3 of the scatter tick: FD probe verdicts + gossip/SYNC
     sends — everything up to (but excluding) the cross-device inbox
     combine.  Returns a dict of per-channel payloads/targets/drop masks
@@ -1979,10 +2307,19 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
     )
     compact = params.compact_wire
     no_msg = delivery.no_message(compact)
+    # The FD verdict is about the record the observer HOLDS — same
+    # incarnation, same identity epoch (a stale-epoch SUSPECT verdict
+    # then drops at every guarded merge gate, including the observer's
+    # own, exactly like any other stale-identity record).
+    fd_entry_epoch = None
+    if params.epoch_bits:
+        fd_entry_epoch = jnp.take_along_axis(
+            epoch, slot_safe[:, None], 1)[:, 0]
     fd_suspect_key = delivery.pack_record(
         jnp.int8(records.SUSPECT),
         jnp.take_along_axis(inc, slot_safe[:, None], 1)[:, 0],
-        compact=compact,
+        compact=compact, epoch=fd_entry_epoch,
+        epoch_bits=params.epoch_bits,
     )
     fd_inbox = jnp.where(
         fd_slot_onehot & verdict_suspect[:, None],
@@ -1998,7 +2335,8 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
 
     # ---- Phase 2 + 3: gossip and SYNC sends ------------------------------
     gossip_keys, sync_keys = _send_payloads(
-        state, status, inc, round_idx, params, world, node_ids, is_self
+        state, status, inc, round_idx, params, world, node_ids, is_self,
+        epoch=epoch,
     )
 
     gossip_targets = prng.targets_excluding_self(
@@ -2184,14 +2522,15 @@ def _scatter_send_aux(s, params):
 def _tick_scatter(state, status, inc, round_idx, params, kn, world,
                   alive, part, node_ids, alive_here, part_here, is_self,
                   fd_round, sync_round, gate_contacts, known_live, is_seed,
-                  keys, offset, axis_name, k_channel=None):
+                  keys, offset, axis_name, k_channel=None, epoch=None,
+                  own_epoch=None):
     n, k = params.n_members, params.n_subjects
     n_local = status.shape[0]
     s = _scatter_send_phase(state, status, inc, round_idx, params, kn,
                             world, alive, part, node_ids, alive_here,
                             part_here, is_self, fd_round, sync_round,
                             gate_contacts, known_live, is_seed, keys,
-                            offset, k_channel=k_channel)
+                            offset, k_channel=k_channel, epoch=epoch)
     delay_g, delay_s = s["delay_g"], s["delay_s"]
 
     def combine_max(buf):
@@ -2288,6 +2627,7 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
         g_delivered=g_delivered, g_ring=g_ring_new,
         lhm_signals=((s["lhm_fail"], s["lhm_clean"])
                      if params.lhm_max > 0 else None),
+        epoch=epoch, own_epoch=own_epoch,
     )
     aux = dict(
         _scatter_send_aux(s, params),
@@ -2397,7 +2737,7 @@ def swim_tick_send(state: SwimState, round_idx, base_key,
                             ctx["sync_round"], ctx["gate_contacts"],
                             ctx["known_live"], ctx["is_seed"],
                             ctx["keys"], offset,
-                            k_channel=ctx["k_shifts"])
+                            k_channel=ctx["k_shifts"], epoch=ctx["epoch"])
     buf, fbuf = _scatter_channel_bufs(s, params, False, False)
     # FD verdicts are observer-local: fold them into the owner's row
     # block of the pending buffer (serial folds after the combine; max
@@ -2464,6 +2804,7 @@ def swim_tick_recv(state: SwimState, pending, send_aux, round_idx,
         ctx["alive_here"], ctx["is_self"], g_delivered=g_delivered,
         lhm_signals=((pending["lhm_fail"], pending["lhm_clean"])
                      if params.lhm_max > 0 else None),
+        epoch=ctx["epoch"], own_epoch=ctx["own_epoch"],
     )
     aux = dict(
         send_aux,
@@ -2564,7 +2905,8 @@ def _shift_sender_gate(eng, d_ids, d_alive, d_part, s, world, round_idx,
 def _tick_shift(state, status, inc, round_idx, params, kn, world,
                 alive, part, node_ids, alive_here, part_here, is_self,
                 fd_round, sync_round, gate_contacts, known_live, is_seed,
-                keys, offset=0, axis_name=None, n_devices=1):
+                keys, offset=0, axis_name=None, n_devices=1, epoch=None,
+                own_epoch=None):
     n, k = params.n_members, params.n_subjects
     n_local = status.shape[0]
     (k_shifts, k_ping_net, k_proxy, k_proxy_net, k_gossip_t, k_gossip_drop,
@@ -2616,6 +2958,8 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             slot = t
             entry_t_status = jnp.take_along_axis(status, t[:, None], 1)[:, 0]
             entry_t_inc = jnp.take_along_axis(inc, t[:, None], 1)[:, 0]
+            entry_t_ep = (jnp.take_along_axis(epoch, t[:, None], 1)[:, 0]
+                          if params.epoch_bits else None)
             has_target = (
                 (entry_t_status == records.ALIVE)
                 | (entry_t_status == records.SUSPECT)
@@ -2626,6 +2970,8 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             slot_sf = jnp.maximum(slot, 0)
             entry_t_status = _entry_at_slot(status, slot_sf, k)
             entry_t_inc = _entry_at_slot(inc, slot_sf, k)
+            entry_t_ep = (_entry_at_slot(epoch, slot_sf, k)
+                          if params.epoch_bits else None)
             has_target = (slot >= 0) & (
                 (entry_t_status == records.ALIVE)
                 | (entry_t_status == records.SUSPECT)
@@ -2646,11 +2992,12 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             probes_sent = probes_sent & lhm_gate
         ping_req_launches = probes_sent & ~direct_ok
         return (suspect_v, refute_v, active,
-                jnp.maximum(slot, 0), entry_t_inc, probes_sent,
+                jnp.maximum(slot, 0), entry_t_inc, entry_t_ep, probes_sent,
                 ping_req_launches, probes_sent & direct_ok)
 
     (verdict_suspect, push_refute, probe_active, slot_safe,
-     entry_t_inc, probes_sent, ping_req_launches, lhm_clean) = fd_phase(0)
+     entry_t_inc, entry_t_ep, probes_sent, ping_req_launches,
+     lhm_clean) = fd_phase(0)
     ping_req_n = jnp.sum(ping_req_launches, dtype=jnp.int32) * r_proxies
 
     compact = params.compact_wire
@@ -2659,7 +3006,8 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         jnp.arange(k, dtype=jnp.int32)[None, :] == slot_safe[:, None]
     )
     fd_suspect_key = delivery.pack_record(
-        jnp.int8(records.SUSPECT), entry_t_inc, compact=compact
+        jnp.int8(records.SUSPECT), entry_t_inc, compact=compact,
+        epoch=entry_t_ep, epoch_bits=params.epoch_bits,
     )
     fd_inbox = jnp.where(
         fd_slot_onehot & verdict_suspect[:, None],
@@ -2669,7 +3017,8 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
 
     # ---- Phase 2 + 3: gossip and SYNC sends ------------------------------
     record_keys, hot, syncable = _send_components(
-        state, status, inc, round_idx, params, world, node_ids, is_self
+        state, status, inc, round_idx, params, world, node_ids, is_self,
+        epoch=epoch,
     )
 
     # Delivery: receiver j's channel-c message comes from sender
@@ -2959,6 +3308,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         g_delivered=g_delivered, g_ring=g_ring_acc,
         lhm_signals=((ping_req_launches, lhm_clean)
                      if params.lhm_max > 0 else None),
+        epoch=epoch, own_epoch=own_epoch,
     )
     aux = dict(
         messages_gossip=n_gossip_sent,
@@ -2985,7 +3335,8 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
 
 def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
                         alive, part, node_ids, alive_here, part_here,
-                        is_self, fd_round, sync_round, keys):
+                        is_self, fd_round, sync_round, keys,
+                        own_epoch=None):
     """The shift tick restructured as a fori_loop over K column blocks.
 
     Bit-identical to ``_tick_shift`` (single device, full view, no delay
@@ -3043,6 +3394,12 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
     entry_t_status = jnp.take_along_axis(status, t[:, None], 1)[:, 0]
     entry_t_inc = jnp.take_along_axis(inc, t[:, None], 1)[:, 0] \
         .astype(jnp.int32)
+    entry_t_ep = None
+    if params.epoch_bits:
+        # The raw carry's epoch lane (t != i, so the unpinned diagonal
+        # is never read — the _round_context k_block contract).
+        entry_t_ep = jnp.take_along_axis(
+            state.epoch, t[:, None], 1)[:, 0].astype(jnp.int32)
     has_target = ((entry_t_status == records.ALIVE)
                   | (entry_t_status == records.SUSPECT))
     probe_active = fd_round & has_target & alive_here
@@ -3056,7 +3413,8 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
     ping_req_n = jnp.sum(ping_req_launches, dtype=jnp.int32) * r_proxies
     slot_safe = t                                    # full view: slot == id
     fd_suspect_key = delivery.pack_record(
-        jnp.int8(records.SUSPECT), entry_t_inc, compact=wire
+        jnp.int8(records.SUSPECT), entry_t_inc, compact=wire,
+        epoch=entry_t_ep, epoch_bits=params.epoch_bits,
     )
 
     # ---- Channel sender gates (receiver-indexed [N] vectors) ------------
@@ -3156,8 +3514,9 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
     )
 
     def body(b, acc):
-        (st_acc, inc_acc, spr_acc, dl_acc, self_inc_acc, refuted_acc,
-         h_alive, h_suspect, h_dead, h_still, fsr, svr, ons) = acc
+        (st_acc, inc_acc, ep_acc, spr_acc, dl_acc, self_inc_acc,
+         refuted_acc, h_alive, h_suspect, h_dead, h_still, fsr, svr,
+         ons) = acc
         c0 = b * kb
         cols = c0 + jnp.arange(kb, dtype=jnp.int32)          # global ids
 
@@ -3175,15 +3534,22 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
             # block (the LHS deadline arming reads them); the update
             # itself happens ONCE outside the loop (lhm_signals=None).
             lhm=state.lhm,
+            epoch=(blk_of(state.epoch) if params.epoch_bits
+                   else state.epoch),
             **zero_g,
         )
         blk = _carry_decode(blk_raw, round_idx) if compact else blk_raw
         is_self_b = cols[None, :] == node_ids[:, None]
         st_b = jnp.where(is_self_b, records.ALIVE, blk.status)
         inc_b = jnp.where(is_self_b, state.self_inc[:, None], blk.inc)
+        ep_b = None
+        if params.epoch_bits:
+            ep_b = jnp.where(is_self_b, own_epoch[:, None],
+                             blk.epoch.astype(jnp.int32))
 
         record_keys_b, hot_b, syncable_b = _send_components(
-            blk, st_b, inc_b, round_idx, params, world, node_ids, is_self_b
+            blk, st_b, inc_b, round_idx, params, world, node_ids,
+            is_self_b, epoch=ep_b,
         )
 
         h_keys_b = eng.prep(record_keys_b)
@@ -3227,6 +3593,7 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
         new_blk, refuted_b = _merge_and_timers(
             blk, st_b, inc_b, inbox_b, inbox_alive_b, round_idx,
             params, kn, world, node_ids, alive_here, is_self_b,
+            epoch=ep_b, own_epoch=own_epoch,
         )
         out_blk = (_carry_encode(new_blk, round_idx) if compact
                    else new_blk)
@@ -3235,6 +3602,9 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
             st_acc, out_blk.status, c0, 1)
         inc_acc = jax.lax.dynamic_update_slice_in_dim(
             inc_acc, out_blk.inc, c0, 1)
+        if params.epoch_bits:
+            ep_acc = jax.lax.dynamic_update_slice_in_dim(
+                ep_acc, out_blk.epoch, c0, 1)
         spr_acc = jax.lax.dynamic_update_slice_in_dim(
             spr_acc, out_blk.spread_until, c0, 1)
         dl_acc = jax.lax.dynamic_update_slice_in_dim(
@@ -3278,20 +3648,21 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
             fsr += jnp.sum(fsr_b)
             svr += jnp.sum(svr_b)
             ons += jnp.sum(ons_b)
-        return (st_acc, inc_acc, spr_acc, dl_acc, self_inc_acc, refuted_acc,
-                h_alive, h_suspect, h_dead, h_still, fsr, svr, ons)
+        return (st_acc, inc_acc, ep_acc, spr_acc, dl_acc, self_inc_acc,
+                refuted_acc, h_alive, h_suspect, h_dead, h_still, fsr,
+                svr, ons)
 
     # Accumulators stay in the STORED layout (compact dtypes included):
     # blocks are decoded on read and re-encoded on write, so no wide
     # [N, K] int32 copy of the carry ever exists.
     acc0 = (
-        state.status, state.inc,
+        state.status, state.inc, state.epoch,
         state.spread_until, state.suspect_deadline,
         state.self_inc, jnp.zeros((n,), dtype=jnp.bool_),
         hist_init(), hist_init(), hist_init(), hist_init(),
         hist_init(), hist_init(), hist_init(),
     )
-    (st_acc, inc_acc, spr_acc, dl_acc, self_inc_acc, refuted,
+    (st_acc, inc_acc, ep_acc, spr_acc, dl_acc, self_inc_acc, refuted,
      h_alive, h_suspect, h_dead, h_still, fsr, svr, ons) = \
         jax.lax.fori_loop(0, n_blocks, body, acc0)
 
@@ -3323,6 +3694,7 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
         g_infected=g_infected, g_spread_until=g_spread_until,
         g_ring=state.g_ring,
         lhm=new_lhm,
+        epoch=ep_acc,
     )
     subject_alive_i = (alive[world.subject_ids].astype(jnp.int32)
                        if per_subject
@@ -3382,7 +3754,7 @@ def node_snapshot(state: SwimState, params: SwimParams, world: SwimWorld,
     def ids_with(code):
         return subjects[(status == code) & not_self].tolist()
 
-    return {
+    snapshot = {
         "node_id": int(node_id),
         "incarnation": int(np.asarray(state.self_inc)[node_id]),
         "alive_members": ids_with(records.ALIVE),
@@ -3400,6 +3772,17 @@ def node_snapshot(state: SwimState, params: SwimParams, world: SwimWorld,
             if st != records.ABSENT
         },
     }
+    if params.epoch_bits:
+        # Guard arm only: the naive-reuse arm (epoch_guard=False) has
+        # no lane, so the field is OMITTED there rather than reported
+        # as a misleading empty dict.
+        epochs = np.asarray(state.epoch[node_id])
+        snapshot["record_epochs"] = {
+            int(s): int(e)
+            for s, e, st in zip(subjects, epochs, status)
+            if st != records.ABSENT
+        }
+    return snapshot
 
 
 def _wide_timer_fields(state: SwimState, params: SwimParams, cursor):
@@ -3600,13 +3983,18 @@ def run_traced(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
             params.n_members, params.n_subjects, trace_capacity
         )
 
+    prev_ep_of = (lambda st: st.epoch) if params.epoch_bits else \
+        (lambda st: None)
+
     def tick(carry, round_idx):
         st, tel = carry
         prev_status, prev_inc = st.status, st.inc
+        prev_epoch = prev_ep_of(st)
         new_st, metrics = swim_tick(st, round_idx, base_key, params, world,
                                     knobs=knobs, shift_key=shift_key)
         tel = telemetry_trace.observe_round(
-            tel, round_idx, prev_status, prev_inc, new_st, world
+            tel, round_idx, prev_status, prev_inc, new_st, world,
+            prev_epoch=prev_epoch,
         )
         return (new_st, tel), metrics
 
@@ -3620,10 +4008,12 @@ def run_traced(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
         ms, codes_l, inc_l = [], [], []
         for j in range(params.rounds_per_step):
             prev_status, prev_inc = st.status, st.inc
+            prev_epoch = prev_ep_of(st)
             st, m = swim_tick(st, rounds_k[j], base_key, params, world,
                               knobs=knobs, shift_key=shift_key)
             tel, codes, ev_inc = telemetry_trace.observe_round_codes(
-                tel, rounds_k[j], prev_status, prev_inc, st, world
+                tel, rounds_k[j], prev_status, prev_inc, st, world,
+                prev_epoch=prev_epoch,
             )
             ms.append(m)
             codes_l.append(codes)

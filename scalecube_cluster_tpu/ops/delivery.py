@@ -40,6 +40,31 @@ _INC_MASK = (1 << 29) - 1
 # (wire + inbox + carry) in full-view capacity runs.
 _INC_MASK16 = (1 << 13) - 1
 
+# Open-world identity epochs (models/swim.SwimParams.open_world): when
+# ``epoch_bits > 0`` the key donates its TOP incarnation bits to a
+# per-slot identity epoch, directly below the dead bit:
+#
+#   wide:    bit 30 = dead | bits (30-E)..29 = epoch | inc | bit 0 = suspect
+#   compact: bit 14 = dead | bits (14-E)..13 = epoch | inc | bit 0 = suspect
+#
+# The dead bit stays on top, so the inbox max-fold keeps the reference's
+# DEAD-absorbs order (a naive-reuse run folds exactly like the
+# reference); within a liveness class a higher epoch orders above any
+# incarnation of an older occupant.  Cross-epoch SEMANTICS live in
+# :func:`merge_inbox`'s gate, not the fold.  Epoch bit widths are fixed
+# per wire format (SwimParams.epoch_bits): 6 wide / 2 compact, which
+# drops the incarnation saturation point to 2^23-1 / 2^11-1
+# (models/swim._wire_inc_sat) — still far past any refutation-bump
+# reachable count.
+EPOCH_BITS_WIDE = 6
+EPOCH_BITS_COMPACT = 2
+
+
+def _field_layout(compact: bool, epoch_bits: int):
+    """(dead_bit, inc_bits) of the active key layout."""
+    dead_bit = 14 if compact else 30
+    return dead_bit, dead_bit - 1 - epoch_bits
+
 
 def no_message(compact: bool = False):
     """The "no message" key in the wire dtype.
@@ -50,26 +75,48 @@ def no_message(compact: bool = False):
     return jnp.int16(-1) if compact else NO_MESSAGE
 
 
-def pack_record(status, inc, compact: bool = False):
-    """Pack (status, incarnation) into the merge key (records.merge_key,
-    or the int16 records.merge_key16 when ``compact``).
+def pack_record(status, inc, compact: bool = False, epoch=None,
+                epoch_bits: int = 0):
+    """Pack (status, incarnation[, epoch]) into the merge key
+    (records.merge_key, or the int16 records.merge_key16 when
+    ``compact``; the epoch-extended layout when ``epoch_bits > 0`` —
+    see the module-level layout comment).
 
     ABSENT packs to -1 == no_message(compact): absent entries are simply
     never transmitted, matching the reference where only table-present
     records go into SYNC/gossip payloads
     (MembershipProtocolImpl.java:446-454).
     """
-    if compact:
-        return records.merge_key16(status, inc)
-    return records.merge_key(status, inc)
+    if epoch_bits == 0:
+        if compact:
+            return records.merge_key16(status, inc)
+        return records.merge_key(status, inc)
+    status = jnp.asarray(status)
+    inc = jnp.asarray(inc, dtype=jnp.int32)
+    dead_bit, inc_bits = _field_layout(compact, epoch_bits)
+    is_dead = (status == records.DEAD).astype(jnp.int32)
+    is_suspect = (status == records.SUSPECT).astype(jnp.int32)
+    inc_sat = jnp.minimum(inc, jnp.int32((1 << inc_bits) - 1))
+    ep = jnp.asarray(0 if epoch is None else epoch, jnp.int32)
+    ep = jnp.clip(ep, 0, (1 << epoch_bits) - 1)
+    key = ((is_dead << dead_bit) | (ep << (inc_bits + 1))
+           | (inc_sat << 1) | is_suspect)
+    key = jnp.where(status == records.ABSENT, -1, key)
+    return key.astype(jnp.int16) if compact else key
 
 
-def unpack_record(key, compact: bool = False):
+def unpack_record(key, compact: bool = False, epoch_bits: int = 0):
     """Invert :func:`pack_record`: key -> (status int8, incarnation int32).
 
-    Keys < 0 unpack to (ABSENT, 0).
+    Keys < 0 unpack to (ABSENT, 0).  The epoch field (when
+    ``epoch_bits > 0``) is read separately by :func:`unpack_epoch` so
+    the dominant two-field call sites stay unchanged.
     """
-    dead_bit, inc_mask = (14, _INC_MASK16) if compact else (30, _INC_MASK)
+    if epoch_bits == 0:
+        dead_bit, inc_mask = (14, _INC_MASK16) if compact else (30, _INC_MASK)
+    else:
+        dead_bit, inc_bits = _field_layout(compact, epoch_bits)
+        inc_mask = (1 << inc_bits) - 1
     key = jnp.asarray(key, dtype=jnp.int32)
     is_dead = (key >> dead_bit) & 1
     is_suspect = key & 1
@@ -81,6 +128,17 @@ def unpack_record(key, compact: bool = False):
     status = jnp.where(key < 0, records.ABSENT, status).astype(jnp.int8)
     inc = jnp.where(key < 0, 0, (key >> 1) & inc_mask).astype(jnp.int32)
     return status, inc
+
+
+def unpack_epoch(key, compact: bool = False, epoch_bits: int = 0):
+    """The identity-epoch field of an epoch-extended key (int32; keys
+    < 0 — no message / ABSENT — unpack to epoch 0)."""
+    if epoch_bits == 0:
+        return jnp.zeros_like(jnp.asarray(key, jnp.int32))
+    _, inc_bits = _field_layout(compact, epoch_bits)
+    key = jnp.asarray(key, dtype=jnp.int32)
+    ep = (key >> (inc_bits + 1)) & ((1 << epoch_bits) - 1)
+    return jnp.where(key < 0, 0, ep).astype(jnp.int32)
 
 
 def is_alive_key(key, compact: bool = False):
@@ -161,7 +219,8 @@ def wire_saturation(messages_sent, live_senders, fanout):
 
 
 def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
-                compact: bool = False, suppress=None):
+                compact: bool = False, suppress=None, entry_epoch=None,
+                epoch_bits: int = 0, epoch_guard: bool = True):
     """Merge one round's inbox into the membership table rows.
 
     Equivalent to one valid arrival-order serialization of the reference's
@@ -197,9 +256,44 @@ def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
     After the window the cell gates like ABSENT again (the reference's
     remove-then-re-add recovery).
 
-    Returns (status int8, inc int32, changed bool).
+    Identity epochs (``epoch_bits > 0`` — the open-world plane,
+    models/swim.SwimParams.open_world): ``entry_epoch`` is the stored
+    cell's identity epoch and the winner's epoch unpacks from the key.
+    With ``epoch_guard`` on (the plane's contract):
+
+      - a LOWER-epoch winner is DROPPED — the previous occupant's
+        tombstones and stale hot ALIVE notices cannot touch the new
+        identity's record (the slot-recycling hazard this lane exists
+        to kill);
+      - a HIGHER-epoch winner is admitted only when it is ALIVE — the
+        new identity enters through its own join announcement, exactly
+        the ABSENT null-gate rule applied per identity
+        (MembershipRecord.java:67-69), and the admission OVERRIDES the
+        dead-member suppression window (a suppressed tombstone guards
+        the OLD identity's death notice; it must not block a
+        higher-epoch JOIN);
+      - equal epochs gate exactly as before, on the epoch-stripped
+        record keys.
+
+    ``epoch_guard=False`` with ``epoch_bits > 0`` compares the FULL
+    packed keys — epoch-blind precedence with the epoch field demoted
+    to high incarnation bits.  The production naive-reuse control arm
+    (models/swim.SwimParams.epoch_guard=False) instead drops the epoch
+    field from the wire entirely (its ``epoch_bits`` property returns 0
+    — the true reference layout, under which the old occupant's hot
+    tombstone kills the new member and its stale higher-incarnation
+    ALIVE notices shadow the dead identity; the invariant monitor
+    proves those attribution-free by incarnation forensics,
+    chaos/monitor.NO_RESURRECTION).  This branch exists for unit-level
+    demonstrations of exactly what the guard changes on
+    otherwise-identical keys (tests/test_open_world.py).
+
+    Returns (status int8, inc int32, changed bool) when
+    ``epoch_bits == 0`` (the exact pre-open-world contract), else
+    (status int8, inc int32, epoch int32, changed bool).
     """
-    win_status, win_inc = unpack_record(inbox_key, compact=compact)
+    win_status, win_inc = unpack_record(inbox_key, compact=compact,
+                                        epoch_bits=epoch_bits)
 
     # Stored DEAD gates like ABSENT (record was deleted in the reference).
     gate_status = jnp.where(entry_status == records.DEAD, records.ABSENT, entry_status)
@@ -225,16 +319,63 @@ def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
     # (models/swim._wire_inc_sat) — and the at-the-cap merge behavior
     # is pinned by tests/test_wire16.py's saturation-boundary tests.
     absent = gate_status == records.ABSENT
-    accepts = jnp.where(
-        absent, inbox_any_alive & (inbox_key >= 0), inbox_key > entry_key
-    )
-    if suppress is not None:
-        # Suppressed tombstones keep their DEAD key in the gate: only a
-        # strictly higher DEAD key overrides during the window.
-        true_key = pack_record(entry_status, entry_inc, compact=compact)
-        accepts = jnp.where(suppress, inbox_key > true_key, accepts)
+    if epoch_bits == 0:
+        accepts = jnp.where(
+            absent, inbox_any_alive & (inbox_key >= 0), inbox_key > entry_key
+        )
+        if suppress is not None:
+            # Suppressed tombstones keep their DEAD key in the gate: only
+            # a strictly higher DEAD key overrides during the window.
+            true_key = pack_record(entry_status, entry_inc, compact=compact)
+            accepts = jnp.where(suppress, inbox_key > true_key, accepts)
+        new_epoch = None
+    else:
+        entry_ep = jnp.asarray(entry_epoch, jnp.int32)
+        win_ep = unpack_epoch(inbox_key, compact=compact,
+                              epoch_bits=epoch_bits)
+        if epoch_guard:
+            # Same-epoch precedence on the epoch-STRIPPED keys (wide
+            # int32 — the unpacked fields are already int32, and the
+            # stripped compare never meets the int16 wire).
+            entry_key0 = pack_record(gate_status, entry_inc)
+            win_key0 = pack_record(win_status, win_inc)
+            accepts = jnp.where(
+                absent, inbox_any_alive & (inbox_key >= 0),
+                win_key0 > entry_key0,
+            )
+            if suppress is not None:
+                true_key0 = pack_record(entry_status, entry_inc)
+                accepts = jnp.where(suppress, win_key0 > true_key0, accepts)
+            # Cross-epoch: lower drops, higher admits only through the
+            # new identity's own ALIVE (overriding any suppression —
+            # the window guards the OLD identity's notice).
+            accepts = jnp.where(
+                win_ep > entry_ep, win_status == records.ALIVE,
+                jnp.where(win_ep < entry_ep, False, accepts),
+            )
+        else:
+            # Naive reuse (instrumented control): the reference's
+            # epoch-blind precedence on the FULL packed keys; the epoch
+            # field only rides along for attribution.
+            entry_key_full = pack_record(gate_status, entry_inc,
+                                         compact=compact, epoch=entry_ep,
+                                         epoch_bits=epoch_bits)
+            accepts = jnp.where(
+                absent, inbox_any_alive & (inbox_key >= 0),
+                inbox_key > entry_key_full,
+            )
+            if suppress is not None:
+                true_key = pack_record(entry_status, entry_inc,
+                                       compact=compact, epoch=entry_ep,
+                                       epoch_bits=epoch_bits)
+                accepts = jnp.where(suppress, inbox_key > true_key, accepts)
+        new_epoch = jnp.where(accepts, win_ep, entry_ep).astype(jnp.int32)
 
     new_status = jnp.where(accepts, win_status, entry_status).astype(jnp.int8)
     new_inc = jnp.where(accepts, win_inc, entry_inc).astype(jnp.int32)
     changed = accepts & ((new_status != entry_status) | (new_inc != entry_inc))
-    return new_status, new_inc, changed
+    if new_epoch is None:
+        return new_status, new_inc, changed
+    changed = changed | (accepts & (new_epoch != jnp.asarray(
+        entry_epoch, jnp.int32)))
+    return new_status, new_inc, new_epoch, changed

@@ -93,6 +93,7 @@ def _shard_prelude(params: swim.SwimParams, mesh: Mesh):
         inbox_ring=P(None, axis), flag_ring=P(None, axis),
         g_infected=P(axis), g_spread_until=P(axis), g_ring=P(None, axis),
         lhm=P(axis),
+        epoch=P(axis),
     )
     metric_names = ["alive", "suspect", "dead", "absent", "false_positives",
                     "false_suspicion_onsets", "false_suspect_rounds",

@@ -244,7 +244,8 @@ def _run_segment(shape: str, key, params, world, start: int, end: int,
 
     state = ckpt.state_from_arrays(
         {k[len("state/"):]: v for k, v in carry.items()
-         if k.startswith("state/")}
+         if k.startswith("state/")},
+        params=params,
     )
     step = end - start
     common = dict(state=state, start_round=start, knobs=opts.get("knobs"),
@@ -572,7 +573,8 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
 
     state = ckpt.state_from_arrays(
         {k[len("state/"):]: v for k, v in carry.items()
-         if k.startswith("state/")}
+         if k.startswith("state/")},
+        params=params,
     )
     return ResilientRunResult(
         state=state, carry_arrays=carry, next_round=n_rounds,

@@ -61,13 +61,24 @@ from typing import Callable, Iterable, List, Optional, Sequence, Set, Tuple
 
 class TraceEventType(enum.IntEnum):
     """Membership-table transition kinds (int codes are the wire/lane
-    values — stable, do not renumber)."""
+    values — stable, do not renumber).
+
+    ``JOINED`` is the open-world admission lane
+    (models/swim.SwimParams.open_world): the cell's stored identity
+    EPOCH advanced to a live record — a NEW member entered a recycled
+    slot — where plain ``ADDED`` stays the same-identity (re-)add
+    (cold-start discovery, tombstone reopen after partition heal,
+    crash-revival).  The reference's listener emits ADDED for both
+    (it has real per-identity member ids); consumers diffing against
+    the oracle union the two types (chaos/campaign.cross_validate_churn).
+    """
 
     ADDED = 0
     SUSPECTED = 1
     ALIVE_REFUTED = 2
     REMOVED = 3
     LEAVING = 4
+    JOINED = 5
 
 
 @dataclasses.dataclass(frozen=True, order=True)

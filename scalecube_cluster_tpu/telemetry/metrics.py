@@ -90,6 +90,9 @@ DEFAULT_COUNTERS = (
     "false_positive_rounds",     # observer-rounds holding FP views
     "live_observer_rounds",      # sum of live members over rounds
     "chaos_violations",          # invariant-monitor trips (monitored)
+    "joins_admitted",            # open-world JOINs fired (ground-truth
+                                 # admissions, SwimWorld.join_at; 0
+                                 # when the plane is off)
 )
 DEFAULT_GAUGES = (
     "live_members",              # ground-truth live count
@@ -100,6 +103,9 @@ DEFAULT_GAUGES = (
     "lhm",                       # mean Lifeguard health multiplier over
                                  # live members (models/lifeguard.py;
                                  # 0 = plane off, 1 = all healthy)
+    "free_slots",                # slots with no live occupant — the
+                                 # open-world admission capacity
+                                 # (n_members - live_members)
 )
 DEFAULT_HISTOGRAMS = (
     ("suspicion_lifetime_rounds", DEFAULT_SUSPICION_EDGES),
@@ -297,6 +303,15 @@ def observe_tick(ms: MetricsState, spec: MetricsSpec, params, kn,
         updates["live_observer_rounds"] = (
             jnp.sum(world.alive_at(round_idx), dtype=jnp.int32) * lead_w
         )
+    if (getattr(params, "open_world", False)
+            and "joins_admitted" in spec.counters):
+        # Ground-truth admissions this round (the world join schedule —
+        # the tick executes exactly these; gated on the plane so a
+        # plane-off registry never even traces the reduction).
+        updates["joins_admitted"] = (
+            jnp.sum(world.join_at == jnp.asarray(round_idx, jnp.int32),
+                    dtype=jnp.int32) * lead_w
+        )
     ms = inc_many(ms, spec, updates)
 
     # Suspicion-transition block: local-state derivation (NOT
@@ -389,6 +404,7 @@ def sample_gauges(ms: MetricsState, spec: MetricsSpec, params, kn,
         "live_members": live,
         "suspect_entries": suspect,
         "dead_entries": dead,
+        "free_slots": jnp.int32(params.n_members) - live,
         "gossip_piggyback_occupancy": gossip_model.piggyback_occupancy(
             hot, live * params.n_subjects),
     }

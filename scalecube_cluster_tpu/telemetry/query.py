@@ -312,7 +312,8 @@ def load_bench_payload(path: str) -> Tuple[Optional[dict], Optional[str]]:
                      or "metrics_overhead_ratio" in payload
                      or "pipelined_speedup_ratio" in payload
                      or "sync_rounds_to_converge" in payload
-                     or "fp_ratio" in payload)):
+                     or "fp_ratio" in payload
+                     or "no_resurrection_violations" in payload)):
             return None, stub_note
     return payload, None
 
@@ -542,6 +543,58 @@ def regress(paths: Sequence[str],
                       isinstance(delta, (int, float))
                       and math.isfinite(delta)
                       and delta <= DISSEMINATION_SLACK_ROUNDS)
+        # Open-world churn A/B artifacts (bench.py --churn): ABSOLUTE
+        # gates — the epoch guard must hold ZERO resurrection and
+        # join-completeness violations with join propagation inside the
+        # scenario's dissemination bound, the storm must actually GROW
+        # the cluster, and the naive control arm must DEMONSTRATE the
+        # resurrection failure (a control that stops failing means the
+        # A/B stopped measuring the hazard).  Smoke artifacts are
+        # provenance unless the walk holds only smoke rounds (the
+        # sync-heal rule).
+        ch_all = [(p, pl) for p, pl in entries
+                  if "no_resurrection_violations" in pl
+                  and "join_propagation_p99_rounds" in pl]
+        ch = [(p, pl) for p, pl in ch_all
+              if not pl.get("smoke")] or ch_all
+        if ch is not ch_all:
+            for p, pl in ch_all:
+                if pl.get("smoke"):
+                    rows.append({
+                        "check": "slo/churn_growth", "source":
+                        os.path.basename(p), "ok": None,
+                        "note": "smoke churn round — different scale, "
+                                "not a trajectory datum",
+                    })
+        if ch:
+            last_path, last = ch[-1]
+            check("slo/churn_no_resurrection", last_path,
+                  last.get("no_resurrection_violations"), 0, 0,
+                  last.get("no_resurrection_violations") == 0)
+            check("slo/churn_join_completeness", last_path,
+                  last.get("join_completeness_violations"), 0, 0,
+                  last.get("join_completeness_violations") == 0)
+            naive = last.get("naive_no_resurrection_violations")
+            check("slo/churn_naive_demonstrates_failure", last_path,
+                  naive, "> 0", 1,
+                  isinstance(naive, (int, float)) and naive > 0)
+            p99 = last.get("join_propagation_p99_rounds")
+            jbound = last.get("join_propagation_bound_rounds")
+            if isinstance(p99, (int, float)) and isinstance(
+                    jbound, (int, float)):
+                check("slo/churn_join_propagation_within_bound",
+                      last_path, p99, jbound, jbound, p99 <= jbound)
+            else:
+                rows.append({
+                    "check": "slo/churn_join_propagation_within_bound",
+                    "source": os.path.basename(last_path), "ok": None,
+                    "note": "no join-propagation samples recorded — "
+                            "nothing to gate",
+                })
+            growth = last.get("net_growth_members")
+            check("slo/churn_net_positive_growth", last_path, growth,
+                  "> 0", 1,
+                  isinstance(growth, (int, float)) and growth > 0)
     return ok, rows
 
 

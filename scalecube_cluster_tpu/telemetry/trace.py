@@ -164,7 +164,8 @@ jax.tree_util.register_dataclass(
 
 
 def derive_event_codes(prev_status, prev_inc, new_status, new_inc,
-                       is_self, leaving_now, self_inc):
+                       is_self, leaving_now, self_inc,
+                       prev_epoch=None, new_epoch=None):
     """(codes, incarnations) of this round's net cell transitions.
 
     ``codes`` [N, K] int8: 0 = no event, else TraceEventType + 1.  The
@@ -181,7 +182,17 @@ def derive_event_codes(prev_status, prev_inc, new_status, new_inc,
     event is LEAVING, injected from the world's leave schedule with the
     announced incarnation self_inc + 1 (leaveCluster's DEAD@inc+1).
 
-    The four transition masks are mutually exclusive by construction
+    ``prev_epoch``/``new_epoch`` (the open-world identity lane,
+    models/swim.SwimState.epoch — None when the plane is off): a cell
+    whose stored EPOCH ADVANCED to a live record is a JOIN admission —
+    it codes ``JOINED``, disambiguating a NEW identity entering a
+    recycled slot from a same-identity re-add (which stays ``ADDED``).
+    The admission wins over every status-derived code for the cell
+    (e.g. a stale-ALIVE cell admitting the new identity is a JOINED,
+    not a silent ALIVE->ALIVE), keeping the one-event-per-cell
+    partition exact.
+
+    The transition masks are mutually exclusive by construction
     (they partition on the NEW status: ALIVE splits on the previous
     status, SUSPECT and DEAD each gate on not-already-there), so the
     code matrix is ONE weighted sum of disjoint masks — a single fused
@@ -197,6 +208,19 @@ def derive_event_codes(prev_status, prev_inc, new_status, new_inc,
     refuted = (prev == records.SUSPECT) & (new == records.ALIVE)
     removed = (new == records.DEAD) & (prev != records.DEAD)
 
+    joined = None
+    if prev_epoch is not None and jnp.asarray(prev_epoch).size:
+        joined = (
+            (jnp.asarray(new_epoch, jnp.int32)
+             > jnp.asarray(prev_epoch, jnp.int32))
+            & ((new == records.ALIVE) | (new == records.SUSPECT))
+        )
+        not_joined = ~joined
+        added &= not_joined
+        suspected &= not_joined
+        refuted &= not_joined
+        removed &= not_joined
+
     code = (
         added.astype(jnp.int8) * jnp.int8(TraceEventType.ADDED + 1)
         + suspected.astype(jnp.int8) * jnp.int8(TraceEventType.SUSPECTED + 1)
@@ -204,6 +228,9 @@ def derive_event_codes(prev_status, prev_inc, new_status, new_inc,
         * jnp.int8(TraceEventType.ALIVE_REFUTED + 1)
         + removed.astype(jnp.int8) * jnp.int8(TraceEventType.REMOVED + 1)
     )
+    if joined is not None:
+        code = code + joined.astype(jnp.int8) * jnp.int8(
+            TraceEventType.JOINED + 1)
     code = jnp.where(is_self, jnp.int8(0), code)
     code = jnp.where(leaving_now, jnp.int8(TraceEventType.LEAVING + 1), code)
 
@@ -275,10 +302,14 @@ def record_events_batch(trace: EventTrace, round_ids, codes, incarnations,
 
 
 def round_transition_codes(round_idx, prev_status, prev_inc, new_state,
-                           world, observer_offset: int = 0):
+                           world, observer_offset: int = 0,
+                           prev_epoch=None):
     """(codes, ev_inc) of one tick's net transitions (the derive half of
     :func:`observe_round` — split out so the fused scan can batch the
-    record half across rounds_per_step ticks)."""
+    record half across rounds_per_step ticks).  ``prev_epoch``: the
+    carry's identity-epoch lane BEFORE the tick (open-world plane; the
+    new lane rides in ``new_state.epoch``) — None disables the JOINED
+    disambiguation."""
     n = prev_status.shape[0]
     node_ids = jnp.arange(n, dtype=jnp.int32) + observer_offset
     is_self = jnp.asarray(world.subject_ids, jnp.int32)[None, :] \
@@ -287,6 +318,8 @@ def round_transition_codes(round_idx, prev_status, prev_inc, new_state,
     return derive_event_codes(
         prev_status, prev_inc, new_state.status, new_state.inc,
         is_self, leaving_now, new_state.self_inc,
+        prev_epoch=prev_epoch,
+        new_epoch=None if prev_epoch is None else new_state.epoch,
     )
 
 
@@ -310,29 +343,33 @@ def update_first_rounds(tel: TelemetryState, codes,
 
 def observe_round_codes(tel: TelemetryState, round_idx, prev_status,
                         prev_inc, new_state, world,
-                        observer_offset: int = 0):
+                        observer_offset: int = 0, prev_epoch=None):
     """(tel', codes, ev_inc) for one tick, with the WHOLE derivation +
     first-round update gated on a two-reduction predicate.
 
     Every event type requires a status transition (incarnation-only
     changes emit nothing) except LEAVING, which fires off the world's
-    leave schedule — so ``any(status changed) | any(leaving now)`` is an
-    exact emptiness test, and steady-state rounds (the overwhelming
-    majority) cost one [N, K] compare + one [N] compare instead of the
-    full derivation.  The silent branch returns all-zero codes, which
-    every consumer (record scatter, first-round updates) treats as the
-    identity — bit-identical to the ungated path.
+    leave schedule, and JOINED, which requires an epoch-lane change —
+    so ``any(status changed) | any(leaving now) [| any(epoch changed)]``
+    is an exact emptiness test, and steady-state rounds (the
+    overwhelming majority) cost one [N, K] compare + one [N] compare
+    instead of the full derivation.  The silent branch returns all-zero
+    codes, which every consumer (record scatter, first-round updates)
+    treats as the identity — bit-identical to the ungated path.
     """
     n = prev_status.shape[0]
     node_ids = jnp.arange(n, dtype=jnp.int32) + observer_offset
     pred = jnp.any(prev_status != new_state.status) | jnp.any(
         world.leave_at[node_ids] == round_idx
     )
+    if prev_epoch is not None and jnp.asarray(prev_epoch).size:
+        pred = pred | jnp.any(
+            jnp.asarray(prev_epoch) != jnp.asarray(new_state.epoch))
 
     def active(t):
         codes, ev_inc = round_transition_codes(
             round_idx, prev_status, prev_inc, new_state, world,
-            observer_offset,
+            observer_offset, prev_epoch=prev_epoch,
         )
         return update_first_rounds(t, codes, round_idx), codes, ev_inc
 
@@ -344,8 +381,8 @@ def observe_round_codes(tel: TelemetryState, round_idx, prev_status,
 
 
 def observe_round(tel: TelemetryState, round_idx, prev_status, prev_inc,
-                  new_state, world, observer_offset: int = 0
-                  ) -> TelemetryState:
+                  new_state, world, observer_offset: int = 0,
+                  prev_epoch=None) -> TelemetryState:
     """One round's telemetry update: derive transitions, record them,
     advance the first-suspect/first-removed matrices.
 
@@ -359,7 +396,7 @@ def observe_round(tel: TelemetryState, round_idx, prev_status, prev_inc,
     """
     tel, codes, ev_inc = observe_round_codes(
         tel, round_idx, prev_status, prev_inc, new_state, world,
-        observer_offset,
+        observer_offset, prev_epoch=prev_epoch,
     )
     trace = record_events(tel.trace, round_idx, codes, ev_inc,
                           world.subject_ids, observer_offset)

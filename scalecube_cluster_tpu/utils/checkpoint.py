@@ -38,24 +38,42 @@ def state_to_arrays(state: SwimState) -> dict:
     }
 
 
-def state_from_arrays(fields: dict, origin: str = "checkpoint") -> SwimState:
+def state_from_arrays(fields: dict, origin: str = "checkpoint",
+                      params=None) -> SwimState:
     """Inverse of :func:`state_to_arrays` (keys WITHOUT the ``state/``
     prefix).  Checkpoints written before the user-gossip fields existed
-    load as G=0 (zero-width arrays), and ones written before the
-    Lifeguard health lane existed load with the plane-off zero-size
-    ``lhm`` — the layouts params.n_user_gossips=0 / params.lhm_max=0
-    produce, so resume validation stays meaningful."""
+    load as G=0 (zero-width arrays), ones written before the Lifeguard
+    health lane existed load with the plane-off zero-size ``lhm``, and
+    ones written before the open-world identity lane existed load with
+    the plane-off zero-size ``epoch`` — the layouts
+    params.n_user_gossips=0 / params.lhm_max=0 / params.open_world=False
+    produce, so resume validation stays meaningful.
+
+    ``params`` (optional SwimParams): when given and the checkpoint
+    predates the epoch lane while the run expects it
+    (``params.open_world``), the lane defaults to ZERO-EPOCH — a full
+    [N, K] zeros lane in the params' carry dtype (every record
+    attributed to the original occupants, exactly the pre-open-world
+    semantics), so an open-world run can resume a legacy checkpoint
+    instead of refusing on shape mismatch."""
     fields = {k: jax.numpy.asarray(v) for k, v in fields.items()}
     missing = ({f.name for f in dataclasses.fields(SwimState)}
                - set(fields))
     if missing:
         n = fields["status"].shape[0]
+        if params is not None and getattr(params, "epoch_bits", 0):
+            from scalecube_cluster_tpu.models import swim as _swim
+            epoch_default = _swim.initial_epoch(params)
+        else:
+            epoch_default = jax.numpy.zeros(
+                (n, 0), dtype=jax.numpy.int32)
         g_defaults = {
             "g_infected": jax.numpy.zeros((n, 0), dtype=bool),
             "g_spread_until": jax.numpy.zeros(
                 (n, 0), dtype=jax.numpy.int32),
             "g_ring": jax.numpy.zeros((0, n, 0), dtype=bool),
             "lhm": jax.numpy.zeros((0,), dtype=jax.numpy.int32),
+            "epoch": epoch_default,
         }
         unknown = missing - set(g_defaults)
         if unknown:
@@ -100,14 +118,21 @@ def _atomic_savez(path: str, arrays: dict) -> None:
         raise
 
 
-def load(path: str) -> Tuple[SwimState, int, Optional[jax.Array], dict]:
-    """Load (state, next_round, key-or-None, meta) written by :func:`save`."""
+def load(path: str, params=None
+         ) -> Tuple[SwimState, int, Optional[jax.Array], dict]:
+    """Load (state, next_round, key-or-None, meta) written by :func:`save`.
+
+    ``params`` (optional SwimParams) forwards to
+    :func:`state_from_arrays`: pass the run's params when resuming a
+    legacy checkpoint into an OPEN-WORLD run, so a missing epoch lane
+    defaults to zero-epoch instead of the plane-off zero-size shape."""
     with np.load(path) as z:
         fields = {
             name[len("state/"):]: z[name]
             for name in z.files if name.startswith("state/")
         }
-        state = state_from_arrays(fields, origin=f"checkpoint {path}")
+        state = state_from_arrays(fields, origin=f"checkpoint {path}",
+                                  params=params)
         next_round = int(z["next_round"])
         key = None
         if "key_data" in z.files:
@@ -188,7 +213,8 @@ def run_checkpointed(run_fn, key, params, world, n_rounds: int, path: str,
     meta = json.loads(json.dumps(meta)) if meta is not None else None
     metrics_chunks = []
     if os.path.exists(path):
-        state, start_round, saved_key, saved_meta = load(path)
+        state, start_round, saved_key, saved_meta = load(path,
+                                                        params=params)
         if saved_key is not None:
             key = saved_key
         if meta is not None and saved_meta != meta:

@@ -1,0 +1,103 @@
+"""bench.py --churn --smoke: the open-world A/B JSON contract.
+
+Like tests/test_bench_lifeguard_smoke.py for the health plane: the
+bench is the one entry point the open-world measurement flows through,
+so this tier-1 test runs the real script in a subprocess (CPU) and pins
+the published contract — one JSON line with the A/B fields (the epoch
+guard holding zero NO_RESURRECTION / JOIN_COMPLETENESS violations with
+join propagation inside the bound, the naive control arm demonstrating
+the resurrection failure, net-positive growth), an
+artifacts/churn_growth.json-style artifact the query layer loads as a
+real payload, and the regress gate walking it with the absolute churn
+checks.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.openworld
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run_churn_bench(tmp_path, extra_env=None, timeout=540):
+    artifact = tmp_path / "churn_growth_smoke.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_TPU_TELEMETRY_DIR=str(tmp_path),
+        SCALECUBE_CHURN_ARTIFACT=str(artifact),
+        SCALECUBE_XLA_CACHE_DIR="",           # no cache writes from tests
+    )
+    env.pop("SCALECUBE_TPU_PROFILE_DIR", None)
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--churn", "--smoke"],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout      # exactly ONE JSON line
+    return json.loads(lines[0]), artifact
+
+
+def test_bench_churn_smoke_contract(tmp_path):
+    result, artifact = _run_churn_bench(tmp_path)
+
+    assert "error" not in result, result
+    assert result["smoke"] is True
+    assert result["metric"] == "churn_growth"
+    # value stays None BY DESIGN (absolute violation/latency gates must
+    # not enter the generic throughput walk); the payload says so.
+    assert result["value"] is None
+    assert "value_note" in result
+
+    # The headline acceptance: the guard arm is clean, the naive arm
+    # demonstrates the hazard, the joins propagate inside the bound,
+    # and the storm actually grew the cluster.
+    assert result["guard_green"] is True
+    assert result["no_resurrection_violations"] == 0
+    assert result["join_completeness_violations"] == 0
+    assert result["naive_no_resurrection_violations"] > 0
+    assert result["join_propagation_p99_rounds"] is not None
+    assert (result["join_propagation_p99_rounds"]
+            <= result["join_propagation_bound_rounds"])
+    assert result["net_growth_members"] > 0
+    assert result["joins_admitted"] > 0
+    assert result["joined_events"] > 0
+    # The identity-confusion refutation burn is a naive-arm property.
+    assert result["refutations_naive"] > result["refutations_guard"]
+
+    # Workload provenance: the seeded scenario and its repro line.
+    assert result["n_scenarios"] >= 1
+    assert result["delivery"] == "shift"
+    for row in result["scenarios"]:
+        assert "churn_growth_scenario" in row["repro"]
+        assert row["joined_events"] > 0
+
+    # The artifact landed and is a real query-layer payload with the
+    # absolute churn gates passing.
+    assert artifact.exists()
+    art = json.loads(artifact.read_text())
+    assert art["no_resurrection_violations"] == 0
+
+    from scalecube_cluster_tpu.telemetry import query
+
+    payload, note = query.load_bench_payload(str(artifact))
+    assert payload is not None, note
+    ok, rows = query.regress([str(artifact)])
+    assert ok, rows
+    checks = {r["check"] for r in rows if r.get("ok") is not None}
+    assert "slo/churn_no_resurrection" in checks
+    assert "slo/churn_naive_demonstrates_failure" in checks
+    assert "slo/churn_join_propagation_within_bound" in checks
+    assert "slo/churn_net_positive_growth" in checks
+
+    # The in-bench regress gate ran and passed.
+    assert result["regress"]["ok"] is True

@@ -1,0 +1,596 @@
+"""Open-world membership plane (PR 10): JOIN admission into recycled
+slots guarded by per-slot identity epochs.
+
+Pins, in order:
+
+  - the wire layer: the epoch-extended key layout (epoch directly under
+    the dead bit — ops/delivery.py), its fold order, and the merge
+    gate's cross-epoch semantics (lower drops, higher admits only via
+    ALIVE, admission overrides the dead-suppression window);
+  - the STRONG no-op contract (the PR-7/PR-9 pattern): open_world=True
+    with no scheduled joins is table+trace+metrics-identical to
+    open_world=False across full-view/focal/compact/wire16 layouts,
+    both delivery modes, the blocked tick, round fusion, and the
+    sharded pipelined==serial path;
+  - join semantics: every live observer admits the new identity (epoch
+    1, incarnation 0), the JOINED trace lane disambiguates admissions
+    from same-identity re-adds, a suppressed tombstone does not block
+    the join, and the naive-reuse control (epoch_guard=False) exhibits
+    the resurrection hazard the monitor's NO_RESURRECTION /
+    JOIN_COMPLETENESS codes count;
+  - layout/run-shape identity with joins ON: compact/wire16/k_block/
+    fused twins bit-identical, the five run shapes agreeing, and the
+    sharded pipelined path == the serial combine through a real join;
+  - checkpoint back-compat: a pre-epoch checkpoint loads as zero-epoch
+    for an open-world resume (utils/checkpoint.state_from_arrays);
+  - the oracle ground truth: a net-positive churn schedule with
+    mid-run ``Cluster.join`` replayed on the event-driven oracle
+    produces the same per-slot ADDED/SUSPECTED/REMOVED key sets
+    (chaos/campaign.cross_validate_churn).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu import records
+from scalecube_cluster_tpu.chaos import campaign as cc
+from scalecube_cluster_tpu.chaos import monitor as cmonitor
+from scalecube_cluster_tpu.chaos import scenarios as cs
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.ops import delivery
+from scalecube_cluster_tpu.telemetry import trace as ttrace
+from scalecube_cluster_tpu.telemetry.events import TraceEventType
+
+from tests.test_swim_model import fast_config
+
+pytestmark = pytest.mark.openworld
+
+INT32_MAX = int(jnp.iinfo(jnp.int32).max)
+
+
+def make(n, k=None, open_world=True, **overrides):
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, n_subjects=k,
+        open_world=open_world, **overrides,
+    )
+    return params, swim.SwimWorld.healthy(params)
+
+
+def crash_join_world(world, slot=3, crash_at=5, join_at=40):
+    return world.with_crash(slot, crash_at).with_join(slot, join_at)
+
+
+def assert_tables_equal(a, b, msg="", with_epoch=True):
+    np.testing.assert_array_equal(np.asarray(a.status),
+                                  np.asarray(b.status),
+                                  err_msg=f"{msg}: status")
+    np.testing.assert_array_equal(np.asarray(a.inc, dtype=np.int32),
+                                  np.asarray(b.inc, dtype=np.int32),
+                                  err_msg=f"{msg}: inc")
+    if with_epoch and a.epoch.size and b.epoch.size:
+        np.testing.assert_array_equal(
+            np.asarray(a.epoch, dtype=np.int32),
+            np.asarray(b.epoch, dtype=np.int32),
+            err_msg=f"{msg}: epoch")
+
+
+def assert_metrics_equal(ma, mb, msg=""):
+    assert set(ma) == set(mb), msg
+    for name in ma:
+        np.testing.assert_array_equal(np.asarray(ma[name]),
+                                      np.asarray(mb[name]),
+                                      err_msg=f"{msg}: metric {name}")
+
+
+# --------------------------------------------------------------------------
+# Wire layer
+# --------------------------------------------------------------------------
+
+
+class TestEpochWire:
+    def test_plane_off_layout_is_the_legacy_key(self):
+        st = jnp.asarray([records.ALIVE, records.SUSPECT, records.DEAD,
+                          records.ABSENT], jnp.int8)
+        inc = jnp.asarray([0, 5, 7, 3])
+        np.testing.assert_array_equal(
+            np.asarray(delivery.pack_record(st, inc)),
+            np.asarray(records.merge_key(st, inc)))
+        np.testing.assert_array_equal(
+            np.asarray(delivery.pack_record(st, inc, compact=True)),
+            np.asarray(records.merge_key16(st, inc)))
+
+    @pytest.mark.parametrize("compact,eb", [
+        (False, delivery.EPOCH_BITS_WIDE),
+        (True, delivery.EPOCH_BITS_COMPACT),
+    ])
+    def test_epoch_key_roundtrip_and_order(self, compact, eb):
+        st = jnp.asarray([records.ALIVE, records.SUSPECT, records.DEAD,
+                          records.ABSENT], jnp.int8)
+        inc = jnp.asarray([4, 9, 2, 0])
+        ep = jnp.asarray([1, 0, 2, 0])
+        key = delivery.pack_record(st, inc, compact=compact, epoch=ep,
+                                   epoch_bits=eb)
+        got_st, got_inc = delivery.unpack_record(key, compact=compact,
+                                                 epoch_bits=eb)
+        got_ep = delivery.unpack_epoch(key, compact=compact, epoch_bits=eb)
+        np.testing.assert_array_equal(np.asarray(got_st), np.asarray(st))
+        np.testing.assert_array_equal(np.asarray(got_inc),
+                                      np.asarray([4, 9, 2, 0]))
+        np.testing.assert_array_equal(np.asarray(got_ep),
+                                      np.asarray([1, 0, 2, 0]))
+        # is_alive_key is layout-invariant (dead bit + suspect bit
+        # positions are unchanged by the epoch field).
+        np.testing.assert_array_equal(
+            np.asarray(delivery.is_alive_key(key, compact=compact)),
+            np.asarray([True, False, False, False]))
+
+        def k(s, i, e):
+            return int(delivery.pack_record(jnp.int8(s), jnp.int32(i),
+                                            compact=compact, epoch=e,
+                                            epoch_bits=eb))
+
+        # Fold order: DEAD absorbs across epochs (the reference's rule
+        # 3 stays on top); within a liveness class a higher epoch
+        # outranks any incarnation of an older occupant.
+        assert k(records.DEAD, 0, 0) > k(records.ALIVE, 100, 1)
+        assert k(records.ALIVE, 0, 1) > k(records.ALIVE, 100, 0)
+        assert k(records.SUSPECT, 3, 1) > k(records.ALIVE, 3, 1)
+
+    def test_inc_saturation_cap_drops_by_epoch_bits(self):
+        p_off, _ = make(8, open_world=False)
+        p_on, _ = make(8)
+        assert swim._wire_inc_sat(p_off) == (1 << 29) - 1
+        assert swim._wire_inc_sat(p_on) == (
+            1 << (29 - delivery.EPOCH_BITS_WIDE)) - 1
+        p_c = dataclasses.replace(p_on, int16_wire=True)
+        assert swim._wire_inc_sat(p_c) == (
+            1 << (13 - delivery.EPOCH_BITS_COMPACT)) - 1
+
+    def test_naive_arm_epoch_bits_are_zero(self):
+        """The naive control arm runs the TRUE legacy wire: no lane, no
+        epoch field (SwimParams.epoch_bits docstring)."""
+        p, _ = make(8)
+        p_naive = dataclasses.replace(p, epoch_guard=False)
+        assert p.epoch_bits > 0
+        assert p_naive.epoch_bits == 0
+        assert swim.initial_epoch(p_naive).size == 0
+
+
+class TestEpochMergeGate:
+    EB = delivery.EPOCH_BITS_WIDE
+
+    def _merge(self, entry, key, any_alive=True, suppress=None,
+               guard=True):
+        st, inc, ep = entry
+        s, i, e, ch = delivery.merge_inbox(
+            jnp.asarray([st], jnp.int8), jnp.asarray([inc]),
+            jnp.asarray([key]), jnp.asarray([any_alive]),
+            suppress=None if suppress is None else jnp.asarray([suppress]),
+            entry_epoch=jnp.asarray([ep]), epoch_bits=self.EB,
+            epoch_guard=guard,
+        )
+        return s[0], i[0], e[0], ch
+
+    def _key(self, st, inc, ep):
+        return int(delivery.pack_record(jnp.int8(st), jnp.int32(inc),
+                                        epoch=ep, epoch_bits=self.EB))
+
+    def test_lower_epoch_records_drop(self):
+        """The old occupant's tombstone AND its hot ALIVE notice both
+        bounce off a higher-epoch record — the slot-recycling hazard."""
+        for st, inc in ((records.DEAD, 7), (records.ALIVE, 9),
+                        (records.SUSPECT, 9)):
+            s, i, e, ch = self._merge((records.ALIVE, 0, 1),
+                                      self._key(st, inc, 0))
+            assert (int(s), int(i), int(e)) == (records.ALIVE, 0, 1)
+            assert not bool(ch[0])
+
+    def test_higher_epoch_admits_only_alive(self):
+        s, i, e, ch = self._merge((records.DEAD, 7, 0),
+                                  self._key(records.ALIVE, 0, 1))
+        assert (int(s), int(i), int(e)) == (records.ALIVE, 0, 1)
+        assert bool(ch[0])
+        # A higher-epoch SUSPECT/DEAD is NOT an admission (the ABSENT
+        # null-gate rule applied per identity).
+        for st in (records.SUSPECT, records.DEAD):
+            s, i, e, ch = self._merge((records.DEAD, 7, 0),
+                                      self._key(st, 0, 1))
+            assert (int(s), int(e)) == (records.DEAD, 0)
+            assert not bool(ch[0])
+
+    def test_suppressed_tombstone_does_not_block_higher_epoch_join(self):
+        """The dead_suppress_rounds interplay pin: the window guards the
+        OLD identity's death notice, never a new identity's arrival."""
+        # Same-epoch ALIVE: suppressed (the PR-9 contract)...
+        s, _, _, ch = self._merge((records.DEAD, 7, 0),
+                                  self._key(records.ALIVE, 9, 0),
+                                  suppress=True)
+        assert int(s) == records.DEAD and not bool(ch[0])
+        # ...but the higher-epoch JOIN admits through it.
+        s, i, e, ch = self._merge((records.DEAD, 7, 0),
+                                  self._key(records.ALIVE, 0, 1),
+                                  suppress=True)
+        assert (int(s), int(i), int(e)) == (records.ALIVE, 0, 1)
+        assert bool(ch[0])
+
+    def test_same_epoch_gate_is_the_legacy_gate(self):
+        s, i, e, ch = self._merge((records.ALIVE, 3, 1),
+                                  self._key(records.SUSPECT, 3, 1))
+        assert (int(s), int(i), int(e)) == (records.SUSPECT, 3, 1)
+        assert bool(ch[0])
+
+    def test_guard_off_is_epoch_blind(self):
+        """The unit-level demonstration of what the guard changes: on
+        identical keys, the blind gate lets the old tombstone kill the
+        new identity."""
+        s, i, e, ch = self._merge((records.ALIVE, 0, 1),
+                                  self._key(records.DEAD, 7, 0),
+                                  guard=False)
+        assert (int(s), int(i), int(e)) == (records.DEAD, 7, 0)
+        assert bool(ch[0])
+
+
+# --------------------------------------------------------------------------
+# Strong no-op: plane on, no joins == plane off
+# --------------------------------------------------------------------------
+
+
+LAYOUTS = {
+    "fullview-shift": dict(n=16, delivery="shift"),
+    "fullview-scatter": dict(n=16, delivery="scatter"),
+    "focal-scatter": dict(n=24, k=8, delivery="scatter"),
+    "compact": dict(n=16, delivery="shift", compact_carry=True),
+    "wire16": dict(n=16, delivery="shift", int16_wire=True),
+    "blocked": dict(n=16, delivery="shift", k_block=4),
+    "fused": dict(n=16, delivery="shift", rounds_per_step=4),
+}
+
+
+class TestStrongNoOp:
+    @pytest.mark.parametrize("layout", sorted(LAYOUTS))
+    def test_plane_on_without_joins_is_identical(self, layout):
+        kw = dict(LAYOUTS[layout])
+        n = kw.pop("n")
+        k = kw.pop("k", None)
+        p_off, world = make(n, k=k, open_world=False, **kw)
+        p_on, _ = make(n, k=k, open_world=True, **kw)
+        # A little background churn (crash + leave, no joins) so the
+        # no-op holds through real fault machinery, not just warm idle.
+        world = world.with_crash(1, 6).with_leave(2, 9)
+        st_off, m_off = swim.run(jax.random.key(0), p_off, world, 48)
+        st_on, m_on = swim.run(jax.random.key(0), p_on, world, 48)
+        assert_tables_equal(st_off, st_on, msg=layout, with_epoch=False)
+        assert_metrics_equal(m_off, m_on, msg=layout)
+        # The lane exists, and nothing ever advanced an epoch.
+        if p_on.epoch_bits:
+            assert np.asarray(st_on.epoch).max(initial=0) == 0
+
+    def test_trace_identical_without_joins(self):
+        p_off, world = make(16, open_world=False)
+        p_on, _ = make(16, open_world=True)
+        world = world.with_crash(1, 6, 30)      # crash + revive re-add
+        _, tel_off, _ = swim.run_traced(jax.random.key(0), p_off, world, 64)
+        _, tel_on, _ = swim.run_traced(jax.random.key(0), p_on, world, 64)
+        ev_off = [e.key() for e in ttrace.decode_events(tel_off)]
+        ev_on = [e.key() for e in ttrace.decode_events(tel_on)]
+        assert ev_off == ev_on
+        # The revival re-add stays a plain ADDED (same identity — the
+        # JOINED lane is admissions only).
+        assert not any(e.event_type == TraceEventType.JOINED
+                       for e in ttrace.decode_events(tel_on))
+
+
+# --------------------------------------------------------------------------
+# Join semantics
+# --------------------------------------------------------------------------
+
+
+class TestJoinSemantics:
+    @pytest.mark.parametrize("mode", ["shift", "scatter"])
+    def test_every_observer_admits_the_new_identity(self, mode):
+        p, world = make(16, delivery=mode)
+        world = crash_join_world(world, slot=3, crash_at=5, join_at=40)
+        st, _ = swim.run(jax.random.key(0), p, world, 90)
+        stt = np.asarray(st.status)[:, 3]
+        ep = np.asarray(st.epoch)[:, 3]
+        inc = np.asarray(st.inc)[:, 3]
+        assert (stt == records.ALIVE).all()
+        assert (ep == 1).all()
+        assert (inc == 0).all()
+        assert int(np.asarray(st.self_inc)[3]) == 0
+
+    def test_joined_events_fire_for_admissions(self):
+        p, world = make(16)
+        world = crash_join_world(world, slot=3, crash_at=5, join_at=40)
+        _, tel, _ = swim.run_traced(jax.random.key(0), p, world, 90)
+        ev = ttrace.decode_events(tel)
+        joined = [e for e in ev if e.event_type == TraceEventType.JOINED]
+        assert {e.subject for e in joined} == {3}
+        assert all(e.incarnation == 0 and e.round >= 40 for e in joined)
+        # Every OTHER live member admits exactly once (the joiner's own
+        # self cell is pinned, not an event).
+        assert {e.observer for e in joined} == set(range(16)) - {3}
+        # The old identity's lifecycle stays on the legacy lanes.
+        assert any(e.event_type == TraceEventType.REMOVED
+                   and e.subject == 3 for e in ev)
+
+    def test_join_mid_suppression_window(self):
+        """A tombstone inside its dead_suppress_rounds window must not
+        block the join (the ISSUE's interplay requirement), and the
+        suppression expiry riding the deadline lane is cleared by the
+        admission."""
+        p, world = make(16, dead_suppress_rounds=64)
+        world = crash_join_world(world, slot=3, crash_at=5, join_at=44)
+        st, _ = swim.run(jax.random.key(0), p, world, 90)
+        assert (np.asarray(st.status)[:, 3] == records.ALIVE).all()
+        assert (np.asarray(st.epoch)[:, 3] == 1).all()
+        assert (np.asarray(st.suspect_deadline)[:, 3] == INT32_MAX).all()
+
+    def test_focal_mode_admission(self):
+        """Focal layout (K << N): a tracked subject's slot recycles and
+        every observer's column admits the new identity."""
+        p, world = make(24, k=8, delivery="scatter")
+        world = crash_join_world(world, slot=3, crash_at=5, join_at=40)
+        st, _ = swim.run(jax.random.key(0), p, world, 120)
+        col = 3                                  # subject_ids = arange(8)
+        assert (np.asarray(st.status)[:, col] == records.ALIVE).all()
+        assert (np.asarray(st.epoch)[:, col] == 1).all()
+        assert (np.asarray(st.inc)[:, col] == 0).all()
+
+    def test_delay_ring_rows_cleared_at_join(self):
+        """With delay modeling on, messages queued for the OLD occupant
+        die with it (the ring rows reset) and the admission still
+        propagates.  (A mean delay near the ping budget legitimately
+        false-suspects live members in this regime, so the pin is the
+        IDENTITY outcome: every cell admitted the new epoch and nobody
+        holds the new member DEAD.)"""
+        p, world = make(16, delivery="scatter", max_delay_rounds=2,
+                        mean_delay_ms=120.0)
+        world = crash_join_world(world, slot=3, crash_at=5, join_at=40)
+        st, _ = swim.run(jax.random.key(0), p, world, 120)
+        col = np.asarray(st.status)[:, 3]
+        assert ((col == records.ALIVE) | (col == records.SUSPECT)).all()
+        assert (np.asarray(st.epoch)[:, 3] == 1).all()
+
+    def test_joiner_bootstraps_via_seeds(self):
+        """With seeds configured, the joiner's cold row relearns the
+        cluster through the existing joiner<->seed SYNC round trip —
+        the reference's arrival path reused verbatim."""
+        p, world = make(16, delivery="scatter")
+        world = crash_join_world(world.with_seeds([0, 1]), slot=3,
+                                 crash_at=5, join_at=40)
+        st, _ = swim.run(jax.random.key(0), p, world, 120)
+        row = np.asarray(st.status)[3]
+        assert (row == records.ALIVE).sum() >= 14  # knows ~everyone
+
+    def test_naive_reuse_exhibits_resurrection(self):
+        """The A/B that motivates the plane (bench.py --churn): on the
+        canonical churn-growth storm the guard holds zero join-code
+        violations while the epoch-blind control arm provably holds
+        dead identities' records as live (NO_RESURRECTION > 0) and
+        burns incarnations refuting the ghost's death notices."""
+        scen = cs.churn_growth_scenario(seed=3, n=24)
+        p = cc.campaign_params(scen, delivery="shift")
+        assert p.open_world and p.epoch_guard
+        world, spec = scen.build(p)
+        assert spec.check_joins
+        _, mon, m = cmonitor.run_monitored(
+            jax.random.key(0), p, world, spec, scen.horizon)
+        v = cmonitor.verdict(mon)
+        assert v["green"], v["codes"]
+
+        p_naive = dataclasses.replace(p, epoch_guard=False)
+        world_n, spec_n = scen.build(p_naive)
+        _, mon_n, m_n = cmonitor.run_monitored(
+            jax.random.key(0), p_naive, world_n, spec_n, scen.horizon)
+        v_n = cmonitor.verdict(mon_n)
+        assert v_n["codes"]["NO_RESURRECTION"]["violations"] > 0
+        assert (int(np.asarray(m_n["refutations"]).sum())
+                > int(np.asarray(m["refutations"]).sum()))
+        # Net-positive growth: the storm ends with more live members
+        # than it started with.
+        alive0 = int(np.asarray(world.alive_at(0)).sum())
+        alive1 = int(np.asarray(world.alive_at(scen.horizon - 1)).sum())
+        assert alive1 > alive0
+
+
+# --------------------------------------------------------------------------
+# Layout / run-shape identity with joins ON
+# --------------------------------------------------------------------------
+
+
+class TestLayoutIdentityWithJoins:
+    def _world(self, p):
+        w = swim.SwimWorld.healthy(p)
+        return crash_join_world(w, slot=3, crash_at=5, join_at=26)
+
+    def test_compact_wire16_blocked_fused_identical(self):
+        p_wide, _ = make(16, delivery="shift")
+        world = self._world(p_wide)
+        st_ref, m_ref = swim.run(jax.random.key(1), p_wide, world, 60)
+        for name, kw in (("compact", dict(compact_carry=True)),
+                         ("wire16", dict(int16_wire=True)),
+                         ("blocked", dict(k_block=4)),
+                         ("fused", dict(rounds_per_step=4))):
+            p = dataclasses.replace(p_wide, **kw)
+            st, m = swim.run(jax.random.key(1), p, world, 60)
+            assert_tables_equal(st_ref, st, msg=name)
+            assert_metrics_equal(m_ref, m, msg=name)
+
+    def test_five_run_shapes_agree(self):
+        p, _ = make(16, delivery="shift")
+        world = self._world(p)
+        key = jax.random.key(1)
+        st_run, m_run = swim.run(key, p, world, 60)
+        st_tr, _, m_tr = swim.run_traced(key, p, world, 60)
+        st_me, _, m_me = swim.run_metered(key, p, world, 60)
+        spec = cmonitor.MonitorSpec.passive(p)
+        st_mo, _, m_mo = cmonitor.run_monitored(key, p, world, spec, 60)
+        st_mm, _, _, m_mm = cmonitor.run_monitored_metered(
+            key, p, world, spec, 60)
+        for name, st, m in (("traced", st_tr, m_tr),
+                            ("metered", st_me, m_me),
+                            ("monitored", st_mo, m_mo),
+                            ("monitored_metered", st_mm, m_mm)):
+            assert_tables_equal(st_run, st, msg=name)
+            assert_metrics_equal(m_run, m, msg=name)
+
+
+# --------------------------------------------------------------------------
+# Checkpoint back-compat
+# --------------------------------------------------------------------------
+
+
+class TestCheckpointBackCompat:
+    def test_pre_epoch_checkpoint_loads_as_zero_epoch(self, tmp_path):
+        from scalecube_cluster_tpu.utils import checkpoint as ckpt
+
+        p, world = make(12, open_world=False)
+        st = swim.initial_state(p, world)
+        arrays = ckpt.state_to_arrays(st)
+        del arrays["state/epoch"]               # a pre-PR-10 checkpoint
+        fields = {k[len("state/"):]: np.asarray(v)
+                  for k, v in arrays.items()}
+        # Plane-off load: the zero-size lane (the lhm pattern).
+        loaded = ckpt.state_from_arrays(dict(fields))
+        assert loaded.epoch.size == 0
+        # Open-world load with params: ZERO-EPOCH — a full lane of
+        # zeros in the params' carry dtype, so the resumed run treats
+        # every record as the original occupants'.
+        p_on, _ = make(12, open_world=True)
+        loaded_on = ckpt.state_from_arrays(dict(fields), params=p_on)
+        assert loaded_on.epoch.shape == (12, 12)
+        assert int(np.asarray(loaded_on.epoch).max()) == 0
+        p_c = dataclasses.replace(p_on, compact_carry=True,
+                                  delivery="shift")
+        loaded_c = ckpt.state_from_arrays(dict(fields), params=p_c)
+        assert loaded_c.epoch.dtype == jnp.int16
+
+    def test_epoch_lane_roundtrips(self, tmp_path):
+        from scalecube_cluster_tpu.utils import checkpoint as ckpt
+
+        p, world = make(12)
+        world = crash_join_world(world, slot=3, crash_at=5, join_at=26)
+        st, _ = swim.run(jax.random.key(0), p, world, 40)
+        path = str(tmp_path / "ck.npz")
+        ckpt.save(path, st, next_round=40)
+        loaded, nxt, _, _ = ckpt.load(path)
+        assert nxt == 40
+        assert_tables_equal(st, loaded, msg="roundtrip")
+        # Resume is bit-exact: 40+20 == 60 in one go.
+        st_resumed, _ = swim.run(jax.random.key(0), p, world, 20,
+                                 state=loaded, start_round=40)
+        st_full, _ = swim.run(jax.random.key(0), p, world, 60)
+        assert_tables_equal(st_full, st_resumed, msg="resume")
+
+
+# --------------------------------------------------------------------------
+# Sharded pipelined == serial through a real join
+# --------------------------------------------------------------------------
+
+
+def _has_shard_map():
+    from scalecube_cluster_tpu.parallel import compat
+    return compat.HAS_SHARD_MAP
+
+
+@pytest.mark.multichip
+@pytest.mark.skipif(not _has_shard_map(),
+                    reason="jax.shard_map unavailable")
+def test_sharded_pipelined_equals_serial_through_join():
+    from scalecube_cluster_tpu.parallel import mesh as pmesh
+
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    mesh = pmesh.make_mesh(8)
+    p, world = make(16, delivery="scatter")
+    world = crash_join_world(world, slot=3, crash_at=5, join_at=26)
+    key = jax.random.key(0)
+    f_ser, m_ser = pmesh.shard_run(key, p, world, 60, mesh,
+                                   pipelined=False)
+    f_pip, m_pip = pmesh.shard_run(key, p, world, 60, mesh,
+                                   pipelined=True)
+    assert_tables_equal(f_ser, f_pip, msg="pipelined")
+    assert_metrics_equal(m_ser, m_pip, msg="pipelined")
+    # And the join actually happened in the sharded run.
+    assert (np.asarray(f_ser.epoch)[:, 3] == 1).all()
+    assert (np.asarray(f_ser.status)[:, 3] == records.ALIVE).all()
+
+
+# --------------------------------------------------------------------------
+# Oracle ground truth: mid-run Cluster.join parity
+# --------------------------------------------------------------------------
+
+
+def test_oracle_mid_run_join_key_set_parity():
+    """A quiesced net-positive churn schedule (two permanent crashes,
+    two joins — one recycling a crashed slot, one consuming a pre-dead
+    free slot) replayed on the event-driven oracle with genuine mid-run
+    ``Cluster.join`` members: the model's ADDED/SUSPECTED/REMOVED key
+    sets match per slot over continuously-live observers (JOINED
+    normalizes to ADDED — campaign.cross_validate_churn)."""
+    n = 12
+    params = swim.SwimParams.from_config(cc.campaign_config(),
+                                         n_members=n)
+    # Quiesced: the old identities' deaths fully mature and go cold
+    # before the joins, so both layers reach the same terminal key sets
+    # (the cross_validate determinism precondition).
+    join_at = 8 + cs.quiesce_bound(params, n)
+    horizon = join_at + cs.completeness_bound(params, n) + 16
+    scen = cs.Scenario(
+        name="oracle-churn-join", n_members=n, horizon=horizon,
+        ops=(cs.Crash(3, at_round=8), cs.Crash(5, at_round=0),
+             cs.Join(3, at_round=join_at),
+             cs.Join(5, at_round=join_at + 2)),
+    )
+    diff = cc.cross_validate_churn(scen, seed=0)
+    assert diff is not None
+    assert diff["joins"] == 2 and diff["crashes"] == 2
+    assert diff["agree"], diff["slots"]
+
+
+def test_cross_validate_churn_inexpressible_returns_none():
+    n = 12
+    scen = cs.Scenario(          # no joins -> not a churn-join replay
+        name="nope", n_members=n, horizon=64,
+        ops=(cs.Crash(3, at_round=8),))
+    assert cc.cross_validate_churn(scen, seed=0) is None
+    scen2 = cs.Scenario(         # revive schedules are out of scope
+        name="nope2", n_members=n, horizon=64,
+        ops=(cs.Crash(3, at_round=8, until_round=20),
+             cs.Join(5, at_round=30)))
+    assert cc.cross_validate_churn(scen2, seed=0) is None
+
+
+# --------------------------------------------------------------------------
+# Full storm matrix (slow tier)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("delivery", ["shift", "scatter"])
+def test_churn_growth_matrix_guard_green(seed, delivery):
+    scen = cs.churn_growth_scenario(seed=seed, n=32)
+    p = cc.campaign_params(scen, delivery=delivery)
+    world, spec = scen.build(p)
+    _, mon, _ = cmonitor.run_monitored(
+        jax.random.key(seed), p, world, spec, scen.horizon)
+    v = cmonitor.verdict(mon)
+    assert v["green"], (scen.repro(), v["codes"])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("suppress", [0, 64])
+def test_churn_growth_matrix_suppress_interplay(suppress):
+    scen = cs.churn_growth_scenario(seed=11, n=32)
+    p = cc.campaign_params(scen, delivery="shift",
+                           dead_suppress_rounds=suppress)
+    world, spec = scen.build(p)
+    _, mon, _ = cmonitor.run_monitored(
+        jax.random.key(11), p, world, spec, scen.horizon)
+    v = cmonitor.verdict(mon)
+    assert v["green"], (suppress, v["codes"])

@@ -164,3 +164,70 @@ class TestCompositionEdges:
                   .with_crash(2, at_round=40, until_round=60))
         assert bool(w.alive_at(10)[2])       # first window clobbered
         assert not bool(w.alive_at(45)[2])
+
+
+# --------------------------------------------------------------------------
+# with_join validation (open-world JOIN schedule, PR 10)
+# --------------------------------------------------------------------------
+
+
+class TestWithJoinValidation:
+    """``with_join`` mirrors the crash/leave guards and enforces the
+    recycled-slot precondition: the slot must be scheduled dead
+    strictly before the join and still down AT the join round."""
+
+    def test_out_of_range_slot_raises(self):
+        _, world = make_world()
+        with pytest.raises(ValueError, match="with_join"):
+            world.with_crash(2, 5).with_join(99, 10)
+
+    def test_join_into_live_slot_raises(self):
+        _, world = make_world()
+        with pytest.raises(ValueError, match="LIVE slot"):
+            world.with_join(3, at_round=10)
+
+    def test_join_before_death_raises(self):
+        _, world = make_world()
+        with pytest.raises(ValueError, match="strictly after"):
+            world.with_crash(3, at_round=10).with_join(3, at_round=10)
+        with pytest.raises(ValueError, match="strictly after"):
+            world.with_crash(3, at_round=10).with_join(3, at_round=4)
+
+    def test_join_at_or_before_leave_raises(self):
+        _, world = make_world()
+        with pytest.raises(ValueError, match="strictly after"):
+            world.with_leave(3, at_round=10).with_join(3, at_round=10)
+
+    def test_join_over_scheduled_revival_raises(self):
+        """crash -> revive -> join would put two identities in sequence
+        with no death between the revival and the join — refuse."""
+        _, world = make_world()
+        with pytest.raises(ValueError, match="revive the OLD identity"):
+            (world.with_crash(3, at_round=5, until_round=20)
+                  .with_join(3, at_round=30))
+
+    def test_valid_join_revives_slot_as_new_epoch(self):
+        _, world = make_world()
+        w = world.with_crash(3, at_round=5).with_join(3, at_round=30)
+        assert int(w.join_at[3]) == 30
+        # Ground truth: dead during [5, 30), alive (new identity) after.
+        assert not bool(w.alive_at(10)[3])
+        assert bool(w.alive_at(30)[3])
+        assert int(w.epoch_at(29)[3]) == 0
+        assert int(w.epoch_at(30)[3]) == 1
+        assert bool(w.joining_at(30)[3])
+        assert not bool(w.joining_at(31)[3])
+
+    def test_join_after_leave_is_valid(self):
+        _, world = make_world()
+        w = world.with_leave(3, at_round=10).with_join(3, at_round=30)
+        assert int(w.join_at[3]) == 30
+        assert bool(w.alive_at(31)[3])
+
+    def test_second_join_without_second_death_raises(self):
+        """One join per slot per run: re-joining requires re-killing
+        first (the previous join's revival reads as a live occupant)."""
+        _, world = make_world()
+        w = world.with_crash(3, at_round=5).with_join(3, at_round=20)
+        with pytest.raises(ValueError, match="revive the OLD identity"):
+            w.with_join(3, at_round=40)
