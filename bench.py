@@ -127,6 +127,28 @@ pass pinned by tests/test_bench_churn_smoke.py.  Env overrides:
 SCALECUBE_CHURN_N, SCALECUBE_CHURN_SEED, SCALECUBE_CHURN_SCENARIOS,
 SCALECUBE_CHURN_SUPPRESS, SCALECUBE_CHURN_ARTIFACT.
 
+``--fuzz``: the vmapped chaos mega-campaign — scenario throughput as a
+SPEED metric and violation coverage as a QUALITY metric.  Thousands of
+seeded scenarios per severity tier (chaos/scenarios.
+generate_fuzz_campaign) are bucketed by compiled shape signature and
+each bucket is fuzzed by ONE device program (jax.vmap of the monitored
+scan over the scenario batch axis — chaos/monitor.run_monitored_batch),
+timed interleaved against the sequential one-dispatch-per-scenario
+loop on the SAME batch: ``vmap_speedup_ratio`` must stay >= 1 (compile/
+dispatch amortization has to pay on any host).  A COVERAGE arm reruns
+the completeness-promising slice of the batch on a deliberately-
+weakened build (suspicion timers stretched past the horizon —
+chaos.campaign.weakened_knobs, a dynamic-knobs change that reuses the
+healthy batch's compiled program) and requires the fuzzer to FIND the
+planted violations while the healthy arm found none.  Writes an
+``artifacts/fuzz_campaign.json``-style artifact (smoke runs get
+``fuzz_campaign_smoke.json`` — provenance, the sync-heal convention)
+walked by ``telemetry regress``.  ``--fuzz --smoke`` is the
+tier-1-safe mini batch pinned by tests/test_bench_fuzz_smoke.py.  Env
+overrides: SCALECUBE_FUZZ_N, SCALECUBE_FUZZ_SEEDS_PER_TIER,
+SCALECUBE_FUZZ_SEED, SCALECUBE_FUZZ_REPS, SCALECUBE_FUZZ_CAPACITY,
+SCALECUBE_FUZZ_ARTIFACT.
+
 Env overrides for debugging: SCALECUBE_BENCH_N, SCALECUBE_BENCH_ROUNDS,
 SCALECUBE_BENCH_DELIVERY, SCALECUBE_BENCH_SKIP_CANARY,
 SCALECUBE_BENCH_COMPACT (=1: the capacity-oriented compact carry layout,
@@ -1727,6 +1749,192 @@ def run_churn_bench():
     print(json.dumps(result), flush=True)
 
 
+def run_fuzz_bench():
+    """The --fuzz mode: the vmapped chaos mega-campaign (module
+    docstring) — one JSON line out (never-ship-empty).
+
+    Three stages, all over the SAME generated scenario batch:
+
+      1. *verdict pass* — the batch bucketed by compiled shape and run
+         through ``chaos.run_campaign_vmapped`` (this also warms the
+         vmapped compiles and writes the JSONL manifest with its
+         ``chaos_bucket`` rows — bucket sizes are never silent);
+      2. *speed* — sequential one-``run_monitored``-per-scenario sweep
+         vs the per-bucket vmapped sweep, interleaved best-of windows
+         (the ``interleaved_best_of`` discipline): scenarios/sec,
+         aggregate member-rounds/sec, and ``vmap_speedup_ratio``;
+      3. *coverage* — the completeness-promising slice rerun on the
+         deliberately-weakened build (``chaos.weakened_knobs``: a
+         dynamic-knobs change, so the rerun reuses the healthy
+         compiled programs): the fuzzer must FIND the planted
+         violations (> 0) while the healthy arm found none.
+
+    ``value`` stays None by design: scenarios/sec is host-dependent and
+    the quality gates are absolute — regress walks the dedicated fuzz
+    checks instead (telemetry/query.py).
+    """
+    result = {
+        "metric": "fuzz_campaign",
+        "value": None,
+        "unit": "scenarios/sec",
+        "smoke": SMOKE,
+    }
+    artifact = (os.environ.get("SCALECUBE_FUZZ_ARTIFACT")
+                or os.path.join("artifacts",
+                                "fuzz_campaign_smoke.json" if SMOKE
+                                else "fuzz_campaign.json"))
+    try:
+        jax, platform = init_backend()
+        result["platform"] = platform
+
+        from scalecube_cluster_tpu.chaos import campaign as ccampaign
+        from scalecube_cluster_tpu.chaos import monitor as cmonitor
+        from scalecube_cluster_tpu.chaos import scenarios as cscenarios
+        from scalecube_cluster_tpu.telemetry import sink as tsink
+        from scalecube_cluster_tpu.utils import runlog
+
+        n = int(os.environ.get("SCALECUBE_FUZZ_N", 16 if SMOKE else 32))
+        per_tier = int(os.environ.get("SCALECUBE_FUZZ_SEEDS_PER_TIER",
+                                      1 if SMOKE else 334))
+        seed = int(os.environ.get("SCALECUBE_FUZZ_SEED", 100))
+        reps = int(os.environ.get("SCALECUBE_FUZZ_REPS", 2))
+        # Evidence-lane capacity: the monitor carries [B, capacity, 5]
+        # through the batched scan, so the fuzz path trims the buffer
+        # (green runs need none; exact per-code totals are uncapped
+        # either way) — applied to BOTH timed arms for a fair ratio.
+        capacity = int(os.environ.get("SCALECUBE_FUZZ_CAPACITY", 256))
+
+        scens = cscenarios.generate_fuzz_campaign(seed, per_tier, n=n)
+        member_rounds = sum(s.n_members * s.horizon for s in scens)
+        rlog = runlog.get_logger("bench")
+        buckets = ccampaign.build_buckets(scens, seed=seed,
+                                          delivery="shift", log=rlog)
+        log(f"fuzz: {len(scens)} scenarios ({per_tier}/tier) at n={n} -> "
+            f"{len(buckets)} compile buckets "
+            f"(sizes {[b.size for b in buckets]}), "
+            f"{member_rounds} member-rounds per sweep")
+
+        def force(mon):
+            runlog.completion_barrier(mon.code_counts)
+
+        # ---- stage 1: verdicts + manifest (vmapped compile warm-up) ----
+        t0 = time.time()
+        with tsink.TelemetrySink.from_env(
+                default_dir=os.path.join("artifacts", "telemetry"),
+                prefix="fuzz-smoke" if SMOKE else "fuzz") as sink:
+            campaign_res = ccampaign.run_campaign_vmapped(
+                scens, seed=seed, delivery="shift", capacity=capacity,
+                sink=sink, log=rlog, buckets=buckets)
+        summary = campaign_res.summary()
+        log(f"fuzz verdict pass: {summary['green_scenarios']}/"
+            f"{summary['scenarios']} green in {time.time() - t0:.1f}s "
+            f"(vmapped compiles included)")
+
+        # ---- stage 2: interleaved sequential-vs-vmapped timing ---------
+        def seq_sweep(rep=0):
+            mon = None
+            for b in buckets:
+                for i, (world, spec) in zip(b.indices, b.members):
+                    _, mon, _ = cmonitor.run_monitored(
+                        jax.random.key(seed + i), b.params, world, spec,
+                        b.horizon, capacity=capacity)
+            force(mon)
+
+        def vmap_sweep(rep=0):
+            mon = None
+            for b in buckets:
+                mon, _ = ccampaign.run_bucket(b, capacity=capacity)
+            force(mon)
+
+        t0 = time.perf_counter()
+        seq_sweep()
+        log(f"fuzz: sequential compile+first sweep took "
+            f"{time.perf_counter() - t0:.1f}s")
+        s_best, v_best = interleaved_best_of(seq_sweep, vmap_sweep, reps)
+        ratio = round(s_best / v_best, 4)
+        seq_rate = len(scens) / s_best
+        vmap_rate = len(scens) / v_best
+        log(f"fuzz: sequential {s_best:.3f}s vs vmapped {v_best:.3f}s "
+            f"per sweep (best of {reps}, interleaved) -> "
+            f"{seq_rate:.2f} / {vmap_rate:.2f} scenarios/sec "
+            f"(vmap speedup x{ratio})")
+
+        # ---- stage 3: weakened-build coverage arm ----------------------
+        t0 = time.time()
+        cov, weak_counts, first_red = ccampaign.run_weakened_slice(
+            buckets, capacity=capacity)
+        healthy_on_slice = sum(
+            campaign_res.verdicts[i].verdict["total_violations"]
+            for i in cov)
+        first_repro = None
+        if first_red is not None:
+            first_repro = (
+                f"chaos.run_scenario({scens[first_red].repro()}, "
+                f"seed={seed + first_red}, delivery='shift', "
+                f"knobs=lambda p: chaos.weakened_knobs(None, p))")
+        weak_by_code = {
+            cmonitor.InvariantCode(c).name: int(weak_counts[c])
+            for c in range(cmonitor.N_CODES) if weak_counts[c]
+        }
+        coverage = {
+            "scenarios": len(cov),
+            "weakened_violations": int(weak_counts.sum()),
+            "weakened_by_code": weak_by_code,
+            "healthy_violations": int(healthy_on_slice),
+            "planted": ("suspicion timers stretched past the horizon "
+                        "(chaos.weakened_knobs): permanent crashes are "
+                        "never removed, so every completeness-promising "
+                        "scenario must trip COMPLETENESS"),
+            "first_repro": first_repro,
+        }
+        log(f"fuzz coverage arm: {len(cov)} completeness-promising "
+            f"scenarios, weakened violations "
+            f"{coverage['weakened_violations']} {weak_by_code}, healthy "
+            f"violations {healthy_on_slice} ({time.time() - t0:.1f}s, "
+            f"compiled programs reused)")
+
+        result.update(
+            scenario_throughput=round(vmap_rate, 3),
+            scenario_throughput_sequential=round(seq_rate, 3),
+            member_rounds_per_sec=round(member_rounds / v_best, 1),
+            vmap_speedup_ratio=ratio,
+            scenarios=len(scens),
+            seeds_per_tier=per_tier,
+            green=summary["green"],
+            green_scenarios=summary["green_scenarios"],
+            violations_by_code=summary["violations_by_code"],
+            failing_repros=summary["failing_repros"][:8],
+            buckets=campaign_res.buckets,
+            coverage=coverage,
+            n_members=n,
+            seed=seed,
+            capacity=capacity,
+            delivery="shift",
+            manifest=campaign_res.manifest_path,
+            value_note=("value stays null by design: scenarios/sec is "
+                        "host-dependent and the coverage gates are "
+                        "absolute — regress walks the dedicated fuzz "
+                        "checks instead"),
+        )
+
+        art = dict(result)
+        os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        result["artifact"] = artifact
+        log(f"fuzz artifact written to {artifact}")
+
+        apply_regress_gate(
+            result, ["BENCH_*.json",
+                     os.path.join("artifacts", "fuzz_campaign*.json"),
+                     artifact])
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -1788,6 +1996,15 @@ def main():
              "parity) into an artifacts/lifeguard_fp.json-style "
              "artifact; combine with --smoke for the tier-1-safe "
              "single-scenario pass",
+    )
+    parser.add_argument(
+        "--fuzz", action="store_true",
+        help="run the vmapped chaos mega-campaign instead: thousands of "
+             "seeded scenarios bucketed by compiled shape and fuzzed by "
+             "one device program per bucket, sequential-vs-vmapped "
+             "timing + a weakened-build coverage arm into an "
+             "artifacts/fuzz_campaign.json-style artifact; combine "
+             "with --smoke for the tier-1-safe mini batch",
     )
     mode = parser.add_mutually_exclusive_group()
     mode.add_argument(
@@ -1856,6 +2073,14 @@ def main():
             parser.error(
                 "--churn measures the open-world membership A/B on its "
                 "own workload — drop the other mode flags")
+        if args.fuzz and (args.chaos or args.resilience or args.metrics
+                          or args.multichip or args.sync
+                          or args.lifeguard or args.churn
+                          or args.traced or args.untraced
+                          or args.gap_artifact):
+            parser.error(
+                "--fuzz runs the vmapped chaos mega-campaign on its own "
+                "workload — drop the other mode flags")
     except SystemExit as e:
         # The one-JSON-line contract holds even for a bad argv: argparse
         # already printed its usage message to stderr; ship the error
@@ -1884,6 +2109,8 @@ def main():
         return run_lifeguard_bench()
     if args.churn:
         return run_churn_bench()
+    if args.fuzz:
+        return run_fuzz_bench()
 
     result = {
         "metric": "swim_member_rounds_per_sec_per_chip",

@@ -1,0 +1,145 @@
+"""Vmapped chaos mega-campaign sweep: thousands of seeded scenarios per
+compile bucket, with optional weakened-build coverage + minimization.
+
+The experiment driver over the fuzz engine (chaos/campaign.
+build_buckets + run_campaign_vmapped): generates ``--seeds-per-tier``
+scenarios PER severity tier (chaos.generate_fuzz_campaign), buckets
+them by compiled shape signature, fuzzes each bucket with ONE device
+program, and prints the verdict summary plus the bucket histogram (the
+no-silent-caps accounting).  ``--weakened`` additionally reruns the
+completeness-promising slice on the deliberately-weakened build
+(chaos.weakened_knobs — suspicion timers stretched past the horizon; a
+dynamic-knobs change, so the rerun reuses the healthy compiled
+programs) and reports the planted violations the fuzzer found;
+``--minimize`` shrinks the first weakened violation to its guilty op
+(chaos.campaign.minimize) and prints the one-line repro.
+
+The regress-gated speed/quality artifact comes from ``bench.py --fuzz``
+(artifacts/fuzz_campaign.json); this driver writes a side artifact
+(default ``artifacts/fuzz_sweep.json`` — outside the regress glob) for
+ad-hoc sweeps at arbitrary scale.
+
+Usage:
+    python experiments/fuzz_campaign.py                  # 334/tier, n=32
+    python experiments/fuzz_campaign.py --seeds-per-tier 40 --n 24
+    python experiments/fuzz_campaign.py --weakened --minimize
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=100,
+                   help="campaign base seed (scenario i uses seed+i)")
+    p.add_argument("--seeds-per-tier", type=int, default=334,
+                   help="scenarios per severity tier (334 -> 1002 total)")
+    p.add_argument("--n", type=int, default=32, help="members per scenario")
+    p.add_argument("--delivery", choices=["scatter", "shift"],
+                   default="shift")
+    p.add_argument("--capacity", type=int, default=256,
+                   help="violation evidence lanes per scenario")
+    p.add_argument("--weakened", action="store_true",
+                   help="also rerun the completeness-promising slice on "
+                        "the weakened build (planted-violation coverage)")
+    p.add_argument("--minimize", action="store_true",
+                   help="shrink the first weakened violation to its "
+                        "guilty op and print the one-line repro "
+                        "(implies --weakened)")
+    p.add_argument("--out", default=os.path.join("artifacts",
+                                                 "fuzz_sweep.json"))
+    args = p.parse_args()
+    if args.minimize:
+        args.weakened = True
+
+    from scalecube_cluster_tpu import chaos
+    from scalecube_cluster_tpu.chaos import campaign as cc
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+    from scalecube_cluster_tpu.utils import runlog
+
+    log = runlog.get_logger("fuzz")
+    scens = chaos.generate_fuzz_campaign(args.seed, args.seeds_per_tier,
+                                         n=args.n)
+    t0 = time.time()
+    buckets = cc.build_buckets(scens, seed=args.seed,
+                               delivery=args.delivery, log=log)
+    log.info("%d scenarios -> %d compile buckets (sizes %s)",
+             len(scens), len(buckets),
+             sorted((b.size for b in buckets), reverse=True))
+    with tsink.TelemetrySink.from_env(
+            default_dir=os.path.join("artifacts", "telemetry"),
+            prefix="fuzz-sweep") as sink:
+        result = cc.run_campaign_vmapped(
+            scens, seed=args.seed, delivery=args.delivery,
+            capacity=args.capacity, sink=sink, log=log, buckets=buckets)
+    elapsed = time.time() - t0
+    summary = result.summary()
+    log.info("mega-campaign: %d/%d green in %.1fs (%.2f scenarios/sec "
+             "incl. compiles) -> %s", summary["green_scenarios"],
+             summary["scenarios"], elapsed, len(scens) / elapsed,
+             result.manifest_path)
+    for line in summary["failing_repros"][:10]:
+        log.info("RED %s", line)
+
+    artifact = {
+        "metric": "fuzz_sweep",
+        "seed": args.seed,
+        "seeds_per_tier": args.seeds_per_tier,
+        "n_members": args.n,
+        "delivery": args.delivery,
+        "elapsed_sec": round(elapsed, 1),
+        "buckets": result.buckets,
+        "manifest": result.manifest_path,
+        **summary,
+    }
+
+    if args.weakened:
+        t0 = time.time()
+        cov, weak_counts, first_red = cc.run_weakened_slice(
+            buckets, capacity=args.capacity)
+        weak_total = int(weak_counts.sum())
+        healthy = sum(result.verdicts[i].verdict["total_violations"]
+                      for i in cov)
+        log.info("weakened coverage: %d planted violations over %d "
+                 "scenarios (healthy arm: %d) in %.1fs",
+                 weak_total, len(cov), healthy, time.time() - t0)
+        artifact["coverage"] = {"scenarios": len(cov),
+                                "weakened_violations": weak_total,
+                                "healthy_violations": healthy}
+        if args.minimize and first_red is not None:
+            # The candidates must replay on the SAME weakened build the
+            # violation was found on, or nothing reproduces and nothing
+            # shrinks — minimize's run= hook (+ repro_args, so the
+            # emitted line carries the weakening too).
+            def weak_run(s):
+                return cc.run_scenario(
+                    s, seed=args.seed + first_red,
+                    delivery=args.delivery,
+                    knobs=lambda p: cc.weakened_knobs(s, p))
+
+            minimized = cc.minimize(
+                weak_run(scens[first_red]), run=weak_run, log=log,
+                repro_args="knobs=lambda p: "
+                           "chaos.weakened_knobs(None, p)")
+            log.info("minimized (%d op(s) dropped): %s",
+                     minimized.dropped_ops, minimized.repro())
+            artifact["minimized_repro"] = minimized.repro()
+
+    tmp = args.out + ".tmp"
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, args.out)
+    print(json.dumps(artifact))
+    return 0 if result.green else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,8 @@ from scalecube_cluster_tpu.chaos.monitor import (  # noqa: F401
     MonitorState,
     decode_violations,
     run_monitored,
+    run_monitored_batch,
+    unstack_monitor,
     verdict,
 )
 from scalecube_cluster_tpu.chaos.scenarios import (  # noqa: F401
@@ -48,13 +50,21 @@ from scalecube_cluster_tpu.chaos.scenarios import (  # noqa: F401
     asymmetric_degraded_range,
     completeness_bound,
     generate_campaign,
+    generate_fuzz_campaign,
     generate_scenario,
 )
 from scalecube_cluster_tpu.chaos.campaign import (  # noqa: F401
     CampaignResult,
+    MinimizedRepro,
+    ScenarioBucket,
     ScenarioVerdict,
+    build_buckets,
     campaign_config,
     cross_validate,
+    minimize,
+    run_bucket,
     run_campaign,
+    run_campaign_vmapped,
     run_scenario,
+    weakened_knobs,
 )

@@ -113,6 +113,10 @@ class ScenarioVerdict:
 class CampaignResult:
     verdicts: List[ScenarioVerdict]
     manifest_path: Optional[str]
+    # Vmapped campaigns record their compile-shape buckets (size,
+    # horizon, n) — the no-silent-caps accounting of run_campaign_vmapped;
+    # None for the sequential runner.
+    buckets: Optional[List[dict]] = None
 
     @property
     def green(self) -> bool:
@@ -140,12 +144,16 @@ _COUNTER_KEYS = ("false_suspicion_onsets", "false_positives",
 def run_scenario(scenario: "cscenarios.Scenario", seed: int = 0,
                  delivery: str = "shift",
                  capacity: int = cmonitor.DEFAULT_CAPACITY,
-                 **param_overrides) -> ScenarioVerdict:
+                 knobs=None, **param_overrides) -> ScenarioVerdict:
     """Compile + run one scenario through the monitored scan.
 
     Never raises on a violated invariant — the run completes and the
     red verdict carries the evidence (graceful degradation); only a
     malformed scenario (DSL validation) raises, at build time.
+
+    ``knobs``: optional dynamic-knob override for the run — a
+    ``swim.Knobs`` or a callable ``params -> Knobs`` (the weakened-build
+    hook, :func:`weakened_knobs`); None runs the params' own schedule.
     """
     import jax
 
@@ -155,6 +163,7 @@ def run_scenario(scenario: "cscenarios.Scenario", seed: int = 0,
     _, mon, metrics = cmonitor.run_monitored(
         jax.random.key(seed), params, world, spec, scenario.horizon,
         capacity=capacity,
+        knobs=knobs(params) if callable(knobs) else knobs,
     )
     v = cmonitor.verdict(mon)
     counters = {
@@ -204,6 +213,366 @@ def run_campaign(scenarios: Sequence["cscenarios.Scenario"],
     if sink is not None:
         sink.write_record("chaos_verdict", result.summary())
     return result
+
+
+# --------------------------------------------------------------------------
+# The vmapped mega-campaign: bucket by compiled shape, fuzz per bucket
+# --------------------------------------------------------------------------
+
+
+def _bucket_key(params: "swim.SwimParams", horizon: int, world, spec):
+    """The compiled shape signature one vmapped batch must share: the
+    (hashable, static) params, the scan length, and the full treedef +
+    leaf shapes/dtypes of the built (world, spec) pytrees.  Everything
+    that picks an XLA program for the monitored scan is in here — rule
+    pad widths and partition-schedule lengths via the world leaf
+    shapes, the monitor's static check flags via the spec treedef."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((world, spec))
+    shapes = tuple((tuple(x.shape), str(x.dtype)) for x in leaves)
+    return (params, int(horizon), treedef, shapes)
+
+
+@dataclasses.dataclass
+class ScenarioBucket:
+    """One compile-shape bucket of a vmapped campaign: scenarios whose
+    :func:`_bucket_key` signatures are identical, their built
+    worlds/specs/keys/knobs stacked along a leading batch axis so ONE
+    device program (chaos/monitor.run_monitored_batch) fuzzes them all.
+    ``members`` keeps the unstacked (world, spec) pairs for the
+    sequential arm and per-row replays."""
+
+    indices: List[int]
+    scenarios: List["cscenarios.Scenario"]
+    params: "swim.SwimParams"
+    horizon: int
+    worlds: object                  # stacked SwimWorld pytree [B, ...]
+    specs: object                   # stacked MonitorSpec pytree [B, ...]
+    keys: object                    # [B] PRNG keys (seed + scenario index)
+    knobs: object                   # stacked swim.Knobs pytree [B]
+    members: List[tuple]            # unstacked [(world, spec)] per row
+
+    @property
+    def size(self) -> int:
+        return len(self.indices)
+
+
+def build_buckets(scenarios: Sequence["cscenarios.Scenario"],
+                  seed: int = 0, delivery: str = "shift",
+                  knobs_fn=None, log=None,
+                  **param_overrides) -> List[ScenarioBucket]:
+    """Bucket ``scenarios`` by compiled shape signature and stack each
+    bucket's built pytrees along a leading batch axis — the vmapped
+    mega-campaign input.  Row i keeps the sequential path's PRNG seed
+    ``seed + i`` (i = the scenario's position in the input list), so a
+    bucketed run's verdicts are bit-comparable to ``run_campaign`` on
+    the same list.
+
+    NEVER drops a scenario: every index lands in exactly ONE bucket —
+    singletons included (a batch of one still runs) — and bucket sizes
+    are logged per the no-silent-caps rule; ``run_campaign_vmapped``
+    additionally writes them into the manifest.
+
+    ``knobs_fn(scenario, params) -> swim.Knobs`` overrides the per-row
+    dynamic knobs (default ``Knobs.from_params``) — the deliberately-
+    weakened coverage arm's hook (:func:`weakened_knobs`); knob changes
+    are traced data, so they never split a bucket.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    groups: dict = {}
+    order: list = []
+    for i, scen in enumerate(scenarios):
+        params = campaign_params(scen, delivery=delivery,
+                                 **param_overrides)
+        world, spec = scen.build(params)
+        key = _bucket_key(params, scen.horizon, world, spec)
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append((i, scen, params, world, spec))
+
+    def stack(*xs):
+        return jnp.stack(xs)
+
+    buckets = []
+    for key in order:
+        members = groups[key]
+        params = members[0][2]
+        buckets.append(ScenarioBucket(
+            indices=[m[0] for m in members],
+            scenarios=[m[1] for m in members],
+            params=params,
+            horizon=members[0][1].horizon,
+            worlds=jax.tree_util.tree_map(stack, *[m[3] for m in members]),
+            specs=jax.tree_util.tree_map(stack, *[m[4] for m in members]),
+            keys=jnp.stack([jax.random.key(seed + m[0]) for m in members]),
+            knobs=jax.tree_util.tree_map(stack, *[
+                (knobs_fn(m[1], params) if knobs_fn is not None
+                 else swim.Knobs.from_params(params)) for m in members]),
+            members=[(m[3], m[4]) for m in members],
+        ))
+    if log is not None:
+        for b in buckets:
+            log.info(
+                "chaos bucket: %d scenario(s) @ n=%d horizon=%d "
+                "(first: %s)", b.size, b.params.n_members, b.horizon,
+                b.scenarios[0].name)
+    return buckets
+
+
+def run_bucket(bucket: ScenarioBucket,
+               capacity: int = cmonitor.DEFAULT_CAPACITY, knobs=None):
+    """One vmapped device call for one bucket.  Returns
+    ``(monitors, metrics)``, both with a leading batch axis; ``knobs``
+    overrides the bucket's stacked knobs (same pytree shapes -> the
+    weakened rerun reuses the compiled program)."""
+    _, mon, metrics = cmonitor.run_monitored_batch(
+        bucket.keys, bucket.params, bucket.worlds, bucket.specs,
+        bucket.horizon, capacity=capacity,
+        knobs=bucket.knobs if knobs is None else knobs)
+    return mon, metrics
+
+
+def run_campaign_vmapped(scenarios: Sequence["cscenarios.Scenario"],
+                         seed: int = 0, delivery: str = "shift",
+                         capacity: int = cmonitor.DEFAULT_CAPACITY,
+                         sink=None, log=None, knobs_fn=None,
+                         buckets: Optional[List[ScenarioBucket]] = None
+                         ) -> CampaignResult:
+    """The vmapped mega-campaign: ``scenarios`` bucketed by compiled
+    shape signature (:func:`build_buckets`), each bucket fuzzed by ONE
+    device program — a ``jax.vmap`` of the monitored scan over the
+    scenario batch axis — with per-scenario verdict extraction.  Row
+    i's verdict is exactly what sequential ``run_scenario(scenarios[i],
+    seed=seed + i)`` would produce (parity pinned tier-1 by
+    tests/test_chaos_fuzz.py).
+
+    The manifest mirrors ``run_campaign`` (manifest header,
+    ``chaos_scenario`` rows in scenario order, closing ``chaos_verdict``)
+    plus one ``chaos_bucket`` row per bucket — bucket sizes are never
+    silent.  ``buckets`` accepts prebuilt buckets (bench.py --fuzz
+    builds once and times several sweeps over them).
+    """
+    if buckets is None:
+        buckets = build_buckets(scenarios, seed=seed, delivery=delivery,
+                                knobs_fn=knobs_fn, log=log)
+    if sink is not None:
+        sink.write_manifest(
+            params=campaign_config(),
+            workload={"kind": "chaos_campaign_vmapped",
+                      "scenarios": len(scenarios), "seed": seed,
+                      "delivery": delivery,
+                      "bucket_sizes": [b.size for b in buckets]},
+        )
+    verdicts: List[Optional[ScenarioVerdict]] = [None] * len(scenarios)
+    for b in buckets:
+        mon_b, metrics_b = run_bucket(b, capacity=capacity)
+        rows = cmonitor.unstack_monitor(mon_b)
+        # One device->host transfer per counter key, not per (row, key).
+        host_counters = {k: np.asarray(metrics_b[k])
+                         for k in _COUNTER_KEYS if k in metrics_b}
+        for j, (i, scen, mon) in enumerate(zip(b.indices, b.scenarios,
+                                               rows)):
+            v = cmonitor.verdict(mon)
+            counters = {k: int(c[j].sum())
+                        for k, c in host_counters.items()}
+            verdicts[i] = ScenarioVerdict(
+                scenario=scen, green=v["green"], verdict=v,
+                seed=seed + i, delivery=delivery, counters=counters)
+        if sink is not None:
+            sink.write_record("chaos_bucket", {
+                "scenarios": b.size,
+                "n_members": b.params.n_members,
+                "horizon": b.horizon,
+                "green_scenarios": sum(
+                    1 for i in b.indices if verdicts[i].green),
+            })
+        if log is not None:
+            log.info("chaos bucket (%d scenarios, horizon %d): %d green",
+                     b.size, b.horizon,
+                     sum(1 for i in b.indices if verdicts[i].green))
+    if sink is not None:
+        for v in verdicts:
+            sink.write_record("chaos_scenario", v.to_json())
+    result = CampaignResult(
+        verdicts=verdicts,
+        manifest_path=getattr(sink, "path", None),
+        buckets=[{"scenarios": b.size, "n_members": b.params.n_members,
+                  "horizon": b.horizon} for b in buckets],
+    )
+    if sink is not None:
+        sink.write_record("chaos_verdict", result.summary())
+    return result
+
+
+def run_weakened_slice(buckets: List[ScenarioBucket],
+                       capacity: int = cmonitor.DEFAULT_CAPACITY,
+                       knobs_fn=None):
+    """The fuzz COVERAGE arm: rerun every bucket holding a
+    completeness-promising row (finite ``MonitorSpec.complete_by``) on
+    the deliberately-weakened build (``knobs_fn``, default
+    :func:`weakened_knobs`) and count what the fuzzer finds there —
+    shared by ``bench.py --fuzz`` and ``experiments/fuzz_campaign.py``
+    so the slice predicate and rerun protocol cannot drift.
+
+    Because the weakening is dynamic Knobs data, every rerun REUSES the
+    healthy batch's compiled programs (chaos/monitor.run_monitored_batch
+    docstring).  Returns ``(cov_indices, weak_counts, first_red)``:
+    the set of completeness-promising scenario indices, the summed
+    per-code violation totals (np.int64 [N_CODES]) over that slice on
+    the weakened build, and the first red row's index (None if the
+    weakened arm found nothing)."""
+    import jax
+    import jax.numpy as jnp
+
+    if knobs_fn is None:
+        knobs_fn = weakened_knobs
+    int32_max = int(np.iinfo(np.int32).max)
+    cov = {
+        i
+        for b in buckets
+        for i, (_, spec) in zip(b.indices, b.members)
+        if int(np.asarray(spec.complete_by).min()) < int32_max
+    }
+    weak_counts = np.zeros(cmonitor.N_CODES, dtype=np.int64)
+    first_red = None
+    for b in buckets:
+        if not any(i in cov for i in b.indices):
+            continue
+        kn_w = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[knobs_fn(s, b.params) for s in b.scenarios])
+        mon_w, _ = run_bucket(b, capacity=capacity, knobs=kn_w)
+        for i, mon in zip(b.indices, cmonitor.unstack_monitor(mon_w)):
+            if i not in cov:
+                continue
+            counts = np.asarray(mon.code_counts, dtype=np.int64)
+            weak_counts += counts
+            if first_red is None and counts.sum() > 0:
+                first_red = i
+    return cov, weak_counts, first_red
+
+
+def weakened_knobs(scenario: "cscenarios.Scenario",
+                   params: "swim.SwimParams") -> "swim.Knobs":
+    """The deliberately-WEAKENED build of the fuzz coverage arm
+    (``build_buckets``' ``knobs_fn`` signature): suspicion timers
+    stretched far past any campaign horizon (2^20 rounds), so
+    suspicions never mature into DEAD verdicts — permanently crashed
+    members are never removed, and every scenario that promises
+    completeness (finite ``MonitorSpec.complete_by``) must trip
+    COMPLETENESS past its deadline.  The fuzzer finding exactly these
+    planted violations (and the healthy build finding none) is the
+    coverage gate of ``bench.py --fuzz``.
+
+    A dynamic-knobs weakening on purpose: Knobs are traced data, so the
+    weakened rerun REUSES the healthy batch's compiled program
+    (chaos/monitor.run_monitored_batch docstring)."""
+    import jax.numpy as jnp
+
+    del scenario  # same weakening for every row; the hook passes it
+    return dataclasses.replace(
+        swim.Knobs.from_params(params),
+        suspicion_rounds=jnp.int32(1 << 20))
+
+
+# --------------------------------------------------------------------------
+# Minimizing reducer: violating scenario -> one-line repro
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MinimizedRepro:
+    """:func:`minimize`'s result: the shrunken (still-violating)
+    scenario, its red verdict, and the executable one-line repro."""
+
+    scenario: "cscenarios.Scenario"
+    verdict: ScenarioVerdict
+    dropped_ops: int
+    codes: List[str]
+    # Extra run_scenario kwargs the replay needs, verbatim (e.g. the
+    # weakened-build knobs) — without it a violation found through
+    # minimize()'s ``run=`` hook would print a line that replays the
+    # HEALTHY build and reproduces nothing.
+    repro_args: str = ""
+
+    def repro(self) -> str:
+        """One line that reproduces the minimized violation (everything
+        resolves under ``from scalecube_cluster_tpu import chaos``)."""
+        ops = ", ".join(f"chaos.{op!r}" for op in self.scenario.ops)
+        trail = "," if len(self.scenario.ops) == 1 else ""
+        extra = (f", extra_slack={self.scenario.extra_slack}"
+                 if self.scenario.extra_slack else "")
+        suffix = f", {self.repro_args}" if self.repro_args else ""
+        return (f"chaos.run_scenario(chaos.Scenario("
+                f"name={self.scenario.name!r}, "
+                f"n_members={self.scenario.n_members}, "
+                f"horizon={self.scenario.horizon}, ops=({ops}{trail}), "
+                f"loss_probability={self.scenario.loss_probability}"
+                f"{extra}), seed={self.verdict.seed}, "
+                f"delivery={self.verdict.delivery!r}{suffix})")
+
+
+def minimize(verdict: ScenarioVerdict, run=None, log=None,
+             repro_args: str = "") -> MinimizedRepro:
+    """Greedy minimizing reducer: drop ops from a violating scenario one
+    at a time (restarting the sweep after every successful drop) while
+    EVERY one of the verdict's violating codes still reproduces under
+    the same run seed/delivery — the emitted repro replays the whole
+    ``codes`` list, never just its loudest member — down to a local
+    minimum: usually the single guilty op, or one op per code when the
+    codes have different culprits.
+
+    ``run(scenario) -> ScenarioVerdict`` overrides the replay (default:
+    sequential :func:`run_scenario` with the verdict's seed/delivery) —
+    the hook that lets the weakened coverage arm minimize under its
+    weakened knobs.  When ``run`` departs from the default, pass the
+    departure as ``repro_args`` (verbatim ``run_scenario`` kwargs, e.g.
+    ``"knobs=lambda p: chaos.weakened_knobs(None, p)"``) so the emitted
+    one-line repro actually replays the failing build.  A candidate
+    whose op-drop breaks DSL composition (build-time validation) is
+    skipped, never fatal; a drop that surfaces NEW codes keeps only the
+    original codes as the reproduction predicate.
+    """
+    codes = [c for c, d in verdict.verdict["codes"].items()
+             if d["violations"] > 0]
+    if not codes:
+        raise ValueError("minimize() needs a violating verdict "
+                         "(all code totals are zero)")
+    if run is None:
+        def run(scen):
+            return run_scenario(scen, seed=verdict.seed,
+                                delivery=verdict.delivery)
+
+    cur_scen, cur_verdict = verdict.scenario, verdict
+    dropped = 0
+    progress = True
+    while progress and len(cur_scen.ops) > 1:
+        progress = False
+        for j in range(len(cur_scen.ops)):
+            cand = dataclasses.replace(
+                cur_scen, ops=cur_scen.ops[:j] + cur_scen.ops[j + 1:],
+                name=f"{verdict.scenario.name}-min",
+                seed=None, severity=None)
+            try:
+                v = run(cand)
+            except (ValueError, TypeError):
+                continue        # the drop broke DSL composition: keep op
+            if all(v.verdict["codes"][c]["violations"] > 0
+                   for c in codes):
+                cur_scen, cur_verdict = cand, v
+                dropped += 1
+                progress = True
+                if log is not None:
+                    log.info("minimize: dropped op %d -> %d op(s) left",
+                             j, len(cand.ops))
+                break
+    return MinimizedRepro(scenario=cur_scen, verdict=cur_verdict,
+                          dropped_ops=dropped, codes=codes,
+                          repro_args=repro_args)
 
 
 # --------------------------------------------------------------------------

@@ -278,16 +278,18 @@ def _record_flat(mon: MonitorState, mask, rows) -> MonitorState:
                                dropped=dropped)
 
 
-def check_round(mon: MonitorState, spec: MonitorSpec,
-                params: "swim.SwimParams", kn: "swim.Knobs", round_idx,
-                prev: "swim.SwimState", new: "swim.SwimState",
-                world: "swim.SwimWorld") -> MonitorState:
-    """Evaluate every invariant on one tick's (prev, new) WIDE carries.
+def _check_cells(spec: MonitorSpec, params: "swim.SwimParams",
+                 kn: "swim.Knobs", round_idx, prev: "swim.SwimState",
+                 new: "swim.SwimState", world: "swim.SwimWorld"):
+    """Evaluate every invariant on one tick's (prev, new) WIDE carries —
+    the pure mask/total computation, shared by the sequential
+    ``check_round`` and the batched scan (``run_monitored_batch``,
+    which needs the masks separately so its evidence-recording
+    ``lax.cond`` can gate on a BATCH-level predicate).
 
-    Pure jnp, called inside the scan body; the whole evidence-recording
-    pass runs under a ``lax.cond`` and is skipped unless a code trips
-    for the first time, so green rounds cost a handful of fused
-    elementwise reductions.
+    Returns ``(vio [N_CODES, N, K] bool, details [N_CODES, N, K] i32,
+    v_self_inc [N] bool, v_self_sat [N] bool, self_inc [N] i32,
+    totals [N_CODES] i32)``.
     """
     n, k = prev.status.shape
     node_ids = jnp.arange(n, dtype=jnp.int32)
@@ -473,12 +475,6 @@ def check_round(mon: MonitorState, spec: MonitorSpec,
     details = jnp.stack([ni, ni, jnp.where(has_timer, dl, -1), ni,
                          ns.astype(jnp.int32), ns.astype(jnp.int32),
                          ep_detail, ns.astype(jnp.int32)])
-    cell_code_of = jnp.asarray([
-        InvariantCode.FALSE_SUSPICION, InvariantCode.INC_REGRESSION,
-        InvariantCode.TIMER_BOUND, InvariantCode.WIRE_SATURATION,
-        InvariantCode.COMPLETENESS, InvariantCode.POST_HEAL_DIVERGENCE,
-        InvariantCode.NO_RESURRECTION, InvariantCode.JOIN_COMPLETENESS,
-    ], dtype=jnp.int32)
 
     # Self-incarnation lanes (subject == observer): regression + cap.
     # A joining node is REBORN at incarnation 0 — exempt.
@@ -491,6 +487,63 @@ def check_round(mon: MonitorState, spec: MonitorSpec,
               .add(jnp.sum(v_self_inc, dtype=jnp.int32))
               .at[InvariantCode.WIRE_SATURATION]
               .add(jnp.sum(v_self_sat, dtype=jnp.int32)))
+    return vio, details, v_self_inc, v_self_sat, new.self_inc, totals
+
+
+def _record_round(mon: MonitorState, round_idx, vio, details, v_self_inc,
+                  v_self_sat, self_inc, subject_ids,
+                  fresh) -> MonitorState:
+    """The evidence-recording pass for one round's ``_check_cells``
+    output: first-trip lanes of every freshly tripped code compacted
+    into the buffer (``_record_flat``).  A NO-OP when nothing fresh
+    tripped (every mask cell is false), which is what lets callers run
+    it under a ``lax.cond`` whose predicate covers a whole batch."""
+    n = v_self_inc.shape[0]
+    node_ids = jnp.arange(n, dtype=jnp.int32)
+    cell_code_of = jnp.asarray([
+        InvariantCode.FALSE_SUSPICION, InvariantCode.INC_REGRESSION,
+        InvariantCode.TIMER_BOUND, InvariantCode.WIRE_SATURATION,
+        InvariantCode.COMPLETENESS, InvariantCode.POST_HEAL_DIVERGENCE,
+        InvariantCode.NO_RESURRECTION, InvariantCode.JOIN_COMPLETENESS,
+    ], dtype=jnp.int32)
+    cell_fresh = fresh[cell_code_of][:, None, None]
+    obs_grid = jnp.broadcast_to(node_ids[None, :, None], vio.shape)
+    subj_grid = jnp.broadcast_to(subject_ids[None, None, :], vio.shape)
+    code_grid = jnp.broadcast_to(cell_code_of[:, None, None], vio.shape)
+    mask = jnp.concatenate([
+        (vio & cell_fresh).reshape(-1),
+        v_self_inc & fresh[InvariantCode.INC_REGRESSION],
+        v_self_sat & fresh[InvariantCode.WIRE_SATURATION],
+    ])
+    self_codes = (
+        jnp.full((n,), InvariantCode.INC_REGRESSION, jnp.int32),
+        jnp.full((n,), InvariantCode.WIRE_SATURATION, jnp.int32),
+    )
+    rows = jnp.stack([
+        jnp.full(mask.shape, round_idx, dtype=jnp.int32),
+        jnp.concatenate([obs_grid.reshape(-1), node_ids, node_ids]),
+        jnp.concatenate([subj_grid.reshape(-1), node_ids, node_ids]),
+        jnp.concatenate([code_grid.reshape(-1), *self_codes]),
+        jnp.concatenate([details.reshape(-1), self_inc, self_inc]),
+    ], axis=1)
+    return _record_flat(mon, mask, rows)
+
+
+def check_round(mon: MonitorState, spec: MonitorSpec,
+                params: "swim.SwimParams", kn: "swim.Knobs", round_idx,
+                prev: "swim.SwimState", new: "swim.SwimState",
+                world: "swim.SwimWorld") -> MonitorState:
+    """Evaluate every invariant on one tick's (prev, new) WIDE carries
+    (``_check_cells``) and fold the result into the monitor carry.
+
+    Pure jnp, called inside the scan body; the whole evidence-recording
+    pass runs under a ``lax.cond`` and is skipped unless a code trips
+    for the first time, so green rounds cost a handful of fused
+    elementwise reductions.
+    """
+    vio, details, v_self_inc, v_self_sat, self_inc, totals = _check_cells(
+        spec, params, kn, round_idx, prev, new, world)
+    subject_ids = jnp.asarray(world.subject_ids, jnp.int32)
 
     fresh = mon.code_counts == 0                          # [N_CODES]
     new_counts = mon.code_counts + totals
@@ -499,32 +552,11 @@ def check_round(mon: MonitorState, spec: MonitorSpec,
         mon.code_first_round,
     )
 
-    def record(m: MonitorState) -> MonitorState:
-        cell_fresh = fresh[cell_code_of][:, None, None]
-        obs_grid = jnp.broadcast_to(node_ids[None, :, None], vio.shape)
-        subj_grid = jnp.broadcast_to(subject_ids[None, None, :], vio.shape)
-        code_grid = jnp.broadcast_to(cell_code_of[:, None, None], vio.shape)
-        mask = jnp.concatenate([
-            (vio & cell_fresh).reshape(-1),
-            v_self_inc & fresh[InvariantCode.INC_REGRESSION],
-            v_self_sat & fresh[InvariantCode.WIRE_SATURATION],
-        ])
-        self_codes = (
-            jnp.full((n,), InvariantCode.INC_REGRESSION, jnp.int32),
-            jnp.full((n,), InvariantCode.WIRE_SATURATION, jnp.int32),
-        )
-        rows = jnp.stack([
-            jnp.full(mask.shape, round_idx, dtype=jnp.int32),
-            jnp.concatenate([obs_grid.reshape(-1), node_ids, node_ids]),
-            jnp.concatenate([subj_grid.reshape(-1), node_ids, node_ids]),
-            jnp.concatenate([code_grid.reshape(-1), *self_codes]),
-            jnp.concatenate([details.reshape(-1), new.self_inc,
-                             new.self_inc]),
-        ], axis=1)
-        return _record_flat(m, mask, rows)
-
     mon = jax.lax.cond(
-        jnp.any(fresh & (totals > 0)), record, lambda m: m, mon
+        jnp.any(fresh & (totals > 0)),
+        lambda m: _record_round(m, round_idx, vio, details, v_self_inc,
+                                v_self_sat, self_inc, subject_ids, fresh),
+        lambda m: m, mon,
     )
     return dataclasses.replace(mon, code_counts=new_counts,
                                code_first_round=first_round)
@@ -646,6 +678,111 @@ def run_monitored(base_key, params: "swim.SwimParams",
         start_round, knobs, shift_key, monitor, None, None,
     )
     return final_state, monitor, metrics
+
+
+@partial(jax.jit, static_argnames=("params", "n_rounds", "capacity"))
+def run_monitored_batch(base_keys, params: "swim.SwimParams", worlds,
+                        specs, n_rounds: int,
+                        capacity: int = DEFAULT_CAPACITY, knobs=None):
+    """ONE device program fuzzing a whole scenario batch: the monitored
+    scan with every per-round computation ``jax.vmap``-ed over a
+    leading batch axis of (PRNG key, world, spec-dynamic lanes[,
+    knobs]).
+
+    The batch must share ONE compiled shape signature — same ``params``
+    (static), same horizon, same world/spec pytree shapes; that is
+    exactly what the scenario generator's compile hygiene (quantized
+    horizons, padded rule widths — chaos/scenarios.py) buys, and what
+    ``chaos.campaign.build_buckets`` groups by.  The batched ``specs``
+    may differ only in DATA lanes (deadlines); the static treedef flags
+    (``check_false_suspicion`` etc.) are shared by construction.
+
+    The scan stays OUTSIDE the vmap so the evidence-recording pass can
+    keep its ``lax.cond`` with a predicate reduced over the WHOLE batch
+    (any row freshly tripping any code): under a per-row vmap the cond
+    would degrade to running the recording branch every round for every
+    row — measured 4-5x slower than the sequential loop it is supposed
+    to beat — while ``_record_round`` is a no-op for rows with nothing
+    fresh, so gating on the batch-level predicate records the exact
+    per-row lanes the sequential path records.
+
+    ``knobs`` (optional, batched like the keys) are the per-row dynamic
+    protocol knobs; None uses ``Knobs.from_params`` broadcast over the
+    batch.  Because knobs are traced DATA, a rerun of the same batch
+    with different knobs — the deliberately-weakened coverage arm
+    (``chaos.campaign.weakened_knobs``) — reuses this function's
+    compiled program.
+
+    Returns ``(final_states, monitors, metrics)``, each with a leading
+    batch axis; row i is exactly what ``run_monitored(base_keys[i],
+    params, world_i, spec_i, n_rounds, capacity)`` would have produced
+    (verdict parity pinned by tests/test_chaos_fuzz.py).
+    """
+    batch = base_keys.shape[0]
+    if knobs is None:
+        kn1 = swim.Knobs.from_params(params)
+        knobs = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x, (batch,) + x.shape), kn1)
+    states = jax.vmap(lambda w: swim.initial_state(params, w))(worlds)
+    monitors = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (batch,) + jnp.shape(x)),
+        MonitorState.init(capacity))
+
+    def tick(carry, round_idx):
+        st, mon = carry
+
+        def step(key, world, spec, kn, s):
+            prev = _wide(params, s, round_idx)
+            new_st, metrics = swim.swim_tick(s, round_idx, key, params,
+                                             world, knobs=kn)
+            cells = _check_cells(
+                spec, params, kn, round_idx, prev,
+                _wide(params, new_st, round_idx + 1), world)
+            return new_st, metrics, cells
+
+        new_st, metrics, cells = jax.vmap(step)(base_keys, worlds, specs,
+                                                knobs, st)
+        vio, details, v_self_inc, v_self_sat, self_inc, totals = cells
+        fresh = mon.code_counts == 0            # [B, N_CODES]
+        trip = fresh & (totals > 0)
+        subj = jnp.asarray(worlds.subject_ids, jnp.int32)
+        mon = jax.lax.cond(
+            jnp.any(trip),
+            lambda m: jax.vmap(
+                _record_round,
+                in_axes=(0, None, 0, 0, 0, 0, 0, 0, 0),
+            )(m, round_idx, vio, details, v_self_inc, v_self_sat,
+              self_inc, subj, fresh),
+            lambda m: m, mon,
+        )
+        mon = dataclasses.replace(
+            mon,
+            code_counts=mon.code_counts + totals,
+            code_first_round=jnp.where(
+                trip, jnp.asarray(round_idx, jnp.int32),
+                mon.code_first_round),
+        )
+        return (new_st, mon), metrics
+
+    (final_states, monitors), metrics = swim._fused_scan(
+        tick, (states, monitors), n_rounds, 0, params.rounds_per_step)
+    # The scan stacks rounds ahead of the batch axis; present the
+    # batch-major [B, rounds, ...] layout a per-row consumer expects
+    # (row i's metrics == the sequential run's [rounds, ...] traces).
+    metrics = {k: jnp.moveaxis(v, 0, 1) for k, v in metrics.items()}
+    return final_states, monitors, metrics
+
+
+def unstack_monitor(mon: MonitorState) -> List[MonitorState]:
+    """Split a batched (leading-axis) :class:`MonitorState` — the
+    ``run_monitored_batch`` output — into per-row host-side states, each
+    of which decodes/verdicts exactly like a sequentially produced one
+    (``decode_violations`` / ``verdict``)."""
+    arrays = {f.name: np.asarray(getattr(mon, f.name))
+              for f in dataclasses.fields(MonitorState)}
+    batch = arrays["count"].shape[0]
+    return [MonitorState(**{k: v[i] for k, v in arrays.items()})
+            for i in range(batch)]
 
 
 @partial(jax.jit, static_argnames=("params", "n_rounds", "capacity",

@@ -985,3 +985,23 @@ def generate_campaign(seed: int, n_scenarios: int, n: int = 32,
                           severity=severities[i % len(severities)])
         for i in range(n_scenarios)
     ]
+
+
+def generate_fuzz_campaign(seed: int, seeds_per_tier: int, n: int = 32,
+                           severities: Sequence[str] = SEVERITIES
+                           ) -> list:
+    """The mega-campaign form of :func:`generate_campaign`:
+    ``seeds_per_tier`` scenarios PER severity tier, tier-cycled so
+    scenario i's generation seed stays ``seed + i`` — the run-seed
+    alignment that keeps every verdict row's repro line exact when a
+    campaign runner assigns run seed ``seed + i`` by position
+    (chaos/campaign.run_campaign / run_campaign_vmapped).
+
+    By construction ``generate_fuzz_campaign(seed, k)`` ==
+    ``generate_campaign(seed, k * len(severities))``; the name states
+    the scaling contract: thousands of seeds per tier, quantized
+    horizons and padded rule widths collapsing them into a handful of
+    compile buckets, one vmapped device program per bucket
+    (chaos/campaign.build_buckets)."""
+    return generate_campaign(seed, seeds_per_tier * len(severities),
+                             n=n, severities=severities)

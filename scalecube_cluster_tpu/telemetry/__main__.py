@@ -88,7 +88,8 @@ def _cmd_regress(args) -> int:
         or ["BENCH_*.json", "MULTICHIP_*.json",
             os.path.join("artifacts", "sync_heal*.json"),
             os.path.join("artifacts", "lifeguard_fp*.json"),
-            os.path.join("artifacts", "churn_growth*.json")])
+            os.path.join("artifacts", "churn_growth*.json"),
+            os.path.join("artifacts", "fuzz_campaign*.json")])
     readable = [p for p in paths if os.path.exists(p)]
     if not readable:
         print("regress: no artifacts matched", file=sys.stderr)

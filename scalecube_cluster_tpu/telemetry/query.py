@@ -313,7 +313,8 @@ def load_bench_payload(path: str) -> Tuple[Optional[dict], Optional[str]]:
                      or "pipelined_speedup_ratio" in payload
                      or "sync_rounds_to_converge" in payload
                      or "fp_ratio" in payload
-                     or "no_resurrection_violations" in payload)):
+                     or "no_resurrection_violations" in payload
+                     or "vmap_speedup_ratio" in payload)):
             return None, stub_note
     return payload, None
 
@@ -354,7 +355,13 @@ def regress(paths: Sequence[str],
         ``detection_p99_delta_rounds`` present, bench.py --lifeguard):
         absolute gates — ``fp_ratio`` (plane-on FP observer rate over
         its own control) <= 0.5 and the crash-detection latency P99
-        delta <= +1 round.
+        delta <= +1 round;
+      - Fuzz-campaign artifacts (``vmap_speedup_ratio`` + ``coverage``
+        present, bench.py --fuzz): absolute gates — the healthy
+        mega-campaign green, the weakened coverage arm found > 0
+        planted violations with the healthy arm at 0 on the same
+        slice, and (full rounds only) ``vmap_speedup_ratio`` >= 1 —
+        plus the banded non-smoke ``scenario_throughput`` series.
 
     Returns (ok, check rows); each row {"check", "latest", "reference",
     "threshold", "ok", "source"}.  Unreadable/failed artifacts — and
@@ -595,6 +602,72 @@ def regress(paths: Sequence[str],
             check("slo/churn_net_positive_growth", last_path, growth,
                   "> 0", 1,
                   isinstance(growth, (int, float)) and growth > 0)
+        # Vmapped fuzz-campaign artifacts (bench.py --fuzz): the chaos
+        # mega-fuzzer's speed AND quality gates.  ABSOLUTE — the
+        # healthy mega-campaign is green, the deliberately-weakened
+        # coverage arm FOUND its planted violations (> 0) while the
+        # healthy arm found none on the same slice, and (full rounds
+        # only) the vmapped batch beats the sequential dispatch loop:
+        # ``vmap_speedup_ratio`` >= 1.  The speedup floor skips smoke
+        # rounds as provenance — a mini smoke batch is mostly singleton
+        # buckets, where there is no batch axis to amortize dispatch
+        # over, so its ratio hovers at ~1 by construction and gating it
+        # would be a coin flip; the quality gates keep the sync-heal
+        # fallback rule (smoke rounds gate themselves when the walk
+        # holds nothing else).  BANDED (non-smoke rounds only —
+        # scenarios/sec is host-dependent, the throughput rule): the
+        # ``scenario_throughput`` series is smaller-is-worse and must
+        # not shrink beyond the noise band.
+        fz_all = [(p, pl) for p, pl in entries
+                  if "vmap_speedup_ratio" in pl and "coverage" in pl]
+        fz = [(p, pl) for p, pl in fz_all
+              if not pl.get("smoke")] or fz_all
+        if fz is not fz_all:
+            for p, pl in fz_all:
+                if pl.get("smoke"):
+                    rows.append({
+                        "check": "slo/fuzz_campaign", "source":
+                        os.path.basename(p), "ok": None,
+                        "note": "smoke fuzz round — different scale, "
+                                "not a trajectory datum (quality gates "
+                                "still apply when nothing else walks)",
+                    })
+        if fz:
+            last_path, last = fz[-1]
+            speedup = last.get("vmap_speedup_ratio")
+            if last.get("smoke"):
+                rows.append({
+                    "check": "slo/fuzz_vmap_speedup",
+                    "source": os.path.basename(last_path), "ok": None,
+                    "note": "smoke round — singleton-bucket mini "
+                            "batches have no batch axis to amortize "
+                            "dispatch over; the floor gates full "
+                            "rounds",
+                })
+            else:
+                check("slo/fuzz_vmap_speedup", last_path, speedup, 1.0,
+                      1.0,
+                      isinstance(speedup, (int, float))
+                      and math.isfinite(speedup) and speedup >= 1.0)
+            check("slo/fuzz_campaign_green", last_path,
+                  last.get("green"), True, True,
+                  last.get("green") is True)
+            cov = last.get("coverage") or {}
+            planted = cov.get("weakened_violations")
+            check("slo/fuzz_coverage_finds_planted", last_path, planted,
+                  "> 0", 1,
+                  isinstance(planted, (int, float)) and planted > 0)
+            healthy = cov.get("healthy_violations")
+            check("slo/fuzz_coverage_healthy_clean", last_path, healthy,
+                  0, 0, healthy == 0)
+        st = [(p, pl["scenario_throughput"]) for p, pl in fz_all
+              if isinstance(pl.get("scenario_throughput"), (int, float))
+              and not pl.get("smoke")]
+        if len(st) >= 2:
+            *prior, (last_path, last) = st
+            best = max(v for _, v in prior)
+            check("slo/fuzz_scenario_throughput", last_path, last, best,
+                  best * (1.0 - band), last >= best * (1.0 - band))
     return ok, rows
 
 

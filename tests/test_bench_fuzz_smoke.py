@@ -1,0 +1,225 @@
+"""bench.py --fuzz --smoke: the vmapped mega-campaign JSON contract.
+
+The smoke-pin pattern of tests/test_bench_sync_smoke.py: the bench is
+the one entry point the fuzz measurement flows through, so this tier-1
+test runs the real script in a subprocess (CPU) and pins the published
+contract — one JSON line with the interleaved sequential-vs-vmapped
+throughput fields, the bucket accounting (sizes sum to the scenario
+count — no silent drops), the weakened-build coverage arm (planted
+violations FOUND, healthy arm clean), an artifacts/fuzz_campaign.json-
+style artifact the query layer loads as a real payload, and the regress
+gate walking the dedicated fuzz checks.  The full thousand-seed
+campaign runs under @slow.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.chaos, pytest.mark.fuzz]
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run_fuzz_bench(tmp_path, extra_args=(), extra_env=None, timeout=540):
+    artifact = tmp_path / "fuzz_campaign_smoke.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_TPU_TELEMETRY_DIR=str(tmp_path),
+        SCALECUBE_FUZZ_ARTIFACT=str(artifact),
+        SCALECUBE_XLA_CACHE_DIR="",           # no cache writes from tests
+    )
+    env.pop("SCALECUBE_TPU_PROFILE_DIR", None)
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--fuzz", *extra_args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout      # exactly ONE JSON line
+    return json.loads(lines[0]), artifact
+
+
+def test_bench_fuzz_smoke_contract(tmp_path):
+    result, artifact = _run_fuzz_bench(tmp_path, extra_args=("--smoke",))
+
+    assert "error" not in result, result
+    assert result["smoke"] is True
+    assert result["metric"] == "fuzz_campaign"
+    # value stays None BY DESIGN (scenarios/sec is host-dependent and
+    # the coverage gates are absolute); the payload says so.
+    assert result["value"] is None
+    assert "value_note" in result
+
+    # The scenario batch + bucket accounting: sizes sum to the scenario
+    # count — bucketing never silently drops a scenario.
+    assert result["scenarios"] == 3 * result["seeds_per_tier"]
+    assert sum(b["scenarios"] for b in result["buckets"]) \
+        == result["scenarios"]
+
+    # Speed: both arms measured, ratio recorded (a smoke mini batch is
+    # mostly singleton buckets, so the >= 1 floor gates full rounds
+    # only — telemetry/query.py).
+    assert result["scenario_throughput"] > 0
+    assert result["scenario_throughput_sequential"] > 0
+    assert result["member_rounds_per_sec"] > 0
+    assert result["vmap_speedup_ratio"] > 0
+
+    # Quality: the healthy mega-campaign is green; the weakened
+    # coverage arm FOUND its planted violations while the healthy arm
+    # found none on the same slice.
+    assert result["green"] is True
+    assert result["green_scenarios"] == result["scenarios"]
+    assert all(v == 0 for v in result["violations_by_code"].values())
+    cov = result["coverage"]
+    assert cov["scenarios"] > 0
+    assert cov["weakened_violations"] > 0
+    assert cov["weakened_by_code"].get("COMPLETENESS", 0) > 0
+    assert cov["healthy_violations"] == 0
+    assert "weakened_knobs" in cov["first_repro"]
+
+    # Manifest: bucket rows + per-scenario verdict rows round-trip.
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+
+    path = result["manifest"]
+    assert os.path.dirname(path) == str(tmp_path)
+    kinds = {r["kind"] for r in tsink.read_records(path)}
+    assert {"manifest", "chaos_bucket", "chaos_scenario",
+            "chaos_verdict"} <= kinds
+    rows = tsink.read_records(path, kind="chaos_scenario")
+    assert len(rows) == result["scenarios"]
+    assert all(r["green"] for r in rows)
+
+    # The artifact loads as a REAL (non-stub) payload and the regress
+    # gate ran green with the dedicated fuzz checks.
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    art = json.loads(artifact.read_text())
+    assert art["metric"] == result["metric"]
+    payload, skip_note = tquery.load_bench_payload(str(artifact))
+    assert skip_note is None
+    assert payload["coverage"]["weakened_violations"] > 0
+
+    assert result["regress"]["ok"] is True
+    ok, checks = tquery.regress([str(artifact)])
+    assert ok
+    names = {r["check"] for r in checks}
+    assert {"slo/fuzz_campaign_green", "slo/fuzz_coverage_finds_planted",
+            "slo/fuzz_coverage_healthy_clean"} <= names
+    # The speedup floor reports the smoke round as provenance, not a
+    # verdict (singleton buckets can't amortize dispatch).
+    speedup_rows = [r for r in checks
+                    if r["check"] == "slo/fuzz_vmap_speedup"]
+    assert speedup_rows and speedup_rows[0]["ok"] is None
+
+
+def test_regress_gates_fuzz_quality_absolutely(tmp_path):
+    """A fuzz artifact whose coverage arm missed the plant (or whose
+    healthy arm tripped, or whose vmapped batch lost to the sequential
+    loop on a full round) must fail the gate."""
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    def art(path, **kw):
+        doc = {
+            "metric": "fuzz_campaign", "value": None, "smoke": False,
+            "green": True, "vmap_speedup_ratio": 2.0,
+            "scenario_throughput": 50.0,
+            "coverage": {"scenarios": 4, "weakened_violations": 100,
+                         "healthy_violations": 0},
+        }
+        doc.update(kw)
+        path.write_text(json.dumps(doc))
+        return str(path)
+
+    ok, _ = tquery.regress([art(tmp_path / "fuzz_ok.json")])
+    assert ok
+
+    ok, rows = tquery.regress([art(
+        tmp_path / "fuzz_missed.json",
+        coverage={"scenarios": 4, "weakened_violations": 0,
+                  "healthy_violations": 0})])
+    assert not ok
+    assert any(r["check"] == "slo/fuzz_coverage_finds_planted"
+               and r["ok"] is False for r in rows)
+
+    ok, rows = tquery.regress([art(
+        tmp_path / "fuzz_dirty.json",
+        coverage={"scenarios": 4, "weakened_violations": 100,
+                  "healthy_violations": 3})])
+    assert not ok
+    assert any(r["check"] == "slo/fuzz_coverage_healthy_clean"
+               and r["ok"] is False for r in rows)
+
+    ok, rows = tquery.regress([art(tmp_path / "fuzz_red.json",
+                                   green=False)])
+    assert not ok
+    assert any(r["check"] == "slo/fuzz_campaign_green"
+               and r["ok"] is False for r in rows)
+
+    ok, rows = tquery.regress([art(tmp_path / "fuzz_slow.json",
+                                   vmap_speedup_ratio=0.8)])
+    assert not ok
+    assert any(r["check"] == "slo/fuzz_vmap_speedup"
+               and r["ok"] is False for r in rows)
+
+
+def test_regress_bands_fuzz_throughput_series(tmp_path):
+    """The non-smoke scenario_throughput series is smaller-is-worse
+    within the noise band; smoke rounds stay out of the series."""
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    def art(path, rate, smoke=False):
+        path.write_text(json.dumps({
+            "metric": "fuzz_campaign", "value": None, "smoke": smoke,
+            "green": True, "vmap_speedup_ratio": 2.0,
+            "scenario_throughput": rate,
+            "coverage": {"scenarios": 4, "weakened_violations": 10,
+                         "healthy_violations": 0},
+        }))
+        return str(path)
+
+    a = art(tmp_path / "fuzz_r01.json", 100.0)
+    ok, _ = tquery.regress([a, art(tmp_path / "fuzz_r02.json", 95.0)])
+    assert ok                                  # within the band
+    ok, rows = tquery.regress([a, art(tmp_path / "fuzz_r03.json", 50.0)])
+    assert not ok
+    assert any(r["check"] == "slo/fuzz_scenario_throughput"
+               and r["ok"] is False for r in rows)
+    # A smoke round's host-dependent rate never enters the series.
+    ok, _ = tquery.regress([a, art(tmp_path / "fuzz_smoke.json", 1.0,
+                                   smoke=True)])
+    assert ok
+
+
+@pytest.mark.slow
+def test_bench_fuzz_full_campaign(tmp_path):
+    """The full (non-smoke) mega-campaign path.  The design-target
+    scale is thousands of seeds per tier on an accelerator; under the
+    CPU-forced test environment the same non-smoke code path runs at a
+    CPU-feasible seed count (env override drops on real hardware) —
+    real buckets, interleaved timing with the >= 1 speedup floor, the
+    weakened coverage arm, the regress gate."""
+    result, artifact = _run_fuzz_bench(
+        tmp_path,
+        extra_env={
+            "SCALECUBE_FUZZ_N": os.environ.get("SCALECUBE_FUZZ_N", "16"),
+            "SCALECUBE_FUZZ_SEEDS_PER_TIER": os.environ.get(
+                "SCALECUBE_FUZZ_SEEDS_PER_TIER", "12"),
+        },
+        timeout=3000,
+    )
+    assert "error" not in result, result
+    assert result["smoke"] is False
+    assert result["scenarios"] == 3 * result["seeds_per_tier"]
+    assert result["green"] is True
+    assert result["vmap_speedup_ratio"] >= 1.0
+    assert result["coverage"]["weakened_violations"] > 0
+    assert result["coverage"]["healthy_violations"] == 0
+    assert result["regress"]["ok"] is True, result["regress"]
