@@ -149,6 +149,21 @@ overrides: SCALECUBE_FUZZ_N, SCALECUBE_FUZZ_SEEDS_PER_TIER,
 SCALECUBE_FUZZ_SEED, SCALECUBE_FUZZ_REPS, SCALECUBE_FUZZ_CAPACITY,
 SCALECUBE_FUZZ_ARTIFACT.
 
+``--wire``: the fused single-buffer scatter wire A/B — the default
+``SwimParams.fused_wire`` path (ALIVE flags riding the key word's
+spare bits, ONE full-height collective per round) against the HEAD
+two-buffer path (int32 key + int8 flag pair, two collectives), on both
+the serial in-round combine and the pipelined sharded run, interleaved
+best-of per pair.  Emits the fused/legacy speedup ratios (regress
+floor: fused never slower), the compiled-HLO full-height collective
+counts (1 vs 2), and the traffic model's 4-vs-5 B/slot + wire24
+headroom numbers into an ``artifacts/wire_fused.json``-style artifact
+(smoke runs get ``wire_fused_smoke.json`` — provenance, the sync-heal
+convention) walked by ``telemetry regress``.  ``--wire --smoke`` is
+the CPU-safe virtual-8-device pass pinned by
+tests/test_bench_wire_smoke.py.  Env overrides: SCALECUBE_WIRE_DEVICES,
+SCALECUBE_WIRE_N, SCALECUBE_WIRE_ROUNDS, SCALECUBE_WIRE_ARTIFACT.
+
 Env overrides for debugging: SCALECUBE_BENCH_N, SCALECUBE_BENCH_ROUNDS,
 SCALECUBE_BENCH_DELIVERY, SCALECUBE_BENCH_SKIP_CANARY,
 SCALECUBE_BENCH_COMPACT (=1: the capacity-oriented compact carry layout,
@@ -1140,6 +1155,259 @@ def run_multichip_bench():
     print(json.dumps(result), flush=True)
 
 
+def run_wire_bench():
+    """The --wire mode: the FUSED single-buffer scatter wire
+    (SwimParams.fused_wire, the default — ALIVE flags ride the key
+    word's spare bits, ONE full-height collective per round) A/B'd
+    against the HEAD two-buffer path (``fused_wire=False``: int32 key +
+    int8 flag pair, two collectives) on BOTH the serial in-round
+    combine and the pipelined sharded run, each pair on the
+    ``interleaved_best_of`` window discipline.  One JSON line out with
+    the fused per-chip rate, the fused/legacy speedup ratios (the
+    regress floor: fused must never run slower), the compiled-HLO
+    full-height collective counts (the 1-vs-2 pin, straight from the
+    program text), and the traffic model's 4-vs-5 B/slot + wire24
+    headroom numbers — into an ``artifacts/wire_fused.json`` artifact
+    walked by ``telemetry regress``.
+
+    ``--smoke`` forces CPU with the virtual 8-device mesh and writes
+    ``artifacts/wire_fused_smoke.json`` (never the committed artifact —
+    the sync-heal convention); env overrides: SCALECUBE_WIRE_DEVICES,
+    SCALECUBE_WIRE_N, SCALECUBE_WIRE_ROUNDS, SCALECUBE_WIRE_ARTIFACT.
+    """
+    result = {
+        "metric": "swim_wire_fused_member_rounds_per_sec_per_chip",
+        "value": None,
+        "unit": "member-rounds/sec/chip",
+        "smoke": SMOKE,
+    }
+    artifact = (os.environ.get("SCALECUBE_WIRE_ARTIFACT")
+                or os.path.join(
+                    "artifacts",
+                    "wire_fused_smoke.json" if SMOKE
+                    else "wire_fused.json"))
+    try:
+        # Device-count resolution before the first jax import (the
+        # multichip rule: CPU only exposes multiple devices through
+        # xla_force_host_platform_device_count).
+        want_dev = int(os.environ.get("SCALECUBE_WIRE_DEVICES",
+                                      "8" if SMOKE else "0") or 0)
+        if SMOKE:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        if want_dev and os.environ.get("JAX_PLATFORMS",
+                                       "").startswith("cpu"):
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count={want_dev}"
+                ).strip()
+        jax, platform = init_backend()
+        result["platform"] = platform
+
+        import dataclasses
+
+        import numpy as np
+
+        from scalecube_cluster_tpu.config import ClusterConfig
+        from scalecube_cluster_tpu.models import swim
+        from scalecube_cluster_tpu.parallel import compat, traffic
+        from scalecube_cluster_tpu.parallel import mesh as pmesh
+        from scalecube_cluster_tpu.utils import runlog
+
+        if not compat.HAS_SHARD_MAP:
+            raise NotImplementedError(compat.SKIP_REASON)
+
+        def force(state):
+            return runlog.completion_barrier(state.status)
+
+        n_dev = want_dev or len(jax.devices())
+        mesh = pmesh.make_mesh(n_dev)
+        n_members = int(os.environ.get(
+            "SCALECUBE_WIRE_N", 1024 if SMOKE else 4096))
+        n_members = max(n_dev, n_members - n_members % n_dev)
+        rounds = int(os.environ.get(
+            "SCALECUBE_WIRE_ROUNDS", 48 if SMOKE else 128))
+
+        def make_params(fused):
+            return swim.SwimParams.from_config(
+                ClusterConfig.default(), n_members=n_members,
+                n_subjects=N_SUBJECTS, loss_probability=0.02,
+                delivery="scatter", fused_wire=fused,
+            )
+
+        p_fused, p_legacy = make_params(True), make_params(False)
+        world = swim.SwimWorld.healthy(p_fused).with_crash(3, at_round=10)
+        key = jax.random.key(0)
+        log(f"wire: mesh {list(mesh.devices.shape)} on {platform}, "
+            f"N={n_members}, {rounds}-round windows; modeled "
+            f"{traffic.scatter_wire_bytes_per_slot(p_fused)} B/slot "
+            f"fused vs {traffic.scatter_wire_bytes_per_slot(p_legacy)} "
+            f"legacy, {traffic.scatter_collectives_per_round(p_fused)} "
+            f"vs {traffic.scatter_collectives_per_round(p_legacy)} "
+            f"collectives/round")
+
+        # Compile + first run of all four paths; within each wire the
+        # pipelined-vs-serial pair doubles as the bit-identity probe
+        # (the fused-vs-legacy pair is NOT claimed identical here: the
+        # bench world has loss, where the documented merge-gate corner
+        # may transiently differ — tests/test_wire_fused.py pins the
+        # deterministic-schedule identity).
+        t0 = time.perf_counter()
+        states, metrics = {}, {}
+        for wire, params in (("fused", p_fused), ("legacy", p_legacy)):
+            for pipe in (False, True):
+                s, m = pmesh.shard_run(key, params, world, rounds, mesh,
+                                       pipelined=pipe)
+                force(s)
+                states[(wire, pipe)] = s
+                metrics[(wire, pipe)] = m
+        log(f"wire: compile+first-run (4 paths) took "
+            f"{time.perf_counter() - t0:.1f}s")
+        parity = {}
+        for wire in ("fused", "legacy"):
+            s_ser, s_pip = states[(wire, False)], states[(wire, True)]
+            parity[wire] = bool(
+                all(np.array_equal(np.asarray(metrics[(wire, False)][k2]),
+                                   np.asarray(metrics[(wire, True)][k2]))
+                    for k2 in metrics[(wire, False)])
+                and all(np.array_equal(
+                    np.asarray(getattr(s_ser, f.name)),
+                    np.asarray(getattr(s_pip, f.name)))
+                    for f in dataclasses.fields(s_ser))
+            )
+        log(f"wire: pipelined==serial parity probe "
+            f"{'OK' if all(parity.values()) else 'DIVERGED ' + repr(parity)}")
+
+        # The compiled-program pin: full-height [N, K] all-reduce
+        # instructions in the SERIAL program text — 1 fused vs 2
+        # legacy.  Counting only the [N, K]-shaped combines keeps the
+        # pin lowering-neutral (metric psums are [K]/scalar shaped;
+        # tests/test_traffic.py
+        # test_pipelined_combine_count_doubles_lowering_neutral); an
+        # exotic lowering that defeats the text parse records null —
+        # provenance, never a voided measurement.
+        try:
+            import re
+
+            def full_height_combines(params):
+                txt = pmesh.shard_run.lower(
+                    key, params, world, 4, mesh,
+                    state=swim.initial_state(params, world),
+                    start_round=0, pipelined=False,
+                ).compile().as_text()
+                k_cols = params.n_subjects
+                return len(re.findall(
+                    r"= \w+\[" + f"{n_members},{k_cols}"
+                    + r"\]\S* all-reduce\(", txt))
+
+            hlo_counts = {"fused": full_height_combines(p_fused),
+                          "legacy": full_height_combines(p_legacy)}
+            log(f"wire: HLO full-height collectives/round {hlo_counts}")
+        except Exception as e:  # noqa: BLE001
+            hlo_counts = None
+            log(f"wire: HLO collective count unavailable "
+                f"({type(e).__name__}: {e})")
+
+        reps = 6 if SMOKE else 4
+        rates = {}
+        for pipe, pipe_name in ((False, "serial"), (True, "pipelined")):
+            def run_wire(wire, rep, pipe=pipe):
+                params = p_fused if wire == "fused" else p_legacy
+                s, _ = pmesh.shard_run(
+                    key, params, world, rounds, mesh,
+                    state=states[(wire, pipe)],
+                    start_round=rounds * (1 + rep), pipelined=pipe)
+                force(s)
+                states[(wire, pipe)] = s
+
+            f_best, l_best = interleaved_best_of(
+                lambda rep: run_wire("fused", rep),
+                lambda rep: run_wire("legacy", rep), reps)
+            rates[(pipe_name, "fused")] = n_members * rounds / f_best / n_dev
+            rates[(pipe_name, "legacy")] = n_members * rounds / l_best / n_dev
+            log(f"wire/{pipe_name}: fused {f_best:.3f}s vs legacy "
+                f"{l_best:.3f}s per {rounds}-round window (best of "
+                f"{reps}, interleaved) -> speedup "
+                f"x{f_best and l_best / f_best:.4f}")
+
+        serial_ratio = round(
+            rates[("serial", "fused")] / rates[("serial", "legacy")], 4)
+        pipelined_ratio = round(
+            rates[("pipelined", "fused")] / rates[("pipelined", "legacy")],
+            4)
+        result.update(
+            value=round(rates[("pipelined", "fused")], 1),
+            fused_serial_member_rounds_per_sec_per_chip=round(
+                rates[("serial", "fused")], 1),
+            legacy_serial_member_rounds_per_sec_per_chip=round(
+                rates[("serial", "legacy")], 1),
+            fused_pipelined_member_rounds_per_sec_per_chip=round(
+                rates[("pipelined", "fused")], 1),
+            legacy_pipelined_member_rounds_per_sec_per_chip=round(
+                rates[("pipelined", "legacy")], 1),
+            fused_serial_speedup_ratio=serial_ratio,
+            fused_pipelined_speedup_ratio=pipelined_ratio,
+            pipelined_serial_parity=parity,
+            hlo_full_height_collectives=hlo_counts,
+            wire_collectives_per_round={
+                "fused": traffic.scatter_collectives_per_round(p_fused),
+                "legacy": traffic.scatter_collectives_per_round(p_legacy),
+            },
+            wire_bytes_per_slot={
+                "fused": traffic.scatter_wire_bytes_per_slot(p_fused),
+                "legacy": traffic.scatter_wire_bytes_per_slot(p_legacy),
+            },
+            # The wire24 rung's headroom at zero extra wire bytes, and
+            # the shift-mode accounting untouched by the flag fold —
+            # straight from the model (the HLO versions live in
+            # tests/test_traffic.py).
+            wire24_bytes_per_slot=traffic.scatter_wire_bytes_per_slot(
+                swim.SwimParams.from_config(
+                    ClusterConfig.default(), n_members=n_members,
+                    n_subjects=N_SUBJECTS, delivery="scatter",
+                    compact_carry=True, wire24=True)),
+            wire_inc_sat={
+                name: swim._wire_inc_sat(swim.SwimParams.from_config(
+                    ClusterConfig.default(), n_members=n_members,
+                    n_subjects=N_SUBJECTS, delivery="scatter",
+                    open_world=True, **kw))
+                for name, kw in (
+                    ("wide", {}),
+                    ("wire16", {"compact_carry": True}),
+                    ("wire24", {"compact_carry": True, "wire24": True}),
+                )},
+            shift_accounting_unchanged=bool(
+                traffic.shift_ici_bytes_per_device_round(
+                    dataclasses.replace(p_fused, delivery="shift"), n_dev)
+                == traffic.shift_ici_bytes_per_device_round(
+                    dataclasses.replace(p_legacy, delivery="shift"),
+                    n_dev)),
+            n_devices=n_dev,
+            mesh_shape=list(mesh.devices.shape),
+            n_members=n_members,
+            rounds_timed=rounds,
+            delivery="scatter",
+        )
+
+        art = dict(result)
+        os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        result["artifact"] = artifact
+        log(f"wire artifact written to {artifact}")
+
+        apply_regress_gate(
+            result, ["BENCH_*.json", "MULTICHIP_*.json",
+                     os.path.join("artifacts", "wire_fused*.json"),
+                     artifact])
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 def run_sync_bench():
     """The --sync mode: partition-heal convergence of the SYNC
     anti-entropy plane (models/sync.py) against the gossip-only
@@ -2006,6 +2274,15 @@ def main():
              "artifacts/fuzz_campaign.json-style artifact; combine "
              "with --smoke for the tier-1-safe mini batch",
     )
+    parser.add_argument(
+        "--wire", action="store_true",
+        help="measure the fused single-buffer scatter wire against the "
+             "two-buffer HEAD path (serial AND pipelined sharded runs, "
+             "fused/legacy speedup ratios + compiled-HLO collective "
+             "counts + traffic-model bytes/slot) into an "
+             "artifacts/wire_fused.json-style artifact; combine with "
+             "--smoke for the CPU-safe virtual-8-device pass",
+    )
     mode = parser.add_mutually_exclusive_group()
     mode.add_argument(
         "--untraced", action="store_true",
@@ -2081,6 +2358,15 @@ def main():
             parser.error(
                 "--fuzz runs the vmapped chaos mega-campaign on its own "
                 "workload — drop the other mode flags")
+        if args.wire and (args.chaos or args.resilience or args.metrics
+                          or args.multichip or args.sync
+                          or args.lifeguard or args.churn or args.fuzz
+                          or args.traced or args.untraced
+                          or args.gap_artifact):
+            parser.error(
+                "--wire measures the fused-vs-two-buffer wire gap on "
+                "its own interleaved windows — drop the other mode "
+                "flags")
     except SystemExit as e:
         # The one-JSON-line contract holds even for a bad argv: argparse
         # already printed its usage message to stderr; ship the error
@@ -2111,6 +2397,8 @@ def main():
         return run_churn_bench()
     if args.fuzz:
         return run_fuzz_bench()
+    if args.wire:
+        return run_wire_bench()
 
     result = {
         "metric": "swim_member_rounds_per_sec_per_chip",

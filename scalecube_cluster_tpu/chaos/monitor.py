@@ -37,7 +37,14 @@ Invariant codes (:class:`InvariantCode`; lane values are stable):
   WIRE_SATURATION   the carry holds an incarnation above the active
                     wire key format's saturation point (or negative) —
                     past it wire and table silently diverge at the
-                    merge gate (models/swim._wire_inc_sat).
+                    merge gate.  The bound is per FORMAT, derived from
+                    the one ops/delivery.WIRE_FORMATS table via
+                    models/swim._wire_inc_sat (2^29-1 wide, 8191
+                    wire16, 32767 wire24 under the compact carry; the
+                    open-world epoch field lowers the wire caps) — a
+                    clamped run sits exactly AT the cap under
+                    saturation pressure and stays green
+                    (tests/test_wire_saturation.py).
   COMPLETENESS      time-bounded completeness: past the scenario's
                     per-subject ``complete_by`` deadline, an eligible
                     observer (continuously alive since the subject's

@@ -194,6 +194,44 @@ class SwimParams:
     # bytes DO halve — parallel/traffic._key_bytes — a multi-chip,
     # ICI-bound regime may price it differently than single-chip HBM).
     int16_wire: bool = False
+    # wire24: the compact-carry HEADROOM rung of the wire-format ladder
+    # (ops/delivery.WIRE24).  The STORED table stays int16 (requires
+    # ``compact_carry`` — that pairing is the point: wire width is
+    # decoupled from carry width) but the WIRE key widens from the int16
+    # merge_key16 layout to a 24-bit field inside an int32 word — epoch
+    # 2 -> 4 bits, and the incarnation ceiling rises from the wire16
+    # cap (8191, or 2^11-1 = 2047 with epoch bits) to the int16
+    # stored-incarnation ceiling 32767 (_wire_inc_sat: the wire field
+    # itself carries 2^22-1 / 2^18-1, so the carry dtype binds first).
+    # Wire buffers (payloads, inbox, delay-ring slots, scatter
+    # contributions) are int32 — under the FUSED single-buffer scatter
+    # wire this costs zero extra collectives and, per slot, the same
+    # 4 B the pre-ladder wide wire paid for its key alone
+    # (parallel/traffic.scatter_wire_bytes_per_slot).
+    wire24: bool = False
+    # FUSED single-buffer wire (scatter delivery): the per-slot
+    # ALIVE/transmit flag is NOT shipped as a parallel [N, K] int8
+    # buffer — it already lives in the key word's spare bits (an ALIVE
+    # record is exactly a key with the dead and suspect bits clear,
+    # ops/delivery.is_alive_key), so the merge gate derives it from the
+    # round's folded winner key.  The scatter tick then moves ONE
+    # full-height [N, K] buffer per round instead of the key + flag
+    # pair: one cross-device collective instead of two (each delay bin
+    # likewise halved), 4 B/slot instead of 5 on the wide wire, and the
+    # pipelined double-buffer (parallel/mesh._pipelined_rounds) carries
+    # a single buffer.  Documented gate deviation: the separate flag
+    # buffer OR-folded aliveness over ALL of a round's arrivals, so an
+    # ABSENT-gated cell could open on a losing ALIVE arrival and store
+    # a non-ALIVE winner; the fused gate opens only when the WINNER
+    # itself is ALIVE — the reference's per-message null-gate
+    # (MembershipRecord.java:67-69) applied to the round's folded
+    # message.  The two differ only when an ALIVE and a strictly
+    # higher non-ALIVE record about the same subject land at the same
+    # ABSENT-gated cell in the same round (tests/test_wire_fused.py
+    # pins both the scenario-level identity and the corner).
+    # False = the pre-ladder two-buffer wire, kept as the bench.py
+    # --wire comparison baseline and equivalence-pin arm.
+    fused_wire: bool = True
     # Single-device shift delivery: replace the persistent doubled
     # [2N, K] payload buffers with a jnp.roll per channel (transient
     # two-slice concats) — value-identical (ops/shift.ShiftEngine
@@ -401,6 +439,18 @@ class SwimParams:
                     "k_block supports max_delay_rounds=0 and "
                     "link_counters=False only (capacity path)"
                 )
+        if self.wire24 and not self.compact_carry:
+            raise ValueError(
+                "wire24 is the compact-carry headroom rung — it widens "
+                "the WIRE key while the STORED table stays int16; with a "
+                "wide carry the wide wire already has more headroom (set "
+                "compact_carry=True, or drop wire24)"
+            )
+        if self.wire24 and self.int16_wire:
+            raise ValueError(
+                "wire24 and int16_wire are distinct rungs of the wire-"
+                "format ladder (24-bit vs 16-bit wire keys) — pick one"
+            )
         if self.compact_carry:
             if self.periods_to_spread + 1 > 127:
                 raise ValueError(
@@ -432,11 +482,24 @@ class SwimParams:
     @property
     def compact_wire(self) -> bool:
         """True when the wire format is int16 (records.merge_key16):
-        chosen directly by ``int16_wire`` or implied by ``compact_carry``.
-        Gates every wire-format decision (pack/unpack, no-message
-        sentinel, alive-key bits, ring-slot dtype); carry-layout
-        decisions gate on ``compact_carry`` alone."""
-        return self.compact_carry or self.int16_wire
+        chosen directly by ``int16_wire`` or implied by ``compact_carry``
+        — unless ``wire24`` widens the wire back to an int32 word.
+        Gates every wire-WIDTH decision (ring-slot dtype, traffic-model
+        key bytes); format-layout decisions go through ``wire_format``;
+        carry-layout decisions gate on ``compact_carry`` alone."""
+        return (self.compact_carry or self.int16_wire) and not self.wire24
+
+    @property
+    def wire_format(self) -> "delivery.WireFormat":
+        """The active rung of the wire-format bitfield ladder
+        (ops/delivery.WIRE_FORMATS) — the one object every pack/unpack/
+        merge/no-message call site threads, and the single source of
+        the saturation and epoch-width constants
+        (tests/test_wire_constants.py grep-proofs that no clamp site
+        hard-codes them)."""
+        if self.wire24:
+            return delivery.WIRE24
+        return delivery.WIRE16 if self.compact_wire else delivery.WIDE
 
     @property
     def epoch_bits(self) -> int:
@@ -444,14 +507,14 @@ class SwimParams:
         open-world plane is off OR the epoch guard is disabled (the
         exact legacy key layouts — the naive-reuse arm runs the
         reference's epoch-blind wire, which is the point of the
-        control), else the fixed per-format width
-        (ops/delivery.EPOCH_BITS_*).  Gates every epoch decision — lane
-        allocation, pack/unpack, the merge gate — so one predicate
-        compiles the whole identity plane in or out."""
+        control), else the active format's fixed width
+        (ops/delivery.WireFormat.epoch_bits: 6 wide / 4 wire24 /
+        2 wire16).  Gates every epoch decision — lane allocation,
+        pack/unpack, the merge gate — so one predicate compiles the
+        whole identity plane in or out."""
         if not (self.open_world and self.epoch_guard):
             return 0
-        return (delivery.EPOCH_BITS_COMPACT if self.compact_wire
-                else delivery.EPOCH_BITS_WIDE)
+        return self.wire_format.epoch_bits
 
     @staticmethod
     def from_config(config, n_members: int, n_subjects: Optional[int] = None,
@@ -1107,7 +1170,7 @@ def initial_state(params: SwimParams, world: SwimWorld,
     )
     # The ring stores wire-format keys; the int16 wire (compact_carry or
     # int16_wire) makes its delayed slots int16 (records.merge_key16).
-    ring_dtype = jnp.int16 if params.compact_wire else jnp.int32
+    ring_dtype = params.wire_format.dtype
     if params.compact_carry:
         # Relative encodings (the carry is re-relativized every tick by
         # _carry_encode): spread_until / suspect_deadline as remaining
@@ -1142,13 +1205,17 @@ def initial_state(params: SwimParams, world: SwimWorld,
 # compact_carry sentinel: "no suspicion timer" in the int16
 # remaining-rounds encoding (decodes to INT32_MAX).
 _DEADLINE_NONE16 = 32767
-_INC_SAT16 = (1 << 13) - 1      # matches the int16 wire format's inc field
-_INC_SAT32 = (1 << 29) - 1      # records.merge_key's int32 inc field
+# int16 stored-incarnation ceiling (the COMPACT CARRY's dtype bound —
+# a carry-layout constant, distinct from the per-format WIRE saturation
+# points that live in ops/delivery.WIRE_FORMATS).
+_CARRY16_INC_SAT = (1 << 15) - 1
 
 
 def _wire_inc_sat(params: "SwimParams") -> int:
-    """Largest incarnation the active wire-key format carries exactly
-    (records.merge_key16's 8191 / merge_key's 2^29-1 saturation point).
+    """Largest incarnation the active wire format AND carry layout hold
+    exactly — min of the wire key's incarnation-field saturation
+    (ops/delivery.WireFormat.inc_sat, the one format table) and, under
+    ``compact_carry``, the int16 stored-incarnation ceiling.
 
     The carry must never hold an incarnation ABOVE this cap: past it the
     packed keys of distinct incarnations collide, so the merge gate
@@ -1161,12 +1228,18 @@ def _wire_inc_sat(params: "SwimParams") -> int:
     instead of a silent wire/table divergence.
 
     The open-world plane's epoch field is carved out of the TOP of the
-    incarnation field (ops/delivery.py layout comment), so the cap
-    drops by ``2^epoch_bits`` — 2^23-1 wide / 2^11-1 compact, still far
-    past any refutation-bump-reachable count.
+    incarnation field (ops/delivery.py layout comment), so the wire cap
+    drops by ``2^epoch_bits`` — 2^23-1 wide / 2^11-1 wire16.  The
+    wire24 rung exists exactly to lift the compact-carry pairing off
+    that 2^11-1 floor: its 24-bit key field carries 2^18-1 with the
+    4-bit epoch field, so the int16 CARRY ceiling (32767) becomes the
+    binding cap — 16x the wire16+epoch headroom at identical wire
+    bytes per slot under the fused single-buffer wire.
     """
-    base_bits = 13 if params.compact_wire else 29
-    return (1 << (base_bits - params.epoch_bits)) - 1
+    sat = params.wire_format.inc_sat(params.epoch_bits)
+    if params.compact_carry:
+        sat = min(sat, _CARRY16_INC_SAT)
+    return sat
 
 
 def _carry_decode(state: SwimState, round_idx) -> SwimState:
@@ -1194,8 +1267,15 @@ def _carry_decode(state: SwimState, round_idx) -> SwimState:
     )
 
 
-def _carry_encode(state: SwimState, round_idx) -> SwimState:
+def _carry_encode(state: SwimState, round_idx, inc_sat: int) -> SwimState:
     """wide -> compact, relative to the NEXT round's cursor.
+
+    ``inc_sat`` (required — a defaulted carry-ceiling clamp would
+    silently under-clamp a wire16 run): the incarnation clamp — callers
+    pass the active format's ``_wire_inc_sat(params)`` (8191 under
+    wire16, 32767 under wire24; a well-formed carry is already at or
+    below it, since the refutation bump clamps there — this is the
+    encode-side safety for hand-seeded states).
 
     A ``suspect_deadline`` in the past encodes as a NEGATIVE remaining
     count — a frozen (crashed/left) row's pending timer goes stale
@@ -1231,7 +1311,7 @@ def _carry_encode(state: SwimState, round_idx) -> SwimState:
     remaining = dl - nxt
     return dataclasses.replace(
         state,
-        inc=jnp.minimum(state.inc, _INC_SAT16).astype(jnp.int16),
+        inc=jnp.minimum(state.inc, inc_sat).astype(jnp.int16),
         epoch=(state.epoch if state.epoch.size == 0
                else state.epoch.astype(jnp.int16)),
         spread_until=jnp.clip(
@@ -1295,28 +1375,42 @@ def _chain_ok(key, hop_losses: Sequence[jnp.ndarray],
     return ok & (total_delay <= budget_ms)
 
 
-def _ring_open(state: SwimState, params: SwimParams, round_idx):
+def _ring_open(state: SwimState, params: SwimParams, round_idx,
+               with_flags: bool = True):
     """Read this round's due slot and clear it for reuse (ops/ring.py).
 
     Returns (inbox_now, flags_now, g_now, ring, fring, gring, slot0) —
     the rings already have slot0 reset, ready to accumulate future
     arrivals.  With delay modeling off (max_delay_rounds == 0) returns
     Nones; the user-gossip pair is None when n_user_gossips == 0.
+
+    ``with_flags=False`` (the fused scatter wire): the flag ring is
+    never written or read — the merge gate derives ALIVE flags from the
+    ring's folded KEYS at open time — so skip the per-round full-height
+    reset store and pass ``state.flag_ring`` through untouched
+    (all-zeros forever).  The lane itself stays allocated: zero-sizing
+    it under the DEFAULT config would change checkpoint shapes for
+    delay configs, and the wire change promises legacy checkpoints
+    load as-is (MIGRATING.md).  Shift-mode delay genuinely uses the
+    ring (its channels push transmit flags), so it keeps the default.
     """
     if params.max_delay_rounds == 0:
         return None, None, None, None, None, None, None
     slot0 = round_idx % (params.max_delay_rounds + 1)
     inbox_now, ring = ring_ops.open_slot(
-        state.inbox_ring, slot0, delivery.no_message(params.compact_wire)
+        state.inbox_ring, slot0, delivery.no_message(fmt=params.wire_format)
     )
-    flags_now, fring = ring_ops.open_slot(
-        state.flag_ring, slot0, jnp.int8(0)
-    )
+    if with_flags:
+        flags_now, fring = ring_ops.open_slot(
+            state.flag_ring, slot0, jnp.int8(0)
+        )
+        flags_now = flags_now.astype(jnp.bool_)
+    else:
+        flags_now, fring = None, state.flag_ring
     g_now, gring = (None, None)
     if params.n_user_gossips > 0:
         g_now, gring = ring_ops.open_slot(state.g_ring, slot0, False)
-    return inbox_now, flags_now.astype(jnp.bool_), g_now, ring, fring, \
-        gring, slot0
+    return inbox_now, flags_now, g_now, ring, fring, gring, slot0
 
 
 def _ring_push(ring, fring, slot, keys, flags):
@@ -1340,7 +1434,7 @@ def _route_delayed(ok, delivered, delivered_flags, delay_mean, key, params,
     """
     if params.max_delay_rounds == 0 or delay_mean is None:
         return ok, ring, fring, g_ring
-    no_msg = delivery.no_message(params.compact_wire)
+    no_msg = delivery.no_message(fmt=params.wire_format)
     q = ring_ops.delay_bins(key, delay_mean, params.round_ms,
                             params.max_delay_rounds, ok.shape)
     d = params.max_delay_rounds + 1
@@ -1429,7 +1523,7 @@ def _apply_joins(state: SwimState, round_idx, params: SwimParams,
     if params.max_delay_rounds > 0:
         # In-flight messages addressed to the OLD occupant die with it.
         inbox_ring = jnp.where(
-            jrow[None], delivery.no_message(params.compact_wire),
+            jrow[None], delivery.no_message(fmt=params.wire_format),
             state.inbox_ring,
         )
         flag_ring = jnp.where(jrow[None], jnp.int8(0), state.flag_ring)
@@ -1686,7 +1780,8 @@ def swim_tick(state: SwimState, round_idx, base_key, params: SwimParams,
     metrics = _round_metrics(new_state, status, aux, params, world,
                              alive, alive_here, axis_name)
     if params.compact_carry and not params.k_block:
-        new_state = _carry_encode(new_state, round_idx)
+        new_state = _carry_encode(new_state, round_idx,
+                                  inc_sat=_wire_inc_sat(params))
     return new_state, metrics
 
 
@@ -1877,14 +1972,14 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
     eb = params.epoch_bits
     if eb:
         new_status, new_inc, new_epoch, changed = delivery.merge_inbox(
-            status, inc, inbox, inbox_alive, compact=params.compact_wire,
+            status, inc, inbox, inbox_alive, fmt=params.wire_format,
             suppress=suppress, entry_epoch=epoch, epoch_bits=eb,
             epoch_guard=params.epoch_guard,
         )
     else:
         new_epoch = None
         new_status, new_inc, changed = delivery.merge_inbox(
-            status, inc, inbox, inbox_alive, compact=params.compact_wire,
+            status, inc, inbox, inbox_alive, fmt=params.wire_format,
             suppress=suppress,
         )
 
@@ -1892,7 +1987,7 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
     # inbound winner about ME overrides my ALIVE@self_inc record, bump to
     # max(inc)+1 and gossip the refutation (spread reset via `changed`).
     win_status, win_inc = delivery.unpack_record(
-        inbox, compact=params.compact_wire, epoch_bits=eb
+        inbox, fmt=params.wire_format, epoch_bits=eb
     )
     self_overridden = is_self & records.is_overrides_array(
         win_status, win_inc, records.ALIVE, state.self_inc[:, None]
@@ -1902,7 +1997,7 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
         # about ME — a new member must not burn incarnations refuting
         # the PREVIOUS occupant's death notice (the naive-reuse arm
         # deliberately omits this, measuring exactly that burn).
-        win_ep = delivery.unpack_epoch(inbox, compact=params.compact_wire,
+        win_ep = delivery.unpack_epoch(inbox, fmt=params.wire_format,
                                        epoch_bits=eb)
         self_overridden = self_overridden & (
             win_ep == jnp.asarray(own_epoch, jnp.int32)[:, None]
@@ -2053,12 +2148,12 @@ def _send_components(state, status, inc, round_idx, params, world,
     leaving_now = (world.leave_at[node_ids] == round_idx)[:, None] & is_self
     hot = (status != records.ABSENT) & (round_idx < state.spread_until)
     hot = hot | leaving_now
-    compact = params.compact_wire
+    wf = params.wire_format
     eb = params.epoch_bits
-    record_keys = delivery.pack_record(status, inc, compact=compact,
+    record_keys = delivery.pack_record(status, inc, fmt=wf,
                                        epoch=epoch, epoch_bits=eb)
     leave_key = delivery.pack_record(
-        jnp.int8(records.DEAD), state.self_inc[:, None] + 1, compact=compact,
+        jnp.int8(records.DEAD), state.self_inc[:, None] + 1, fmt=wf,
         epoch=epoch, epoch_bits=eb,
     )
     record_keys = jnp.where(leaving_now, leave_key, record_keys)
@@ -2101,8 +2196,8 @@ def _seed_anti_entropy(status, sync_keys, inbox, inbox_alive, sync_round,
     the pushers, acks at the seed).
     """
     n_seeds = world.seed_ids.shape[0]
-    compact = params.compact_wire
-    no_msg = delivery.no_message(compact)
+    wf = params.wire_format
+    no_msg = delivery.no_message(fmt=wf)
     has_absent = jnp.any(status == records.ABSENT, axis=1)
     pusher = sync_round & alive_here & has_absent
     k_sel, k_push, k_ack = jax.random.split(key, 3)
@@ -2132,8 +2227,9 @@ def _seed_anti_entropy(status, sync_keys, inbox, inbox_alive, sync_round,
         inbox = jnp.maximum(
             inbox, jnp.where(is_seed_row, contribution[None, :], no_msg)
         )
-        inbox_alive |= is_seed_row & delivery.is_alive_key(
-            contribution, compact=compact)[None, :]
+        if inbox_alive is not None:
+            inbox_alive |= is_seed_row & delivery.is_alive_key(
+                contribution, fmt=wf)[None, :]
         # The ack: the seed's syncable row back to every successful
         # pusher, over the reverse link.
         seed_row = pmax(jnp.max(
@@ -2148,8 +2244,9 @@ def _seed_anti_entropy(status, sync_keys, inbox, inbox_alive, sync_round,
         inbox = jnp.maximum(
             inbox, jnp.where(ok_ack[:, None], seed_row[None, :], no_msg)
         )
-        inbox_alive |= ok_ack[:, None] & delivery.is_alive_key(
-            seed_row, compact=compact)[None, :]
+        if inbox_alive is not None:
+            inbox_alive |= ok_ack[:, None] & delivery.is_alive_key(
+                seed_row, fmt=wf)[None, :]
         # Wire accounting (SwimParams.link_counters): pushes at the
         # pushers, acks at the seed.
         at_seed = node_ids == sid
@@ -2172,7 +2269,7 @@ def _send_payloads(state, status, inc, round_idx, params, world,
         state, status, inc, round_idx, params, world, node_ids, is_self,
         epoch=epoch,
     )
-    no_msg = delivery.no_message(params.compact_wire)
+    no_msg = delivery.no_message(fmt=params.wire_format)
     gossip_keys = jnp.where(hot, record_keys, no_msg)
     sync_keys = jnp.where(syncable, record_keys, no_msg)
     return gossip_keys, sync_keys
@@ -2305,8 +2402,8 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
     fd_slot_onehot = (
         jnp.arange(k, dtype=jnp.int32)[None, :] == slot_safe[:, None]
     )
-    compact = params.compact_wire
-    no_msg = delivery.no_message(compact)
+    wf = params.wire_format
+    no_msg = delivery.no_message(fmt=wf)
     # The FD verdict is about the record the observer HOLDS — same
     # incarnation, same identity epoch (a stale-epoch SUSPECT verdict
     # then drops at every guarded merge gate, including the observer's
@@ -2318,7 +2415,7 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
     fd_suspect_key = delivery.pack_record(
         jnp.int8(records.SUSPECT),
         jnp.take_along_axis(inc, slot_safe[:, None], 1)[:, 0],
-        compact=compact, epoch=fd_entry_epoch,
+        fmt=wf, epoch=fd_entry_epoch,
         epoch_bits=params.epoch_bits,
     )
     fd_inbox = jnp.where(
@@ -2393,8 +2490,8 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
     sync_ok = alive[sync_target[:, 0]] & part_ok_s & ~wire_drop_s
     sync_drop = (~(do_sync & sync_ok))[:, None]
 
-    alive_flags = delivery.is_alive_key(gossip_keys, compact=compact)
-    sync_alive_flags = delivery.is_alive_key(sync_keys, compact=compact)
+    alive_flags = delivery.is_alive_key(gossip_keys, fmt=wf)
+    sync_alive_flags = delivery.is_alive_key(sync_keys, fmt=wf)
     hot_any = jnp.any(gossip_keys >= 0, axis=1)
     hot_g = None
     if params.n_user_gossips > 0:
@@ -2462,9 +2559,16 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
 def _scatter_channel_bufs(s, params, gossip_extra_drop, sync_extra_drop,
                           ae_suppress=False):
     """The UNCOMBINED global-height inbox contribution of one scatter
-    round: the max-folded packed-key buffer and the int8 ALIVE-flag
-    buffer (both [N, K]).  The serial tick pmax-combines these in the
-    same round body; the pipelined path carries them to the next one.
+    round: the max-folded packed-key buffer (``[N, K]``), plus — on the
+    legacy two-buffer wire (``params.fused_wire`` False) — the int8
+    ALIVE-flag buffer.  The serial tick pmax-combines these in the same
+    round body; the pipelined path carries them to the next one.
+
+    Under the FUSED wire (the default) the flag buffer is None: the
+    ALIVE flag lives in the key word's own bits and the merge gate
+    derives it from the folded winner (delivery.is_alive_key), so the
+    round moves ONE buffer — half the scatter folds, half the
+    cross-device collectives (SwimParams.fused_wire docstring).
 
     The anti-entropy plane's paired exchange (``sync_interval > 0``)
     folds its two channels into the SAME buffers — same payload as the
@@ -2481,6 +2585,14 @@ def _scatter_channel_bufs(s, params, gossip_extra_drop, sync_extra_drop,
                              g_drop, n),
         delivery.scatter_max(s["sync_keys"], s["sync_target"], s_drop, n),
     )
+    if params.sync_interval > 0 and not ae_suppress:
+        buf = jnp.maximum(
+            buf,
+            delivery.scatter_max(s["sync_keys"], s["ae_targets"],
+                                 s["ae_drop"], n),
+        )
+    if params.fused_wire:
+        return buf, None
     fbuf = (
         delivery.scatter_or(s["alive_flags"], s["gossip_targets"],
                             g_drop, n)
@@ -2488,11 +2600,6 @@ def _scatter_channel_bufs(s, params, gossip_extra_drop, sync_extra_drop,
                               s_drop, n)
     )
     if params.sync_interval > 0 and not ae_suppress:
-        buf = jnp.maximum(
-            buf,
-            delivery.scatter_max(s["sync_keys"], s["ae_targets"],
-                                 s["ae_drop"], n),
-        )
         fbuf = fbuf | delivery.scatter_or(
             s["sync_alive_flags"], s["ae_targets"], s["ae_drop"], n
         )
@@ -2547,18 +2654,22 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     # small-N validation mode, so its extra per-bin combines are
     # acceptable — the 1M shift path bins receiver-side instead).
     inbox_now, flags_now, g_now, ring, fring, gring, slot0 = _ring_open(
-        state, params, round_idx
+        state, params, round_idx, with_flags=not params.fused_wire
     )
 
     def channel_bufs(gossip_extra_drop, sync_extra_drop, ae_suppress=False):
         buf, fbuf = _scatter_channel_bufs(s, params, gossip_extra_drop,
                                           sync_extra_drop,
                                           ae_suppress=ae_suppress)
-        return combine_max(buf), combine_max(fbuf)
+        # Fused wire: ONE combined buffer per bin (fbuf is None — the
+        # merge gate derives the ALIVE flag from the winner key).
+        return combine_max(buf), (None if fbuf is None
+                                  else combine_max(fbuf))
 
     if params.max_delay_rounds == 0:
         inbox, inbox_alive8 = channel_bufs(False, False)
-        inbox_alive = inbox_alive8.astype(jnp.bool_)
+        inbox_alive = (None if inbox_alive8 is None
+                       else inbox_alive8.astype(jnp.bool_))
     else:
         # delay None = statically zero (link_eval docstring): bin 0 always.
         q_g = (jnp.zeros((n_local, params.fanout), jnp.int32)
@@ -2573,14 +2684,22 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
                    (n_local,)))[:, None]
         inbox, inbox_alive8 = channel_bufs(q_g != 0, q_s != 0)
         inbox = jnp.maximum(inbox, inbox_now)
-        inbox_alive = inbox_alive8.astype(jnp.bool_) | flags_now
+        inbox_alive = (None if inbox_alive8 is None
+                       else inbox_alive8.astype(jnp.bool_) | flags_now)
         d = params.max_delay_rounds + 1
         for j in range(1, d):
             # The anti-entropy exchange is same-round only (bin 0).
             buf_j, fbuf_j = channel_bufs(q_g != j, q_s != j,
                                          ae_suppress=True)
-            ring, fring = _ring_push(ring, fring, (slot0 + j) % d,
-                                     buf_j, fbuf_j.astype(jnp.bool_))
+            if fbuf_j is None:
+                # Fused wire: the flag ring is dead weight — future
+                # flags rederive from the ring's key slots at open time
+                # (is_alive_key of the folded winner), so only the key
+                # contribution is pushed.
+                ring = ring_ops.push_max(ring, (slot0 + j) % d, buf_j)
+            else:
+                ring, fring = _ring_push(ring, fring, (slot0 + j) % d,
+                                         buf_j, fbuf_j.astype(jnp.bool_))
 
     # FD local verdicts fold into the same inbox (observer-local, no comm).
     inbox = jnp.maximum(inbox, s["fd_inbox"])
@@ -2595,6 +2714,14 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
             part, jax.random.fold_in(s["k_sync_drop"], 29),
             axis_name=axis_name,
         )
+
+    if params.fused_wire:
+        # The FUSED merge gate: the ALIVE flag of the round's folded
+        # winner, derived from the key bits themselves after every fold
+        # (channels, delay ring, FD verdicts, seed round trip) — the
+        # reference's per-message null-gate applied to the round's one
+        # folded message (SwimParams.fused_wire docstring).
+        inbox_alive = delivery.is_alive_key(inbox, fmt=params.wire_format)
 
     # User-gossip bits ride the same gossip channels, targets, and drop
     # masks — one GOSSIP_REQ carries membership records AND user gossips
@@ -2708,10 +2835,12 @@ def swim_tick_send(state: SwimState, round_idx, base_key,
     """First half of the PIPELINED scatter round: phases 1-3 only.
 
     Returns ``(pending, send_aux)``: ``pending`` is the device's
-    UNCOMBINED global-height inbox contribution (packed-key buffer +
-    int8 ALIVE-flag buffer + optional user-gossip bits, with the FD
-    verdicts max-folded into the owner's local row block), and
-    ``send_aux`` the send-side counters.  Both are consumed by
+    UNCOMBINED global-height inbox contribution — under the FUSED wire
+    (the default) a SINGLE packed-key buffer whose spare bits carry the
+    ALIVE flags, else the legacy key + int8 flag pair — plus optional
+    user-gossip bits, with the FD verdicts max-folded into the owner's
+    local row block; ``send_aux`` is the send-side counters.  Both are
+    consumed by
     :func:`swim_tick_recv` — in the NEXT scan body under the pipelined
     runner (parallel/mesh.shard_run) — which is where the cross-device
     ``pmax`` actually runs.
@@ -2747,7 +2876,11 @@ def swim_tick_send(state: SwimState, round_idx, base_key,
     buf = jax.lax.dynamic_update_slice(
         buf, jnp.maximum(local, s["fd_inbox"]), (offset, 0)
     )
-    pending = dict(keys=buf, flags=fbuf)
+    # Fused wire: the pipelined carry is a SINGLE buffer — the ALIVE
+    # flag rides the key word's own bits (SwimParams.fused_wire).
+    pending = dict(keys=buf)
+    if fbuf is not None:
+        pending["flags"] = fbuf
     if params.n_user_gossips > 0:
         pending["g_bits"] = delivery.scatter_or(
             s["hot_g"], s["gossip_targets"], s["gossip_drop"],
@@ -2793,7 +2926,12 @@ def swim_tick_recv(state: SwimState, pending, send_aux, round_idx,
         return jax.lax.dynamic_slice_in_dim(buf, offset, n_local, axis=0)
 
     inbox = combine_max(pending["keys"])
-    inbox_alive = combine_max(pending["flags"]).astype(jnp.bool_)
+    if params.fused_wire:
+        # The fused merge gate: the folded winner's own ALIVE flag,
+        # derived from the combined key buffer (ONE pmax per round).
+        inbox_alive = delivery.is_alive_key(inbox, fmt=params.wire_format)
+    else:
+        inbox_alive = combine_max(pending["flags"]).astype(jnp.bool_)
     g_delivered = None
     if params.n_user_gossips > 0:
         g_delivered = combine_max(pending["g_bits"]).astype(jnp.bool_)
@@ -2813,7 +2951,8 @@ def swim_tick_recv(state: SwimState, pending, send_aux, round_idx,
     metrics = _round_metrics(new_state, ctx["status"], aux, params, world,
                              ctx["alive"], ctx["alive_here"], axis_name)
     if params.compact_carry:
-        new_state = _carry_encode(new_state, round_idx)
+        new_state = _carry_encode(new_state, round_idx,
+                                  inc_sat=_wire_inc_sat(params))
     return new_state, metrics
 
 
@@ -3000,13 +3139,13 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
      lhm_clean) = fd_phase(0)
     ping_req_n = jnp.sum(ping_req_launches, dtype=jnp.int32) * r_proxies
 
-    compact = params.compact_wire
-    no_msg = delivery.no_message(compact)
+    wf = params.wire_format
+    no_msg = delivery.no_message(fmt=wf)
     fd_slot_onehot = (
         jnp.arange(k, dtype=jnp.int32)[None, :] == slot_safe[:, None]
     )
     fd_suspect_key = delivery.pack_record(
-        jnp.int8(records.SUSPECT), entry_t_inc, compact=compact,
+        jnp.int8(records.SUSPECT), entry_t_inc, fmt=wf,
         epoch=entry_t_ep, epoch_bits=params.epoch_bits,
     )
     fd_inbox = jnp.where(
@@ -3059,7 +3198,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         keys = eng.deliver(h_keys, s)
         tx = (eng.deliver(h_tx, s) & tx_bit) != 0
         payload = jnp.where(tx, keys, no_msg)
-        return payload, delivery.is_alive_key(payload, compact=compact)
+        return payload, delivery.is_alive_key(payload, fmt=wf)
 
     def deliver_gossip(s):
         return deliver_channel(s, 1)
@@ -3360,8 +3499,8 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
     f = params.fanout
     eng = shift_ops.ShiftEngine(n, roll_payloads=params.shift_roll_payloads)
     compact = params.compact_carry          # carry layout
-    wire = params.compact_wire              # wire-key format
-    no_msg = delivery.no_message(wire)
+    wf = params.wire_format                 # wire-key format
+    no_msg = delivery.no_message(fmt=wf)
 
     # ---- Round draws: identical keys/shapes to _tick_shift --------------
     n_shifts = 1 + r_proxies + f + 1
@@ -3413,7 +3552,7 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
     ping_req_n = jnp.sum(ping_req_launches, dtype=jnp.int32) * r_proxies
     slot_safe = t                                    # full view: slot == id
     fd_suspect_key = delivery.pack_record(
-        jnp.int8(records.SUSPECT), entry_t_inc, compact=wire,
+        jnp.int8(records.SUSPECT), entry_t_inc, fmt=wf,
         epoch=entry_t_ep, epoch_bits=params.epoch_bits,
     )
 
@@ -3561,7 +3700,7 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
             keys_c = eng.deliver(h_keys_b, sft)
             tx = (eng.deliver(h_tx_b, sft) & tx_bit) != 0
             payload = jnp.where(tx, keys_c, no_msg)
-            return payload, delivery.is_alive_key(payload, compact=wire)
+            return payload, delivery.is_alive_key(payload, fmt=wf)
 
         # FD verdict lands on column slot_safe (one cell per row).
         inbox_b = jnp.where(
@@ -3595,8 +3734,9 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
             params, kn, world, node_ids, alive_here, is_self_b,
             epoch=ep_b, own_epoch=own_epoch,
         )
-        out_blk = (_carry_encode(new_blk, round_idx) if compact
-                   else new_blk)
+        out_blk = (_carry_encode(new_blk, round_idx,
+                                 inc_sat=_wire_inc_sat(params))
+                   if compact else new_blk)
 
         st_acc = jax.lax.dynamic_update_slice_in_dim(
             st_acc, out_blk.status, c0, 1)

@@ -24,6 +24,8 @@ ICI-collective analog of the reference's point-to-point TCP.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax.numpy as jnp
 
 from scalecube_cluster_tpu import records
@@ -32,93 +34,161 @@ from scalecube_cluster_tpu import records
 # for any non-ABSENT record; ABSENT maps to -1 and never wins).
 NO_MESSAGE = jnp.int32(-1)
 
-_INC_MASK = (1 << 29) - 1
 
-# Compact (int16) wire format — records.merge_key16: dead bit 14,
-# incarnation bits 1..13, suspect bit 0.  Chosen by
-# models/swim.SwimParams.compact_carry to halve the [.., K] key buffers
-# (wire + inbox + carry) in full-view capacity runs.
-_INC_MASK16 = (1 << 13) - 1
-
-# Open-world identity epochs (models/swim.SwimParams.open_world): when
-# ``epoch_bits > 0`` the key donates its TOP incarnation bits to a
-# per-slot identity epoch, directly below the dead bit:
+# --------------------------------------------------------------------------
+# The wire-format bitfield ladder
+# --------------------------------------------------------------------------
 #
-#   wide:    bit 30 = dead | bits (30-E)..29 = epoch | inc | bit 0 = suspect
-#   compact: bit 14 = dead | bits (14-E)..13 = epoch | inc | bit 0 = suspect
+# Every wire key is one signed integer word laid out
 #
-# The dead bit stays on top, so the inbox max-fold keeps the reference's
-# DEAD-absorbs order (a naive-reuse run folds exactly like the
-# reference); within a liveness class a higher epoch orders above any
-# incarnation of an older occupant.  Cross-epoch SEMANTICS live in
-# :func:`merge_inbox`'s gate, not the fold.  Epoch bit widths are fixed
-# per wire format (SwimParams.epoch_bits): 6 wide / 2 compact, which
-# drops the incarnation saturation point to 2^23-1 / 2^11-1
-# (models/swim._wire_inc_sat) — still far past any refutation-bump
-# reachable count.
-EPOCH_BITS_WIDE = 6
-EPOCH_BITS_COMPACT = 2
+#   [sign 0] [dead] [epoch (E bits, open-world only)] [incarnation] [suspect]
+#
+# with the dead bit on top so the inbox max-fold keeps the reference's
+# DEAD-absorbs order (records.merge_key docstring), a higher epoch
+# ordering above any incarnation of an older occupant within a liveness
+# class (cross-epoch SEMANTICS live in :func:`merge_inbox`'s gate, not
+# the fold), then incarnation, then the suspect bit breaking ties at
+# equal incarnation.  The three rungs differ in where the dead bit sits
+# — i.e. how many bits the key spends — and in the word dtype:
+#
+#   wide    int32 word, dead bit 30: the default.  29 incarnation bits
+#           (23 with the 6-bit epoch field) — saturation 2^29-1 / 2^23-1.
+#   wire24  int32 word, dead bit 23: the compact-carry headroom rung.
+#           The STORED table stays int16 (models/swim.SwimParams.
+#           compact_carry) but the WIRE key widens from 16 to 24 bits
+#           inside the int32 word already crossing ICI — epoch 2 -> 4
+#           bits and the incarnation field grows to 22 / 18 bits, so the
+#           int16 stored-incarnation ceiling (32767) becomes the binding
+#           cap instead of the wire's 2^11-1 (models/swim._wire_inc_sat).
+#   wire16  int16 word, dead bit 14 (records.merge_key16): the
+#           capacity/bandwidth rung.  13 incarnation bits (11 with the
+#           2-bit epoch field) — saturation 8191 / 2047.
+#
+# ALIVE/transmit flags are NOT a separate field: an ALIVE record is
+# exactly a key with the dead and suspect bits clear (is_alive_key), so
+# the fused single-buffer wire (models/swim.SwimParams.fused_wire)
+# derives the merge gate's ALIVE flag from the folded winner key itself
+# instead of shipping a parallel flag buffer — the flag bits ride inside
+# the key word for free, for every rung of the ladder.
 
 
-def _field_layout(compact: bool, epoch_bits: int):
-    """(dead_bit, inc_bits) of the active key layout."""
-    dead_bit = 14 if compact else 30
-    return dead_bit, dead_bit - 1 - epoch_bits
+@dataclasses.dataclass(frozen=True)
+class WireFormat:
+    """One rung of the wire-format ladder (module-level layout comment).
+
+    ``dead_bit`` fixes the whole layout: suspect at bit 0, incarnation
+    at bits 1..(dead_bit-1-epoch_bits), the identity-epoch field (when
+    the open-world plane is on) directly under the dead bit.  This
+    table is the ONE source of truth for every saturation clamp in the
+    tree — the self-refutation bump, the WIRE_SATURATION monitor bound,
+    the compact-carry encode clamp all derive from :meth:`inc_sat`
+    (grep-proofed by tests/test_wire_constants.py).
+    """
+
+    name: str
+    dead_bit: int
+    epoch_bits: int      # field width when the open-world plane is on
+    wide_word: bool      # True: int32 wire word; False: int16
+
+    @property
+    def dtype(self):
+        return jnp.int32 if self.wide_word else jnp.int16
+
+    @property
+    def word_bytes(self) -> int:
+        return 4 if self.wide_word else 2
+
+    def inc_bits(self, epoch_bits: int = 0) -> int:
+        """Incarnation field width at the given active epoch width."""
+        return self.dead_bit - 1 - epoch_bits
+
+    def inc_sat(self, epoch_bits: int = 0) -> int:
+        """Largest incarnation the key field carries exactly."""
+        return (1 << self.inc_bits(epoch_bits)) - 1
+
+    def epoch_cap(self) -> int:
+        return (1 << self.epoch_bits) - 1
 
 
-def no_message(compact: bool = False):
+WIDE = WireFormat("wide", dead_bit=30, epoch_bits=6, wide_word=True)
+WIRE24 = WireFormat("wire24", dead_bit=23, epoch_bits=4, wide_word=True)
+WIRE16 = WireFormat("wire16", dead_bit=14, epoch_bits=2, wide_word=False)
+
+WIRE_FORMATS = {f.name: f for f in (WIDE, WIRE24, WIRE16)}
+
+# Back-compat aliases (the PR-10 epoch-bit constants, now table rows).
+EPOCH_BITS_WIDE = WIDE.epoch_bits
+EPOCH_BITS_COMPACT = WIRE16.epoch_bits
+
+
+def resolve_format(compact: bool = False, fmt: WireFormat = None) -> WireFormat:
+    """The active :class:`WireFormat`: an explicit ``fmt`` wins; the
+    legacy ``compact`` bool selects wire16 vs wide (every pre-ladder
+    call site and test keeps meaning exactly what it meant)."""
+    if fmt is not None:
+        return fmt
+    return WIRE16 if compact else WIDE
+
+
+def no_message(compact: bool = False, fmt: WireFormat = None):
     """The "no message" key in the wire dtype.
 
     Mixing the int32 constant into int16 expressions would silently
     promote whole buffers back to int32 — always take the constant from
     here when the key dtype is mode-dependent."""
-    return jnp.int16(-1) if compact else NO_MESSAGE
+    f = resolve_format(compact, fmt)
+    return NO_MESSAGE if f.wide_word else jnp.int16(-1)
 
 
 def pack_record(status, inc, compact: bool = False, epoch=None,
-                epoch_bits: int = 0):
-    """Pack (status, incarnation[, epoch]) into the merge key
-    (records.merge_key, or the int16 records.merge_key16 when
-    ``compact``; the epoch-extended layout when ``epoch_bits > 0`` —
-    see the module-level layout comment).
+                epoch_bits: int = 0, fmt: WireFormat = None):
+    """Pack (status, incarnation[, epoch]) into the merge key of the
+    active wire format (the :class:`WireFormat` ladder; the
+    epoch-extended layout when ``epoch_bits > 0`` — see the
+    module-level layout comment).
 
-    ABSENT packs to -1 == no_message(compact): absent entries are simply
+    ABSENT packs to -1 == no_message(...): absent entries are simply
     never transmitted, matching the reference where only table-present
     records go into SYNC/gossip payloads
     (MembershipProtocolImpl.java:446-454).
     """
+    f = resolve_format(compact, fmt)
     if epoch_bits == 0:
-        if compact:
+        # The two legacy rungs delegate to the records.py key builders
+        # (byte-for-byte the pre-ladder wire).
+        if f is WIRE16:
             return records.merge_key16(status, inc)
-        return records.merge_key(status, inc)
+        if f is WIDE:
+            return records.merge_key(status, inc)
     status = jnp.asarray(status)
     inc = jnp.asarray(inc, dtype=jnp.int32)
-    dead_bit, inc_bits = _field_layout(compact, epoch_bits)
+    inc_bits = f.inc_bits(epoch_bits)
     is_dead = (status == records.DEAD).astype(jnp.int32)
     is_suspect = (status == records.SUSPECT).astype(jnp.int32)
     inc_sat = jnp.minimum(inc, jnp.int32((1 << inc_bits) - 1))
+    # At epoch_bits == 0 (wire24's flat layout reaches this generic
+    # branch) the epoch field has ZERO width: clip to 0, never let a
+    # passed epoch value shift into the dead bit.
     ep = jnp.asarray(0 if epoch is None else epoch, jnp.int32)
     ep = jnp.clip(ep, 0, (1 << epoch_bits) - 1)
-    key = ((is_dead << dead_bit) | (ep << (inc_bits + 1))
+    key = ((is_dead << f.dead_bit) | (ep << (inc_bits + 1))
            | (inc_sat << 1) | is_suspect)
     key = jnp.where(status == records.ABSENT, -1, key)
-    return key.astype(jnp.int16) if compact else key
+    return key.astype(f.dtype)
 
 
-def unpack_record(key, compact: bool = False, epoch_bits: int = 0):
+def unpack_record(key, compact: bool = False, epoch_bits: int = 0,
+                  fmt: WireFormat = None):
     """Invert :func:`pack_record`: key -> (status int8, incarnation int32).
 
     Keys < 0 unpack to (ABSENT, 0).  The epoch field (when
     ``epoch_bits > 0``) is read separately by :func:`unpack_epoch` so
     the dominant two-field call sites stay unchanged.
     """
-    if epoch_bits == 0:
-        dead_bit, inc_mask = (14, _INC_MASK16) if compact else (30, _INC_MASK)
-    else:
-        dead_bit, inc_bits = _field_layout(compact, epoch_bits)
-        inc_mask = (1 << inc_bits) - 1
+    f = resolve_format(compact, fmt)
+    inc_mask = (1 << f.inc_bits(epoch_bits)) - 1
     key = jnp.asarray(key, dtype=jnp.int32)
-    is_dead = (key >> dead_bit) & 1
+    is_dead = (key >> f.dead_bit) & 1
     is_suspect = key & 1
     status = jnp.where(
         is_dead == 1,
@@ -130,18 +200,20 @@ def unpack_record(key, compact: bool = False, epoch_bits: int = 0):
     return status, inc
 
 
-def unpack_epoch(key, compact: bool = False, epoch_bits: int = 0):
+def unpack_epoch(key, compact: bool = False, epoch_bits: int = 0,
+                 fmt: WireFormat = None):
     """The identity-epoch field of an epoch-extended key (int32; keys
     < 0 — no message / ABSENT — unpack to epoch 0)."""
     if epoch_bits == 0:
         return jnp.zeros_like(jnp.asarray(key, jnp.int32))
-    _, inc_bits = _field_layout(compact, epoch_bits)
+    f = resolve_format(compact, fmt)
+    inc_bits = f.inc_bits(epoch_bits)
     key = jnp.asarray(key, dtype=jnp.int32)
     ep = (key >> (inc_bits + 1)) & ((1 << epoch_bits) - 1)
     return jnp.where(key < 0, 0, ep).astype(jnp.int32)
 
 
-def is_alive_key(key, compact: bool = False):
+def is_alive_key(key, compact: bool = False, fmt: WireFormat = None):
     """True where ``key`` packs an ALIVE record (dead/suspect bits clear).
 
     The ALIVE-gate side channel must reflect the *transmitted* record, not
@@ -149,10 +221,15 @@ def is_alive_key(key, compact: bool = False):
     final-round gossip carries DEAD@inc+1 while its own table row is
     pinned ALIVE (models/swim._send_payloads).  An ABSENT entry must not
     open for that DEAD notice (MembershipRecord.java:67-69).
+
+    This is also the FUSED wire's merge gate (models/swim.SwimParams.
+    fused_wire): applied to the round's folded winner key it yields the
+    winner's own ALIVE flag — no parallel flag buffer needs to cross
+    the wire, because the flag is a pure function of the key bits.
     """
-    dead_bit = 14 if compact else 30
+    f = resolve_format(compact, fmt)
     key = jnp.asarray(key)
-    return (key >= 0) & (((key >> dead_bit) & 1) == 0) & ((key & 1) == 0)
+    return (key >= 0) & (((key >> f.dead_bit) & 1) == 0) & ((key & 1) == 0)
 
 
 def scatter_max(values, targets, drop, n_rows: int):
@@ -220,7 +297,8 @@ def wire_saturation(messages_sent, live_senders, fanout):
 
 def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
                 compact: bool = False, suppress=None, entry_epoch=None,
-                epoch_bits: int = 0, epoch_guard: bool = True):
+                epoch_bits: int = 0, epoch_guard: bool = True,
+                fmt: WireFormat = None):
     """Merge one round's inbox into the membership table rows.
 
     Equivalent to one valid arrival-order serialization of the reference's
@@ -292,8 +370,9 @@ def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
     ``epoch_bits == 0`` (the exact pre-open-world contract), else
     (status int8, inc int32, epoch int32, changed bool).
     """
-    win_status, win_inc = unpack_record(inbox_key, compact=compact,
-                                        epoch_bits=epoch_bits)
+    f = resolve_format(compact, fmt)
+    win_status, win_inc = unpack_record(inbox_key, epoch_bits=epoch_bits,
+                                        fmt=f)
 
     # Stored DEAD gates like ABSENT (record was deleted in the reference).
     gate_status = jnp.where(entry_status == records.DEAD, records.ABSENT, entry_status)
@@ -306,7 +385,7 @@ def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
     # replaces the five-rule select chain in the hottest fusion; exact
     # below the key's incarnation saturation, where the fold itself
     # already lives.
-    entry_key = pack_record(gate_status, entry_inc, compact=compact)
+    entry_key = pack_record(gate_status, entry_inc, fmt=f)
     # The ABSENT gate: only an ALIVE opener admits the winner (any
     # non-absent winner, i.e. key >= 0, once open).
     #
@@ -326,12 +405,12 @@ def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
         if suppress is not None:
             # Suppressed tombstones keep their DEAD key in the gate: only
             # a strictly higher DEAD key overrides during the window.
-            true_key = pack_record(entry_status, entry_inc, compact=compact)
+            true_key = pack_record(entry_status, entry_inc, fmt=f)
             accepts = jnp.where(suppress, inbox_key > true_key, accepts)
         new_epoch = None
     else:
         entry_ep = jnp.asarray(entry_epoch, jnp.int32)
-        win_ep = unpack_epoch(inbox_key, compact=compact,
+        win_ep = unpack_epoch(inbox_key, fmt=f,
                               epoch_bits=epoch_bits)
         if epoch_guard:
             # Same-epoch precedence on the epoch-STRIPPED keys (wide
@@ -358,7 +437,7 @@ def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
             # epoch-blind precedence on the FULL packed keys; the epoch
             # field only rides along for attribution.
             entry_key_full = pack_record(gate_status, entry_inc,
-                                         compact=compact, epoch=entry_ep,
+                                         fmt=f, epoch=entry_ep,
                                          epoch_bits=epoch_bits)
             accepts = jnp.where(
                 absent, inbox_any_alive & (inbox_key >= 0),
@@ -366,7 +445,7 @@ def merge_inbox(entry_status, entry_inc, inbox_key, inbox_any_alive,
             )
             if suppress is not None:
                 true_key = pack_record(entry_status, entry_inc,
-                                       compact=compact, epoch=entry_ep,
+                                       fmt=f, epoch=entry_ep,
                                        epoch_bits=epoch_bits)
                 accepts = jnp.where(suppress, inbox_key > true_key, accepts)
         new_epoch = jnp.where(accepts, win_ep, entry_ep).astype(jnp.int32)

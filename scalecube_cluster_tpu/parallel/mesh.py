@@ -150,7 +150,10 @@ def _pipelined_rounds(base_key, params: swim.SwimParams,
     (the merge is the tick's last phase), this is a scheduling change
     only: outputs are BIT-IDENTICAL to the serial scan
     (tests/test_pipelined_delivery.py), at the cost of double-buffering
-    one [N, K] contribution pair in the carry.
+    one [N, K] contribution in the carry — a SINGLE packed-key buffer
+    under the fused wire (SwimParams.fused_wire, the default: the
+    ALIVE flags ride the key bits), the legacy key + int8 flag pair
+    under ``fused_wire=False``.
 
     ``on_round(extra, prev_state, round_idx, new_state, metrics)`` is
     the per-round observation hook (the metered twin's registry fold),

@@ -30,12 +30,17 @@ D halves both the per-device compute and the per-device ICI traffic.
 
 Scatter mode (ops/delivery + lax.pmax)
 --------------------------------------
-The inbox combine is a ``pmax`` over the full-height [N, K] int32
-contribution buffer plus the int8 ALIVE-flag buffer (2 collectives per
-round with delay modeling off; each extra delay bin adds 2 more).  A ring
-all-reduce sends ``2 * (D-1)/D * size`` per device, i.e. per-device ICI
-bytes are **O(N * K) — constant in D**.  Scatter mode is the validation
-path; at scale the shift path's advantage grows linearly in D.
+Under the FUSED single-buffer wire (SwimParams.fused_wire, the default)
+the inbox combine is ONE ``pmax`` over the full-height [N, K] packed-key
+contribution buffer per round (per delay bin) — the ALIVE flag rides the
+key word's own bits, so no flag buffer crosses ICI: 4 B/slot on the
+wide wire vs the legacy two-buffer path's 5 (int32 key + int8 flag, 2
+collectives per round; ``fused_wire=False`` keeps that path as the
+bench.py --wire baseline, and each extra delay bin adds one more
+collective per buffer).  A ring all-reduce sends ``2 * (D-1)/D * size``
+per device, i.e. per-device ICI bytes are **O(N * K) — constant in D**.
+Scatter mode is the validation path; at scale the shift path's
+advantage grows linearly in D.
 
 Pipelined scatter (parallel/mesh._pipelined_rounds)
 ---------------------------------------------------
@@ -71,14 +76,15 @@ INT8 = 1
 
 
 def _key_bytes(params) -> int:
-    """Wire bytes per packed record key.
-
-    The int16 wire (``compact_carry`` or ``int16_wire``) ships
-    records.merge_key16 keys, halving every key exchange's ICI bytes —
-    the sharded full-view capacity layout is also the cheaper one to
-    scale out.
+    """Wire bytes per packed record key — the active WireFormat's word
+    width (ops/delivery.WIRE_FORMATS): 2 for wire16 (``compact_carry``
+    or ``int16_wire``, halving every key exchange's ICI bytes — the
+    sharded full-view capacity layout is also the cheaper one to scale
+    out), 4 for the wide and wire24 rungs (wire24 spends the int32
+    word's idle bits on incarnation headroom instead of narrower
+    lanes).
     """
-    return INT16 if params.compact_wire else INT32
+    return params.wire_format.word_bytes
 
 
 def shift_exchanges_per_round(params, gate_contacts: bool = False):
@@ -127,27 +133,43 @@ def shift_ici_bytes_per_device_round(params, n_devices: int,
 
 
 def scatter_collectives_per_round(params) -> int:
-    """Full-height pmax combines per tick, scatter mode (delay off: the
-    key buffer + the ALIVE-flag buffer; each delay bin doubles that)."""
+    """Full-height pmax combines per tick, scatter mode.
+
+    FUSED wire (default): ONE combined key buffer per delay bin — the
+    ALIVE flags ride the key bits (models/swim._scatter_channel_bufs).
+    Legacy two-buffer wire (``fused_wire=False``): the key buffer plus
+    the int8 ALIVE-flag buffer per bin."""
     bins = params.max_delay_rounds + 1 if params.max_delay_rounds > 0 else 1
-    return 2 * bins
+    return (1 if params.fused_wire else 2) * bins
+
+
+def scatter_wire_bytes_per_slot(params) -> int:
+    """Wire bytes ONE (receiver, subject) inbox slot costs per round in
+    the scatter combine: the packed-key word, plus the int8 ALIVE flag
+    on the legacy two-buffer wire — the 4-vs-5 B/slot headline of the
+    fused wire (wide rung; 2 vs 3 on wire16, 4 on wire24 whose word
+    already carries the widened key)."""
+    return _key_bytes(params) + (0 if params.fused_wire else INT8)
 
 
 def pipelined_scatter_hlo_collectives(params) -> int:
     """Full-height combine instructions in the compiled PIPELINED
-    scatter program: the per-round pair rides the scan body (combining
-    the PREVIOUS round's carried contribution) and the final round's
-    pair runs in the loop epilogue — so the instruction count doubles
-    while per-round collectives (``scatter_collectives_per_round``) and
-    per-round ICI bytes are unchanged.  Pipelining moves the combine,
-    it does not add traffic."""
+    scatter program: the per-round combines ride the scan body
+    (combining the PREVIOUS round's carried contribution — ONE
+    instruction under the fused wire, the key + flag pair on the
+    legacy two-buffer wire) and the final round's combines run in the
+    loop epilogue — so the instruction count doubles while per-round
+    collectives (``scatter_collectives_per_round``) and per-round ICI
+    bytes are unchanged.  Pipelining moves the combine, it does not
+    add traffic."""
     return 2 * scatter_collectives_per_round(params)
 
 
 def scatter_ici_bytes_per_device_round(params, n_devices: int) -> int:
     """Bytes each device sends over ICI per round, scatter mode: ring
-    all-reduce cost 2*(D-1)/D * buffer over the [N,K] key + int8 flag
-    buffers.
+    all-reduce cost 2*(D-1)/D * buffer over the [N, K] combined key
+    buffer (plus the int8 flag buffer on the legacy two-buffer wire —
+    ``scatter_wire_bytes_per_slot``).
 
     The anti-entropy plane adds NO scatter-mode ICI traffic: its two
     exchange channels scatter into the SAME full-height contribution
@@ -157,7 +179,7 @@ def scatter_ici_bytes_per_device_round(params, n_devices: int) -> int:
     """
     n, k = params.n_members, params.n_subjects
     bins = params.max_delay_rounds + 1 if params.max_delay_rounds > 0 else 1
-    buffer_bytes = n * k * (_key_bytes(params) + INT8) * bins
+    buffer_bytes = n * k * scatter_wire_bytes_per_slot(params) * bins
     return int(2 * (n_devices - 1) / n_devices * buffer_bytes)
 
 

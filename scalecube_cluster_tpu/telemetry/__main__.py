@@ -89,7 +89,8 @@ def _cmd_regress(args) -> int:
             os.path.join("artifacts", "sync_heal*.json"),
             os.path.join("artifacts", "lifeguard_fp*.json"),
             os.path.join("artifacts", "churn_growth*.json"),
-            os.path.join("artifacts", "fuzz_campaign*.json")])
+            os.path.join("artifacts", "fuzz_campaign*.json"),
+            os.path.join("artifacts", "wire_fused*.json")])
     readable = [p for p in paths if os.path.exists(p)]
     if not readable:
         print("regress: no artifacts matched", file=sys.stderr)
@@ -133,7 +134,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("paths", nargs="*",
                    help="artifact files/globs (default: BENCH_*.json "
                         "MULTICHIP_*.json artifacts/sync_heal*.json "
-                        "artifacts/lifeguard_fp*.json)")
+                        "artifacts/lifeguard_fp*.json "
+                        "artifacts/churn_growth*.json "
+                        "artifacts/fuzz_campaign*.json "
+                        "artifacts/wire_fused*.json)")
     p.add_argument("--band", type=float, default=query.DEFAULT_NOISE_BAND,
                    help="relative noise band (default 0.10)")
     p.add_argument("--json", action="store_true")

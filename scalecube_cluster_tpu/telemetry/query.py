@@ -314,7 +314,8 @@ def load_bench_payload(path: str) -> Tuple[Optional[dict], Optional[str]]:
                      or "sync_rounds_to_converge" in payload
                      or "fp_ratio" in payload
                      or "no_resurrection_violations" in payload
-                     or "vmap_speedup_ratio" in payload)):
+                     or "vmap_speedup_ratio" in payload
+                     or "fused_serial_speedup_ratio" in payload)):
             return None, stub_note
     return payload, None
 
@@ -668,6 +669,72 @@ def regress(paths: Sequence[str],
             best = max(v for _, v in prior)
             check("slo/fuzz_scenario_throughput", last_path, last, best,
                   best * (1.0 - band), last >= best * (1.0 - band))
+        # Fused-wire artifacts (bench.py --wire): the single-buffer
+        # scatter wire's committed win.  ABSOLUTE gates on the latest
+        # round — fused throughput >= the two-buffer HEAD path on BOTH
+        # the serial and pipelined runs (ratio >= 1.0, a floor: the
+        # collective halving must never cost throughput), the modeled
+        # bytes/slot and collectives/round pinned exactly (4-vs-5 B,
+        # 1-vs-2 combines — arithmetic, not host-dependent), the HLO
+        # instruction counts matching the model when the text parse
+        # was available (null = provenance), and shift-mode accounting
+        # untouched.  Smoke artifacts are provenance unless the walk
+        # holds only smoke rounds (the sync-heal rule: `--wire
+        # --smoke`'s in-bench check of its own fresh artifact still
+        # bites).
+        wr_all = [(p, pl) for p, pl in entries
+                  if "fused_serial_speedup_ratio" in pl
+                  and "fused_pipelined_speedup_ratio" in pl]
+        wr = [(p, pl) for p, pl in wr_all
+              if not pl.get("smoke")] or wr_all
+        if wr is not wr_all:
+            for p, pl in wr_all:
+                if pl.get("smoke"):
+                    rows.append({
+                        "check": "slo/wire_fused", "source":
+                        os.path.basename(p), "ok": None,
+                        "note": "smoke wire round — host-dependent "
+                                "rates, not a trajectory datum",
+                    })
+        if wr:
+            last_path, last = wr[-1]
+            for ratio_key in ("fused_serial_speedup_ratio",
+                              "fused_pipelined_speedup_ratio"):
+                ratio = last.get(ratio_key)
+                check(f"slo/{ratio_key}", last_path, ratio, 1.0, 1.0,
+                      isinstance(ratio, (int, float))
+                      and math.isfinite(ratio) and ratio >= 1.0)
+            bps = last.get("wire_bytes_per_slot") or {}
+            check("slo/wire_fused_bytes_per_slot", last_path,
+                  bps.get("fused"), 4, 4, bps.get("fused") == 4)
+            check("slo/wire_legacy_bytes_per_slot", last_path,
+                  bps.get("legacy"), 5, 5, bps.get("legacy") == 5)
+            cpr = last.get("wire_collectives_per_round") or {}
+            check("slo/wire_fused_collectives_per_round", last_path,
+                  cpr.get("fused"), 1, 1, cpr.get("fused") == 1)
+            check("slo/wire_legacy_collectives_per_round", last_path,
+                  cpr.get("legacy"), 2, 2, cpr.get("legacy") == 2)
+            hlo = last.get("hlo_full_height_collectives")
+            if isinstance(hlo, dict):
+                check("slo/wire_hlo_fused_single_collective", last_path,
+                      hlo.get("fused"), 1, 1, hlo.get("fused") == 1)
+                check("slo/wire_hlo_legacy_collective_pair", last_path,
+                      hlo.get("legacy"), 2, 2, hlo.get("legacy") == 2)
+            else:
+                rows.append({
+                    "check": "slo/wire_hlo_fused_single_collective",
+                    "source": os.path.basename(last_path), "ok": None,
+                    "note": "no compiled-HLO collective count recorded "
+                            "— nothing to gate",
+                })
+            check("slo/wire_shift_accounting_unchanged", last_path,
+                  last.get("shift_accounting_unchanged"), True, True,
+                  last.get("shift_accounting_unchanged") is True)
+            parity = last.get("pipelined_serial_parity") or {}
+            check("slo/wire_pipelined_serial_parity", last_path,
+                  parity, True, True,
+                  parity.get("fused") is True
+                  and parity.get("legacy") is True)
     return ok, rows
 
 

@@ -1,0 +1,113 @@
+"""bench.py --wire --smoke: the fused-wire A/B JSON contract.
+
+Like tests/test_bench_multichip_smoke.py for the pipelined delivery
+gap: the bench is the one entry point the fused-vs-two-buffer
+measurement flows through, so this tier-1 test runs the real script in
+a subprocess (CPU, virtual 8-device mesh) and pins the published
+contract — one JSON line with both wires' serial AND pipelined rates,
+finite speedup ratios, the pipelined==serial parity probes, the
+compiled-HLO 1-vs-2 full-height collective counts, the traffic model's
+4-vs-5 B/slot + wire24 headroom numbers, a wire_fused_smoke.json
+artifact (never the committed one), and the regress gate walking it.
+"""
+
+import json
+import math
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.wire
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_bench_wire_smoke_contract(tmp_path):
+    artifact = tmp_path / "wire_fused_smoke.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_TPU_TELEMETRY_DIR=str(tmp_path),
+        SCALECUBE_WIRE_ARTIFACT=str(artifact),
+        SCALECUBE_XLA_CACHE_DIR="",           # no cache writes from tests
+    )
+    env.pop("SCALECUBE_TPU_PROFILE_DIR", None)
+    # The subprocess must size its own virtual mesh (conftest's 8-device
+    # XLA_FLAGS hack applies to THIS process, not children).
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--wire", "--smoke"],
+        capture_output=True, text=True, timeout=540, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout      # exactly ONE JSON line
+    result = json.loads(lines[0])
+
+    assert "error" not in result, result
+    assert result["smoke"] is True
+    assert result["metric"] == "swim_wire_fused_member_rounds_per_sec_per_chip"
+    assert result["n_devices"] >= 2
+    assert result["mesh_shape"] == [result["n_devices"]]
+    assert result["delivery"] == "scatter"
+
+    # Both wires, both run shapes, measured for real; ratios finite and
+    # consistent.  No floor on the smoke ratios here (a loaded CI box
+    # can skew one window); the committed artifacts/wire_fused.json
+    # records the pinned >= 1.0 measurements and the regress gate
+    # holds future committed rounds to the floor.
+    for pipe in ("serial", "pipelined"):
+        fused = result[f"fused_{pipe}_member_rounds_per_sec_per_chip"]
+        legacy = result[f"legacy_{pipe}_member_rounds_per_sec_per_chip"]
+        ratio = result[f"fused_{pipe}_speedup_ratio"]
+        assert fused > 0 and legacy > 0
+        assert math.isfinite(ratio) and ratio > 0
+        assert ratio == pytest.approx(fused / legacy, rel=1e-3)
+    assert result["value"] == \
+        result["fused_pipelined_member_rounds_per_sec_per_chip"]
+    assert result["rounds_timed"] > 0
+
+    # Within each wire the pipeline is a pure scheduling change.
+    assert result["pipelined_serial_parity"] == {
+        "fused": True, "legacy": True}
+
+    # The collective-halving pins: the model's counts, and — whenever
+    # the program text was parseable (it is on this runner's lowering)
+    # — the compiled HLO's full-height combine count agreeing: ONE
+    # instruction per round fused, the pair on the legacy wire.
+    assert result["wire_collectives_per_round"] == {
+        "fused": 1, "legacy": 2}
+    assert result["wire_bytes_per_slot"] == {"fused": 4, "legacy": 5}
+    hlo = result["hlo_full_height_collectives"]
+    if hlo is not None:
+        assert hlo == {"fused": 1, "legacy": 2}
+
+    # wire24: headroom at zero extra wire bytes — same 4 B/slot as the
+    # wide fused wire, with the ROADMAP saturation ladder recorded.
+    assert result["wire24_bytes_per_slot"] == 4
+    assert result["wire_inc_sat"]["wire16"] == 2047
+    assert result["wire_inc_sat"]["wire24"] == 32767
+    assert result["shift_accounting_unchanged"] is True
+
+    # The artifact round-trips as a real (non-stub) payload and the
+    # regress gate's wire checks bite on it.
+    art = json.loads(artifact.read_text())
+    assert art["metric"] == result["metric"]
+
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    payload, skip_note = tquery.load_bench_payload(str(artifact))
+    assert skip_note is None
+    assert payload["fused_serial_speedup_ratio"] == \
+        result["fused_serial_speedup_ratio"]
+    assert result["regress"]["ok"] is True
+    ok, rows = tquery.regress([str(artifact)])
+    wire_checks = {r["check"] for r in rows if r.get("ok") is not None}
+    assert "slo/fused_serial_speedup_ratio" in wire_checks
+    assert "slo/fused_pipelined_speedup_ratio" in wire_checks
+    assert "slo/wire_fused_bytes_per_slot" in wire_checks
+    assert "slo/wire_fused_collectives_per_round" in wire_checks

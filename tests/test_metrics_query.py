@@ -325,6 +325,90 @@ def test_regress_pipelined_speedup_floor(tmp_path):
     assert ok, rows
 
 
+def _wire_payload(**overrides):
+    payload = {
+        "metric": "swim_wire_fused_member_rounds_per_sec_per_chip",
+        "value": 95375.8,
+        "fused_serial_speedup_ratio": 1.356,
+        "fused_pipelined_speedup_ratio": 1.5217,
+        "pipelined_serial_parity": {"fused": True, "legacy": True},
+        "hlo_full_height_collectives": {"fused": 1, "legacy": 2},
+        "wire_collectives_per_round": {"fused": 1, "legacy": 2},
+        "wire_bytes_per_slot": {"fused": 4, "legacy": 5},
+        "shift_accounting_unchanged": True,
+    }
+    payload.update(overrides)
+    return payload
+
+
+def test_regress_wire_fused_gates(tmp_path):
+    """The --wire artifact's gates: fused >= legacy on BOTH run shapes
+    (absolute 1.0 floor), the 4-vs-5 B/slot and 1-vs-2 collective
+    models pinned exactly, HLO counts gated when recorded and
+    provenance when null."""
+    art = tmp_path / "wire_fused.json"
+    with open(art, "w") as f:
+        json.dump(_wire_payload(), f)
+    ok, rows = query.regress([str(art)])
+    assert ok, rows
+    checks = {r["check"] for r in rows if r.get("ok") is not None}
+    assert {"slo/fused_serial_speedup_ratio",
+            "slo/fused_pipelined_speedup_ratio",
+            "slo/wire_fused_bytes_per_slot",
+            "slo/wire_fused_collectives_per_round",
+            "slo/wire_hlo_fused_single_collective",
+            "slo/wire_shift_accounting_unchanged",
+            "slo/wire_pipelined_serial_parity"} <= checks
+
+    # A fused wire that runs SLOWER than the two-buffer HEAD fails the
+    # absolute floor — no band: the committed win must not rot.
+    with open(art, "w") as f:
+        json.dump(_wire_payload(fused_pipelined_speedup_ratio=0.97), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    (bad,) = [r for r in rows if r.get("ok") is False]
+    assert bad["check"] == "slo/fused_pipelined_speedup_ratio"
+
+    # A second collective sneaking back into the fused program fails
+    # the absolute instruction pin.
+    with open(art, "w") as f:
+        json.dump(_wire_payload(
+            hlo_full_height_collectives={"fused": 2, "legacy": 2}), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    assert any(r["check"] == "slo/wire_hlo_fused_single_collective"
+               for r in rows if r.get("ok") is False)
+
+    # Null HLO counts (unparseable lowering) are provenance, not a
+    # failure.
+    with open(art, "w") as f:
+        json.dump(_wire_payload(hlo_full_height_collectives=None), f)
+    ok, rows = query.regress([str(art)])
+    assert ok, rows
+    assert any(r["check"] == "slo/wire_hlo_fused_single_collective"
+               and r.get("ok") is None for r in rows)
+
+
+def test_regress_wire_smoke_is_provenance_beside_full_round(tmp_path):
+    """The sync-heal rule for --wire: a smoke artifact beside a full
+    round is provenance; alone it gates itself."""
+    full = tmp_path / "wire_fused.json"
+    smoke = tmp_path / "wire_fused_smoke.json"
+    with open(full, "w") as f:
+        json.dump(_wire_payload(), f)
+    with open(smoke, "w") as f:
+        json.dump(_wire_payload(smoke=True,
+                                fused_serial_speedup_ratio=0.8), f)
+    # Beside the full round the failing smoke ratio must NOT gate.
+    ok, rows = query.regress([str(full), str(smoke)])
+    assert ok, rows
+    assert any(r["check"] == "slo/wire_fused" and r.get("ok") is None
+               for r in rows)
+    # Alone, the smoke round gates itself and the bad ratio bites.
+    ok, rows = query.regress([str(smoke)])
+    assert not ok
+
+
 def test_cli_regress_default_globs_include_multichip(tmp_path, capsys,
                                                      monkeypatch):
     """Bare ``regress`` walks BENCH_*.json AND MULTICHIP_*.json from

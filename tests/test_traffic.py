@@ -118,26 +118,47 @@ def test_shift_hlo_collectives_match_traffic_model(n, k, gate, layout):
     assert _op_operand_bytes(hlo, "all-reduce") == []
 
 
-@hlo_pinned
-@pytest.mark.parametrize("compact", [False, True])
-def test_scatter_hlo_collectives_match_traffic_model(compact):
-    n, k = 256, 16
+# The wire-format ladder x fused/legacy wire matrix the scatter HLO
+# pins run over: (params overrides, expected key dtype in the HLO).
+WIRE_LAYOUTS = {
+    "wide": ({}, "s32"),
+    "wire16": ({"compact_carry": True}, "s16"),
+    "wire24": ({"compact_carry": True, "wire24": True}, "s32"),
+}
+
+
+def _scatter_params(n, k, layout, fused):
+    overrides, key_dtype = WIRE_LAYOUTS[layout]
     params = swim.SwimParams.from_config(
         fast_config(), n_members=n, n_subjects=k, delivery="scatter",
-        compact_carry=compact,
+        fused_wire=fused, **overrides,
     )
+    return params, key_dtype
+
+
+@hlo_pinned
+@pytest.mark.parametrize("fused", [True, False], ids=["fused", "legacy"])
+@pytest.mark.parametrize("layout", sorted(WIRE_LAYOUTS))
+def test_scatter_hlo_collectives_match_traffic_model(layout, fused):
+    """The full-height pmax combines per round: ONE combined key buffer
+    under the fused wire (the ALIVE flags ride the key bits — no s8
+    buffer in the compiled program at all), the key + s8 flag pair on
+    the legacy two-buffer wire."""
+    n, k = 256, 16
+    params, key_dtype = _scatter_params(n, k, layout, fused)
     world = swim.SwimWorld.healthy(params)
     hlo = _compiled_hlo(params, world)
 
     ars = _op_operand_bytes(hlo, "all-reduce")
-    # The full-height pmax combines: one key buffer (s32 wide, s16
-    # compact) + one s8 ALIVE-flag buffer per round (delay modeling off).
-    assert len(ars) == traffic.scatter_collectives_per_round(params)
+    n_combines = traffic.scatter_collectives_per_round(params)
+    assert n_combines == (1 if fused else 2)
+    assert len(ars) == n_combines
     dims = sorted(d for _, d, _ in ars)
-    assert dims == [f"{n},{k}", f"{n},{k}"]
+    assert dims == [f"{n},{k}"] * n_combines
     key_dtypes = {t for t, _, _ in ars}
-    assert key_dtypes == ({"s16", "s8"} if compact else {"s32", "s8"})
+    assert key_dtypes == ({key_dtype} if fused else {key_dtype, "s8"})
     buffer_bytes = sum(b for _, _, b in ars)
+    assert buffer_bytes == n * k * traffic.scatter_wire_bytes_per_slot(params)
     # Ring all-reduce: each device sends 2*(D-1)/D of the buffer.
     assert int(2 * (N_DEV - 1) / N_DEV * buffer_bytes) == (
         traffic.scatter_ici_bytes_per_device_round(params, N_DEV)
@@ -146,34 +167,66 @@ def test_scatter_hlo_collectives_match_traffic_model(compact):
 
 
 @hlo_pinned
-@pytest.mark.parametrize("compact", [False, True])
-def test_pipelined_scatter_hlo_collectives_match_traffic_model(compact):
+@pytest.mark.parametrize("fused", [True, False], ids=["fused", "legacy"])
+@pytest.mark.parametrize("layout", sorted(WIRE_LAYOUTS))
+def test_pipelined_scatter_hlo_collectives_match_traffic_model(layout,
+                                                               fused):
     """The PIPELINED scatter program doubles the combine instruction
-    count (loop-body pair over the carried contribution + epilogue pair
-    for the final round) without adding per-round traffic — the
+    count (loop-body combine over the carried contribution + epilogue
+    combine for the final round) without adding per-round traffic — the
     placement move is visible in the compiled text exactly as
-    traffic.pipelined_scatter_hlo_collectives models it."""
+    traffic.pipelined_scatter_hlo_collectives models it.  Under the
+    fused wire that is ONE instruction in the body and one in the
+    epilogue: the pipelined carry is a single buffer."""
     n, k = 256, 16
-    params = swim.SwimParams.from_config(
-        fast_config(), n_members=n, n_subjects=k, delivery="scatter",
-        compact_carry=compact,
-    )
+    params, key_dtype = _scatter_params(n, k, layout, fused)
     world = swim.SwimWorld.healthy(params)
     hlo = _compiled_hlo(params, world, pipelined=True)
 
     ars = _op_operand_bytes(hlo, "all-reduce")
-    assert len(ars) == traffic.pipelined_scatter_hlo_collectives(params)
+    n_instr = traffic.pipelined_scatter_hlo_collectives(params)
+    assert n_instr == (2 if fused else 4)
+    assert len(ars) == n_instr
     dims = sorted(d for _, d, _ in ars)
-    assert dims == [f"{n},{k}"] * 4
+    assert dims == [f"{n},{k}"] * n_instr
     key_dtypes = {t for t, _, _ in ars}
-    assert key_dtypes == ({"s16", "s8"} if compact else {"s32", "s8"})
+    assert key_dtypes == ({key_dtype} if fused else {key_dtype, "s8"})
     # Per-ROUND bytes are the serial figure — half the instructions run
     # per iteration, the other half once at the epilogue.
-    loop_pair_bytes = sum(b for _, _, b in ars) // 2
-    assert int(2 * (N_DEV - 1) / N_DEV * loop_pair_bytes) == (
+    loop_bytes = sum(b for _, _, b in ars) // 2
+    assert int(2 * (N_DEV - 1) / N_DEV * loop_bytes) == (
         traffic.scatter_ici_bytes_per_device_round(params, N_DEV)
     )
     assert _op_operand_bytes(hlo, "collective-permute") == []
+
+
+def test_fused_wire_byte_model():
+    """The 4-vs-5 B/slot headline, straight from the model: the fused
+    wire drops the s8 flag byte per inbox slot on every rung, wire24
+    costs exactly what the pre-ladder wide wire paid for its key alone,
+    and SHIFT-mode accounting is untouched by the flag fold (shift
+    ships tx masks, not flag buffers)."""
+    def p(fused, **kw):
+        return swim.SwimParams.from_config(
+            fast_config(), n_members=256, n_subjects=16,
+            fused_wire=fused, **kw)
+
+    assert traffic.scatter_wire_bytes_per_slot(p(True)) == 4
+    assert traffic.scatter_wire_bytes_per_slot(p(False)) == 5
+    assert traffic.scatter_wire_bytes_per_slot(
+        p(True, compact_carry=True)) == 2
+    assert traffic.scatter_wire_bytes_per_slot(
+        p(False, compact_carry=True)) == 3
+    assert traffic.scatter_wire_bytes_per_slot(
+        p(True, compact_carry=True, wire24=True)) == 4
+    for kw in ({}, {"compact_carry": True},
+               {"compact_carry": True, "wire24": True}):
+        a = p(True, delivery="shift", **kw)
+        b = p(False, delivery="shift", **kw)
+        assert traffic.shift_ici_bytes_per_device_round(a, N_DEV) == \
+            traffic.shift_ici_bytes_per_device_round(b, N_DEV)
+        assert traffic.shift_exchanges_per_round(a) == \
+            traffic.shift_exchanges_per_round(b)
 
 
 def test_pipelined_combine_count_doubles_lowering_neutral():

@@ -182,6 +182,238 @@ def test_merge_gate_at_wire16_saturation_boundary():
     assert (status, changed) == (records.DEAD, True)
 
 
+# --------------------------------------------------------------------------
+# Format-parameterized boundary matrix: the same edges for every rung
+# of the wire-format ladder (ops/delivery.WIRE_FORMATS), with and
+# without the open-world epoch field.
+# --------------------------------------------------------------------------
+
+
+FORMATS = ["wire16", "wire24", "wide"]
+
+
+def _fmt(name):
+    from scalecube_cluster_tpu.ops import delivery
+    return delivery.WIRE_FORMATS[name]
+
+
+@pytest.mark.wire
+@pytest.mark.parametrize("epoch_on", [False, True], ids=["flat", "epoch"])
+@pytest.mark.parametrize("fmt_name", FORMATS)
+def test_saturation_edge_per_format(fmt_name, epoch_on):
+    """The merge gate exactly AT each format's incarnation cap: one
+    below the cap a refutation lands; at the cap incarnations stop
+    distinguishing (suspect bit wins the key tie — loud in the
+    protocol, never a silent wire/table divergence); above the cap the
+    key saturates; DEAD still absorbs (dead bit above the inc field)."""
+    from scalecube_cluster_tpu import records
+    from scalecube_cluster_tpu.ops import delivery
+
+    fmt = _fmt(fmt_name)
+    eb = fmt.epoch_bits if epoch_on else 0
+    cap = fmt.inc_sat(eb)
+
+    def merge_one(entry_status, entry_inc, in_status, in_inc):
+        key = delivery.pack_record(jnp.int8(in_status), jnp.int32(in_inc),
+                                   fmt=fmt, epoch_bits=eb)
+        out = delivery.merge_inbox(
+            jnp.int8(entry_status), jnp.int32(entry_inc),
+            key, jnp.asarray(in_status == records.ALIVE), fmt=fmt,
+            entry_epoch=jnp.int32(0) if eb else None, epoch_bits=eb,
+        )
+        status, inc, changed = out[0], out[1], out[-1]
+        return int(status), int(inc), bool(changed)
+
+    assert merge_one(records.SUSPECT, cap - 1, records.ALIVE, cap) == \
+        (records.ALIVE, cap, True)
+    status, _, changed = merge_one(records.SUSPECT, cap, records.ALIVE, cap)
+    assert (status, changed) == (records.SUSPECT, False)
+    status, _, changed = merge_one(records.SUSPECT, cap,
+                                   records.ALIVE, cap + 1)
+    assert (status, changed) == (records.SUSPECT, False)
+    status, _, changed = merge_one(records.SUSPECT, cap, records.DEAD, cap)
+    assert (status, changed) == (records.DEAD, True)
+
+
+@pytest.mark.wire
+@pytest.mark.parametrize("fmt_name", FORMATS)
+def test_epoch_rollover_per_format(fmt_name):
+    """The epoch field at each format's width: the top epoch value
+    round-trips through pack/unpack, packing clips above the cap
+    (epochs never wrap into the dead bit), and a top-epoch ALIVE still
+    sits BELOW any DEAD key — the fold order survives rollover."""
+    from scalecube_cluster_tpu import records
+    from scalecube_cluster_tpu.ops import delivery
+
+    fmt = _fmt(fmt_name)
+    eb = fmt.epoch_bits
+    top = fmt.epoch_cap()
+    key_top = delivery.pack_record(jnp.int8(records.ALIVE), jnp.int32(7),
+                                   fmt=fmt, epoch=jnp.int32(top),
+                                   epoch_bits=eb)
+    assert int(delivery.unpack_epoch(key_top, fmt=fmt, epoch_bits=eb)) == top
+    st, inc = delivery.unpack_record(key_top, fmt=fmt, epoch_bits=eb)
+    assert (int(st), int(inc)) == (records.ALIVE, 7)
+    # Above the cap the pack clips to the cap instead of carrying into
+    # the dead bit.
+    key_over = delivery.pack_record(jnp.int8(records.ALIVE), jnp.int32(7),
+                                    fmt=fmt, epoch=jnp.int32(top + 1),
+                                    epoch_bits=eb)
+    assert int(key_over) == int(key_top)
+    # DEAD at epoch 0 still absorbs a top-epoch ALIVE in the fold.
+    key_dead0 = delivery.pack_record(jnp.int8(records.DEAD), jnp.int32(0),
+                                     fmt=fmt, epoch=jnp.int32(0),
+                                     epoch_bits=eb)
+    assert int(key_dead0) > int(key_top)
+    # With the epoch field compiled OUT (epoch_bits=0) a passed epoch
+    # value is IGNORED — it must not shift into the dead bit (the
+    # wire24 flat layout reaches the generic pack branch, where an
+    # off-by-one clip would turn ALIVE@epoch>0 into a DEAD key).
+    key_flat = delivery.pack_record(jnp.int8(records.ALIVE), jnp.int32(7),
+                                    fmt=fmt, epoch=jnp.int32(1),
+                                    epoch_bits=0)
+    st, inc = delivery.unpack_record(key_flat, fmt=fmt)
+    assert (int(st), int(inc)) == (records.ALIVE, 7)
+    assert int(key_flat) == int(delivery.pack_record(
+        jnp.int8(records.ALIVE), jnp.int32(7), fmt=fmt))
+
+
+@pytest.mark.wire
+@pytest.mark.parametrize("epoch_on", [False, True], ids=["flat", "epoch"])
+@pytest.mark.parametrize("fmt_name", FORMATS)
+def test_dead_absorbs_precedence_per_format(fmt_name, epoch_on):
+    """records lattice order survives every layout: within a liveness
+    class higher incarnation wins, suspect beats alive at equal inc,
+    and ANY dead key beats every live key (the reference's
+    DEAD-absorbs max-fold order, records.merge_key docstring)."""
+    from scalecube_cluster_tpu import records
+    from scalecube_cluster_tpu.ops import delivery
+
+    fmt = _fmt(fmt_name)
+    eb = fmt.epoch_bits if epoch_on else 0
+
+    def k(status, inc):
+        return int(delivery.pack_record(jnp.int8(status), jnp.int32(inc),
+                                        fmt=fmt, epoch_bits=eb))
+
+    cap = fmt.inc_sat(eb)
+    assert k(records.ALIVE, 5) > k(records.ALIVE, 4)
+    assert k(records.SUSPECT, 5) > k(records.ALIVE, 5)
+    assert k(records.ALIVE, 6) > k(records.SUSPECT, 5)
+    assert k(records.DEAD, 0) > k(records.SUSPECT, cap)
+    assert k(records.DEAD, 0) > k(records.ALIVE, cap)
+    assert k(records.DEAD, 1) > k(records.DEAD, 0)
+    # ABSENT packs to the no-message sentinel and never wins a fold.
+    assert k(records.ABSENT, 0) == int(delivery.no_message(fmt=fmt))
+
+
+@pytest.mark.wire
+@pytest.mark.parametrize("fmt_name", FORMATS)
+def test_flag_fold_equivalence_per_format(fmt_name):
+    """The ISSUE's lexicographic ``combined = key << 8 | flag`` fold is
+    pointwise equal to deriving the flag from the folded winner key
+    (is_alive_key of the max), because the ALIVE flag is a pure
+    function of the key bits: for equal keys max over flag bytes IS the
+    OR the separate pmax computed, and for differing keys the winner's
+    flag rides along.  That equivalence is why the fused wire ships NO
+    flag buffer at all — pinned here over every (status, inc) pair
+    combination per format."""
+    from scalecube_cluster_tpu import records
+    from scalecube_cluster_tpu.ops import delivery
+
+    fmt = _fmt(fmt_name)
+    cap = fmt.inc_sat(0)
+    statuses = [records.ABSENT, records.ALIVE, records.SUSPECT, records.DEAD]
+    incs = [0, 1, cap - 1, cap]
+    recs = [(s, i) for s in statuses for i in incs]
+    keys = np.asarray(
+        [int(delivery.pack_record(jnp.int8(s), jnp.int32(i), fmt=fmt))
+         for s, i in recs], np.int64)
+    flags = np.asarray(
+        delivery.is_alive_key(jnp.asarray(keys, jnp.int32), fmt=fmt))
+    a = np.repeat(keys, keys.shape[0])
+    b = np.tile(keys, keys.shape[0])
+    fa = np.repeat(flags, flags.shape[0])
+    fb = np.tile(flags, flags.shape[0])
+    # The issue's explicit bitfield fold, in int64 numpy scratch — the
+    # wide key's dead bit 30 would overflow an int32 ``key << 8``
+    # (which is exactly why the implementation derives the flag from
+    # the unshifted key instead of spending 8 key bits).
+    combined = np.maximum((a << 8) | fa, (b << 8) | fb)
+    lex_winner, lex_flag = combined >> 8, (combined & 0xFF) != 0
+    # The implemented fold: max the keys, rederive the flag.
+    winner = np.maximum(a, b)
+    derived_flag = np.asarray(delivery.is_alive_key(
+        jnp.asarray(winner, jnp.int32), fmt=fmt))
+    np.testing.assert_array_equal(lex_winner, winner)
+    np.testing.assert_array_equal(lex_flag, derived_flag)
+    # And for EQUAL keys the winner flag is exactly the OR of the pair.
+    eq = a == b
+    np.testing.assert_array_equal((fa | fb)[eq], derived_flag[eq])
+
+
+@pytest.mark.wire
+@pytest.mark.parametrize("delivery_mode", ["scatter", "shift"])
+def test_wire24_trace_identical_below_cap(delivery_mode):
+    """wire24 vs wire16 (both compact_carry) below the wire16 cap:
+    table semantics are pinned bit-identical on BOTH delivery modes —
+    the headroom rung changes only what the wire can express, never
+    what a sub-cap run computes."""
+    out = []
+    for wire24 in (False, True):
+        params = swim.SwimParams.from_config(
+            fast_config(), n_members=32, delivery=delivery_mode,
+            compact_carry=True, wire24=wire24, loss_probability=0.1,
+        )
+        world = SCENARIOS["crash_revive"](swim.SwimWorld.healthy(params))
+        out.append(swim.run(jax.random.key(3), params, world, 120))
+    (s_16, m_16), (s_24, m_24) = out
+    for name in m_16:
+        np.testing.assert_array_equal(
+            np.asarray(m_16[name]), np.asarray(m_24[name]),
+            err_msg=f"wire24/{delivery_mode}: metric {name} diverged",
+        )
+    for field in ("status", "inc", "spread_until", "suspect_deadline",
+                  "self_inc"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s_16, field)),
+            np.asarray(getattr(s_24, field)),
+            err_msg=f"wire24/{delivery_mode}: state.{field} diverged",
+        )
+
+
+@pytest.mark.wire
+def test_wire24_restores_refutation_above_wire16_cap():
+    """THE headroom pin at merge level: at inc = the wire16 cap a
+    refutation no longer lands on wire16 (key tie), but the SAME merge
+    on wire24 — same int32 word on the wire — still distinguishes the
+    incarnations and lands it, all the way to the int16 CARRY ceiling
+    (models/swim._wire_inc_sat: 32767, now the binding cap)."""
+    from scalecube_cluster_tpu import records
+    from scalecube_cluster_tpu.ops import delivery
+
+    cap16 = _fmt("wire16").inc_sat(0)
+
+    def merge_one(fmt, entry_inc, in_inc):
+        key = delivery.pack_record(jnp.int8(records.ALIVE),
+                                   jnp.int32(in_inc), fmt=fmt)
+        status, inc, changed = delivery.merge_inbox(
+            jnp.int8(records.SUSPECT), jnp.int32(entry_inc),
+            key, jnp.asarray(True), fmt=fmt,
+        )
+        return int(status), int(inc), bool(changed)
+
+    # wire16: saturated tie, the suspicion stands.
+    status, _, changed = merge_one(_fmt("wire16"), cap16, cap16 + 1)
+    assert (status, changed) == (records.SUSPECT, False)
+    # wire24: the refutation lands, and keeps landing at the carry cap.
+    assert merge_one(_fmt("wire24"), cap16, cap16 + 1) == \
+        (records.ALIVE, cap16 + 1, True)
+    carry_cap = (1 << 15) - 1
+    assert merge_one(_fmt("wire24"), carry_cap - 1, carry_cap) == \
+        (records.ALIVE, carry_cap, True)
+
+
 @pytest.mark.parametrize("wire16,expected_cap", [
     (True, WIRE16_INC_CAP),          # int16 wire: bump clamps at 8191
     (False, WIRE16_INC_CAP + 1),     # wide wire: 8191 is an ordinary inc

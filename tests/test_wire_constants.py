@@ -1,0 +1,129 @@
+"""Constant audit: ops/delivery.WIRE_FORMATS is the ONE source of every
+wire-saturation constant.
+
+Every clamp site in the tree — the self-refutation bump
+(models/swim._merge_and_timers), the WIRE_SATURATION monitor bound
+(chaos/monitor), the compact-carry encode clamp (models/swim.
+_carry_encode) — derives from the format table via
+models/swim._wire_inc_sat.  The grep-proof below tokenizes the whole
+package and fails if any evaluated saturation literal (8191, 2047,
+2^23-1, ...) reappears in CODE outside ops/delivery.py and records.py
+(records.py DEFINES the wide/wire16 key builders the table delegates
+to; comments and docstrings may cite the numbers — documentation is
+not a clamp site).
+"""
+
+import io
+import pathlib
+import tokenize
+
+import pytest
+
+from scalecube_cluster_tpu.chaos import monitor as chaos_monitor
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.ops import delivery
+
+from tests.test_swim_model import fast_config
+
+pytestmark = pytest.mark.wire
+
+PKG = pathlib.Path(swim.__file__).resolve().parents[1]
+
+# The saturation points of every rung x epoch width, evaluated: any of
+# these appearing as a bare literal outside the format table is a
+# hand-copied constant waiting to rot.
+BANNED_LITERALS = {
+    delivery.WIRE16.inc_sat(0),                           # 8191
+    delivery.WIRE16.inc_sat(delivery.WIRE16.epoch_bits),  # 2047
+    delivery.WIRE24.inc_sat(0),                           # 2^22-1
+    delivery.WIRE24.inc_sat(delivery.WIRE24.epoch_bits),  # 2^18-1
+    delivery.WIDE.inc_sat(0),                             # 2^29-1
+    delivery.WIDE.inc_sat(delivery.WIDE.epoch_bits),      # 2^23-1
+}
+
+# The two files allowed to spell the layout out: the format table
+# itself, and the records.py key builders it delegates the legacy
+# rungs to.
+ALLOWED = {"ops/delivery.py", "records.py"}
+
+
+def test_table_is_the_single_source_of_saturation_literals():
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = str(path.relative_to(PKG))
+        if rel in ALLOWED:
+            continue
+        toks = tokenize.generate_tokens(
+            io.StringIO(path.read_text()).readline)
+        for tok in toks:
+            if tok.type != tokenize.NUMBER:
+                continue
+            try:
+                value = int(tok.string, 0)
+            except ValueError:
+                continue
+            if value in BANNED_LITERALS:
+                offenders.append(f"{rel}:{tok.start[0]}: {tok.line.strip()}")
+    assert not offenders, (
+        "wire-saturation literals outside ops/delivery.WIRE_FORMATS "
+        "(derive from the table via swim._wire_inc_sat instead):\n"
+        + "\n".join(offenders)
+    )
+
+
+def test_format_table_layout():
+    """The ladder's shape: dead bit / epoch width / word dtype per rung,
+    and the saturation arithmetic they imply."""
+    assert delivery.WIDE.dead_bit == 30
+    assert delivery.WIRE24.dead_bit == 23
+    assert delivery.WIRE16.dead_bit == 14
+    assert (delivery.WIDE.epoch_bits, delivery.WIRE24.epoch_bits,
+            delivery.WIRE16.epoch_bits) == (6, 4, 2)
+    assert delivery.WIDE.word_bytes == delivery.WIRE24.word_bytes == 4
+    assert delivery.WIRE16.word_bytes == 2
+    for fmt in delivery.WIRE_FORMATS.values():
+        assert fmt.inc_sat(0) == (1 << (fmt.dead_bit - 1)) - 1
+        assert fmt.inc_sat(fmt.epoch_bits) == \
+            (1 << (fmt.dead_bit - 1 - fmt.epoch_bits)) - 1
+    # The wire24 motivation, in numbers: 16x the wire16+epoch headroom.
+    assert delivery.WIRE24.inc_sat(4) == \
+        (delivery.WIRE16.inc_sat(2) + 1) * 128 - 1
+
+
+@pytest.mark.parametrize("kw,expected", [
+    (dict(), delivery.WIDE.inc_sat(0)),
+    (dict(open_world=True), delivery.WIDE.inc_sat(6)),
+    (dict(int16_wire=True), delivery.WIRE16.inc_sat(0)),
+    (dict(compact_carry=True), delivery.WIRE16.inc_sat(0)),
+    (dict(compact_carry=True, open_world=True), delivery.WIRE16.inc_sat(2)),
+    # wire24: the wire field out-carries the int16 STORED table, so the
+    # carry dtype ceiling binds — with or without the epoch field.
+    (dict(compact_carry=True, wire24=True), (1 << 15) - 1),
+    (dict(compact_carry=True, wire24=True, open_world=True), (1 << 15) - 1),
+])
+def test_wire_inc_sat_derives_from_table(kw, expected):
+    params = swim.SwimParams.from_config(fast_config(), n_members=16, **kw)
+    assert swim._wire_inc_sat(params) == expected
+
+
+def test_monitor_bound_follows_the_format(monkeypatch):
+    """The WIRE_SATURATION invariant bound is _wire_inc_sat of the
+    ACTIVE params — not a per-call literal: a spy on _wire_inc_sat sees
+    the monitor consult the table."""
+    params = swim.SwimParams.from_config(fast_config(), n_members=16,
+                                         compact_carry=True, wire24=True)
+    seen = []
+    real = swim._wire_inc_sat
+
+    def spy(p):
+        seen.append(real(p))
+        return real(p)
+
+    monkeypatch.setattr(swim, "_wire_inc_sat", spy)
+    world = swim.SwimWorld.healthy(params)
+    state = swim.initial_state(params, world)
+    chaos_monitor._check_cells(
+        chaos_monitor.MonitorSpec.passive(params), params,
+        swim.Knobs.from_params(params), 0, state, state, world,
+    )
+    assert (1 << 15) - 1 in seen

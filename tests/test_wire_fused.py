@@ -1,0 +1,266 @@
+"""The FUSED single-buffer wire (SwimParams.fused_wire, default True).
+
+The scatter tick's per-round inbox exchange is ONE packed-key buffer:
+the ALIVE/transmit flag is not a parallel int8 buffer but the key
+word's own spare bits (dead + suspect bits clear —
+ops/delivery.is_alive_key), so the merge gate derives it from the
+folded winner.  Contract pinned here:
+
+  - on deterministic-network fault schedules (crash/revive, graceful
+    leave, permanent crash; loss 0) the fused wire is BIT-IDENTICAL to
+    the legacy two-buffer combine across full-view / focal / compact /
+    wire24 layouts — same draws, same merge winners, same timers;
+  - all run shapes agree under the fused wire, and the sharded
+    pipelined path equals the serial combine (single-buffer carry);
+  - the ONE documented gate deviation is exactly the corner the
+    SwimParams.fused_wire docstring names: an ALIVE and a strictly
+    higher non-ALIVE record landing at the same ABSENT-gated cell in
+    the same round — the legacy OR-gate opened on the losing ALIVE and
+    stored the non-ALIVE winner; the fused gate is the reference's
+    per-message null-gate (MembershipRecord.java:67-69) applied to the
+    round's one folded message, so the cell stays closed until a round
+    whose winner is ALIVE.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu import records
+from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.ops import delivery
+
+from tests.test_swim_model import fast_config
+
+pytestmark = pytest.mark.wire
+
+
+LAYOUTS = {
+    "wide": {},
+    "focal": {"n_subjects": 8, "ping_known_only": False},
+    "wire16": {"compact_carry": True},
+    "wire24": {"compact_carry": True, "wire24": True},
+}
+
+# Faults start AFTER the bootstrap spread settles: while initial
+# ABSENT cells are still opening, a stale hot ALIVE and a strictly
+# higher SUSPECT about the same crashed subject can land on one
+# ABSENT-gated cell in one round — the documented gate corner
+# (test_fused_gate_corner_is_the_reference_null_gate), where the two
+# gates transiently differ by design.
+SCENARIOS = {
+    "crash_revive": lambda w: w.with_crash(3, at_round=12, until_round=45),
+    "leave": lambda w: w.with_leave(2, at_round=12),
+    "crash_permanent": lambda w: w.with_crash(5, at_round=12),
+}
+
+
+def run_one(fused, layout, scenario, n=24, rounds=70, seed=0, **overrides):
+    kw = dict(LAYOUTS[layout])
+    kw.update(overrides)
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery="scatter", fused_wire=fused,
+        **kw,
+    )
+    world = SCENARIOS[scenario](swim.SwimWorld.healthy(params))
+    return swim.run(jax.random.key(seed), params, world, rounds)
+
+
+def assert_pair_identical(pair, msg):
+    (s_a, m_a), (s_b, m_b) = pair
+    for name in m_a:
+        np.testing.assert_array_equal(
+            np.asarray(m_a[name]), np.asarray(m_b[name]),
+            err_msg=f"{msg}: metric {name} diverged",
+        )
+    for field in ("status", "inc", "spread_until", "suspect_deadline",
+                  "self_inc", "epoch"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s_a, field)),
+            np.asarray(getattr(s_b, field)),
+            err_msg=f"{msg}: state.{field} diverged",
+        )
+
+
+@pytest.mark.parametrize("layout", sorted(LAYOUTS))
+@pytest.mark.parametrize("scenario", sorted(SCENARIOS))
+def test_fused_identical_to_two_buffer(layout, scenario):
+    """Fused vs legacy two-buffer wire, deterministic network: every
+    metric row and every carry lane bit-identical."""
+    pair = [run_one(fused, layout, scenario) for fused in (True, False)]
+    assert_pair_identical(pair, f"{layout}/{scenario}")
+
+
+def test_fused_identical_with_sync_plane():
+    """The anti-entropy plane's extra scatter folds ride the SAME fused
+    buffer (zero extra collectives) — identity holds with the plane on
+    through a permanent crash."""
+    pair = [run_one(fused, "wide", "crash_permanent", sync_interval=16)
+            for fused in (True, False)]
+    assert_pair_identical(pair, "sync-plane")
+
+
+def test_fused_delay_ring_converges_to_the_same_table():
+    """Each delay bin's combine is likewise single-buffer; under the
+    fused wire the flag ring is dead weight (flags rederive from the
+    ring's folded keys at open time).
+
+    Delays re-create the documented gate corner on purpose — a DELAYED
+    stale ALIVE can co-arrive with a fresher non-ALIVE winner at a
+    DEAD-gated cell, so per-round metrics may transiently differ
+    (test_fused_gate_corner_is_the_reference_null_gate pins the gate
+    semantics) — but both gates admit the same records once any round's
+    winner is ALIVE, so the arms RECONVERGE: same final table, and the
+    crashed member is DEAD everywhere."""
+    pair = [run_one(fused, "wide", "crash_permanent", mean_delay_ms=150.0,
+                    max_delay_rounds=2, rounds=110)
+            for fused in (True, False)]
+    (s_f, m_f), (s_l, m_l) = pair
+    for field in ("status", "inc", "self_inc"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s_f, field)),
+            np.asarray(getattr(s_l, field)),
+            err_msg=f"delay-ring: final state.{field} diverged",
+        )
+    # Both arms actually converged (the identity isn't two equally
+    # stuck tables): every OTHER member holds the crashed one DEAD.
+    st = np.asarray(s_f.status)
+    others = [i for i in range(st.shape[0]) if i != 5]
+    assert (st[others, 5] == records.DEAD).all()
+    # And the corner is TRANSIENT, not a drift: the last quiet rounds
+    # agree on every metric.
+    for name in m_f:
+        np.testing.assert_array_equal(
+            np.asarray(m_f[name])[-20:], np.asarray(m_l[name])[-20:],
+            err_msg=f"delay-ring: late-window metric {name} diverged",
+        )
+
+
+def test_fused_identical_open_world_join():
+    """A JOIN into a recycled slot crosses the fused wire with its
+    epoch field intact: admission, trace and tables match the
+    two-buffer path on a deterministic network."""
+    out = []
+    for fused in (True, False):
+        params = swim.SwimParams.from_config(
+            fast_config(), n_members=16, delivery="scatter",
+            open_world=True, fused_wire=fused,
+        )
+        world = swim.SwimWorld.healthy(params).with_crash(4, at_round=4)
+        world = world.with_join(4, at_round=30)
+        out.append(swim.run(jax.random.key(2), params, world, 60))
+    assert_pair_identical(out, "open-world join")
+
+
+def test_run_shapes_agree_under_fused_wire():
+    """run / run_traced / run_metered / run_monitored /
+    run_monitored_metered — all five run shapes end on the same table
+    under the fused wire (the house all-shapes pin)."""
+    from scalecube_cluster_tpu.chaos import monitor as chaos_monitor
+
+    params = swim.SwimParams.from_config(
+        fast_config(), n_members=16, delivery="scatter",
+    )
+    world = swim.SwimWorld.healthy(params).with_crash(3, at_round=5,
+                                                      until_round=30)
+    key = jax.random.key(0)
+    spec = chaos_monitor.MonitorSpec.passive(params)
+    finals = {}
+    finals["run"], _ = swim.run(key, params, world, 50)
+    finals["traced"], _, _ = swim.run_traced(key, params, world, 50)
+    finals["metered"], _, _ = swim.run_metered(key, params, world, 50)
+    finals["monitored"], _, _ = chaos_monitor.run_monitored(
+        key, params, world, spec, 50)
+    finals["monitored_metered"], _, _, _ = chaos_monitor.run_monitored_metered(
+        key, params, world, spec, 50)
+    base = finals.pop("run")
+    for name, st in finals.items():
+        for field in ("status", "inc", "self_inc"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(base, field)),
+                np.asarray(getattr(st, field)),
+                err_msg=f"{name}: state.{field} diverged from run",
+            )
+
+
+def test_fused_gate_corner_is_the_reference_null_gate():
+    """The ONE documented deviation from the two-buffer gate, at merge
+    level: an ABSENT-gated cell receiving both an ALIVE record and a
+    strictly HIGHER non-ALIVE winner in one round.
+
+      - two-buffer gate (inbox_any_alive OR-folded over all arrivals):
+        the losing ALIVE opens the gate and the non-ALIVE winner is
+        stored;
+      - fused gate (the winner's own flag, is_alive_key of the folded
+        key): the cell stays ABSENT — exactly is_overrides rule 1
+        (MembershipRecord.java:67-69) applied to the folded message.
+
+    Both agree whenever the winner itself is ALIVE — which is every
+    round of a deterministic-network schedule (the identity tests
+    above), where no live SUSPECT contends with a same-incarnation
+    ALIVE in flight.
+    """
+    fmt = delivery.WIDE
+    alive_key = delivery.pack_record(records.ALIVE, 3, fmt=fmt)
+    suspect_key = delivery.pack_record(records.SUSPECT, 3, fmt=fmt)
+    winner = jnp.maximum(alive_key, suspect_key)
+    assert int(winner) == int(suspect_key)  # suspect bit wins the tie
+
+    entry = (jnp.int8(records.ABSENT), jnp.int32(0))
+    # Legacy two-buffer gate: OR of per-arrival flags == True.
+    st2, inc2, ch2 = delivery.merge_inbox(
+        *entry, winner, jnp.asarray(True), fmt=fmt)
+    assert (int(st2), int(inc2), bool(ch2)) == (records.SUSPECT, 3, True)
+    # Fused gate: the winner's own flag.
+    fused_gate = delivery.is_alive_key(winner, fmt=fmt)
+    assert not bool(fused_gate)
+    st1, inc1, ch1 = delivery.merge_inbox(*entry, winner, fused_gate,
+                                          fmt=fmt)
+    assert (int(st1), bool(ch1)) == (records.ABSENT, False)
+
+    # ALIVE winner: both gates agree (the dominant case).
+    st3, inc3, ch3 = delivery.merge_inbox(
+        *entry, alive_key, delivery.is_alive_key(alive_key, fmt=fmt),
+        fmt=fmt)
+    assert (int(st3), int(inc3), bool(ch3)) == (records.ALIVE, 3, True)
+
+
+@pytest.mark.skipif(
+    "not __import__('scalecube_cluster_tpu.parallel.compat', "
+    "fromlist=['HAS_SHARD_MAP']).HAS_SHARD_MAP")
+@pytest.mark.multichip
+def test_sharded_pipelined_equals_serial_single_buffer():
+    """The pipelined double-buffer carries ONE contribution buffer under
+    the fused wire and stays bit-identical to the serial combine — and
+    the legacy two-buffer pipeline still composes (the bench baseline).
+    """
+    from scalecube_cluster_tpu.parallel import mesh as pmesh
+
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    mesh = pmesh.make_mesh(8)
+    for fused in (True, False):
+        params = swim.SwimParams.from_config(
+            fast_config(), n_members=64, fused_wire=fused,
+            loss_probability=0.1,
+        )
+        world = swim.SwimWorld.healthy(params).with_crash(
+            5, at_round=4, until_round=40)
+        key = jax.random.key(0)
+        f_ser, m_ser = pmesh.shard_run(key, params, world, 60, mesh,
+                                       pipelined=False)
+        f_pip, m_pip = pmesh.shard_run(key, params, world, 60, mesh,
+                                       pipelined=True)
+        for field in dataclasses.fields(f_ser):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(f_ser, field.name)),
+                np.asarray(getattr(f_pip, field.name)),
+                err_msg=f"fused={fused}: state {field.name} diverged",
+            )
+        for name in m_ser:
+            np.testing.assert_array_equal(
+                np.asarray(m_ser[name]), np.asarray(m_pip[name]),
+                err_msg=f"fused={fused}: metric {name} diverged",
+            )

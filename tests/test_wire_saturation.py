@@ -1,0 +1,116 @@
+"""Saturation pressure: the measured motivation for the wire24 rung.
+
+ROADMAP item 3 (the PR-10 debt): open-world epoch bits squeeze the
+compact wire16 key's incarnation saturation to 2^11-1 = 2047.  The
+scenario here makes that cap REAL: a seeded long-horizon severe-churn
+run — a mid-suspicion partition heal (the PR-7 unbounded DEAD/ALIVE
+reinfection burn, tests/test_dead_suppression.py) plus a crash/revive
+churn rider, Lifeguard plane on — burns incarnations linearly
+(~0.4/round for the hottest members) until the wire16 arm PINS at the
+cap: refutation bumps clamp there (models/swim._wire_inc_sat), so
+refutations stop landing and the protocol degrades loudly.  The SAME
+seeded scenario under wire24 — same int32 word already crossing ICI,
+zero extra wire bytes (parallel/traffic.scatter_wire_bytes_per_slot) —
+keeps climbing past 2047, far from its own binding cap (the int16
+carry ceiling 32767).
+
+The WIRE_SATURATION monitor runs over the wire16 arm as the loudness
+evidence: the invariant (carry/self_inc strictly ABOVE the cap) stays
+green, i.e. the clamp held exactly AT the boundary — saturation is a
+visible protocol plateau, never a silent wire/table divergence.
+
+Mini version tier-1 (~6k rounds, seconds on the compiled scan); the
+full horizon lives behind @slow (SCALECUBE_SAT_ROUNDS, default 20k).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.chaos import monitor as chaos_monitor
+from scalecube_cluster_tpu.models import swim
+
+from tests.test_swim_model import fast_config
+
+pytestmark = pytest.mark.wire
+
+N = 16
+SPLIT = 48          # < quiesce bound: tombstones still hot at the heal
+
+
+def pressure_params(wire24: bool):
+    return swim.SwimParams.from_config(
+        fast_config(), n_members=N, delivery="scatter", sync_interval=8,
+        compact_carry=True, wire24=wire24, open_world=True, lhm_max=4,
+    )
+
+
+def pressure_world(params):
+    """Mid-suspicion heal (unbounded incarnation burn) + churn rider."""
+    world = swim.SwimWorld.healthy(params)
+    part = np.zeros((8, N), np.int8)
+    part[0, : N // 2] = 1
+    world = world.with_partition_schedule(part, SPLIT)
+    return world.with_crash(3, at_round=10, until_round=30)
+
+
+def run_pressure(wire24: bool, rounds: int):
+    params = pressure_params(wire24)
+    state, _ = swim.run(jax.random.key(1), params, pressure_world(params),
+                        rounds)
+    return params, state
+
+
+def assert_pressure(rounds: int):
+    p16, s16 = run_pressure(wire24=False, rounds=rounds)
+    p24, s24 = run_pressure(wire24=True, rounds=rounds)
+    cap16, cap24 = swim._wire_inc_sat(p16), swim._wire_inc_sat(p24)
+    assert (cap16, cap24) == (2047, 32767)      # the ROADMAP numbers
+
+    si16 = np.asarray(s16.self_inc)
+    si24 = np.asarray(s24.self_inc)
+    # wire16 TRIPS the cap: hottest members pinned exactly AT 2047,
+    # and the carry never exceeds it (the clamp, not an overflow).
+    assert si16.max() == cap16
+    assert (si16 == cap16).sum() >= 2, si16
+    assert np.asarray(s16.inc).max() <= cap16
+    # The SAME seeded scenario under wire24: unsaturated — the burn
+    # kept counting past 2047 (so wire16's plateau really was the cap
+    # binding, not the scenario running out of pressure), with ample
+    # headroom to its own carry-ceiling cap.
+    assert si24.max() > cap16
+    assert si24.max() < cap24
+    # (Sub-cap trace parity between the rungs is pinned separately —
+    # tests/test_wire16.py::test_wire24_trace_identical_below_cap; here
+    # the arms legitimately diverge once the first member saturates,
+    # because a pinned refutation changes the gossip the whole cluster
+    # sees.)
+    return p16
+
+
+def test_saturation_pressure_mini():
+    """Tier-1 mini horizon: the wire16 arm reaches and pins at 2^11-1
+    while wire24 keeps counting — plus the monitor evidence that the
+    clamped arm stayed green (no silent divergence AT the cap)."""
+    p16 = assert_pressure(rounds=6000)
+    # WIRE_SATURATION monitor evidence on a saturated-window replay:
+    # run the wire16 arm monitored PAST the plateau — the invariant
+    # (inc strictly above the cap) must stay green while the state
+    # demonstrably sits at the cap.
+    spec = chaos_monitor.MonitorSpec.passive(p16)
+    state, mon, _ = chaos_monitor.run_monitored(
+        jax.random.key(1), p16, pressure_world(p16), spec, 6000)
+    v = chaos_monitor.verdict(mon)
+    assert v["green"], v
+    assert int(np.asarray(state.self_inc).max()) == swim._wire_inc_sat(p16)
+
+
+@pytest.mark.slow
+def test_saturation_pressure_full_horizon():
+    """The full long-horizon version (SCALECUBE_SAT_ROUNDS, default
+    20k): deep into the saturated regime the wire16 plateau holds and
+    wire24 is STILL unsaturated."""
+    rounds = int(os.environ.get("SCALECUBE_SAT_ROUNDS", "20000"))
+    assert_pressure(rounds=rounds)
