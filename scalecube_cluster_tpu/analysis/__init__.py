@@ -1,0 +1,32 @@
+"""swimlint: project-native static analysis.
+
+The reference implementation gets cross-cutting guarantees from the JVM
+type system; the JAX reproduction threads every protocol plane by hand
+through three tick bodies, two pipelined halves, and seven run entry
+points (ROADMAP item 1's "28 files per plane").  This package machine-
+checks that family of invariants:
+
+  - :mod:`.callgraph` — mention-graph reachability over the source;
+  - :mod:`.rules` — the plane-threading completeness matrix,
+    trace-safety, donation-safety, and the magic-literal owning-table
+    audit;
+  - :mod:`.compile_audit` — jaxpr-level checks on every run entry
+    point (zero host callbacks, compact carry lanes stay narrow, no
+    recompile on a second same-shape call);
+  - :mod:`.engine` — the driver + per-finding baseline contract;
+  - ``python -m scalecube_cluster_tpu.analysis`` — the CLI
+    (``report``/``check``, exit 0/1/2; see :mod:`.__main__`).
+
+The ``check`` artifact (``artifacts/static_analysis.json``) is the
+machine-readable knob x run-shape map the ROADMAP item-1 compose()
+refactor must preserve, and ``telemetry regress`` gates on its
+``findings_total == 0``.
+"""
+
+from scalecube_cluster_tpu.analysis.engine import (  # noqa: F401
+    AnalysisResult, BaselineError, load_baseline, run_analysis,
+)
+from scalecube_cluster_tpu.analysis.rules import (  # noqa: F401
+    ENTRY_POINTS, TICK_BODIES, Finding, LiteralFamily,
+    default_literal_families,
+)

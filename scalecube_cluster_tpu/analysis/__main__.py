@@ -1,0 +1,143 @@
+"""The swimlint CLI: ``python -m scalecube_cluster_tpu.analysis``.
+
+Two subcommands over the project-native static analysis
+(analysis/engine.py):
+
+  report  print the plane-threading matrix summary and every finding
+          (suppressed ones included), write the artifact; exit 0
+          unless the input is unusable
+  check   the CI gate: exit 1 on any unsuppressed finding, 0 clean
+
+Both write ``artifacts/static_analysis.json`` (override with
+``--artifact``; ``--artifact ''`` skips) — the machine-readable map of
+knob x run-shape threading the compose() refactor consumes, and the
+artifact ``telemetry regress`` walks with an absolute findings==0 gate.
+
+Exit codes: 0 clean, 1 findings (check only), 2 usage/input error
+(bad root, malformed baseline) — stable for CI
+(tests/test_analysis_cli.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from scalecube_cluster_tpu.analysis import engine
+
+DEFAULT_ARTIFACT = os.path.join("artifacts", "static_analysis.json")
+
+
+def _write_artifact(artifact: dict, path: str) -> None:
+    if not path:
+        return
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def _print_summary(result: engine.AnalysisResult, verbose: bool) -> None:
+    n_entries = len(engine.ENTRY_POINTS)
+    uniform = sum(
+        1 for f in result.fields
+        if len([e for e, cols in result.matrix["entries"][f].items()
+                if cols]) in (0, n_entries)
+    )
+    print(f"# swimlint @ {result.root}")
+    print(f"rules: {', '.join(result.rules_ran)}")
+    print(f"plane matrix: {len(result.fields)} SwimParams knobs x "
+          f"{n_entries} run shapes + {len(engine.TICK_BODIES)} tick "
+          f"bodies ({uniform}/{len(result.fields)} knobs uniformly "
+          f"threaded)")
+    if result.suppressed:
+        print(f"suppressed (baselined): {len(result.suppressed)}")
+        if verbose:
+            for f in result.suppressed:
+                print(f"  ~ {f.id}: {f.justification}")
+    if result.findings:
+        print(f"FINDINGS: {len(result.findings)}")
+        for f in result.findings:
+            anchor = f"{f.path}:{f.line}" if f.line else f.path
+            print(f"  ! [{f.rule}] {anchor}: {f.message}")
+    else:
+        print("findings: none")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m scalecube_cluster_tpu.analysis",
+        description=__doc__.splitlines()[0],
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    for name, help_ in (("report", "print matrix + findings, exit 0"),
+                        ("check", "CI gate: exit 1 on findings")):
+        p = sub.add_parser(name, help=help_)
+        p.add_argument("--root", default=None,
+                       help="package tree to analyze (default: the "
+                            "installed scalecube_cluster_tpu)")
+        p.add_argument("--baseline", default=None,
+                       help="suppression file (default: the package's "
+                            "analysis/baseline.json for the installed "
+                            "root, none for a foreign --root tree)")
+        p.add_argument("--artifact", default=None,
+                       help=f"artifact path (default {DEFAULT_ARTIFACT} "
+                            f"when analyzing the installed package, no "
+                            f"artifact for a foreign --root tree — the "
+                            f"committed artifact must never be clobbered "
+                            f"by a mutation-debug run; '' skips writing)")
+        p.add_argument("--no-compile", action="store_true",
+                       help="AST rules only — skip the trace/recompile/"
+                            "dtype audits")
+        p.add_argument("--json", action="store_true",
+                       help="print the artifact JSON instead of the "
+                            "summary")
+        p.add_argument("-v", "--verbose", action="store_true")
+        p.set_defaults(mode=name)
+
+    args = parser.parse_args(argv)
+    try:
+        result = engine.run_analysis(
+            root=args.root, baseline=args.baseline,
+            compile_audit=False if args.no_compile else None,
+        )
+    except (engine.BaselineError, FileNotFoundError, SyntaxError,
+            ValueError, KeyError) as e:
+        # KeyError: a parseable --root tree that is not this package
+        # (no models/swim.py / no SwimParams) — input error, exit 2
+        print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    artifact = result.to_artifact()
+    artifact_path = args.artifact
+    if artifact_path is None:
+        # the DEFAULT path is the COMMITTED artifact: only a full run
+        # on the installed tree may write it — a foreign --root tree or
+        # an AST-only --no-compile pass would clobber the committed
+        # compile-audit blocks (tests/test_analysis_cli.py pins both)
+        full_run = (result.root == engine.default_root()
+                    and not args.no_compile)
+        artifact_path = DEFAULT_ARTIFACT if full_run else ""
+    try:
+        _write_artifact(artifact, artifact_path)
+    except OSError as e:
+        print(f"error: cannot write artifact {artifact_path}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(artifact, sort_keys=True))
+    else:
+        _print_summary(result, args.verbose)
+    if args.mode == "check" and not result.ok:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

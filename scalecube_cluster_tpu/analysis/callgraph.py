@@ -1,0 +1,316 @@
+"""Mention-graph over the package source: the reachability substrate
+of swimlint's cross-cutting rules.
+
+The plane-threading matrix (analysis/rules.py) needs "which
+``SwimParams`` knobs does the code reachable from ``shard_run_metered``
+consult?" — a question about the *source*, not the runtime: a knob the
+sharded path never reads is a plane that silently doesn't exist there,
+which is exactly the hazard ROADMAP item 1 describes (one plane ==
+~28 hand-edited files with nothing but review discipline checking
+coverage).
+
+So this module builds a deliberately *over-approximate* static call
+graph:
+
+  - nodes are top-level functions and class methods (nested closures —
+    the ``tick``/``body`` lambdas every run shape wraps around
+    ``lax.scan`` — are inlined into their parent, which is what makes
+    ``lax.scan(tick, ...)`` reachability free);
+  - an edge exists when a function MENTIONS another: a ``Name`` load
+    resolving through the module's import/def table, an attribute on a
+    resolved module alias (``swim.run_metered``), a class attribute
+    (``SwimParams.from_config``), or — the over-approximation — an
+    attribute whose bare name matches a known method/property
+    (``params.wire_format`` edges into the property body, so the
+    fields the property consults count as consulted).
+
+Over-approximation is the safe direction for a *completeness* rule:
+a spurious edge can at worst hide a missing-threading finding behind an
+unrelated same-named method, while a missed edge would fabricate one.
+Two deliberate precision guards keep the cones meaningful:
+
+  - annotations are NOT mentions (every signature says ``SwimParams``;
+    following them would pull ``__post_init__`` — which consults every
+    field for validation — into every cone and blind the matrix);
+  - a bare class-name mention edges only into ``__init__``, never the
+    whole method set (constructors run; validators and classmethods
+    don't, unless actually referenced).
+
+Everything operates on a *root directory* of ``.py`` files, so the
+mutation tests can point the same engine at a copied, deliberately
+broken tree (tests/test_analysis_rules.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One graph node: a top-level function or a class method."""
+
+    qualname: str            # "models/swim.py::run" / "...::SwimParams.wire_format"
+    name: str                # bare name ("run" / "wire_format")
+    rel: str                 # module path relative to the root
+    node: ast.AST            # FunctionDef / AsyncFunctionDef
+    cls: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    rel: str                 # "models/swim.py"
+    path: pathlib.Path
+    tree: ast.Module
+    # name -> ("func", qualname) | ("class", class name) | ("module", rel)
+    symbols: Dict[str, Tuple[str, str]] = dataclasses.field(
+        default_factory=dict)
+    # import aliases that resolve OUTSIDE the package ("np" -> "numpy")
+    extern: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+class PackageGraph:
+    """All modules under ``root`` plus the mention graph between their
+    functions.  ``root`` is the package directory itself (the directory
+    holding ``models/``, ``ops/``, ...)."""
+
+    def __init__(self, root):
+        self.root = pathlib.Path(root).resolve()
+        if not self.root.is_dir():
+            raise FileNotFoundError(f"analysis root is not a directory: "
+                                    f"{self.root}")
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        # bare method/function name -> qualnames (the over-approx index)
+        self.by_name: Dict[str, List[str]] = {}
+        # class name -> {method name -> qualname}
+        self.classes: Dict[str, Dict[str, str]] = {}
+        self._edges: Dict[str, Set[str]] = {}
+        self._load()
+        self._resolve_imports()
+        self._build_edges()
+
+    # -- loading -----------------------------------------------------------
+
+    def _load(self):
+        paths = sorted(self.root.rglob("*.py"))
+        if not paths:
+            raise FileNotFoundError(f"no .py files under {self.root}")
+        for path in paths:
+            rel = str(path.relative_to(self.root))
+            try:
+                tree = ast.parse(path.read_text())
+            except SyntaxError as e:
+                raise SyntaxError(f"{rel}: {e}") from e
+            mod = ModuleInfo(rel=rel, path=path, tree=tree)
+            self.modules[rel] = mod
+            for node in tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._add_function(mod, node, cls=None)
+                elif isinstance(node, ast.ClassDef):
+                    mod.symbols[node.name] = ("class", node.name)
+                    methods = self.classes.setdefault(node.name, {})
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            q = self._add_function(mod, item, cls=node.name)
+                            methods[item.name] = q
+
+    def _add_function(self, mod: ModuleInfo, node, cls: Optional[str]) -> str:
+        qual = (f"{mod.rel}::{cls}.{node.name}" if cls
+                else f"{mod.rel}::{node.name}")
+        info = FunctionInfo(qualname=qual, name=node.name, rel=mod.rel,
+                            node=node, cls=cls)
+        self.functions[qual] = info
+        self.by_name.setdefault(node.name, []).append(qual)
+        if cls is None:
+            mod.symbols[node.name] = ("func", qual)
+        return qual
+
+    # -- import resolution -------------------------------------------------
+
+    def _module_for(self, dotted_parts: List[str]) -> Optional[str]:
+        """Resolve a dotted module path to a rel path under the root by
+        suffix matching (so ``scalecube_cluster_tpu.models.swim`` and a
+        copied tree's ``anything.models.swim`` both land on
+        ``models/swim.py``)."""
+        for start in range(len(dotted_parts)):
+            tail = dotted_parts[start:]
+            as_file = "/".join(tail) + ".py"
+            as_pkg = "/".join(tail + ["__init__.py"])
+            if as_file in self.modules:
+                return as_file
+            if as_pkg in self.modules:
+                return as_pkg
+        return None
+
+    def _resolve_imports(self):
+        for mod in self.modules.values():
+            base_parts = mod.rel.split("/")[:-1]
+            # every Import/ImportFrom in the file, including the lazy
+            # in-function ones run_metered-style bodies use
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        parts = alias.name.split(".")
+                        local = alias.asname or parts[0]
+                        target = self._module_for(parts)
+                        if target is not None:
+                            mod.symbols[local] = ("module", target)
+                        else:
+                            mod.extern[local] = alias.name
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level:  # relative import
+                        up = base_parts[: len(base_parts) - (node.level - 1)]
+                        parts = up + (node.module.split(".")
+                                      if node.module else [])
+                    else:
+                        parts = (node.module or "").split(".")
+                    src = self._module_for(parts)
+                    for alias in node.names:
+                        local = alias.asname or alias.name
+                        sub = self._module_for(parts + [alias.name])
+                        if sub is not None:
+                            mod.symbols[local] = ("module", sub)
+                        elif src is not None:
+                            sym = self.modules[src].symbols.get(alias.name)
+                            if sym is not None:
+                                mod.symbols[local] = sym
+                        elif parts and parts[0]:
+                            mod.extern[local] = ".".join(parts
+                                                         + [alias.name])
+
+    # -- mention edges -----------------------------------------------------
+
+    def _mention_nodes(self, fn_node) -> Iterable[ast.AST]:
+        """Walk a function body skipping annotations (see module
+        docstring: annotations are types, not data flow)."""
+        skip = set()
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.arg) and node.annotation is not None:
+                skip.add(id(node.annotation))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.returns is not None:
+                skip.add(id(node.returns))
+            elif isinstance(node, ast.AnnAssign):
+                skip.add(id(node.annotation))
+        stack = list(ast.iter_child_nodes(fn_node))
+        while stack:
+            node = stack.pop()
+            if id(node) in skip:
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def module_alias(self, mod: ModuleInfo, expr) -> Optional[str]:
+        """rel path if ``expr`` is a Name bound to a package module."""
+        if isinstance(expr, ast.Name):
+            sym = mod.symbols.get(expr.id)
+            if sym is not None and sym[0] == "module":
+                return sym[1]
+        return None
+
+    def extern_root(self, mod: ModuleInfo, expr) -> Optional[str]:
+        """Dotted name of the external module ``expr`` is rooted at
+        (``np.random`` -> "numpy"), else None."""
+        while isinstance(expr, ast.Attribute):
+            expr = expr.value
+        if isinstance(expr, ast.Name):
+            return mod.extern.get(expr.id)
+        return None
+
+    def _edge_targets(self, mod: ModuleInfo, node) -> List[str]:
+        out: List[str] = []
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            sym = mod.symbols.get(node.id)
+            if sym is not None:
+                if sym[0] == "func":
+                    out.append(sym[1])
+                elif sym[0] == "class":
+                    init = self.classes.get(sym[1], {}).get("__init__")
+                    if init:
+                        out.append(init)
+        elif isinstance(node, ast.Attribute):
+            target_mod = self.module_alias(mod, node.value)
+            if target_mod is not None:
+                sym = self.modules[target_mod].symbols.get(node.attr)
+                if sym is not None and sym[0] == "func":
+                    out.append(sym[1])
+                elif sym is not None and sym[0] == "class":
+                    init = self.classes.get(sym[1], {}).get("__init__")
+                    if init:
+                        out.append(init)
+            elif (isinstance(node.value, ast.Name)
+                  and node.value.id in self.classes):
+                q = self.classes[node.value.id].get(node.attr)
+                if q:
+                    out.append(q)
+            elif self.extern_root(mod, node.value) is None:
+                # the over-approximate leg: attribute name matching any
+                # known method/property (``params.wire_format``,
+                # ``eng.deliver``) — see module docstring
+                out.extend(self.by_name.get(node.attr, ()))
+        return out
+
+    def _build_edges(self):
+        for qual, info in self.functions.items():
+            mod = self.modules[info.rel]
+            edges: Set[str] = set()
+            for node in self._mention_nodes(info.node):
+                for tgt in self._edge_targets(mod, node):
+                    if tgt != qual:
+                        edges.add(tgt)
+            self._edges[qual] = edges
+
+    # -- queries -----------------------------------------------------------
+
+    def find(self, rel: str, name: str) -> Optional[str]:
+        qual = f"{rel}::{name}"
+        return qual if qual in self.functions else None
+
+    def cone(self, roots: Iterable[str]) -> Set[str]:
+        """All functions reachable from ``roots`` (roots included)."""
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.functions]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            stack.extend(self._edges.get(q, ()))
+        return seen
+
+    def consult_sites(self, qual: str,
+                      fields: Set[str]) -> List[Tuple[str, str, int]]:
+        """(field, rel, line) for every ``<expr>.<field>`` read in the
+        function whose base is not a module alias — an attribute with a
+        knob's name on a non-module object is a consultation of that
+        knob (``params.sync_interval``, ``kn.suspicion_rounds``,
+        ``self.compact_carry`` inside a property)."""
+        info = self.functions[qual]
+        mod = self.modules[info.rel]
+        sites: List[Tuple[str, str, int]] = []
+        for node in self._mention_nodes(info.node):
+            if (isinstance(node, ast.Attribute) and node.attr in fields
+                    and isinstance(node.ctx, ast.Load)
+                    and self.module_alias(mod, node.value) is None
+                    and self.extern_root(mod, node.value) is None):
+                sites.append((node.attr, info.rel, node.lineno))
+        return sites
+
+    def dataclass_fields(self, rel: str, cls: str) -> List[str]:
+        """Annotated field names of a (data)class, in declaration order
+        — the statically-extracted knob list the matrix rows come
+        from."""
+        mod = self.modules.get(rel)
+        if mod is None:
+            raise KeyError(f"no module {rel!r} under {self.root}")
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == cls:
+                return [item.target.id for item in node.body
+                        if isinstance(item, ast.AnnAssign)
+                        and isinstance(item.target, ast.Name)]
+        raise KeyError(f"no class {cls!r} in {rel}")

@@ -1,0 +1,274 @@
+"""Compile-time audits: trace every run entry point at tiny N and
+check the class of perf regression no unit test sees.
+
+Three audits per entry point (the seven ENTRY_POINTS of
+analysis/rules.py), all on one tiny compact-carry scatter config:
+
+``host-callbacks``
+    The traced program must contain ZERO host callback primitives
+    (``pure_callback``/``io_callback``/``debug_callback``/...): one
+    stray ``jax.debug.print`` or host hook in the scan body turns every
+    round into a device->host round trip and silently serializes the
+    hot loop.
+
+``carry-dtype``
+    With ``compact_carry=True`` the scan carry's int16/int8 lanes must
+    STAY int16/int8: the audit counts narrow-lane avals in the traced
+    scan carry and fails if any lane widened (and if any carry aval is
+    int64/float64 at all).  A widening here is the capacity regression
+    the compact layout exists to prevent — it doubles the [N, K] carry
+    bytes without failing a single numeric test.
+
+``recompile``
+    A second call with identical shapes/statics must be a compile-cache
+    HIT (the jitted entry's miss counter does not move).  An unhashable
+    static, a fresh non-``eq`` params object per call, or an
+    accidentally-dynamic Python value in the signature shows up as a
+    recompile — in production that is a multi-second stall every
+    checkpoint segment.
+
+The audits run the REAL installed package (they import and trace it),
+so the engine only schedules them when the analysis root is the
+installed package tree; AST-only runs on copies (the mutation tests)
+skip them with a note in the artifact.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from scalecube_cluster_tpu.analysis.rules import ENTRY_POINTS, Finding
+
+TINY_N = 8
+TINY_ROUNDS = 3
+
+_CALLBACK_MARKERS = ("callback", "outside_call", "infeed", "outfeed")
+
+
+def _tiny_setup():
+    import jax
+
+    from scalecube_cluster_tpu import config
+    from scalecube_cluster_tpu.models import swim
+
+    cfg = config.ClusterConfig.default().replace(
+        gossip_interval=100, ping_interval=200, ping_timeout=100,
+        sync_interval=1_000, suspicion_mult=3,
+    )
+    # compact carry: the layout whose narrow lanes the dtype audit pins;
+    # scatter delivery so the sharded/pipelined entries run the same
+    # config (k_block/shift variants are covered by the AST matrix).
+    params = swim.SwimParams.from_config(cfg, n_members=TINY_N,
+                                         compact_carry=True)
+    world = swim.SwimWorld.healthy(params)
+    key = jax.random.PRNGKey(0)
+    return params, world, key
+
+
+def _drivers(params, world, key):
+    """name -> (jitted entry object, zero-arg call thunk).  Thunks pass
+    identical arguments every call, so the second invocation must be a
+    cache hit."""
+    from scalecube_cluster_tpu.chaos import monitor
+    from scalecube_cluster_tpu.models import swim
+    from scalecube_cluster_tpu.parallel import compat
+    from scalecube_cluster_tpu.parallel import mesh as pmesh
+
+    spec = monitor.MonitorSpec.passive(params)
+    n = TINY_ROUNDS
+    if compat.HAS_SHARD_MAP:
+        mesh = pmesh.make_mesh(1)
+        sharded = {
+            "shard_run": (
+                pmesh.shard_run,
+                lambda: pmesh.shard_run(key, params, world, n, mesh)),
+            "shard_run_metered": (
+                pmesh.shard_run_metered,
+                lambda: pmesh.shard_run_metered(key, params, world, n,
+                                                mesh)),
+        }
+    else:
+        # legacy JAX without shard_map: the sharded suites all skip
+        # (parallel/compat.py) — the audit records the same skip
+        # instead of a false red
+        sharded = {"shard_run": compat.SKIP_REASON,
+                   "shard_run_metered": compat.SKIP_REASON}
+    return {
+        "run": (swim.run,
+                lambda: swim.run(key, params, world, n)),
+        "run_traced": (swim.run_traced,
+                       lambda: swim.run_traced(key, params, world, n)),
+        "run_metered": (swim.run_metered,
+                        lambda: swim.run_metered(key, params, world, n)),
+        "run_monitored": (
+            monitor.run_monitored,
+            lambda: monitor.run_monitored(key, params, world, spec, n)),
+        "run_monitored_metered": (
+            monitor.run_monitored_metered,
+            lambda: monitor.run_monitored_metered(key, params, world,
+                                                  spec, n)),
+        **sharded,
+    }
+
+
+def _iter_eqns(jaxpr):
+    """Every eqn in a jaxpr, recursing through pjit/scan/cond/shard_map
+    sub-jaxprs carried in eqn params."""
+    stack = [jaxpr]
+    seen = set()
+    while stack:
+        jx = stack.pop()
+        if id(jx) in seen:
+            continue
+        seen.add(id(jx))
+        for eqn in jx.eqns:
+            yield eqn
+            for val in eqn.params.values():
+                stack.extend(_sub_jaxprs(val))
+
+
+def _sub_jaxprs(val):
+    out = []
+    if hasattr(val, "jaxpr") and hasattr(val.jaxpr, "eqns"):
+        out.append(val.jaxpr)          # ClosedJaxpr
+    elif hasattr(val, "eqns"):
+        out.append(val)                # raw Jaxpr
+    elif isinstance(val, (list, tuple)):
+        for item in val:
+            out.extend(_sub_jaxprs(item))
+    return out
+
+
+def _scan_carry_avals(jaxpr):
+    """[(aval, ...)] for each scan eqn's carry block."""
+    carries = []
+    for eqn in _iter_eqns(jaxpr):
+        if eqn.primitive.name != "scan":
+            continue
+        nc = eqn.params.get("num_consts", 0)
+        ncar = eqn.params.get("num_carry", 0)
+        if ncar:
+            carries.append([v.aval for v in
+                            eqn.invars[nc:nc + ncar]])
+    return carries
+
+
+def _narrow_counts(tree) -> Tuple[int, int]:
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    n16 = sum(1 for leaf in leaves if leaf.dtype == jnp.int16)
+    n8 = sum(1 for leaf in leaves if leaf.dtype == jnp.int8)
+    return n16, n8
+
+
+def run_compile_audit(entries: Optional[Sequence[str]] = None
+                      ) -> Tuple[dict, List[Finding]]:
+    """Returns ``(report, findings)``; ``report`` is the per-entry
+    artifact block, ``findings`` is empty when all audits pass."""
+    import jax
+    import jax.numpy as jnp
+
+    from scalecube_cluster_tpu.models import swim
+
+    params, world, key = _tiny_setup()
+    drivers = _drivers(params, world, key)
+    names = list(entries) if entries is not None else list(ENTRY_POINTS)
+    unknown = sorted(set(names) - set(drivers))
+    if unknown:
+        raise ValueError(f"unknown compile-audit entries: {unknown}")
+
+    exp16, exp8 = _narrow_counts(swim.initial_state(params, world))
+    report: Dict[str, dict] = {}
+    findings: List[Finding] = []
+
+    def fail(entry, check, message):
+        findings.append(Finding(
+            rule="compile-audit",
+            id=f"compile-audit:{entry}:{check}",
+            path=ENTRY_POINTS[entry][0], line=0,
+            message=f"[{entry}] {message}",
+        ))
+
+    for entry in names:
+        if isinstance(drivers[entry], str):
+            # environment cannot run this entry at all (e.g. no
+            # shard_map): a skip, not a red — matches the test suites
+            report[entry] = {"ok": True, "skipped": drivers[entry]}
+            continue
+        jitted, thunk = drivers[entry]
+        row: dict = {}
+        report[entry] = row
+        try:
+            jaxpr = jax.make_jaxpr(lambda: thunk())()
+
+            callbacks = sorted({eqn.primitive.name
+                                for eqn in _iter_eqns(jaxpr.jaxpr)
+                                if any(m in eqn.primitive.name
+                                       for m in _CALLBACK_MARKERS)})
+            row["host_callbacks"] = callbacks
+            if callbacks:
+                fail(entry, "host-callbacks",
+                     f"host callback primitives in the traced program: "
+                     f"{callbacks} — every round pays a device->host "
+                     f"round trip")
+
+            carries = _scan_carry_avals(jaxpr.jaxpr)
+            wide = sorted({str(a.dtype) for c in carries for a in c
+                           if str(a.dtype) in ("int64", "float64")})
+            best16 = max((sum(1 for a in c if a.dtype == jnp.int16)
+                          for c in carries), default=0)
+            best8 = max((sum(1 for a in c if a.dtype == jnp.int8)
+                         for c in carries), default=0)
+            row["scan_carry"] = {
+                "scans": len(carries),
+                "int16_lanes": best16, "int16_expected": exp16,
+                "int8_lanes": best8, "int8_expected": exp8,
+                "wide_dtypes": wide,
+            }
+            # distinct check slugs per failure mode: the finding id is
+            # the baseline key, so two different defects must never
+            # share one id (engine._collapse_duplicate_ids would merge
+            # them into a flapping ':x2')
+            if not carries:
+                fail(entry, "carry-scan-missing",
+                     "no scan with a carry found in the traced program "
+                     "— the hot loop moved; update the audit")
+            else:
+                if wide:
+                    fail(entry, "carry-dtype-wide",
+                         f"64-bit dtypes in the scan carry: {wide}")
+                if best16 < exp16 or best8 < exp8:
+                    fail(entry, "carry-dtype-narrowed-lanes-lost",
+                         f"compact int16/int8 lanes widened in the scan "
+                         f"carry: {best16}/{exp16} int16 and "
+                         f"{best8}/{exp8} int8 lanes survive — the "
+                         f"compact layout is paying wide-carry HBM")
+
+            if hasattr(jitted, "_cache_size"):
+                before = jitted._cache_size()
+                jax.block_until_ready(thunk())
+                after_first = jitted._cache_size()
+                jax.block_until_ready(thunk())
+                after_second = jitted._cache_size()
+                row["recompile"] = {
+                    "first_call_misses": after_first - before,
+                    "second_call_misses": after_second - after_first,
+                }
+                if after_second != after_first:
+                    fail(entry, "recompile",
+                         f"second same-shape call recompiled "
+                         f"({after_second - after_first} new cache "
+                         f"entries) — a static argument is not "
+                         f"hash-stable")
+            else:  # pragma: no cover - older/newer jax without the API
+                row["recompile"] = {"skipped": "no _cache_size API"}
+            row["ok"] = not any(f.id.startswith(f"compile-audit:{entry}:")
+                                for f in findings)
+        except Exception as e:  # noqa: BLE001 - audit must report, not die
+            row["ok"] = False
+            row["error"] = f"{type(e).__name__}: {e}"
+            fail(entry, "error",
+                 f"audit raised {type(e).__name__}: {e}")
+    return report, findings

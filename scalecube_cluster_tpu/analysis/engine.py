@@ -1,0 +1,237 @@
+"""swimlint's driver: run the rules, apply the baseline, build the
+artifact.
+
+The baseline file (``analysis/baseline.json`` next to this module by
+default) is the ONLY suppression mechanism: a JSON list of
+``{"id", "justification"}`` rows, one per finding that is *intended*
+(a scatter-only wire knob has no shift-body threading site — that is
+the design, and the justification says so in one line).  The contract
+(tests/test_analysis_cli.py):
+
+  - a suppression with an empty/missing justification is an INPUT
+    error (exit 2) — zero unexplained suppressions can be committed;
+  - a suppression whose finding no longer exists is itself a finding
+    (``baseline:stale:...``) when its rule ran — a fixed asymmetry must
+    leave the baseline, or the file silently grows dead weight that
+    would mask a regression under the same id;
+  - suppressed findings stay in the artifact (``suppressed: true``)
+    so the matrix map stays complete for the compose() refactor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import Dict, List, Optional
+
+from scalecube_cluster_tpu.analysis import rules as rules_mod
+from scalecube_cluster_tpu.analysis.callgraph import PackageGraph
+from scalecube_cluster_tpu.analysis.rules import (
+    ENTRY_POINTS, MATRIX_SITE_CAP, TICK_BODIES, Finding,
+)
+
+SCHEMA = "swimlint/1"
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file — an input error (CLI exit 2), never a
+    findings exit (1)."""
+
+
+def default_root() -> pathlib.Path:
+    """The installed package directory (the tree ``check`` audits)."""
+    return pathlib.Path(__file__).resolve().parents[1]
+
+
+def default_baseline_path() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parent / "baseline.json"
+
+
+def load_baseline(path) -> Dict[str, str]:
+    """id -> justification.  Missing file = empty baseline."""
+    path = pathlib.Path(path)
+    if not path.exists():
+        return {}
+    try:
+        doc = json.loads(path.read_text())
+    except json.JSONDecodeError as e:
+        raise BaselineError(f"baseline {path}: not valid JSON: {e}") from e
+    rows = doc.get("suppressions") if isinstance(doc, dict) else None
+    if not isinstance(rows, list):
+        raise BaselineError(
+            f"baseline {path}: expected {{'suppressions': [...]}}"
+        )
+    out: Dict[str, str] = {}
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict) or not row.get("id"):
+            raise BaselineError(f"baseline {path}: row {i} has no 'id'")
+        just = row.get("justification")
+        if not isinstance(just, str) or not just.strip():
+            raise BaselineError(
+                f"baseline {path}: suppression {row['id']!r} has no "
+                f"justification — zero unexplained suppressions "
+                f"(analysis/engine.py docstring)"
+            )
+        if row["id"] in out:
+            raise BaselineError(
+                f"baseline {path}: duplicate suppression {row['id']!r}"
+            )
+        out[row["id"]] = just.strip()
+    return out
+
+
+def _collapse_duplicate_ids(findings: List[Finding]) -> List[Finding]:
+    """One finding per id; k > 1 same-id occurrences collapse into one
+    whose id gains an ``:x<k>`` suffix.  This is what keeps a baseline
+    suppression from silently absorbing FUTURE occurrences: a second
+    hand-copied literal in the same file changes the id (``...:x2``),
+    so the committed suppression goes stale (its own finding) and the
+    new occurrence surfaces unsuppressed."""
+    groups: Dict[str, List[Finding]] = {}
+    for f in findings:
+        groups.setdefault(f.id, []).append(f)
+    out: List[Finding] = []
+    for fid, group in groups.items():
+        if len(group) == 1:
+            out.append(group[0])
+            continue
+        first = group[0]
+        lines = sorted({g.line for g in group if g.line})
+        first.id = f"{fid}:x{len(group)}"
+        first.message += (f" [{len(group)} occurrences"
+                          + (f": lines {', '.join(map(str, lines))}"
+                             if lines else "") + "]")
+        out.append(first)
+    return out
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    root: pathlib.Path
+    fields: List[str]
+    matrix: dict
+    findings: List[Finding]          # unsuppressed
+    suppressed: List[Finding]
+    compile_report: dict
+    rules_ran: List[str]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_artifact(self) -> dict:
+        def cell(sites: List[str]) -> dict:
+            return {"count": len(sites), "sites": sites[:MATRIX_SITE_CAP]}
+
+        matrix = {
+            group: {f: {col: cell(sites) for col, sites in cols.items()}
+                    for f, cols in per_field.items()}
+            for group, per_field in self.matrix.items()
+        }
+        return {
+            "schema": SCHEMA,
+            "metric": "static_analysis",
+            "generated_by": "python -m scalecube_cluster_tpu.analysis",
+            "root": self.root.name,
+            "rules": self.rules_ran,
+            "fields": self.fields,
+            "entry_points": list(ENTRY_POINTS),
+            "tick_bodies": list(TICK_BODIES),
+            "matrix": matrix,
+            "findings": [f.to_json() for f in self.findings],
+            "suppressed": [f.to_json() for f in self.suppressed],
+            "findings_total": len(self.findings),
+            "suppressed_total": len(self.suppressed),
+            "compile_audit": self.compile_report,
+            "ok": self.ok,
+        }
+
+
+def run_analysis(root=None, baseline=None,
+                 compile_audit: Optional[bool] = None) -> AnalysisResult:
+    """Run every rule over ``root`` and fold in the baseline.
+
+    ``compile_audit=None`` auto-selects: the audits trace the IMPORTED
+    package, so they only run when ``root`` is the installed tree;
+    ``True`` insists (raises on a foreign root), ``False`` skips.
+    """
+    root = pathlib.Path(root).resolve() if root is not None \
+        else default_root()
+    if baseline is not None:
+        baseline_map = load_baseline(baseline)
+    elif root == default_root():
+        baseline_map = load_baseline(default_baseline_path())
+    else:
+        # a foreign root (a mutated copy, a fixture tree) has its own
+        # asymmetries: the installed package's suppressions would all
+        # read as stale there — default to no baseline instead
+        baseline_map = {}
+
+    graph = PackageGraph(root)
+    matrix, findings = rules_mod.plane_matrix(graph)
+    findings += rules_mod.trace_safety(graph)
+    findings += rules_mod.donation_safety(graph)
+    findings += rules_mod.magic_literals(graph)
+    rules_ran = ["plane-matrix", "trace-safety", "donation-safety",
+                 "magic-literal"]
+
+    is_installed_tree = root == default_root()
+    if compile_audit is True and not is_installed_tree:
+        raise ValueError(
+            f"compile audit traces the imported package; root {root} is "
+            f"not the installed tree {default_root()}"
+        )
+    do_compile = (compile_audit if compile_audit is not None
+                  else is_installed_tree)
+    if do_compile:
+        from scalecube_cluster_tpu.analysis.compile_audit import (
+            run_compile_audit,
+        )
+
+        # always the full seven-entry audit: a partial audit would make
+        # the stale-baseline check lie about unaudited entries
+        compile_report, compile_findings = run_compile_audit()
+        findings += compile_findings
+        rules_ran.append("compile-audit")
+    else:
+        compile_report = {
+            "skipped": ("foreign analysis root — AST rules only"
+                        if not is_installed_tree else "disabled"),
+        }
+
+    findings = _collapse_duplicate_ids(findings)
+
+    # Fold the baseline: split suppressed findings out, then flag
+    # baseline rows whose finding no longer exists (only for rules that
+    # actually ran — a --no-compile run must not call compile-audit
+    # suppressions stale).
+    seen_ids = {f.id for f in findings}
+    live, suppressed = [], []
+    for f in findings:
+        if f.id in baseline_map:
+            f.suppressed = True
+            f.justification = baseline_map[f.id]
+            suppressed.append(f)
+        else:
+            live.append(f)
+    for bid, just in sorted(baseline_map.items()):
+        rule = bid.split(":", 1)[0]
+        if bid not in seen_ids and rule in rules_ran:
+            live.append(Finding(
+                rule="baseline", id=f"baseline:stale:{bid}",
+                path="analysis/baseline.json", line=0,
+                message=(
+                    f"baseline suppresses {bid!r} but the finding no "
+                    f"longer exists — remove the row (justification "
+                    f"was: {just})"
+                ),
+            ))
+
+    fields = graph.dataclass_fields(rules_mod.PARAMS_MODULE,
+                                    rules_mod.PARAMS_CLASS)
+    return AnalysisResult(
+        root=root, fields=fields, matrix=matrix, findings=live,
+        suppressed=suppressed, compile_report=compile_report,
+        rules_ran=rules_ran,
+    )

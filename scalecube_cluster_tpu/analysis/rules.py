@@ -1,0 +1,620 @@
+"""swimlint's AST rules.
+
+Four rule families over the :class:`~.callgraph.PackageGraph`:
+
+``plane-matrix``
+    The headline rule.  Rows = every ``SwimParams`` field, statically
+    extracted from the dataclass (no hand-maintained knob list to rot);
+    columns = the seven run entry points and the four tick-body
+    variants.  A cell holds the consultation sites (``params.<knob>``
+    reads) reachable from that column's root cone.  A knob consulted in
+    SOME run shapes but not others is exactly the "28 files per plane"
+    hazard ROADMAP item 1 warns about — a plane that silently does not
+    exist on one path — and fails ``check``.  Within the tick-body
+    group: the three whole-tick bodies (scatter / shift / k_block) must
+    agree with each other, and the pipelined send/recv pair — which IS
+    the scatter tick split in half — must consult at least everything
+    the scatter body does.  Intended asymmetries (a scatter-only wire
+    knob, a shift-only capacity knob) are not bugs; they live in the
+    baseline file with a one-line justification each, so a NEW
+    asymmetry still fires.
+
+``trace-safety``
+    Host nondeterminism and host-sync coercions in the device modules
+    (``models/``, ``ops/``, ``chaos/monitor.py``, ``parallel/mesh.py``):
+    ``time.time``/``random``/``np.random``/``datetime.now`` anywhere in
+    those modules, and ``.item()``/``.tolist()``/``float(jnp...)``-style
+    forced synchronization inside the *device cone* — the functions
+    reachable from the seven entry points, i.e. code that runs under
+    trace where such a call is either a tracer error waiting for the
+    right branch or a silent per-round host round-trip.
+
+``donation-safety``
+    A buffer passed through a ``donate_argnums``/``donate_argnames``
+    jit boundary is gone — XLA reuses its memory for the output (and
+    current XLA donates on CPU too: models/swim.run docstring).  The
+    rule finds call sites of donating functions and flags reads of a
+    donated argument that follow the call in SOURCE order (up to and
+    including a rebind line's RHS).  Source order is the documented
+    approximation: a loop-carried read textually ABOVE the donating
+    call (iteration 2 reading iteration 1's donated buffer) is not
+    flagged — rebind-per-iteration, the repo-wide donation idiom, is
+    what the rule enforces on the lines it can see.
+
+``magic-literal``
+    The generalized PR-13 constant audit: each constant family (wire
+    saturation points, carry dtype bounds, identity-epoch widths,
+    monitor invariant codes) has ONE owning table; an evaluated literal
+    from a family appearing in code outside its owning files is a
+    hand-copied constant waiting to drift.  Token-level, like the
+    tests/test_wire_constants.py grep-proof this rule absorbed —
+    comments and docstrings may cite the numbers (documentation is not
+    a clamp site).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from scalecube_cluster_tpu.analysis.callgraph import PackageGraph
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    id: str            # stable across unrelated edits (no line numbers)
+    path: str          # module path relative to the analysis root
+    line: int          # best-effort anchor for humans (0 = whole file)
+    message: str
+    suppressed: bool = False
+    justification: Optional[str] = None
+
+    def to_json(self) -> dict:
+        d = {"rule": self.rule, "id": self.id, "path": self.path,
+             "line": self.line, "message": self.message}
+        if self.suppressed:
+            d["suppressed"] = True
+            d["justification"] = self.justification
+        return d
+
+
+# --------------------------------------------------------------------------
+# Roots: the seven run entry points and the tick-body variants
+# --------------------------------------------------------------------------
+
+PARAMS_MODULE = "models/swim.py"
+PARAMS_CLASS = "SwimParams"
+
+ENTRY_POINTS: Dict[str, Tuple[str, str]] = {
+    "run": ("models/swim.py", "run"),
+    "run_traced": ("models/swim.py", "run_traced"),
+    "run_metered": ("models/swim.py", "run_metered"),
+    "run_monitored": ("chaos/monitor.py", "run_monitored"),
+    "run_monitored_metered": ("chaos/monitor.py", "run_monitored_metered"),
+    "shard_run": ("parallel/mesh.py", "shard_run"),
+    "shard_run_metered": ("parallel/mesh.py", "shard_run_metered"),
+}
+
+# The three sibling whole-tick bodies swim_tick dispatches between, and
+# the pipelined half-tick pair (= the scatter tick split at the
+# send/recv boundary, parallel/mesh._pipelined_rounds).
+TICK_BODIES: Dict[str, Sequence[Tuple[str, str]]] = {
+    "scatter": (("models/swim.py", "_tick_scatter"),),
+    "shift": (("models/swim.py", "_tick_shift"),),
+    "k_block": (("models/swim.py", "_tick_shift_blocked"),),
+    "pipelined": (("models/swim.py", "swim_tick_send"),
+                  ("models/swim.py", "swim_tick_recv")),
+}
+
+# Whole-tick bodies compared against each other for completeness;
+# "pipelined" is handled as a superset check against "scatter".
+WHOLE_TICK_BODIES = ("scatter", "shift", "k_block")
+
+DEVICE_MODULES_PREFIXES = ("models/", "ops/")
+DEVICE_MODULES_FILES = ("chaos/monitor.py", "parallel/mesh.py")
+
+MATRIX_SITE_CAP = 8  # sites listed per artifact cell (count is exact)
+
+
+def _is_device_module(rel: str) -> bool:
+    return (rel.startswith(DEVICE_MODULES_PREFIXES)
+            or rel in DEVICE_MODULES_FILES)
+
+
+def _resolve_roots(graph: PackageGraph, roots: Iterable[Tuple[str, str]],
+                   strict: bool = True) -> List[str]:
+    out = []
+    for rel, name in roots:
+        qual = graph.find(rel, name)
+        if qual is None:
+            if strict:
+                raise ValueError(
+                    f"plane-matrix root {rel}::{name} not found under "
+                    f"{graph.root} — the seven-entry-point contract "
+                    f"moved; update analysis/rules.py "
+                    f"ENTRY_POINTS/TICK_BODIES"
+                )
+            continue
+        out.append(qual)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Rule 1: plane-threading completeness matrix
+# --------------------------------------------------------------------------
+
+def _column_sites(graph: PackageGraph, roots: List[str],
+                  fields: Set[str]) -> Dict[str, List[Tuple[str, int]]]:
+    sites: Dict[str, List[Tuple[str, int]]] = {}
+    for qual in sorted(graph.cone(roots)):
+        for field, rel, line in graph.consult_sites(qual, fields):
+            sites.setdefault(field, []).append((rel, line))
+    for field in sites:
+        sites[field] = sorted(set(sites[field]))
+    return sites
+
+
+def plane_matrix(graph: PackageGraph):
+    """Returns ``(matrix, findings)``.
+
+    ``matrix`` = {"entries": {field: {entry: [sites]}},
+    "bodies": {field: {body: [sites]}}} with sites as "rel:line"
+    strings — the machine-readable map of what the compose() refactor
+    must preserve (emitted into artifacts/static_analysis.json).
+    """
+    fields = graph.dataclass_fields(PARAMS_MODULE, PARAMS_CLASS)
+    fset = set(fields)
+
+    entry_cols = {name: _column_sites(graph, _resolve_roots(graph, [spec]),
+                                      fset)
+                  for name, spec in ENTRY_POINTS.items()}
+    body_cols = {name: _column_sites(graph, _resolve_roots(graph, specs),
+                                     fset)
+                 for name, specs in TICK_BODIES.items()}
+
+    matrix = {
+        "entries": {f: {e: [f"{r}:{ln}" for r, ln in entry_cols[e].get(f, [])]
+                        for e in ENTRY_POINTS}
+                    for f in fields},
+        "bodies": {f: {b: [f"{r}:{ln}" for r, ln in body_cols[b].get(f, [])]
+                       for b in TICK_BODIES}
+                   for f in fields},
+    }
+
+    findings: List[Finding] = []
+    for f in fields:
+        reached = {e for e in ENTRY_POINTS if entry_cols[e].get(f)}
+        if reached and reached != set(ENTRY_POINTS):
+            for e in sorted(set(ENTRY_POINTS) - reached):
+                where = sorted(reached)
+                findings.append(Finding(
+                    rule="plane-matrix",
+                    id=f"plane-matrix:{f}:entry:{e}",
+                    path=ENTRY_POINTS[e][0], line=0,
+                    message=(
+                        f"SwimParams.{f} is consulted on the "
+                        f"{'/'.join(where)} run shape(s) but nothing "
+                        f"reachable from {e} reads it — the plane does "
+                        f"not exist on that path"
+                    ),
+                ))
+        body_reached = {b for b in WHOLE_TICK_BODIES if body_cols[b].get(f)}
+        if body_reached and body_reached != set(WHOLE_TICK_BODIES):
+            for b in sorted(set(WHOLE_TICK_BODIES) - body_reached):
+                findings.append(Finding(
+                    rule="plane-matrix",
+                    id=f"plane-matrix:{f}:body:{b}",
+                    path=TICK_BODIES[b][0][0], line=0,
+                    message=(
+                        f"SwimParams.{f} is consulted in the "
+                        f"{'/'.join(sorted(body_reached))} tick body(ies) "
+                        f"but not in the {b} body's cone — a plane "
+                        f"threaded through some delivery modes only"
+                    ),
+                ))
+        # The pipelined halves ARE the scatter tick split in two: every
+        # knob the scatter body consults must survive the split.
+        if body_cols["scatter"].get(f) and not body_cols["pipelined"].get(f):
+            findings.append(Finding(
+                rule="plane-matrix",
+                id=f"plane-matrix:{f}:body:pipelined",
+                path=TICK_BODIES["pipelined"][0][0], line=0,
+                message=(
+                    f"SwimParams.{f} is consulted in the scatter tick "
+                    f"body but not in the pipelined send/recv halves — "
+                    f"the knob was lost in the half-tick split"
+                ),
+            ))
+    return matrix, findings
+
+
+# --------------------------------------------------------------------------
+# Rule 2: trace-safety
+# --------------------------------------------------------------------------
+
+# Dotted external prefixes that mean host nondeterminism (a fresh value
+# per trace, frozen into the compiled program — or a tracer error).
+BANNED_EXTERN = (
+    "random.", "numpy.random", "time.time", "time.time_ns",
+    "time.perf_counter", "time.monotonic", "datetime.datetime.now",
+    "datetime.datetime.utcnow", "secrets.", "uuid.uuid",
+)
+
+# Method calls that force device->host synchronization when the
+# receiver is traced; only meaningful inside the device cone.
+HOST_SYNC_METHODS = ("item", "tolist")
+REDUCTION_METHODS = {"sum", "mean", "max", "min", "any", "all"}
+
+
+def _dotted(graph: PackageGraph, mod, expr) -> Optional[str]:
+    """Fully-dotted name of an Attribute/Name chain rooted at an
+    external import alias, else None."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if not isinstance(expr, ast.Name):
+        return None
+    root = mod.extern.get(expr.id)
+    if root is None:
+        return None
+    return ".".join([root] + list(reversed(parts)))
+
+
+def _mentions_traced_reduction(graph: PackageGraph, mod, node) -> bool:
+    """True when the expression contains a jnp-rooted call or an
+    array-reduction method call — the classic ``float(jnp.sum(x))`` /
+    ``int(x.max())`` host-sync shapes."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            dotted = _dotted(graph, mod, fn)
+            if dotted is not None and dotted.startswith(
+                    ("jax.numpy", "jnp")):
+                return True
+            if (isinstance(fn, ast.Attribute)
+                    and fn.attr in REDUCTION_METHODS):
+                return True
+        elif isinstance(sub, ast.Attribute):
+            dotted = _dotted(graph, mod, sub)
+            if dotted is not None and dotted.startswith("jax.numpy"):
+                return True
+    return False
+
+
+def trace_safety(graph: PackageGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    # lenient: fixture trees (tests) may define only a subset of the
+    # entry points — the plane matrix is the strict guardian of the
+    # seven-entry contract
+    entry_roots = _resolve_roots(graph, ENTRY_POINTS.values(),
+                                 strict=False)
+    device_cone = graph.cone(entry_roots)
+
+    for qual, info in sorted(graph.functions.items()):
+        if not _is_device_module(info.rel):
+            continue
+        mod = graph.modules[info.rel]
+        in_cone = qual in device_cone
+        for node in graph._mention_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(graph, mod, node.func)
+            if dotted is not None and dotted.startswith(BANNED_EXTERN):
+                findings.append(Finding(
+                    rule="trace-safety",
+                    id=f"trace-safety:{info.rel}:{info.name}:{dotted}",
+                    path=info.rel, line=node.lineno,
+                    message=(
+                        f"{dotted}() in device module function "
+                        f"{info.name} — host nondeterminism is frozen "
+                        f"into the trace (draw through ops/prng.py "
+                        f"instead)"
+                    ),
+                ))
+                continue
+            if not in_cone:
+                continue  # host-side helper in a device module
+            fn = node.func
+            if (isinstance(fn, ast.Attribute)
+                    and fn.attr in HOST_SYNC_METHODS
+                    and not node.args and not node.keywords):
+                findings.append(Finding(
+                    rule="trace-safety",
+                    id=f"trace-safety:{info.rel}:{info.name}:.{fn.attr}",
+                    path=info.rel, line=node.lineno,
+                    message=(
+                        f".{fn.attr}() inside {info.name}, which is "
+                        f"reachable from the run entry points — a "
+                        f"device->host sync (tracer error under jit)"
+                    ),
+                ))
+            elif (isinstance(fn, ast.Name)
+                  and fn.id in ("float", "int", "bool")
+                  and fn.id not in mod.symbols
+                  and node.args
+                  and _mentions_traced_reduction(graph, mod,
+                                                 node.args[0])):
+                findings.append(Finding(
+                    rule="trace-safety",
+                    id=(f"trace-safety:{info.rel}:{info.name}:"
+                        f"{fn.id}-coercion"),
+                    path=info.rel, line=node.lineno,
+                    message=(
+                        f"{fn.id}() over an array reduction inside "
+                        f"{info.name} (device cone) — host-sync "
+                        f"coercion of a traced value"
+                    ),
+                ))
+            elif (isinstance(fn, ast.Name) and fn.id == "print"
+                  and "print" not in mod.symbols):
+                findings.append(Finding(
+                    rule="trace-safety",
+                    id=f"trace-safety:{info.rel}:{info.name}:print",
+                    path=info.rel, line=node.lineno,
+                    message=(
+                        f"print() inside {info.name} (device cone) — "
+                        f"runs at trace time, not per round; use "
+                        f"telemetry lanes or jax.debug off the hot path"
+                    ),
+                ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# Rule 3: donation-safety
+# --------------------------------------------------------------------------
+
+def _donated_params(graph: PackageGraph
+                    ) -> Dict[str, Tuple[List[str], Set[str]]]:
+    """function QUALNAME -> (positional parameter names, donated
+    parameter names), harvested from ``@partial(jax.jit, ...,
+    donate_argnames/donate_argnums=...)`` decorators on package
+    functions.  Keyed by qualname, not bare name: the package has
+    several same-named ``run`` functions and only swim's donates —
+    call sites resolve through the symbol table before matching."""
+    donating: Dict[str, Tuple[List[str], Set[str]]] = {}
+    for info in graph.functions.values():
+        node = info.node
+        arg_names = [a.arg for a in (node.args.posonlyargs
+                                     + node.args.args)]
+        for dec in node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            names: Set[str] = set()
+            for kw in dec.keywords:
+                if kw.arg == "donate_argnames":
+                    names.update(
+                        elt.value for elt in ast.walk(kw.value)
+                        if isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str))
+                elif kw.arg == "donate_argnums":
+                    for elt in ast.walk(kw.value):
+                        if (isinstance(elt, ast.Constant)
+                                and isinstance(elt.value, int)
+                                and not isinstance(elt.value, bool)
+                                and elt.value < len(arg_names)):
+                            names.add(arg_names[elt.value])
+            if names:
+                donating[info.qualname] = (arg_names, names)
+    return donating
+
+
+def donation_safety(graph: PackageGraph) -> List[Finding]:
+    donating = _donated_params(graph)
+    if not donating:
+        return []
+    findings: List[Finding] = []
+
+    for qual, info in sorted(graph.functions.items()):
+        mod = graph.modules[info.rel]
+        # (donated var name, callee, call first line, call end position)
+        donated: List[Tuple[str, str, int, Tuple[int, int]]] = []
+        stores: Dict[str, List[int]] = {}
+        loads: Dict[str, List[Tuple[int, int]]] = {}
+        for node in graph._mention_nodes(info.node):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Store):
+                    stores.setdefault(node.id, []).append(node.lineno)
+                elif isinstance(node.ctx, ast.Load):
+                    loads.setdefault(node.id, []).append(
+                        (node.lineno, node.col_offset))
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name):
+                # `state += 1` READS the old buffer before storing:
+                # the target is a load at its own position too (the
+                # Store ctx above only sees the write half)
+                loads.setdefault(node.target.id, []).append(
+                    (node.target.lineno, node.target.col_offset))
+            if not isinstance(node, ast.Call):
+                continue
+            # resolve the callee to a QUALNAME through the symbol
+            # table (bare-name matching would confuse swim.run with
+            # the non-donating fd.run/gossip.run)
+            callee_qual = None
+            if isinstance(node.func, ast.Name):
+                sym = mod.symbols.get(node.func.id)
+                if sym is not None and sym[0] == "func":
+                    callee_qual = sym[1]
+            elif isinstance(node.func, ast.Attribute):
+                target_mod = graph.module_alias(mod, node.func.value)
+                if target_mod is not None:
+                    sym = graph.modules[target_mod].symbols.get(
+                        node.func.attr)
+                    if sym is not None and sym[0] == "func":
+                        callee_qual = sym[1]
+            if callee_qual not in donating or callee_qual == qual:
+                continue
+            callee = callee_qual.split("::", 1)[1]
+            # loads inside the call expression (including the donated
+            # argument itself) are part of the donation, not a
+            # read-after — the window opens at the call's end POSITION
+            # (line + column, so a read on the call's own closing line
+            # still counts)
+            call_end = (getattr(node, "end_lineno", node.lineno),
+                        getattr(node, "end_col_offset", 1 << 30))
+            param_names, donated_set = donating[callee_qual]
+            args_bound: List[Tuple[str, ast.AST]] = [
+                (param_names[i], a) for i, a in enumerate(node.args)
+                if i < len(param_names)]
+            args_bound += [(kw.arg, kw.value) for kw in node.keywords
+                           if kw.arg is not None]
+            for pname, val in args_bound:
+                if pname in donated_set and isinstance(val, ast.Name):
+                    donated.append((val.id, callee, node.lineno,
+                                    call_end))
+        for var, callee, call_line, call_end in donated:
+            kills = [ln for ln in stores.get(var, []) if ln >= call_line]
+            horizon = min(kills) if kills else float("inf")
+            # loads BEYOND the rebind line read the new value; loads ON
+            # the rebind line's RHS (pos[0] == horizon) execute before
+            # the store and still read the donated buffer — flag them
+            bad = [pos for pos in loads.get(var, [])
+                   if pos > call_end and pos[0] <= horizon]
+            if bad:
+                bad.sort()
+                findings.append(Finding(
+                    rule="donation-safety",
+                    id=f"donation-safety:{info.rel}:{info.name}:{var}",
+                    path=info.rel, line=bad[0][0],
+                    message=(
+                        f"{info.name} passes `{var}` into {callee} "
+                        f"(donated argument, line {call_line}) and reads "
+                        f"it again at line {bad[0][0]} — the buffer was "
+                        f"reused for the output; snapshot with "
+                        f"jax.device_get first or rebind the name"
+                    ),
+                ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# Rule 4: magic-literal families
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class LiteralFamily:
+    name: str
+    values: frozenset            # evaluated ints that must not reappear
+    allowed: frozenset           # rel paths allowed to spell them out
+    description: str
+
+
+def default_literal_families() -> List[LiteralFamily]:
+    """The owning-table contract, with values computed FROM the tables
+    (import-time, never hand-copied here either)."""
+    from scalecube_cluster_tpu.ops import delivery
+
+    sat = set()
+    for fmt in delivery.WIRE_FORMATS.values():
+        sat.add(fmt.inc_sat(0))
+        sat.add(fmt.inc_sat(fmt.epoch_bits))
+    # int16 carry ceiling family, DERIVED (this file is scanned too:
+    # spelling the bound out here would be its own finding)
+    i16max = (1 << 15) - 1
+    return [
+        LiteralFamily(
+            name="wire-saturation",
+            values=frozenset(sat),
+            allowed=frozenset({"ops/delivery.py", "records.py"}),
+            description=(
+                "incarnation saturation points of every wire-format "
+                "rung x epoch width (ops/delivery.WIRE_FORMATS; derive "
+                "via models/swim._wire_inc_sat)"
+            ),
+        ),
+        LiteralFamily(
+            name="carry-bound",
+            values=frozenset({i16max, i16max - 1, i16max - 2}),
+            allowed=frozenset({"models/swim.py"}),
+            description=(
+                "int16 compact-carry deadline bounds (models/swim.py "
+                "owns the carry encoding and its validators)"
+            ),
+        ),
+    ]
+
+
+def magic_literals(graph: PackageGraph,
+                   families: Optional[Sequence[LiteralFamily]] = None
+                   ) -> List[Finding]:
+    """Token-level family scan plus (on a full default run only) the
+    symbolic monitor-code / epoch-width shape checks.  Passing an
+    explicit ``families`` list narrows the rule to exactly those
+    families — the tests/test_wire_constants.py contract."""
+    symbolic = families is None
+    if families is None:
+        families = default_literal_families()
+    findings: List[Finding] = []
+    for rel in sorted(graph.modules):
+        mod = graph.modules[rel]
+        toks = list(tokenize.generate_tokens(
+            io.StringIO(mod.path.read_text()).readline))
+        for fam in families:
+            if rel in fam.allowed:
+                continue
+            for tok in toks:
+                if tok.type != tokenize.NUMBER:
+                    continue
+                try:
+                    value = int(tok.string, 0)
+                except ValueError:
+                    continue
+                if value in fam.values:
+                    findings.append(Finding(
+                        rule="magic-literal",
+                        id=f"magic-literal:{fam.name}:{rel}:{value}",
+                        path=rel, line=tok.start[0],
+                        message=(
+                            f"literal {value} ({fam.name}) outside its "
+                            f"owning table "
+                            f"({'/'.join(sorted(fam.allowed))}): "
+                            f"{tok.line.strip()}"
+                        ),
+                    ))
+    if not symbolic:
+        return findings
+    # Symbolic sub-checks: monitor codes and epoch widths are small
+    # integers (can't be token-banned), so ban the *shapes* that
+    # hard-code them instead.
+    for rel in sorted(graph.modules):
+        mod = graph.modules[rel]
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Compare) and rel != "chaos/monitor.py"
+                    and isinstance(node.left, ast.Attribute)
+                    and node.left.attr == "code"
+                    and any(isinstance(c, ast.Constant)
+                            and isinstance(c.value, int)
+                            and not isinstance(c.value, bool)
+                            for c in node.comparators)):
+                findings.append(Finding(
+                    rule="magic-literal",
+                    id=f"magic-literal:monitor-code:{rel}",
+                    path=rel, line=node.lineno,
+                    message=(
+                        "comparison of `.code` against a bare int — "
+                        "use chaos/monitor.InvariantCode names"
+                    ),
+                ))
+            elif (isinstance(node, ast.Call)
+                  and rel not in ("ops/delivery.py",)
+                  and any(kw.arg == "epoch_bits"
+                          and isinstance(kw.value, ast.Constant)
+                          and isinstance(kw.value.value, int)
+                          and kw.value.value != 0
+                          for kw in getattr(node, "keywords", []))):
+                findings.append(Finding(
+                    rule="magic-literal",
+                    id=f"magic-literal:epoch-width:{rel}",
+                    path=rel, line=node.lineno,
+                    message=(
+                        "literal epoch_bits= width outside "
+                        "ops/delivery.py — widths come from "
+                        "WireFormat.epoch_bits"
+                    ),
+                ))
+    return findings

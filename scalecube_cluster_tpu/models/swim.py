@@ -2825,6 +2825,12 @@ def pipelined_delivery_unsupported_reason(params: SwimParams,
         return ("the joiner<->seed anti-entropy round trip (push + ack) "
                 "completes within one round, so its combines cannot be "
                 "deferred")
+    if params.rounds_per_step != 1:
+        return ("round fusion (rounds_per_step > 1) unrolls K ticks per "
+                "scan step through _fused_scan; the pipelined loop "
+                "carries exactly one round of pending contribution and "
+                "has no fused body — the serial sharded scan fuses "
+                "instead")
     return None
 
 

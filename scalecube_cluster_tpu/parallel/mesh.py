@@ -248,8 +248,13 @@ def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
                 offset=offset, axis_name=axis, n_devices=n_dev,
             )
 
-        rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
-        return jax.lax.scan(body, state, rounds)
+        # _fused_scan honors params.rounds_per_step (bit-identical for
+        # any K; k == 1 is the classic per-round scan) — the pipelined
+        # path declares fusion unsupported instead
+        # (swim.pipelined_delivery_unsupported_reason), so auto-select
+        # falls back to this body when both knobs are on.
+        return swim._fused_scan(body, state, n_rounds, start_round,
+                                params.rounds_per_step)
 
     return compat.shard_map(
         sharded_body,
@@ -333,9 +338,12 @@ def shard_run_metered(base_key, params: swim.SwimParams,
                 ms = observe(ms, st, round_idx, new_st, m)
                 return (new_st, ms), m
 
-            rounds = jnp.arange(n_rounds, dtype=jnp.int32) + start_round
-            (final_state, ms), metrics = jax.lax.scan(body, (state, ms),
-                                                      rounds)
+            # rounds_per_step rides the same _fused_scan as the
+            # unmetered body (bit-identical for any K).
+            (final_state, ms), metrics = swim._fused_scan(
+                body, (state, ms), n_rounds, start_round,
+                params.rounds_per_step,
+            )
         end = start_round + n_rounds
         _, spread_wide = swim._wide_timer_fields(final_state, params, end)
         alive_here = jax.lax.dynamic_slice_in_dim(

@@ -90,7 +90,8 @@ def _cmd_regress(args) -> int:
             os.path.join("artifacts", "lifeguard_fp*.json"),
             os.path.join("artifacts", "churn_growth*.json"),
             os.path.join("artifacts", "fuzz_campaign*.json"),
-            os.path.join("artifacts", "wire_fused*.json")])
+            os.path.join("artifacts", "wire_fused*.json"),
+            os.path.join("artifacts", "static_analysis*.json")])
     readable = [p for p in paths if os.path.exists(p)]
     if not readable:
         print("regress: no artifacts matched", file=sys.stderr)
@@ -137,7 +138,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "artifacts/lifeguard_fp*.json "
                         "artifacts/churn_growth*.json "
                         "artifacts/fuzz_campaign*.json "
-                        "artifacts/wire_fused*.json)")
+                        "artifacts/wire_fused*.json "
+                        "artifacts/static_analysis*.json)")
     p.add_argument("--band", type=float, default=query.DEFAULT_NOISE_BAND,
                    help="relative noise band (default 0.10)")
     p.add_argument("--json", action="store_true")

@@ -315,7 +315,8 @@ def load_bench_payload(path: str) -> Tuple[Optional[dict], Optional[str]]:
                      or "fp_ratio" in payload
                      or "no_resurrection_violations" in payload
                      or "vmap_speedup_ratio" in payload
-                     or "fused_serial_speedup_ratio" in payload)):
+                     or "fused_serial_speedup_ratio" in payload
+                     or "findings_total" in payload)):
             return None, stub_note
     return payload, None
 
@@ -362,7 +363,11 @@ def regress(paths: Sequence[str],
         mega-campaign green, the weakened coverage arm found > 0
         planted violations with the healthy arm at 0 on the same
         slice, and (full rounds only) ``vmap_speedup_ratio`` >= 1 —
-        plus the banded non-smoke ``scenario_throughput`` series.
+        plus the banded non-smoke ``scenario_throughput`` series;
+      - swimlint artifacts (``findings_total`` present,
+        ``python -m scalecube_cluster_tpu.analysis check``): absolute
+        gates — ``findings_total`` == 0 (unsuppressed static-analysis
+        findings are never noise) and the artifact self-reports ok.
 
     Returns (ok, check rows); each row {"check", "latest", "reference",
     "threshold", "ok", "source"}.  Unreadable/failed artifacts — and
@@ -735,6 +740,22 @@ def regress(paths: Sequence[str],
                   parity, True, True,
                   parity.get("fused") is True
                   and parity.get("legacy") is True)
+        # swimlint artifacts (python -m scalecube_cluster_tpu.analysis
+        # check): ABSOLUTE — the committed static-analysis round must
+        # be finding-free and self-reported ok.  findings_total counts
+        # UNSUPPRESSED findings only (baselined asymmetries don't gate:
+        # they carry a committed justification), so findings > 0 means
+        # either a plane stopped reaching a run shape or a compile
+        # audit went red — never noise, always a gate.
+        sa = [(p, pl) for p, pl in entries
+              if "findings_total" in pl]
+        if sa:
+            last_path, last = sa[-1]
+            total = last.get("findings_total")
+            check("slo/static_analysis_clean", last_path, total, 0, 0,
+                  total == 0)
+            check("slo/static_analysis_ok", last_path,
+                  last.get("ok"), True, True, last.get("ok") is True)
     return ok, rows
 
 

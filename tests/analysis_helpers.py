@@ -1,0 +1,161 @@
+"""Fixture-package builders for the swimlint suites.
+
+``write_tree`` materializes a mini package tree that satisfies the
+plane-matrix root contract (all seven entry points + the four tick-body
+roots exist), so rule tests can plant ONE deliberate defect and assert
+exactly ONE finding fires — and mutate a copy of the REAL package to
+prove the matrix catches a deleted threading site
+(tests/test_analysis_rules.py).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import shutil
+from typing import Dict
+
+# A structurally-faithful miniature of the real layering: SwimParams
+# knobs, a dispatcher (swim_tick) fanning into three sibling tick
+# bodies, the pipelined half pair sharing the dispatcher's preamble
+# (_round_context), and seven entry points across three modules.
+MINI_SWIM = '''\
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class SwimParams:
+    n_members: int
+    sync_interval: int = 0
+    lhm_max: int = 0
+    shadow_knob: int = 0
+
+
+def _round_context(state, params):
+    return state + params.lhm_max
+
+
+def _tick_scatter(state, params):
+    return state + params.sync_interval
+
+
+def _tick_shift(state, params):
+    return state + params.sync_interval
+
+
+def _tick_shift_blocked(state, params):
+    return state + params.sync_interval
+
+
+def swim_tick_send(state, params):
+    ctx = _round_context(state, params)
+    return ctx + params.sync_interval
+
+
+def swim_tick_recv(state, params):
+    return state + params.sync_interval
+
+
+def swim_tick(state, params):
+    ctx = _round_context(state, params)
+    if params.n_members > 2:
+        return _tick_scatter(ctx, params)
+    if state:
+        return _tick_shift(ctx, params)
+    return _tick_shift_blocked(ctx, params)
+
+
+def run(key, params, world, n_rounds):
+    return swim_tick(0, params)
+
+
+def run_traced(key, params, world, n_rounds):
+    return swim_tick(0, params)
+
+
+def run_metered(key, params, world, n_rounds):
+    return swim_tick(0, params)
+'''
+
+MINI_MONITOR = '''\
+from scalecube_cluster_tpu.models import swim
+
+
+def run_monitored(key, params, world, n_rounds):
+    return swim.swim_tick(0, params)
+
+
+def run_monitored_metered(key, params, world, n_rounds):
+    return swim.swim_tick(0, params)
+'''
+
+MINI_MESH = '''\
+from scalecube_cluster_tpu.models import swim
+
+
+def shard_run(key, params, world, n_rounds, mesh):
+    if mesh:
+        pending = swim.swim_tick_send(0, params)
+        return swim.swim_tick_recv(pending, params)
+    return swim.swim_tick(0, params)
+
+
+def shard_run_metered(key, params, world, n_rounds, mesh):
+    return swim.swim_tick(0, params)
+'''
+
+MINI_FILES: Dict[str, str] = {
+    "models/swim.py": MINI_SWIM,
+    "chaos/monitor.py": MINI_MONITOR,
+    "parallel/mesh.py": MINI_MESH,
+}
+
+
+def write_tree(tmp_path, files: Dict[str, str],
+               base: bool = True) -> pathlib.Path:
+    """Write ``files`` (rel path -> source) under ``tmp_path/pkg``,
+    overlaid on the MINI_FILES skeleton when ``base``."""
+    root = pathlib.Path(tmp_path) / "pkg"
+    merged = dict(MINI_FILES) if base else {}
+    merged.update(files)
+    for rel, src in merged.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    return root
+
+
+def copy_real_package(tmp_path) -> pathlib.Path:
+    """A mutable copy of the installed package tree."""
+    from scalecube_cluster_tpu import models
+
+    src = pathlib.Path(models.__file__).resolve().parents[1]
+    dst = pathlib.Path(tmp_path) / "pkg_copy"
+    shutil.copytree(src, dst,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    return dst
+
+
+def blank_consults_in_function(path: pathlib.Path, func: str,
+                               attr_expr: str, replacement: str) -> int:
+    """Textually replace every ``attr_expr`` occurrence INSIDE one
+    top-level function's body (from its ``def`` line to the next
+    column-0 ``def``/``class``/``@``) — the "delete one real threading
+    site" mutation.  Returns the number of sites blanked."""
+    src = path.read_text()
+    m = re.search(rf"^def {re.escape(func)}\b", src, flags=re.M)
+    if m is None:
+        raise AssertionError(f"{path}: no top-level def {func}")
+    tail = src[m.start():]
+    end = re.search(r"^(?:def |class |@)", tail[1:], flags=re.M)
+    seg_end = m.start() + 1 + (end.start() if end else len(tail) - 1)
+    segment = src[m.start():seg_end]
+    count = segment.count(attr_expr)
+    if count == 0:
+        raise AssertionError(
+            f"{path}::{func}: no {attr_expr!r} sites to blank — the "
+            f"mutation target moved; pick another knob/function")
+    path.write_text(src[:m.start()]
+                    + segment.replace(attr_expr, replacement)
+                    + src[seg_end:])
+    return count

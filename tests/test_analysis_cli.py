@@ -1,0 +1,219 @@
+"""swimlint CLI JSON contract: exit codes (0 clean / 1 findings /
+2 input error), artifact schema, and the baseline-file contract
+(mandatory justifications, stale-row findings).
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from scalecube_cluster_tpu.analysis.__main__ import main
+
+from tests.analysis_helpers import MINI_SWIM, write_tree
+
+pytestmark = pytest.mark.lint
+
+ENTRY_NAMES = ["run", "run_traced", "run_metered", "run_monitored",
+               "run_monitored_metered", "shard_run", "shard_run_metered"]
+BODY_NAMES = ["scatter", "shift", "k_block", "pipelined"]
+
+
+def empty_baseline(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"suppressions": []}))
+    return str(p)
+
+
+def broken_tree(tmp_path):
+    """Mini package with one planted plane-matrix finding."""
+    swim_src = MINI_SWIM.replace(
+        "def _tick_shift_blocked(state, params):\n"
+        "    return state + params.sync_interval",
+        "def _tick_shift_blocked(state, params):\n"
+        "    return state + 0",
+    )
+    return str(write_tree(tmp_path, {"models/swim.py": swim_src}))
+
+
+PLANTED_ID = "plane-matrix:sync_interval:body:k_block"
+
+
+class TestExitCodes:
+    def test_check_clean_at_head_is_0(self, tmp_path):
+        art = tmp_path / "a.json"
+        assert main(["check", "--no-compile",
+                     "--artifact", str(art)]) == 0
+
+    def test_check_findings_is_1_report_is_0(self, tmp_path):
+        root = broken_tree(tmp_path)
+        base = empty_baseline(tmp_path)
+        common = ["--root", root, "--baseline", base, "--artifact", ""]
+        assert main(["check"] + common) == 1
+        assert main(["report"] + common) == 0
+
+    def test_bad_root_is_2(self, tmp_path):
+        assert main(["check", "--root", str(tmp_path / "nope"),
+                     "--artifact", ""]) == 2
+
+    def test_foreign_root_defaults_to_no_baseline(self, tmp_path):
+        """A clean copied/fixture tree without --baseline must exit 0 —
+        the installed package's suppressions would all read as stale
+        there (engine.run_analysis defaults the baseline only for the
+        installed root)."""
+        root = str(write_tree(tmp_path, {}))
+        assert main(["check", "--root", root, "--artifact", ""]) == 0
+
+    def test_parseable_but_foreign_package_is_2(self, tmp_path):
+        """A tree of valid .py files that is NOT this package (no
+        models/swim.py / SwimParams) is an input error, not a crash."""
+        root = str(write_tree(tmp_path, {"utils/other.py": "X = 1\n"},
+                              base=False))
+        assert main(["check", "--root", root, "--artifact", ""]) == 2
+
+    @pytest.mark.parametrize("doc", [
+        "{not json",
+        json.dumps({"suppressions": [{"id": "x"}]}),             # no reason
+        json.dumps({"suppressions": [{"id": "x",
+                                      "justification": "  "}]}),  # blank
+        json.dumps({"wrong_key": []}),
+        json.dumps({"suppressions": [{"id": "x", "justification": "ok"},
+                                     {"id": "x",
+                                      "justification": "dup"}]}),
+    ])
+    def test_malformed_baseline_is_2(self, tmp_path, doc):
+        bad = tmp_path / "bad_baseline.json"
+        bad.write_text(doc)
+        assert main(["check", "--no-compile", "--artifact", "",
+                     "--baseline", str(bad)]) == 2
+
+
+class TestBaselineContract:
+    def test_justified_suppression_makes_check_clean(self, tmp_path):
+        root = broken_tree(tmp_path)
+        base = tmp_path / "b.json"
+        base.write_text(json.dumps({"suppressions": [
+            {"id": PLANTED_ID, "justification": "planted by the test"},
+        ]}))
+        art = tmp_path / "a.json"
+        assert main(["check", "--root", root, "--baseline", str(base),
+                     "--artifact", str(art)]) == 0
+        doc = json.loads(art.read_text())
+        assert doc["findings_total"] == 0
+        assert doc["suppressed_total"] == 1
+        assert doc["suppressed"][0]["id"] == PLANTED_ID
+        assert doc["suppressed"][0]["justification"] == \
+            "planted by the test"
+
+    def test_suppression_cannot_absorb_a_second_occurrence(
+            self, tmp_path):
+        """Same-id findings collapse with an ``:x<k>`` occurrence
+        suffix, so a baseline row for ONE justified literal cannot
+        silently mask a SECOND hand-copied one in the same file: the
+        old row goes stale (a finding) and the new ``:x2`` id is
+        unsuppressed."""
+        from scalecube_cluster_tpu.ops import delivery
+
+        cap = delivery.WIRE16.inc_sat(0)  # 8191
+        one_id = f"magic-literal:wire-saturation:models/caps.py:{cap}"
+        base = tmp_path / "b.json"
+        base.write_text(json.dumps({"suppressions": [
+            {"id": one_id, "justification": "the one known site"},
+        ]}))
+        root = str(write_tree(tmp_path, {
+            "models/caps.py": f"CAP = {cap}\n"}))
+        assert main(["check", "--root", root, "--baseline", str(base),
+                     "--artifact", ""]) == 0
+        root2 = str(write_tree(tmp_path / "two", {
+            "models/caps.py": f"CAP = {cap}\nCAP2 = {cap}\n"}))
+        art = tmp_path / "a.json"
+        assert main(["check", "--root", root2, "--baseline", str(base),
+                     "--artifact", str(art)]) == 1
+        got = {f["id"] for f in json.loads(art.read_text())["findings"]}
+        assert got == {f"{one_id}:x2", f"baseline:stale:{one_id}"}
+
+    def test_stale_suppression_is_a_finding(self, tmp_path):
+        root = str(write_tree(tmp_path, {}))  # clean mini tree
+        base = tmp_path / "b.json"
+        base.write_text(json.dumps({"suppressions": [
+            {"id": PLANTED_ID, "justification": "no longer true"},
+        ]}))
+        art = tmp_path / "a.json"
+        assert main(["check", "--root", root, "--baseline", str(base),
+                     "--artifact", str(art)]) == 1
+        doc = json.loads(art.read_text())
+        assert [f["id"] for f in doc["findings"]] == \
+            [f"baseline:stale:{PLANTED_ID}"]
+
+
+class TestArtifactSchema:
+    def test_artifact_contract(self, tmp_path):
+        art = tmp_path / "static_analysis.json"
+        assert main(["check", "--no-compile",
+                     "--artifact", str(art)]) == 0
+        doc = json.loads(art.read_text())
+        assert doc["schema"] == "swimlint/1"
+        assert doc["metric"] == "static_analysis"
+        assert doc["ok"] is True
+        assert doc["findings_total"] == 0 and doc["findings"] == []
+        assert doc["entry_points"] == ENTRY_NAMES
+        assert doc["tick_bodies"] == BODY_NAMES
+        # the knob rows are extracted from SwimParams, not curated
+        for knob in ("sync_interval", "lhm_max", "dead_suppress_rounds",
+                     "open_world", "fused_wire", "rounds_per_step"):
+            assert knob in doc["fields"]
+        # matrix cells: {count, sites} with rel:line site strings, and
+        # a threaded knob reaches every run shape
+        row = doc["matrix"]["entries"]["sync_interval"]
+        for entry in ENTRY_NAMES:
+            cell = row[entry]
+            assert cell["count"] >= 1
+            assert all(":" in s for s in cell["sites"])
+            assert len(cell["sites"]) <= cell["count"]
+        # suppressions carry their justification into the artifact
+        assert doc["suppressed_total"] == len(doc["suppressed"])
+        assert all(f.get("justification")
+                   for f in doc["suppressed"])
+        # AST-only run records why the compile audits did not run
+        assert doc["compile_audit"] == {"skipped": "disabled"}
+
+    def test_foreign_root_never_writes_the_default_artifact(
+            self, tmp_path, monkeypatch):
+        """A mutation-debug run on a copied tree must not clobber the
+        committed artifacts/static_analysis.json: the default artifact
+        path applies only to the installed package."""
+        monkeypatch.chdir(tmp_path)
+        root = broken_tree(tmp_path)
+        base = empty_baseline(tmp_path)
+        assert main(["check", "--root", root,
+                     "--baseline", base]) == 1
+        assert not (tmp_path / "artifacts").exists()
+
+    def test_no_compile_never_writes_the_default_artifact(
+            self, tmp_path, monkeypatch):
+        """The AST-only fast pass must not replace the committed
+        artifact's compile-audit blocks with a skipped note — only a
+        FULL run on the installed tree writes the default path."""
+        monkeypatch.chdir(tmp_path)
+        assert main(["check", "--no-compile"]) == 0
+        assert not (tmp_path / "artifacts").exists()
+
+    def test_json_flag_prints_the_artifact(self, tmp_path, capsys):
+        assert main(["check", "--no-compile", "--artifact", "",
+                     "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["schema"] == "swimlint/1"
+        assert doc["findings_total"] == 0
+
+
+def test_module_entry_point():
+    """``python -m scalecube_cluster_tpu.analysis`` is wired
+    (the -m path the README documents)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "scalecube_cluster_tpu.analysis",
+         "check", "--no-compile", "--artifact", ""],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "findings: none" in proc.stdout

@@ -1,0 +1,403 @@
+"""swimlint rule units: every rule has a triggering and a
+non-triggering fixture case, plus the mutation pin — deleting one real
+threading site from a copied package makes the plane matrix fire
+(ISSUE 14 satellite contract).
+"""
+
+import pathlib
+
+import pytest
+
+from scalecube_cluster_tpu.analysis import compile_audit
+from scalecube_cluster_tpu.analysis import rules as lint
+from scalecube_cluster_tpu.analysis.callgraph import PackageGraph
+
+from tests.analysis_helpers import (
+    MINI_SWIM, blank_consults_in_function, copy_real_package, write_tree,
+)
+
+pytestmark = pytest.mark.lint
+
+
+def graph_of(tmp_path, files, base=True):
+    return PackageGraph(write_tree(tmp_path, files, base=base))
+
+
+def ids_of(findings):
+    return {f.id for f in findings}
+
+
+# --------------------------------------------------------------------------
+# plane-matrix
+# --------------------------------------------------------------------------
+
+class TestPlaneMatrix:
+    def test_uniform_tree_is_clean(self, tmp_path):
+        matrix, findings = lint.plane_matrix(graph_of(tmp_path, {}))
+        assert findings == []
+        # every entry column of a consulted knob is populated
+        assert all(matrix["entries"]["sync_interval"][e]
+                   for e in lint.ENTRY_POINTS)
+        assert all(matrix["bodies"]["sync_interval"][b]
+                   for b in lint.TICK_BODIES)
+        # dispatch-level-only and never-consulted knobs are all-empty
+        # rows in the body matrix — allowed (the entry matrix covers
+        # them)
+        assert not any(matrix["bodies"]["lhm_max"][b]
+                       for b in ("scatter", "shift", "k_block"))
+
+    def test_entry_gap_fires_per_missing_entry(self, tmp_path):
+        swim_src = MINI_SWIM.replace(
+            "    shadow_knob: int = 0",
+            "    shadow_knob: int = 0\n    entry_knob: int = 0",
+        ).replace(
+            "def run(key, params, world, n_rounds):\n"
+            "    return swim_tick(0, params)",
+            "def run(key, params, world, n_rounds):\n"
+            "    return swim_tick(0, params) + params.entry_knob",
+        )
+        _, findings = lint.plane_matrix(
+            graph_of(tmp_path, {"models/swim.py": swim_src}))
+        got = ids_of(findings)
+        missing = set(lint.ENTRY_POINTS) - {"run"}
+        assert got == {f"plane-matrix:entry_knob:entry:{e}"
+                       for e in missing}
+
+    def test_body_gap_fires_for_the_unthreaded_body(self, tmp_path):
+        swim_src = MINI_SWIM.replace(
+            "def _tick_shift_blocked(state, params):\n"
+            "    return state + params.sync_interval",
+            "def _tick_shift_blocked(state, params):\n"
+            "    return state + 0",
+        )
+        _, findings = lint.plane_matrix(
+            graph_of(tmp_path, {"models/swim.py": swim_src}))
+        assert ids_of(findings) == {
+            "plane-matrix:sync_interval:body:k_block"}
+
+    def test_half_tick_split_loss_fires_pipelined(self, tmp_path):
+        swim_src = MINI_SWIM.replace(
+            "def swim_tick_send(state, params):\n"
+            "    ctx = _round_context(state, params)\n"
+            "    return ctx + params.sync_interval",
+            "def swim_tick_send(state, params):\n"
+            "    ctx = _round_context(state, params)\n"
+            "    return ctx",
+        ).replace(
+            "def swim_tick_recv(state, params):\n"
+            "    return state + params.sync_interval",
+            "def swim_tick_recv(state, params):\n"
+            "    return state",
+        )
+        _, findings = lint.plane_matrix(
+            graph_of(tmp_path, {"models/swim.py": swim_src}))
+        assert ids_of(findings) == {
+            "plane-matrix:sync_interval:body:pipelined"}
+
+    def test_missing_entry_root_is_an_input_error(self, tmp_path):
+        swim_src = MINI_SWIM.replace(
+            "def run_metered(key", "def run_metered_renamed(key")
+        with pytest.raises(ValueError, match="run_metered"):
+            lint.plane_matrix(
+                graph_of(tmp_path, {"models/swim.py": swim_src}))
+
+
+class TestMutationPin:
+    """Deleting one REAL threading site from a copied package tree
+    makes the matrix rule fire — the rule reads the actual code, not a
+    curated site list."""
+
+    def test_blanked_sites_fire_blanked_only(self, tmp_path):
+        pristine = lint.plane_matrix(PackageGraph(
+            pathlib.Path(compile_audit.__file__).resolve().parents[1]))
+        mutated_root = copy_real_package(tmp_path)
+        # body-level: the blocked tick's SYNC fold is its own site
+        blank_consults_in_function(
+            mutated_root / "models/swim.py", "_tick_shift_blocked",
+            "params.sync_interval", "0")
+        # entry-level: the monitored scan's fusion consult feeds both
+        # monitored run shapes
+        blank_consults_in_function(
+            mutated_root / "chaos/monitor.py", "_monitored_scan",
+            "params.rounds_per_step", "1")
+        _, findings = lint.plane_matrix(PackageGraph(mutated_root))
+        got = ids_of(findings)
+        expect = {
+            "plane-matrix:sync_interval:body:k_block",
+            "plane-matrix:rounds_per_step:entry:run_monitored",
+            "plane-matrix:rounds_per_step:entry:run_monitored_metered",
+        }
+        assert expect <= got
+        # and none of these fire at HEAD
+        assert not expect & ids_of(pristine[1])
+
+
+# --------------------------------------------------------------------------
+# trace-safety
+# --------------------------------------------------------------------------
+
+class TestTraceSafety:
+    def test_host_entropy_in_device_module_fires(self, tmp_path):
+        swim_src = MINI_SWIM.replace(
+            "import dataclasses",
+            "import dataclasses\nimport numpy as np",
+        ).replace(
+            "def _tick_scatter(state, params):\n"
+            "    return state + params.sync_interval",
+            "def _tick_scatter(state, params):\n"
+            "    return state + np.random.uniform()",
+        )
+        findings = lint.trace_safety(
+            graph_of(tmp_path, {"models/swim.py": swim_src}))
+        assert ids_of(findings) == {
+            "trace-safety:models/swim.py:_tick_scatter:"
+            "numpy.random.uniform"}
+
+    def test_host_entropy_outside_device_modules_is_fine(self, tmp_path):
+        files = {"oracle/helpers.py":
+                 "import random\n\n\ndef pick(xs):\n"
+                 "    return random.choice(xs)\n"}
+        assert lint.trace_safety(graph_of(tmp_path, files)) == []
+
+    def test_item_in_device_cone_fires(self, tmp_path):
+        swim_src = MINI_SWIM.replace(
+            "def swim_tick_recv(state, params):\n"
+            "    return state + params.sync_interval",
+            "def swim_tick_recv(state, params):\n"
+            "    return state.item() + params.sync_interval",
+        )
+        findings = lint.trace_safety(
+            graph_of(tmp_path, {"models/swim.py": swim_src}))
+        assert ids_of(findings) == {
+            "trace-safety:models/swim.py:swim_tick_recv:.item"}
+
+    def test_item_in_host_side_helper_is_fine(self, tmp_path):
+        files = {"models/snapshots.py":
+                 "def decode(state):\n"
+                 "    return state.count.item()\n"}
+        assert lint.trace_safety(graph_of(tmp_path, files)) == []
+
+    def test_float_of_reduction_in_cone_fires(self, tmp_path):
+        swim_src = MINI_SWIM.replace(
+            "def _tick_shift(state, params):\n"
+            "    return state + params.sync_interval",
+            "def _tick_shift(state, params):\n"
+            "    return float(state.sum()) + params.sync_interval",
+        )
+        findings = lint.trace_safety(
+            graph_of(tmp_path, {"models/swim.py": swim_src}))
+        assert ids_of(findings) == {
+            "trace-safety:models/swim.py:_tick_shift:float-coercion"}
+
+    def test_float_of_static_knob_is_fine(self, tmp_path):
+        swim_src = MINI_SWIM.replace(
+            "def _tick_shift(state, params):\n"
+            "    return state + params.sync_interval",
+            "def _tick_shift(state, params):\n"
+            "    return state + float(params.sync_interval)",
+        )
+        assert lint.trace_safety(
+            graph_of(tmp_path, {"models/swim.py": swim_src})) == []
+
+    def test_head_package_is_clean(self):
+        root = pathlib.Path(compile_audit.__file__).resolve().parents[1]
+        assert lint.trace_safety(PackageGraph(root)) == []
+
+
+# --------------------------------------------------------------------------
+# donation-safety
+# --------------------------------------------------------------------------
+
+DONOR = """\
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnames=("state",))
+def consume(key, state):
+    return state
+
+
+def rebind_ok(key, state):
+    state = consume(key, state=state)
+    return state
+
+
+def multiline_call_ok(key, state):
+    out = consume(
+        key,
+        state=state,
+    )
+    return out
+
+
+def read_after_donate_bad(key, state):
+    out = consume(key, state=state)
+    return out + state
+
+
+def positional_donate_bad(key, state):
+    out = consume(key, state)
+    return out + state
+
+
+def same_line_read_bad(key, state):
+    return consume(key, state=state) + state
+
+
+def rebind_rhs_bad(key, state):
+    out = consume(key, state=state)
+    state = state + 1
+    return out, state
+
+
+def augassign_bad(key, state):
+    out = consume(key, state=state)
+    state += 1
+    return out, state
+"""
+
+
+class TestDonationSafety:
+    def test_read_after_donate_fires_and_safe_shapes_do_not(
+            self, tmp_path):
+        findings = lint.donation_safety(
+            graph_of(tmp_path, {"models/donor.py": DONOR}))
+        # keyword, positional, and same-line reads all fire; the rebind
+        # and multi-line-call shapes do not
+        assert ids_of(findings) == {
+            "donation-safety:models/donor.py:read_after_donate_bad:"
+            "state",
+            "donation-safety:models/donor.py:positional_donate_bad:"
+            "state",
+            "donation-safety:models/donor.py:same_line_read_bad:state",
+            # the rebind line's RHS executes BEFORE the store: reading
+            # the donated name there is still a read-after-donate —
+            # and `state += 1` is exactly that read in disguise
+            "donation-safety:models/donor.py:rebind_rhs_bad:state",
+            "donation-safety:models/donor.py:augassign_bad:state",
+        }
+
+    def test_same_bare_name_non_donating_function_does_not_fire(
+            self, tmp_path):
+        """The package has several same-named ``run`` functions and
+        only swim's donates — the rule resolves callees through the
+        symbol table, so a positional call to a NON-donating namesake
+        followed by a read is clean."""
+        files = {
+            "models/donor.py": DONOR,
+            "models/fd2.py": ("def consume(key, state):\n"
+                              "    return state\n"),
+            "models/caller.py": (
+                "from scalecube_cluster_tpu.models import fd2\n\n\n"
+                "def use(key, state):\n"
+                "    out = fd2.consume(key, state)\n"
+                "    return out + state\n"),
+        }
+        findings = lint.donation_safety(graph_of(tmp_path, files))
+        assert not any("caller.py" in f.id for f in findings)
+
+    def test_head_package_is_clean(self):
+        root = pathlib.Path(compile_audit.__file__).resolve().parents[1]
+        assert lint.donation_safety(PackageGraph(root)) == []
+
+
+# --------------------------------------------------------------------------
+# magic-literal
+# --------------------------------------------------------------------------
+
+class TestMagicLiterals:
+    def test_planted_saturation_literal_fires(self, tmp_path):
+        from scalecube_cluster_tpu.ops import delivery
+
+        cap = delivery.WIRE16.inc_sat(0)  # 8191
+        files = {"models/caps.py": f"CAP = {cap}\n"}
+        findings = lint.magic_literals(graph_of(tmp_path, files))
+        assert ids_of(findings) == {
+            f"magic-literal:wire-saturation:models/caps.py:{cap}"}
+
+    def test_docstring_citation_is_not_a_clamp_site(self, tmp_path):
+        files = {"models/doc.py":
+                 '"""Saturates at 8191 (see the table)."""\n\nX = 1\n'}
+        assert lint.magic_literals(graph_of(tmp_path, files)) == []
+
+    def test_carry_bound_outside_swim_fires_inside_is_allowed(
+            self, tmp_path):
+        bound = (1 << 15) - 1
+        files = {"models/elsewhere.py": f"LIM = {bound}\n",
+                 "models/swim.py":
+                 MINI_SWIM + f"\n_DEADLINE_NONE16 = {bound}\n"}
+        findings = lint.magic_literals(graph_of(tmp_path, files))
+        assert ids_of(findings) == {
+            f"magic-literal:carry-bound:models/elsewhere.py:{bound}"}
+
+    def test_monitor_code_comparison_fires_outside_monitor(
+            self, tmp_path):
+        body = ("def is_resurrection(v):\n"
+                "    return v.code == 6\n")
+        findings = lint.magic_literals(graph_of(
+            tmp_path, {"models/checks.py": body}))
+        assert ids_of(findings) == {
+            "magic-literal:monitor-code:models/checks.py"}
+        # the owning module may spell its own codes
+        assert lint.magic_literals(graph_of(
+            tmp_path / "owning", {"chaos/monitor.py": body},
+            base=False)) == []
+
+    def test_literal_epoch_width_fires(self, tmp_path):
+        files = {"models/packer.py":
+                 "def g(p, key):\n"
+                 "    return p.pack(key, epoch_bits=4)\n"}
+        findings = lint.magic_literals(graph_of(tmp_path, files))
+        assert ids_of(findings) == {
+            "magic-literal:epoch-width:models/packer.py"}
+
+
+# --------------------------------------------------------------------------
+# compile-audit plumbing (the full seven-entry audit runs in
+# tests/test_analysis_gate.py; these pin the detectors on toy programs)
+# --------------------------------------------------------------------------
+
+class TestCompileAuditDetectors:
+    def test_planted_callback_is_detected(self):
+        import jax
+        import jax.numpy as jnp
+
+        def bad(c):
+            return jax.pure_callback(
+                lambda a: a, jax.ShapeDtypeStruct(c.shape, c.dtype), c)
+
+        jaxpr = jax.make_jaxpr(
+            lambda x: jax.lax.scan(lambda c, _: (bad(c), None), x, None,
+                                   length=3))(jnp.ones(3))
+        names = {eqn.primitive.name
+                 for eqn in compile_audit._iter_eqns(jaxpr.jaxpr)}
+        assert any("callback" in n for n in names)
+
+    def test_scan_carry_avals_see_narrow_lanes(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(x16, x32):
+            return jax.lax.scan(
+                lambda c, _: (c, None), (x16, x32), None, length=2)
+
+        jaxpr = jax.make_jaxpr(f)(jnp.ones(4, jnp.int16),
+                                  jnp.ones(4, jnp.int32))
+        carries = compile_audit._scan_carry_avals(jaxpr.jaxpr)
+        assert len(carries) == 1
+        dtypes = sorted(str(a.dtype) for a in carries[0])
+        assert dtypes == ["int16", "int32"]
+
+    def test_cache_size_counter_behaviour(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x + 1)
+        f(jnp.ones(3))
+        base = f._cache_size()
+        f(jnp.ones(3))
+        assert f._cache_size() == base          # same shape: cache hit
+        f(jnp.ones(4))
+        assert f._cache_size() == base + 1      # new shape: miss

@@ -409,6 +409,50 @@ def test_regress_wire_smoke_is_provenance_beside_full_round(tmp_path):
     assert not ok
 
 
+def test_regress_static_analysis_gate(tmp_path):
+    """The swimlint artifact gates ABSOLUTELY: findings_total > 0 (an
+    unsuppressed static-analysis finding — a plane missing from a run
+    shape, a red compile audit) fails regress outright; baselined
+    suppressions (suppressed_total) never gate."""
+    art = tmp_path / "static_analysis.json"
+
+    def payload(**kw):
+        doc = {"schema": "swimlint/1", "metric": "static_analysis",
+               "findings_total": 0, "suppressed_total": 12, "ok": True,
+               "findings": []}
+        doc.update(kw)
+        return doc
+
+    with open(art, "w") as f:
+        json.dump(payload(), f)
+    ok, rows = query.regress([str(art)])
+    assert ok, rows
+    checks = {r["check"] for r in rows if r.get("ok") is not None}
+    assert {"slo/static_analysis_clean",
+            "slo/static_analysis_ok"} <= checks
+
+    with open(art, "w") as f:
+        json.dump(payload(findings_total=2, ok=False), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    bad = {r["check"] for r in rows if r.get("ok") is False}
+    assert "slo/static_analysis_clean" in bad
+
+
+def test_cli_regress_default_globs_include_static_analysis(
+        tmp_path, capsys, monkeypatch):
+    """Bare ``regress`` walks artifacts/static_analysis.json — the
+    committed swimlint round passes its absolute gate."""
+    monkeypatch.chdir(REPO)
+    assert cli_main(["regress", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] is True
+    assert any(r.get("source") == "static_analysis.json"
+               and r["check"] == "slo/static_analysis_clean"
+               and r.get("ok") is True
+               for r in out["checks"])
+
+
 def test_cli_regress_default_globs_include_multichip(tmp_path, capsys,
                                                      monkeypatch):
     """Bare ``regress`` walks BENCH_*.json AND MULTICHIP_*.json from

@@ -154,3 +154,81 @@ def test_stream_traced_run_matches_monolithic():
             np.asarray(m_mono[name]), res.metrics[name],
             err_msg=f"metric {name} diverged across segmentation",
         )
+
+
+class TestShardedRoundFusion:
+    """PR 14 (the plane-matrix's first real finding): shard_run /
+    shard_run_metered honor rounds_per_step on the serial sharded path
+    (the same _fused_scan — bit-identical for any K, incl. the
+    90 % 4 remainder tail), and the pipelined path declares fusion
+    unsupported: auto-select falls back serial-fused, ``pipelined=True``
+    raises."""
+
+    @staticmethod
+    def _mesh():
+        from scalecube_cluster_tpu.parallel import compat
+        from scalecube_cluster_tpu.parallel import mesh as pmesh
+
+        if not compat.HAS_SHARD_MAP:
+            pytest.skip(compat.SKIP_REASON)
+        return pmesh.make_mesh(1)
+
+    @staticmethod
+    def _params(rounds_per_step):
+        return swim.SwimParams.from_config(
+            fast_config(), n_members=N, delivery="scatter",
+            rounds_per_step=rounds_per_step,
+        )
+
+    @staticmethod
+    def _assert_same(tag, st_a, m_a, st_b, m_b):
+        assert set(m_a) == set(m_b)
+        for name in m_a:
+            np.testing.assert_array_equal(
+                np.asarray(m_a[name]), np.asarray(m_b[name]),
+                err_msg=f"{tag}: metric {name} diverged")
+        fields_b = state_fields(st_b)
+        for name, v in state_fields(st_a).items():
+            np.testing.assert_array_equal(
+                v, fields_b[name],
+                err_msg=f"{tag}: state.{name} diverged")
+
+    def test_sharded_fused_bit_identical_and_pipelined_raises(self):
+        from scalecube_cluster_tpu.parallel import mesh as pmesh
+
+        mesh = self._mesh()
+        key = jax.random.key(0)
+        p1, p4 = self._params(1), self._params(4)
+        world = crash_revive_world(p1)
+        st1, m1 = pmesh.shard_run(key, p1, world, ROUNDS, mesh,
+                                  pipelined=False)
+        st4, m4 = pmesh.shard_run(key, p4, world, ROUNDS, mesh,
+                                  pipelined=False)
+        self._assert_same("sharded serial K=4", st1, m1, st4, m4)
+        # auto-select with fusion falls back to the serial fused scan
+        # (bit-identical again), instead of silently unfusing
+        sta, ma = pmesh.shard_run(key, p4, world, ROUNDS, mesh)
+        self._assert_same("auto-select K=4", st1, m1, sta, ma)
+        # insisting on the pipeline with fusion is a loud error
+        with pytest.raises(NotImplementedError, match="rounds_per_step"):
+            pmesh.shard_run(key, p4, world, ROUNDS, mesh, pipelined=True)
+
+    def test_sharded_metered_fused_registry_identical(self):
+        from scalecube_cluster_tpu.parallel import mesh as pmesh
+
+        mesh = self._mesh()
+        key = jax.random.key(0)
+        p1, p4 = self._params(1), self._params(4)
+        world = crash_revive_world(p1)
+        st1, ms1, m1 = pmesh.shard_run_metered(key, p1, world, ROUNDS,
+                                               mesh, pipelined=False)
+        st4, ms4, m4 = pmesh.shard_run_metered(key, p4, world, ROUNDS,
+                                               mesh, pipelined=False)
+        self._assert_same("sharded metered K=4", st1, m1, st4, m4)
+        leaves1, tree1 = jax.tree_util.tree_flatten(ms1)
+        leaves4, tree4 = jax.tree_util.tree_flatten(ms4)
+        assert tree1 == tree4
+        for a, b in zip(leaves1, leaves4):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg="metered registry diverged under fusion")

@@ -5,20 +5,22 @@ Every clamp site in the tree — the self-refutation bump
 (models/swim._merge_and_timers), the WIRE_SATURATION monitor bound
 (chaos/monitor), the compact-carry encode clamp (models/swim.
 _carry_encode) — derives from the format table via
-models/swim._wire_inc_sat.  The grep-proof below tokenizes the whole
-package and fails if any evaluated saturation literal (8191, 2047,
-2^23-1, ...) reappears in CODE outside ops/delivery.py and records.py
-(records.py DEFINES the wide/wire16 key builders the table delegates
-to; comments and docstrings may cite the numbers — documentation is
-not a clamp site).
+models/swim._wire_inc_sat.  The grep-proof fails if any evaluated
+saturation literal (8191, 2047, 2^23-1, ...) reappears in CODE outside
+ops/delivery.py and records.py (records.py DEFINES the wide/wire16 key
+builders the table delegates to; comments and docstrings may cite the
+numbers — documentation is not a clamp site).  Since PR 14 the scan
+itself lives in the swimlint rule engine (analysis/rules.magic_literals,
+`python -m scalecube_cluster_tpu.analysis check`); this file keeps the
+pins and asserts the rule enforces exactly them.
 """
 
-import io
 import pathlib
-import tokenize
 
 import pytest
 
+from scalecube_cluster_tpu.analysis import callgraph
+from scalecube_cluster_tpu.analysis import rules as lint
 from scalecube_cluster_tpu.chaos import monitor as chaos_monitor
 from scalecube_cluster_tpu.models import swim
 from scalecube_cluster_tpu.ops import delivery
@@ -48,26 +50,26 @@ ALLOWED = {"ops/delivery.py", "records.py"}
 
 
 def test_table_is_the_single_source_of_saturation_literals():
-    offenders = []
-    for path in sorted(PKG.rglob("*.py")):
-        rel = str(path.relative_to(PKG))
-        if rel in ALLOWED:
-            continue
-        toks = tokenize.generate_tokens(
-            io.StringIO(path.read_text()).readline)
-        for tok in toks:
-            if tok.type != tokenize.NUMBER:
-                continue
-            try:
-                value = int(tok.string, 0)
-            except ValueError:
-                continue
-            if value in BANNED_LITERALS:
-                offenders.append(f"{rel}:{tok.start[0]}: {tok.line.strip()}")
-    assert not offenders, (
+    """ONE implementation since PR 14: the swimlint magic-literal rule
+    (scalecube_cluster_tpu/analysis/rules.py) — this test pins that the
+    rule's wire-saturation family carries EXACTLY the banned values and
+    allowed files the original PR-13 tokenizer grep-proof enforced, and
+    that it holds at HEAD."""
+    families = [f for f in lint.default_literal_families()
+                if f.name == "wire-saturation"]
+    assert len(families) == 1
+    fam = families[0]
+    # identical pins: same evaluated literals, same owning files
+    assert fam.values == frozenset(BANNED_LITERALS)
+    assert fam.allowed == frozenset(ALLOWED)
+    findings = lint.magic_literals(callgraph.PackageGraph(PKG),
+                                   families=[fam])
+    findings = [f for f in findings if f.rule == "magic-literal"
+                and f.id.startswith("magic-literal:wire-saturation:")]
+    assert not findings, (
         "wire-saturation literals outside ops/delivery.WIRE_FORMATS "
         "(derive from the table via swim._wire_inc_sat instead):\n"
-        + "\n".join(offenders)
+        + "\n".join(f"{f.path}:{f.line}: {f.message}" for f in findings)
     )
 
 
