@@ -164,6 +164,22 @@ the CPU-safe virtual-8-device pass pinned by
 tests/test_bench_wire_smoke.py.  Env overrides: SCALECUBE_WIRE_DEVICES,
 SCALECUBE_WIRE_N, SCALECUBE_WIRE_ROUNDS, SCALECUBE_WIRE_ARTIFACT.
 
+``--compose``: the composed plane runner A/B — the full instrumented
+stack (event trace ⊕ invariant monitor ⊕ health registry) through ONE
+scan and ONE compiled program (models/compose.run_composed) against the
+pre-compose alias-by-alias route (run_traced + run_metered +
+run_monitored: three programs, three passes), interleaved best-of with
+a bare-run anchor arm and a bit-identity parity probe, plus a
+compile-cost arm counting programs compiled across the entry-point ×
+layout matrix (head-style: 3/layout, composed: 1/layout — strictly
+reduced).  Writes an ``artifacts/compose_perf.json``-style artifact
+(smoke runs get ``compose_perf_smoke.json``) with
+``compose_speedup_ratio`` (>= 1.0 floor), ``full_stack_overhead_ratio``
+vs the head-style overhead, and the compile counts — all gated by
+``telemetry regress``.  ``--compose --smoke`` is the tier-1-safe pass
+pinned by tests/test_bench_compose_smoke.py.  Env overrides:
+SCALECUBE_COMPOSE_ARTIFACT, SCALECUBE_BENCH_N, SCALECUBE_BENCH_ROUNDS.
+
 Env overrides for debugging: SCALECUBE_BENCH_N, SCALECUBE_BENCH_ROUNDS,
 SCALECUBE_BENCH_DELIVERY, SCALECUBE_BENCH_SKIP_CANARY,
 SCALECUBE_BENCH_COMPACT (=1: the capacity-oriented compact carry layout,
@@ -2203,6 +2219,263 @@ def run_fuzz_bench():
     print(json.dumps(result), flush=True)
 
 
+def run_compose_bench():
+    """The --compose mode: the FULL instrumented stack (event trace ⊕
+    invariant monitor ⊕ health registry) through the composed plane
+    runner's ONE scan (models/compose.run_composed) A/B'd against the
+    pre-compose alias-by-alias route — run_traced + run_metered +
+    run_monitored sequentially, which is what obtaining all three
+    instrumented outputs cost before compose() existed: three XLA
+    programs, three passes over the rounds, each re-deriving the
+    per-round live masks / status-change gates / wide decodes the
+    composed body computes once.  A bare ``swim.run`` arm anchors the
+    overhead ratios, all three arms on one rotated-order interleaved
+    best-of discipline; a PARITY probe asserts the composed outputs are
+    bit-identical to the alias outputs before anything is timed.
+
+    A separate COMPILE-COST arm counts programs compiled (jit cache
+    misses) and compile+first-run wall seconds across an entry-point ×
+    layout matrix at a small fresh N: head-style full instrumentation
+    compiles three programs per layout, the composed stack ONE — the
+    strictly-reduced compile count the regress gate pins.
+
+    Writes an ``artifacts/compose_perf.json``-style artifact (smoke
+    runs get ``compose_perf_smoke.json`` — provenance, the sync-heal
+    convention) with ``compose_speedup_ratio`` (head-style seconds /
+    composed seconds, >= 1.0 floor), ``full_stack_overhead_ratio``
+    (composed vs bare — must be no worse than the head-style overhead)
+    and the compile counts, walked by ``telemetry regress``.  Env
+    overrides: SCALECUBE_COMPOSE_ARTIFACT, SCALECUBE_BENCH_N,
+    SCALECUBE_BENCH_ROUNDS.
+    """
+    result = {
+        "metric": "swim_compose_full_stack_member_rounds_per_sec",
+        "value": None,
+        "unit": "member-rounds/sec (composed full stack)",
+        "smoke": SMOKE,
+    }
+    artifact = os.environ.get("SCALECUBE_COMPOSE_ARTIFACT") or os.path.join(
+        "artifacts",
+        "compose_perf_smoke.json" if SMOKE else "compose_perf.json",
+    )
+    try:
+        jax, platform = init_backend()
+        result["platform"] = platform
+        import numpy as np
+
+        from scalecube_cluster_tpu.chaos import monitor as cmonitor
+        from scalecube_cluster_tpu.config import ClusterConfig
+        from scalecube_cluster_tpu.models import compose, swim
+        from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+        from scalecube_cluster_tpu.utils import runlog
+
+        def force(state):
+            return runlog.completion_barrier(state.status)
+
+        params, world, key = bench_workload(N_MEMBERS)
+        rounds = BENCH_ROUNDS
+        spec = cmonitor.MonitorSpec.passive(params)
+        mspec = tmetrics.MetricsSpec.default()
+        _, cap = traced_window_policy(N_MEMBERS, rounds)
+
+        def head_style(state3, start):
+            """The pre-compose route to full instrumentation: three
+            aliases, three scans, three sets of outputs."""
+            ts, es, os_ = state3
+            ts, tel, _ = swim.run_traced(key, params, world, rounds,
+                                         trace_capacity=cap, state=ts,
+                                         start_round=start)
+            es, ms, _ = swim.run_metered(key, params, world, rounds,
+                                         spec=mspec, state=es,
+                                         start_round=start)
+            os_, mon, _ = cmonitor.run_monitored(key, params, world, spec,
+                                                 rounds, state=os_,
+                                                 start_round=start)
+            return (ts, es, os_), tel, ms, mon
+
+        def composed(state, start):
+            return compose.run_composed(
+                key, params, world, rounds, monitor_spec=spec,
+                trace_capacity=cap, metrics_spec=mspec, state=state,
+                start_round=start)
+
+        def force_head(state3):
+            # The head arm runs THREE separate programs: block on each
+            # one's output, or async dispatch leaks the metered/
+            # monitored work into the next arm's timing window.
+            for st in state3:
+                force(st)
+
+        # Warm-up compiles + the PARITY probe: the composed stack's
+        # outputs must be bit-identical to the alias outputs on the
+        # same inputs before any timing means anything.
+        t0 = time.perf_counter()
+        h_states = tuple(swim.initial_state(params, world)
+                         for _ in range(3))
+        h_states, tel, ms, mon = head_style(h_states, 0)
+        force_head(h_states)
+        c_state, c_res, _ = composed(swim.initial_state(params, world), 0)
+        force(c_state)
+        b_state, _ = swim.run(key, params, world, rounds,
+                              state=swim.initial_state(params, world),
+                              start_round=0)
+        force(b_state)
+        log(f"compose@{N_MEMBERS}: compile+first-run (all arms) took "
+            f"{time.perf_counter() - t0:.1f}s")
+
+        def eq(a, b):
+            return bool(np.array_equal(np.asarray(a), np.asarray(b)))
+
+        parity = {
+            "final_status": eq(h_states[0].status, c_state.status),
+            "trace_lanes": eq(tel.trace.lanes, c_res["trace"].trace.lanes),
+            "trace_count": eq(tel.trace.count, c_res["trace"].trace.count),
+            "monitor_code_counts": eq(mon.code_counts,
+                                      c_res["monitor"].code_counts),
+            # chaos_violations rides only the monitored-metered /
+            # composed registry, so compare every OTHER counter lane.
+            "metrics_counters": all(
+                eq(ms.counters[i], c_res["metrics"].counters[i])
+                for i, name in enumerate(mspec.counters)
+                if name != "chaos_violations"),
+        }
+        result["parity"] = parity
+        if not all(parity.values()):
+            raise AssertionError(f"composed != alias outputs: {parity}")
+
+        # Rotated-order three-arm interleave (the interleaved_best_of
+        # discipline generalized): host drift biases every arm equally.
+        reps = 6 if SMOKE else 3
+        best = {"bare": None, "head": None, "composed": None}
+        states = {"bare": b_state, "head": h_states, "composed": c_state}
+        order = ("bare", "head", "composed")
+        for rep in range(reps):
+            start = rounds * (1 + rep)
+            for tag in order[rep % 3:] + order[:rep % 3]:
+                t0 = time.perf_counter()
+                if tag == "bare":
+                    states[tag], _ = swim.run(key, params, world, rounds,
+                                              state=states[tag],
+                                              start_round=start)
+                    force(states[tag])
+                elif tag == "head":
+                    states[tag], _, _, _ = head_style(states[tag], start)
+                    force_head(states[tag])
+                else:
+                    states[tag], _, _ = composed(states[tag], start)
+                    force(states[tag])
+                dt = time.perf_counter() - t0
+                best[tag] = dt if best[tag] is None else min(best[tag], dt)
+
+        c_rate = N_MEMBERS * rounds / best["composed"]
+        h_rate = N_MEMBERS * rounds / best["head"]
+        b_rate = N_MEMBERS * rounds / best["bare"]
+        speedup = round(best["head"] / best["composed"], 4)
+        log(f"compose@{N_MEMBERS}: bare {best['bare']:.3f}s / composed "
+            f"{best['composed']:.3f}s / head-style {best['head']:.3f}s "
+            f"per {rounds}-round window (best of {reps}, interleaved) -> "
+            f"compose_speedup_ratio {speedup}")
+        result.update(
+            value=round(c_rate, 1),
+            composed_member_rounds_per_sec=round(c_rate, 1),
+            head_style_member_rounds_per_sec=round(h_rate, 1),
+            bare_member_rounds_per_sec=round(b_rate, 1),
+            compose_speedup_ratio=speedup,
+            full_stack_overhead_ratio=round(best["composed"]
+                                            / best["bare"], 4),
+            head_style_overhead_ratio=round(best["head"]
+                                            / best["bare"], 4),
+            n_members=N_MEMBERS,
+            rounds_timed=rounds,
+            delivery=DELIVERY,
+            rounds_per_step=resolve_rounds_per_step(),
+            trace_capacity=cap,
+        )
+
+        # COMPILE-COST arm: fresh tiny-N signatures per layout, jit
+        # cache misses counted per entry — full instrumentation costs
+        # head-style THREE programs per layout, composed ONE.
+        layouts = [
+            ("focal-scatter", dict(delivery="scatter")),
+            ("focal-shift", dict(delivery="shift")),
+        ]
+        if not SMOKE:
+            layouts += [
+                ("compact-scatter", dict(delivery="scatter",
+                                         compact_carry=True)),
+                ("wire24-fused", dict(delivery="scatter",
+                                      compact_carry=True, wire24=True)),
+            ]
+        compile_n, compile_rounds = 64, 4
+        rows = []
+        total_head = total_comp = 0
+        sec_head = sec_comp = 0.0
+        for lname, overrides in layouts:
+            lp = swim.SwimParams.from_config(
+                ClusterConfig.default(), n_members=compile_n,
+                n_subjects=16, **overrides)
+            lw = swim.SwimWorld.healthy(lp)
+            lspec = cmonitor.MonitorSpec.passive(lp)
+
+            def misses(fn, thunk):
+                before = fn._cache_size()
+                t0 = time.perf_counter()
+                jax.block_until_ready(thunk()[0].status)
+                return fn._cache_size() - before, time.perf_counter() - t0
+
+            mh = sh = 0
+            for fn, thunk in (
+                (swim.run_traced,
+                 lambda: swim.run_traced(key, lp, lw, compile_rounds)),
+                (swim.run_metered,
+                 lambda: swim.run_metered(key, lp, lw, compile_rounds)),
+                (cmonitor.run_monitored,
+                 lambda: cmonitor.run_monitored(key, lp, lw, lspec,
+                                                compile_rounds)),
+            ):
+                m, s = misses(fn, thunk)
+                mh += m
+                sh += s
+            mc, sc = misses(
+                compose.run_composed,
+                lambda: compose.run_composed(key, lp, lw, compile_rounds,
+                                             monitor_spec=lspec))
+            rows.append({"layout": lname, "programs_head_style": mh,
+                         "programs_composed": mc,
+                         "seconds_head_style": round(sh, 2),
+                         "seconds_composed": round(sc, 2)})
+            total_head += mh
+            total_comp += mc
+            sec_head += sh
+            sec_comp += sc
+            log(f"compose compile[{lname}]: head-style {mh} programs "
+                f"{sh:.1f}s vs composed {mc} programs {sc:.1f}s")
+        result["compile"] = {
+            "layouts": rows,
+            "programs_head_style": total_head,
+            "programs_composed": total_comp,
+            "seconds_head_style": round(sec_head, 2),
+            "seconds_composed": round(sec_comp, 2),
+        }
+
+        art = dict(result)
+        os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        result["artifact"] = artifact
+        log(f"compose artifact written to {artifact}")
+
+        apply_regress_gate(
+            result, ["BENCH_*.json",
+                     os.path.join("artifacts", "compose_perf*.json"),
+                     artifact])
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -2282,6 +2555,16 @@ def main():
              "counts + traffic-model bytes/slot) into an "
              "artifacts/wire_fused.json-style artifact; combine with "
              "--smoke for the CPU-safe virtual-8-device pass",
+    )
+    parser.add_argument(
+        "--compose", action="store_true",
+        help="measure the composed plane runner: the full instrumented "
+             "stack (trace+metrics+monitor) in ONE scan via "
+             "models/compose.run_composed vs the pre-compose "
+             "alias-by-alias route (three programs, three scans), plus "
+             "a compile-count arm over the entry-point x layout "
+             "matrix, into an artifacts/compose_perf.json-style "
+             "artifact; combine with --smoke for the tier-1-safe pass",
     )
     mode = parser.add_mutually_exclusive_group()
     mode.add_argument(
@@ -2367,6 +2650,15 @@ def main():
                 "--wire measures the fused-vs-two-buffer wire gap on "
                 "its own interleaved windows — drop the other mode "
                 "flags")
+        if args.compose and (args.chaos or args.resilience or args.metrics
+                             or args.multichip or args.sync
+                             or args.lifeguard or args.churn or args.fuzz
+                             or args.wire or args.traced or args.untraced
+                             or args.gap_artifact):
+            parser.error(
+                "--compose measures the composed-vs-alias full-stack "
+                "gap on its own interleaved windows — drop the other "
+                "mode flags")
     except SystemExit as e:
         # The one-JSON-line contract holds even for a bad argv: argparse
         # already printed its usage message to stderr; ship the error
@@ -2399,6 +2691,8 @@ def main():
         return run_fuzz_bench()
     if args.wire:
         return run_wire_bench()
+    if args.compose:
+        return run_compose_bench()
 
     result = {
         "metric": "swim_member_rounds_per_sec_per_chip",

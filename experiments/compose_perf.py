@@ -1,0 +1,87 @@
+"""Composed plane-runner drill: the full instrumented stack in ONE scan.
+
+Drives ``bench.py --compose`` (the one entry point the measurement
+flows through, so the experiment and the driver bench cannot drift):
+the full instrumented stack — membership event trace ⊕ in-jit invariant
+monitor ⊕ health-metrics registry — run through the composed plane
+runner's single scan and single compiled program
+(``models/compose.run_composed``), A/B'd against the pre-compose
+alias-by-alias route (``run_traced`` + ``run_metered`` +
+``run_monitored`` sequentially: three programs, three passes over the
+rounds, each re-deriving the per-round live masks / status-change gates
+/ wide carry decodes the composed body computes once), with a bare
+``swim.run`` anchor arm, all three on one rotated-order interleaved
+best-of window and a bit-identity PARITY probe run before any timing.
+
+A separate compile-cost arm counts programs compiled (jit cache misses)
+and compile wall seconds across the entry-point × layout matrix:
+head-style full instrumentation pays THREE programs per layout, the
+composed stack ONE — the strictly-reduced compile count the regress
+gate pins alongside ``compose_speedup_ratio >= 1.0`` and the composed
+overhead staying within the band of head-style's.
+
+Writes ``artifacts/compose_perf.json`` (override
+``SCALECUBE_COMPOSE_ARTIFACT``) and runs the ``telemetry regress`` gate
+in-bench — the committed artifact is the pinned compose claim, and
+regress exits 1 if it ever rots.  CPU-safe (ratios are same-host
+interleaved; absolute rates are provenance).
+
+Usage:
+    python experiments/compose_perf.py              # committed shape
+    python experiments/compose_perf.py --smoke      # tier-1-safe pass
+    python experiments/compose_perf.py --n 2048 --rounds 120
+"""
+
+import argparse
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# The committed artifact's CPU-feasible shape (bench defaults target an
+# accelerator: N=1M, 1000-round windows).
+DEFAULT_N = 4096
+DEFAULT_ROUNDS = 240
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--smoke", action="store_true",
+                        help="tier-1-safe fast pass (small N, few "
+                             "rounds, 2-layout compile arm)")
+    parser.add_argument("--n", type=int, default=None,
+                        help=f"member count (default {DEFAULT_N}; the "
+                             f"bench smoke preset under --smoke)")
+    parser.add_argument("--rounds", type=int, default=None,
+                        help=f"timed window rounds (default "
+                             f"{DEFAULT_ROUNDS})")
+    parser.add_argument("--artifact", default=None,
+                        help="artifact path (default "
+                             "artifacts/compose_perf.json; smoke runs "
+                             "default to compose_perf_smoke.json)")
+    args = parser.parse_args()
+
+    env = dict(os.environ)
+    if not args.smoke:
+        env.setdefault("SCALECUBE_BENCH_N", str(args.n or DEFAULT_N))
+        env.setdefault("SCALECUBE_BENCH_ROUNDS",
+                       str(args.rounds or DEFAULT_ROUNDS))
+    else:
+        if args.n is not None:
+            env["SCALECUBE_BENCH_N"] = str(args.n)
+        if args.rounds is not None:
+            env["SCALECUBE_BENCH_ROUNDS"] = str(args.rounds)
+    if args.artifact:
+        env["SCALECUBE_COMPOSE_ARTIFACT"] = args.artifact
+
+    cmd = [sys.executable, str(REPO / "bench.py"), "--compose"]
+    if args.smoke:
+        cmd.append("--smoke")
+    proc = subprocess.run(cmd, cwd=str(REPO), env=env)
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -170,11 +170,12 @@ def run_analysis(root=None, baseline=None,
 
     graph = PackageGraph(root)
     matrix, findings = rules_mod.plane_matrix(graph)
+    findings += rules_mod.thin_entries(graph)
     findings += rules_mod.trace_safety(graph)
     findings += rules_mod.donation_safety(graph)
     findings += rules_mod.magic_literals(graph)
-    rules_ran = ["plane-matrix", "trace-safety", "donation-safety",
-                 "magic-literal"]
+    rules_ran = ["plane-matrix", "thin-entry", "trace-safety",
+                 "donation-safety", "magic-literal"]
 
     is_installed_tree = root == default_root()
     if compile_audit is True and not is_installed_tree:

@@ -114,6 +114,36 @@ TICK_BODIES: Dict[str, Sequence[Tuple[str, str]]] = {
 # "pipelined" is handled as a superset check against "scatter".
 WHOLE_TICK_BODIES = ("scatter", "shift", "k_block")
 
+# The composed plane runner's scan drivers (models/compose.py): every
+# entry point is a thin alias over one of these, so every knob that
+# reaches ANY run shape must be consultable from their cones — a knob
+# threaded around compose() instead of through it is the hand-threading
+# regression the refactor exists to end.
+COMPOSE_ROOTS: Sequence[Tuple[str, str]] = (
+    ("models/compose.py", "composed_scan"),
+    ("models/compose.py", "composed_shard_scan"),
+)
+COMPOSE_MODULE = "models/compose.py"
+
+# Scan/tick internals a THIN alias entry point must never touch
+# directly — tick-body logic lives in compose.py and the plane
+# modules, entries only assemble a plane stack and delegate
+# (the thin-entry rule).
+TICK_INTERNALS: Sequence[Tuple[str, str]] = (
+    ("models/swim.py", "swim_tick"),
+    ("models/swim.py", "swim_tick_send"),
+    ("models/swim.py", "swim_tick_recv"),
+    ("models/swim.py", "_fused_scan"),
+    ("models/swim.py", "_tick_scatter"),
+    ("models/swim.py", "_tick_shift"),
+    ("models/swim.py", "_tick_shift_blocked"),
+    ("models/compose.py", "_pipelined_rounds"),
+    ("telemetry/trace.py", "observe_round"),
+    ("telemetry/trace.py", "observe_round_codes"),
+    ("telemetry/metrics.py", "observe_tick"),
+    ("chaos/monitor.py", "check_round"),
+)
+
 DEVICE_MODULES_PREFIXES = ("models/", "ops/")
 DEVICE_MODULES_FILES = ("chaos/monitor.py", "parallel/mesh.py")
 
@@ -175,6 +205,8 @@ def plane_matrix(graph: PackageGraph):
     body_cols = {name: _column_sites(graph, _resolve_roots(graph, specs),
                                      fset)
                  for name, specs in TICK_BODIES.items()}
+    compose_col = _column_sites(
+        graph, _resolve_roots(graph, COMPOSE_ROOTS), fset)
 
     matrix = {
         "entries": {f: {e: [f"{r}:{ln}" for r, ln in entry_cols[e].get(f, [])]
@@ -183,11 +215,31 @@ def plane_matrix(graph: PackageGraph):
         "bodies": {f: {b: [f"{r}:{ln}" for r, ln in body_cols[b].get(f, [])]
                        for b in TICK_BODIES}
                    for f in fields},
+        "compose": {f: {"compose": [f"{r}:{ln}"
+                                    for r, ln in compose_col.get(f, [])]}
+                    for f in fields},
     }
 
     findings: List[Finding] = []
     for f in fields:
         reached = {e for e in ENTRY_POINTS if entry_cols[e].get(f)}
+        # Every knob any run shape consults must be reachable from the
+        # composed scan drivers — the seven entries are thin aliases,
+        # so a consult that exists only outside compose's cone is a
+        # plane threaded around the runner, not through it.
+        if reached and not compose_col.get(f):
+            findings.append(Finding(
+                rule="plane-matrix",
+                id=f"plane-matrix:{f}:compose",
+                path=COMPOSE_ROOTS[0][0], line=0,
+                message=(
+                    f"SwimParams.{f} is consulted on the "
+                    f"{'/'.join(sorted(reached))} run shape(s) but "
+                    f"nothing reachable from the composed scan drivers "
+                    f"({'/'.join(n for _, n in COMPOSE_ROOTS)}) reads "
+                    f"it — the plane bypasses compose()"
+                ),
+            ))
         if reached and reached != set(ENTRY_POINTS):
             for e in sorted(set(ENTRY_POINTS) - reached):
                 where = sorted(reached)
@@ -230,6 +282,78 @@ def plane_matrix(graph: PackageGraph):
                 ),
             ))
     return matrix, findings
+
+
+# --------------------------------------------------------------------------
+# Rule 1b: thin-entry — no tick-body logic outside compose/plane modules
+# --------------------------------------------------------------------------
+
+def thin_entries(graph: PackageGraph) -> List[Finding]:
+    """Each of the seven run entry points must be a THIN alias: it
+    assembles a plane stack and delegates to a models/compose.py scan
+    driver, and neither its own body nor a same-module plain-function
+    helper it directly calls (the ``shard_run`` -> shard_map plumbing
+    shape) may mention a scan/tick internal (``TICK_INTERNALS``) —
+    tick-body logic lives in compose.py and the plane modules only.
+
+    Lenient on missing roots (fixture trees may define a subset — the
+    plane matrix is the strict guardian of the seven-entry contract).
+    """
+    internals = {q for rel, name in TICK_INTERNALS
+                 if (q := graph.find(rel, name)) is not None}
+    findings: List[Finding] = []
+    for entry, (rel, name) in ENTRY_POINTS.items():
+        qual = graph.find(rel, name)
+        if qual is None:
+            continue
+        frontier = [qual]
+        for tgt in sorted(graph._edges.get(qual, ())):
+            info = graph.functions.get(tgt)
+            if (info is not None and info.rel == rel and info.cls is None
+                    and tgt not in internals):
+                frontier.append(tgt)
+        touches_compose = False
+        emitted = set()  # one finding per (entry, internal) defect,
+        #                  even when entry AND helper both reach it
+        for q in frontier:
+            for tgt in sorted(graph._edges.get(q, ())):
+                info = graph.functions.get(tgt)
+                if info is None:
+                    continue
+                if info.rel == COMPOSE_MODULE \
+                        and tgt not in internals:
+                    touches_compose = True
+                if tgt in internals:
+                    fid = f"thin-entry:{entry}:{info.name}"
+                    if fid in emitted:
+                        continue
+                    emitted.add(fid)
+                    findings.append(Finding(
+                        rule="thin-entry",
+                        id=fid,
+                        path=rel,
+                        line=graph.functions[qual].node.lineno,
+                        message=(
+                            f"entry point {entry} reaches the scan/tick "
+                            f"internal {info.rel}::{info.name} directly "
+                            f"(via {graph.functions[q].name}) — tick-"
+                            f"body logic belongs in models/compose.py "
+                            f"or a plane module; entries are thin "
+                            f"aliases"
+                        ),
+                    ))
+        if not touches_compose:
+            findings.append(Finding(
+                rule="thin-entry",
+                id=f"thin-entry:{entry}:no-compose-delegation",
+                path=rel, line=graph.functions[qual].node.lineno,
+                message=(
+                    f"entry point {entry} never delegates to a "
+                    f"models/compose.py scan driver — every run shape "
+                    f"is a thin alias over the composed runner"
+                ),
+            ))
+    return findings
 
 
 # --------------------------------------------------------------------------

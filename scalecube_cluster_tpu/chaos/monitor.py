@@ -287,12 +287,17 @@ def _record_flat(mon: MonitorState, mask, rows) -> MonitorState:
 
 def _check_cells(spec: MonitorSpec, params: "swim.SwimParams",
                  kn: "swim.Knobs", round_idx, prev: "swim.SwimState",
-                 new: "swim.SwimState", world: "swim.SwimWorld"):
+                 new: "swim.SwimState", world: "swim.SwimWorld",
+                 alive_now=None):
     """Evaluate every invariant on one tick's (prev, new) WIDE carries —
     the pure mask/total computation, shared by the sequential
     ``check_round`` and the batched scan (``run_monitored_batch``,
     which needs the masks separately so its evidence-recording
     ``lax.cond`` can gate on a BATCH-level predicate).
+
+    ``alive_now``: the precomputed ``world.alive_at(round_idx)`` from
+    the composed runner's shared round context
+    (models/compose.RoundCtx); None recomputes it (identical bits).
 
     Returns ``(vio [N_CODES, N, K] bool, details [N_CODES, N, K] i32,
     v_self_inc [N] bool, v_self_sat [N] bool, self_inc [N] i32,
@@ -301,7 +306,8 @@ def _check_cells(spec: MonitorSpec, params: "swim.SwimParams",
     n, k = prev.status.shape
     node_ids = jnp.arange(n, dtype=jnp.int32)
     subject_ids = jnp.asarray(world.subject_ids, jnp.int32)
-    alive_now = world.alive_at(round_idx)
+    if alive_now is None:
+        alive_now = world.alive_at(round_idx)
     obs_alive = alive_now[:, None]
     subj_alive = alive_now[subject_ids][None, :]
     is_self = subject_ids[None, :] == node_ids[:, None]
@@ -539,7 +545,7 @@ def _record_round(mon: MonitorState, round_idx, vio, details, v_self_inc,
 def check_round(mon: MonitorState, spec: MonitorSpec,
                 params: "swim.SwimParams", kn: "swim.Knobs", round_idx,
                 prev: "swim.SwimState", new: "swim.SwimState",
-                world: "swim.SwimWorld") -> MonitorState:
+                world: "swim.SwimWorld", alive_now=None) -> MonitorState:
     """Evaluate every invariant on one tick's (prev, new) WIDE carries
     (``_check_cells``) and fold the result into the monitor carry.
 
@@ -549,7 +555,8 @@ def check_round(mon: MonitorState, spec: MonitorSpec,
     elementwise reductions.
     """
     vio, details, v_self_inc, v_self_sat, self_inc, totals = _check_cells(
-        spec, params, kn, round_idx, prev, new, world)
+        spec, params, kn, round_idx, prev, new, world,
+        alive_now=alive_now)
     subject_ids = jnp.asarray(world.subject_ids, jnp.int32)
 
     fresh = mon.code_counts == 0                          # [N_CODES]
@@ -575,85 +582,47 @@ def check_round(mon: MonitorState, spec: MonitorSpec,
 
 
 def _wide(params: "swim.SwimParams", st: "swim.SwimState", cursor):
-    """Any carry layout -> the WIDE form the checks read (lossless
-    below the caps the layouts already validate)."""
-    if params.compact_carry:
-        return swim._carry_decode(st, cursor)
-    if params.int16_wire:
-        return dataclasses.replace(st, inc=st.inc.astype(jnp.int32))
-    return st
+    """Any carry layout -> the WIDE form the checks read — the
+    composed runner's one decode site (models/compose.wide_view),
+    re-exported under the historical name for the batched fuzzer."""
+    from scalecube_cluster_tpu.models import compose
+
+    return compose.wide_view(params, st, cursor)
 
 
-def _monitored_scan(base_key, params: "swim.SwimParams",
-                    world: "swim.SwimWorld", spec: MonitorSpec,
-                    n_rounds: int, capacity: int, state, start_round,
-                    knobs, shift_key, monitor, metrics_spec,
-                    metrics_state):
-    """The ONE monitored scan body behind ``run_monitored`` and
-    ``run_monitored_metered`` — the metered/unmetered duplication
-    CHANGES.md PR 5 flagged as deliberate debt, hoisted before the SYNC
-    anti-entropy plane would have made a fourth copy.  ``metrics_spec``
-    is None for the unmetered shape (no registry in the carry; the
-    returned ``ms`` is None); otherwise the registry folds the same
-    signals as ``swim.run_metered`` plus the ``chaos_violations``
-    counter (the delta of ``MonitorState.code_counts`` — exact totals,
-    not just recorded evidence lanes).
+class MonitorPlane:
+    """The in-jit invariant monitor as a composed-runner plane
+    (models/compose.py): carry slice = :class:`MonitorState`, per-round
+    hook = :func:`check_round` on the shared round context's wide
+    decodes (``rc.prev_wide``/``rc.new_wide`` — computed once and
+    shared with every other plane in the stack), no finalizer work.
 
-    Returns ``(final_state, monitor_state, ms_or_None, metrics)``.
+    ``monitor`` resumes an existing buffer across chunked scans (the
+    ``run_monitored(monitor=...)`` argument threads through here).
+    The slice is NOT donated by any entry point — chaos runs are
+    small-N adversarial workloads, not the 1M hot path.
     """
-    metered = metrics_spec is not None
-    if metered:
-        from scalecube_cluster_tpu.telemetry import metrics as tmetrics
 
-    kn = knobs if knobs is not None else swim.Knobs.from_params(params)
-    if state is None:
-        state = swim.initial_state(params, world)
-    if monitor is None:
-        monitor = MonitorState.init(capacity)
-    if metered and metrics_state is None:
-        metrics_state = tmetrics.MetricsState.init(metrics_spec)
+    name = "monitor"
 
-    def tick(carry, round_idx):
-        st, mon, ms = carry if metered else (*carry, None)
-        prev = _wide(params, st, round_idx)
-        new_st, metrics = swim.swim_tick(st, round_idx, base_key, params,
-                                         world, knobs=kn,
-                                         shift_key=shift_key)
-        new_mon = check_round(mon, spec, params, kn, round_idx, prev,
-                              _wide(params, new_st, round_idx + 1), world)
-        if not metered:
-            return (new_st, new_mon), metrics
-        ms = tmetrics.observe_tick(
-            ms, metrics_spec, params, kn, round_idx, prev.status,
-            prev.suspect_deadline, new_st.status, metrics, world,
-        )
-        if "chaos_violations" in metrics_spec.counters:
-            ms = tmetrics.inc(
-                ms, metrics_spec, "chaos_violations",
-                jnp.sum(new_mon.code_counts - mon.code_counts,
-                        dtype=jnp.int32),
-            )
-        return (new_st, new_mon, ms), metrics
+    def __init__(self, spec: MonitorSpec, capacity: int = DEFAULT_CAPACITY,
+                 monitor: Optional[MonitorState] = None):
+        self.spec = spec
+        self.capacity = capacity
+        self.monitor = monitor
 
-    carry0 = ((state, monitor, metrics_state) if metered
-              else (state, monitor))
-    carry, metrics = swim._fused_scan(
-        tick, carry0, n_rounds, start_round, params.rounds_per_step,
-    )
-    if not metered:
-        final_state, monitor = carry
-        return final_state, monitor, None, metrics
-    final_state, monitor, ms = carry
-    end = start_round + n_rounds
-    _, spread_wide = swim._wide_timer_fields(final_state, params, end)
-    ms = tmetrics.sample_gauges(
-        ms, metrics_spec, params, kn, final_state.status, spread_wide,
-        world.alive_at(end), end, world,
-        last_tick_metrics={k: metrics[k][-1]
-                           for k in ("messages_gossip",) if k in metrics},
-        lhm=final_state.lhm if params.lhm_max > 0 else None,
-    )
-    return final_state, monitor, ms, metrics
+    def init(self, params, world):
+        if self.monitor is not None:
+            return self.monitor
+        return MonitorState.init(self.capacity)
+
+    def on_round(self, rc, mon):
+        return check_round(mon, self.spec, rc.params, rc.kn, rc.round_idx,
+                           rc.prev_wide, rc.new_wide, rc.world,
+                           alive_now=rc.alive_now)
+
+    def finalize(self, fc, mon):
+        return mon
 
 
 @partial(jax.jit, static_argnames=("params", "n_rounds", "capacity"))
@@ -679,12 +648,19 @@ def run_monitored(base_key, params: "swim.SwimParams",
     Works on every carry layout: compact/int16 carries are decoded to
     the wide form for checking only (``swim._carry_decode`` — lossless
     below the caps the layouts already validate).
+
+    Thin alias over the composed plane runner
+    (models/compose.composed_scan with a single :class:`MonitorPlane`);
+    the scan body lives there.
     """
-    final_state, monitor, _, metrics = _monitored_scan(
-        base_key, params, world, spec, n_rounds, capacity, state,
-        start_round, knobs, shift_key, monitor, None, None,
+    from scalecube_cluster_tpu.models import compose
+
+    plane = MonitorPlane(spec, capacity=capacity, monitor=monitor)
+    final_state, results, metrics = compose.composed_scan(
+        base_key, params, world, n_rounds, planes=(plane,), state=state,
+        start_round=start_round, knobs=knobs, shift_key=shift_key,
     )
-    return final_state, monitor, metrics
+    return final_state, results["monitor"], metrics
 
 
 @partial(jax.jit, static_argnames=("params", "n_rounds", "capacity"))
@@ -807,24 +783,36 @@ def run_monitored_metered(base_key, params: "swim.SwimParams",
                           metrics_spec=None, metrics_state=None):
     """``run_monitored`` with the health-metrics registry riding along
     (telemetry/metrics.py): the chaos shape of the always-on numeric
-    health plane — the same scan body (``_monitored_scan``) with the
-    registry in the carry, so monitor verdicts and protocol state are
-    bit-identical to ``run_monitored``.
+    health plane — the same composed scan with a
+    :class:`~telemetry.metrics.MetricsPlane` stacked after the
+    :class:`MonitorPlane` (its ``chaos_from`` hook feeds the
+    ``chaos_violations`` counter from the monitor's per-round count
+    delta), so monitor verdicts and protocol state are bit-identical
+    to ``run_monitored``.
 
     Returns ``(final_state, monitor_state, metrics_state, metrics)``;
     ``metrics_state``/``metrics_spec`` resume/declare the registry like
     ``swim.run_metered`` (the registry carry is donated; the monitor
     carry is not, matching ``run_monitored``).
-    """
-    if metrics_spec is None:
-        from scalecube_cluster_tpu.telemetry import metrics as tmetrics
 
+    Thin alias over models/compose.composed_scan; the scan body lives
+    there.
+    """
+    from scalecube_cluster_tpu.models import compose
+    from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+
+    if metrics_spec is None:
         metrics_spec = tmetrics.MetricsSpec.default()
-    return _monitored_scan(
-        base_key, params, world, spec, n_rounds, capacity, state,
-        start_round, knobs, shift_key, monitor, metrics_spec,
-        metrics_state,
+    planes = (
+        MonitorPlane(spec, capacity=capacity, monitor=monitor),
+        tmetrics.MetricsPlane(metrics_spec, metrics_state=metrics_state,
+                              chaos_from="monitor"),
     )
+    final_state, results, metrics = compose.composed_scan(
+        base_key, params, world, n_rounds, planes=planes, state=state,
+        start_round=start_round, knobs=knobs, shift_key=shift_key,
+    )
+    return final_state, results["monitor"], results["metrics"], metrics
 
 
 # --------------------------------------------------------------------------

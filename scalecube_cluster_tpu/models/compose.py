@@ -1,0 +1,621 @@
+"""Composable plane runner: ONE scan, shared round reductions, any
+plane stack.
+
+The reference layers its protocol as independent peer components driven
+by one scheduler thread (membership ⊕ fdetector ⊕ gossip ⊕ metadata —
+PAPER.md §1 L3); this module is the dense-tick analog of that layering.
+A **plane** is a small object declaring three hooks over the shared
+protocol scan:
+
+  ``init(params, world)``      -> its carry slice (one pytree, carried
+                                  through the scan next to ``SwimState``;
+                                  resume state threads through here)
+  ``on_round(rc, slice)``      -> the per-round observation fold,
+                                  reading the shared :class:`RoundCtx`
+  ``finalize(fc, slice)``      -> the end-of-run sample (gauges, etc.)
+                                  over the shared :class:`FinalCtx`
+
+plus, for planes that batch work across a fused scan step (the event
+trace's one-scatter-per-step record), the optional fused pair
+``on_round_fused(rc, slice) -> (slice, out)`` / ``on_step(rounds_k,
+slice, stacked_outs, world) -> slice`` with ``fused = True``.
+
+:func:`composed_scan` drives the protocol tick once per round and hands
+every plane the SAME :class:`RoundCtx` — live masks, the status-change
+matrix and its emptiness predicate, the wide carry decodes and the wide
+deadline lane are each computed ONCE per round and memoized, where the
+pre-compose run shapes re-derived them per subsystem
+(telemetry/trace.py, telemetry/metrics.py and chaos/monitor.py each
+recomputed ``world.alive_at``, the ``prev != new`` gate and the compact
+decode independently).  :func:`composed_shard_scan` is the row-sharded
+twin (serial or software-pipelined delivery — ``_pipelined_rounds``
+lives here too, so every scan driver is in one module).
+
+All seven run entry points are thin aliases over these two drivers:
+
+  ``models/swim.run``                    -> composed_scan, no planes
+  ``models/swim.run_traced``             -> + TracePlane
+  ``models/swim.run_metered``            -> + MetricsPlane
+  ``chaos/monitor.run_monitored``        -> + MonitorPlane
+  ``chaos/monitor.run_monitored_metered``-> + MonitorPlane ⊕ MetricsPlane
+  ``parallel/mesh.shard_run``            -> composed_shard_scan
+  ``parallel/mesh.shard_run_metered``    -> + MetricsPlane (sharded)
+
+each bit-identical to its pre-compose hand-threaded body (the per-plane
+math is byte-for-byte the same calls on the same values — pinned by
+tests/test_compose.py and the per-subsystem suites), and the NEXT plane
+lands by writing one plane module instead of editing ~28 files
+(ROADMAP item 1's acceptance bar).  :func:`run_composed` is the new
+capability the aliases cannot express: the FULL instrumented stack
+(trace ⊕ metrics ⊕ monitor) in one program and one pass over the
+rounds, where the alias-by-alias route pays three compiles and three
+scans (``bench.py --compose`` measures the gap;
+artifacts/compose_perf.json).
+
+The in-tick planes (SYNC anti-entropy, Lifeguard health, the
+open-world identity epoch, delay rings, user gossip) are compiled into
+``swim_tick`` by their ``SwimParams`` knobs and carried inside
+``SwimState`` lanes; :func:`plane_registry` lists them next to the
+observer planes with their knob gates and carry lanes, so swimlint's
+plane matrix and a human reader see one inventory
+(tests/test_compose.py pins the registry against the real dataclasses).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu.models import swim
+
+
+def wide_view(params: "swim.SwimParams", st: "swim.SwimState", cursor):
+    """Any carry layout -> the WIDE form observer planes read (lossless
+    below the caps the layouts already validate).  The one decode site
+    the monitor and metrics planes share through :class:`RoundCtx`."""
+    if params.compact_carry:
+        return swim._carry_decode(st, cursor)
+    if params.int16_wire:
+        return dataclasses.replace(st, inc=st.inc.astype(jnp.int32))
+    return st
+
+
+class RoundCtx:
+    """Shared per-round context: everything more than one plane might
+    derive from one tick's (prev, new) pair, computed ONCE and memoized.
+
+    ``prev``/``new`` are the scan carry BEFORE/AFTER the tick in their
+    STORED layout; ``metrics`` the tick's per-round metrics dict
+    (already psum-global under sharding).  Planes read the raw fields
+    for stored-layout math and the lazy properties for the shared
+    derivations; a derivation is traced the first time any plane asks
+    and handed to every later plane from the cache — which is exactly
+    the "computed once per round" contract the composed full stack
+    buys over three independent run shapes.
+    """
+
+    __slots__ = ("params", "world", "kn", "round_idx", "prev", "new",
+                 "metrics", "offset", "axis_name", "lead", "_cache",
+                 "_plane_prev", "_plane_new")
+
+    def __init__(self, params, world, kn, round_idx, prev, new, metrics,
+                 offset=0, axis_name=None, lead=None):
+        self.params = params
+        self.world = world
+        self.kn = kn
+        self.round_idx = round_idx
+        self.prev = prev
+        self.new = new
+        self.metrics = metrics
+        self.offset = offset
+        self.axis_name = axis_name
+        self.lead = lead
+        self._cache = {}
+        self._plane_prev = {}
+        self._plane_new = {}
+
+    def _memo(self, key, fn):
+        if key not in self._cache:
+            self._cache[key] = fn()
+        return self._cache[key]
+
+    # -- live masks --------------------------------------------------------
+
+    @property
+    def alive_now(self):
+        """[N] ground-truth liveness at this round (world.alive_at) —
+        consulted by the monitor's eligibility masks AND the metrics
+        plane's live_observer_rounds counter."""
+        return self._memo("alive_now",
+                          lambda: self.world.alive_at(self.round_idx))
+
+    # -- the shared emptiness gate -----------------------------------------
+
+    @property
+    def status_changed(self):
+        """[N, K] bool: cells whose status changed this tick — the one
+        compare matrix behind the trace event derivation, the metrics
+        suspicion-transition gate and the trace emptiness predicate."""
+        return self._memo(
+            "status_changed",
+            lambda: self.prev.status != self.new.status)
+
+    @property
+    def any_status_change(self):
+        """Scalar emptiness predicate over :attr:`status_changed` —
+        the trace/metrics gates share this ONE reduction."""
+        return self._memo("any_status_change",
+                          lambda: jnp.any(self.status_changed))
+
+    # -- wide decodes ------------------------------------------------------
+
+    @property
+    def prev_wide(self):
+        """``prev`` decoded wide at this round's cursor (the monitor's
+        check input; under compact carries this is the per-round decode
+        the pre-compose monitored scan paid on its own)."""
+        return self._memo(
+            "prev_wide",
+            lambda: wide_view(self.params, self.prev, self.round_idx))
+
+    @property
+    def new_wide(self):
+        """``new`` decoded wide at the NEXT round's cursor."""
+        return self._memo(
+            "new_wide",
+            lambda: wide_view(self.params, self.new, self.round_idx + 1))
+
+    @property
+    def prev_deadline_wide(self):
+        """``prev.suspect_deadline`` in absolute wide rounds — the lane
+        the metrics plane's suspicion-lifetime recovery reads.  Served
+        from :attr:`prev_wide` when a plane already paid the full
+        decode (the monitored-metered stack), else from the two-lane
+        ``swim._wide_timer_fields`` fast path (the metrics-only
+        stack)."""
+        def derive():
+            if "prev_wide" in self._cache:
+                return self._cache["prev_wide"].suspect_deadline
+            return swim._wide_timer_fields(self.prev, self.params,
+                                           self.round_idx)[0]
+        return self._memo("prev_deadline_wide", derive)
+
+    # -- cross-plane reads -------------------------------------------------
+
+    def plane_before(self, name: str):
+        """Another plane's carry slice BEFORE its on_round this round
+        (planes run in stack order; later planes may read earlier
+        ones — the metered monitor's chaos_violations delta)."""
+        return self._plane_prev[name]
+
+    def plane_after(self, name: str):
+        """Another plane's carry slice AFTER its on_round this round."""
+        return self._plane_new[name]
+
+
+class FinalCtx:
+    """Shared end-of-run context for plane finalizers: the final carry
+    at cursor ``end_round`` plus the stacked per-round metrics, with
+    the wide decodes and liveness slices memoized like
+    :class:`RoundCtx`."""
+
+    __slots__ = ("params", "world", "kn", "end_round", "final_state",
+                 "metrics", "offset", "axis_name", "n_local", "_cache")
+
+    def __init__(self, params, world, kn, end_round, final_state, metrics,
+                 offset=0, axis_name=None, n_local=None):
+        self.params = params
+        self.world = world
+        self.kn = kn
+        self.end_round = end_round
+        self.final_state = final_state
+        self.metrics = metrics
+        self.offset = offset
+        self.axis_name = axis_name
+        self.n_local = n_local
+        self._cache = {}
+
+    def _memo(self, key, fn):
+        if key not in self._cache:
+            self._cache[key] = fn()
+        return self._cache[key]
+
+    @property
+    def spread_until_wide(self):
+        """Final ``spread_until`` decoded wide at the end cursor (the
+        piggyback-occupancy gauge input)."""
+        return self._memo(
+            "spread_until_wide",
+            lambda: swim._wide_timer_fields(self.final_state, self.params,
+                                            self.end_round)[1])
+
+    @property
+    def alive_here(self):
+        """Ground-truth liveness rows matching the (possibly local)
+        final carry: the full [N] vector single-device, this shard's
+        contiguous slice under sharding."""
+        def derive():
+            alive = self.world.alive_at(self.end_round)
+            if self.n_local is not None \
+                    and self.n_local != self.params.n_members:
+                return jax.lax.dynamic_slice_in_dim(alive, self.offset,
+                                                    self.n_local)
+            return alive
+        return self._memo("alive_here", derive)
+
+    @property
+    def last_tick_metrics(self):
+        """The final round's row of the wire-gauge inputs."""
+        return self._memo(
+            "last_tick_metrics",
+            lambda: {k: self.metrics[k][-1]
+                     for k in ("messages_gossip",) if k in self.metrics})
+
+
+# --------------------------------------------------------------------------
+# The scan drivers
+# --------------------------------------------------------------------------
+
+
+def _apply_planes(planes, rc: RoundCtx, slices) -> Tuple:
+    """One round's plane folds, in stack order, publishing each plane's
+    before/after slice into the ctx for cross-plane reads."""
+    out = []
+    for plane, sl in zip(planes, slices):
+        rc._plane_prev[plane.name] = sl
+        new_sl = plane.on_round(rc, sl)
+        rc._plane_new[plane.name] = new_sl
+        out.append(new_sl)
+    return tuple(out)
+
+
+def _finalize_planes(planes, fc: FinalCtx, slices) -> dict:
+    return {plane.name: plane.finalize(fc, sl)
+            for plane, sl in zip(planes, slices)}
+
+
+def composed_scan(base_key, params: "swim.SwimParams",
+                  world: "swim.SwimWorld", n_rounds: int, planes=(),
+                  state: Optional["swim.SwimState"] = None,
+                  start_round: int = 0,
+                  knobs: Optional["swim.Knobs"] = None, shift_key=None):
+    """Scan the SWIM tick over ``n_rounds`` with ``planes`` riding the
+    carry — the ONE single-device scan body behind run / run_traced /
+    run_metered / run_monitored / run_monitored_metered and
+    :func:`run_composed`.
+
+    Round fusion (``params.rounds_per_step``) is honored exactly like
+    the pre-compose entries: planes without a fused hook fold once per
+    tick inside the fused body; a ``fused`` plane's per-round outputs
+    are stacked and handed to its ``on_step`` once per scan step (the
+    trace plane's single batched event scatter) — bit-identical to the
+    per-round path for any K (``swim._fused_scan`` docstring).
+
+    Returns ``(final_state, {plane name: finalized slice}, metrics)``.
+    """
+    kn = knobs if knobs is not None else swim.Knobs.from_params(params)
+    if state is None:
+        state = swim.initial_state(params, world)
+    slices = tuple(p.init(params, world) for p in planes)
+
+    def tick(carry, round_idx):
+        st, pcs = carry
+        new_st, m = swim.swim_tick(st, round_idx, base_key, params, world,
+                                   knobs=kn, shift_key=shift_key)
+        rc = RoundCtx(params, world, kn, round_idx, st, new_st, m)
+        return (new_st, _apply_planes(planes, rc, pcs)), m
+
+    k = params.rounds_per_step
+    fused_body = None
+    if k > 1 and any(getattr(p, "fused", False) for p in planes):
+        def fused_body(carry, rounds_k):
+            # K ticks with per-round plane folds, but each fused
+            # plane's record half batched ONCE per step — flattened
+            # round-major, bit-identical to K sequential folds
+            # (telemetry/trace.record_events_batch docstring).
+            st, pcs = carry
+            pcs = list(pcs)
+            ms = []
+            step_outs = {i: [] for i, p in enumerate(planes)
+                         if getattr(p, "fused", False)}
+            for j in range(k):
+                prev = st
+                st, m = swim.swim_tick(prev, rounds_k[j], base_key,
+                                       params, world, knobs=kn,
+                                       shift_key=shift_key)
+                rc = RoundCtx(params, world, kn, rounds_k[j], prev, st, m)
+                for i, plane in enumerate(planes):
+                    rc._plane_prev[plane.name] = pcs[i]
+                    if i in step_outs:
+                        pcs[i], out = plane.on_round_fused(rc, pcs[i])
+                        step_outs[i].append(out)
+                    else:
+                        pcs[i] = plane.on_round(rc, pcs[i])
+                    rc._plane_new[plane.name] = pcs[i]
+                ms.append(m)
+            for i, outs in step_outs.items():
+                stacked = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *outs)
+                pcs[i] = planes[i].on_step(rounds_k, pcs[i], stacked,
+                                           world)
+            return (st, tuple(pcs)), jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *ms)
+
+    (final_state, slices), metrics = swim._fused_scan(
+        tick, (state, slices), n_rounds, start_round, k,
+        fused_body=fused_body,
+    )
+    fc = FinalCtx(params, world, kn, start_round + n_rounds, final_state,
+                  metrics)
+    return final_state, _finalize_planes(planes, fc, slices), metrics
+
+
+def _pipelined_rounds(base_key, params: "swim.SwimParams",
+                      world: "swim.SwimWorld", state: "swim.SwimState",
+                      n_rounds: int, start_round, offset, axis: str,
+                      n_dev: int, on_round=None, carry0=None):
+    """Software-pipelined scatter round loop (runs INSIDE shard_map).
+
+    Round structure: scan body j combines + merges round j-1's carried
+    contribution (swim.swim_tick_recv) and then computes round j's
+    sends (swim.swim_tick_send); the first send runs as a prologue and
+    the last combine+merge as an epilogue.  The cross-device pmax of a
+    round therefore sits in the SAME program body as the next round's
+    state-independent draw compute (targets, drop masks, FD chains),
+    which is what lets XLA's latency-hiding scheduler run the ICI
+    transfer under it — in the serial body the pmax's only in-body
+    consumers follow it immediately, and an async collective pair
+    cannot span the scan iteration boundary.
+
+    Because delivery is already "send this round, listen next round"
+    (the merge is the tick's last phase), this is a scheduling change
+    only: outputs are BIT-IDENTICAL to the serial scan
+    (tests/test_pipelined_delivery.py), at the cost of double-buffering
+    one [N, K] contribution in the carry — a SINGLE packed-key buffer
+    under the fused wire (SwimParams.fused_wire, the default: the
+    ALIVE flags ride the key bits), the legacy key + int8 flag pair
+    under ``fused_wire=False``.
+
+    ``on_round(extra, prev_state, round_idx, new_state, metrics)`` is
+    the per-round observation hook (the composed plane folds), applied
+    after each round's merge with the round's OWN index and pre-merge
+    state — exactly the serial ordering; ``carry0`` is its initial
+    value.  Returns (final_state, extra, stacked metrics).
+    """
+    if n_rounds < 1:
+        raise ValueError("pipelined delivery needs n_rounds >= 1")
+
+    def send(st, r):
+        return swim.swim_tick_send(st, r, base_key, params, world,
+                                   offset=offset, axis_name=axis,
+                                   n_devices=n_dev)
+
+    def recv(st, pend, aux, r):
+        return swim.swim_tick_recv(st, pend, aux, r, base_key, params,
+                                   world, offset=offset, axis_name=axis,
+                                   n_devices=n_dev)
+
+    start = jnp.asarray(start_round, jnp.int32)
+    pending, send_aux = send(state, start)
+
+    def body(carry, round_idx):
+        st, pend, aux, extra = carry
+        new_st, metrics = recv(st, pend, aux, round_idx - 1)
+        if on_round is not None:
+            extra = on_round(extra, st, round_idx - 1, new_st, metrics)
+        new_pend, new_aux = send(new_st, round_idx)
+        return (new_st, new_pend, new_aux, extra), metrics
+
+    rounds = jnp.arange(1, n_rounds, dtype=jnp.int32) + start
+    (st, pend, aux, extra), ms = jax.lax.scan(
+        body, (state, pending, send_aux, carry0), rounds
+    )
+    last = start + jnp.int32(n_rounds - 1)
+    final_state, last_metrics = recv(st, pend, aux, last)
+    if on_round is not None:
+        extra = on_round(extra, st, last, final_state, last_metrics)
+    metrics = jax.tree.map(
+        lambda rows, tail: jnp.concatenate([rows, tail[None]], axis=0),
+        ms, last_metrics,
+    )
+    return final_state, extra, metrics
+
+
+def composed_shard_scan(base_key, params: "swim.SwimParams",
+                        world: "swim.SwimWorld",
+                        state: "swim.SwimState", n_rounds: int,
+                        start_round, offset, axis: str, n_dev: int,
+                        n_local: int, planes=(),
+                        use_pipeline: bool = False, lead=None):
+    """The row-sharded twin of :func:`composed_scan` — runs INSIDE
+    shard_map with this device's ``offset``/``n_local`` row slice,
+    driving either the serial fused scan or the software-pipelined
+    delivery loop (:func:`_pipelined_rounds`), with the plane folds
+    observing each round after its (possibly deferred) merge with the
+    SAME pre-merge state and round index the serial body sees — so
+    plane slices stay bit-identical across ``pipelined`` too.
+
+    ``lead`` is the sharded-dedup weight for psum-global tick counters
+    (telemetry/metrics.observe_tick) — the ctx carries it to every
+    plane.  Returns ``(final_state, {name: finalized}, metrics)``.
+    """
+    kn = swim.Knobs.from_params(params)
+    slices = tuple(p.init(params, world) for p in planes)
+
+    if use_pipeline:
+        def on_round(pcs, prev_st, round_idx, new_st, m):
+            rc = RoundCtx(params, world, kn, round_idx, prev_st, new_st,
+                          m, offset=offset, axis_name=axis, lead=lead)
+            return _apply_planes(planes, rc, pcs)
+
+        final_state, slices, metrics = _pipelined_rounds(
+            base_key, params, world, state, n_rounds, start_round,
+            offset, axis, n_dev,
+            on_round=on_round if planes else None, carry0=slices,
+        )
+    else:
+        def body(carry, round_idx):
+            st, pcs = carry
+            new_st, m = swim.swim_tick(
+                st, round_idx, base_key, params, world,
+                offset=offset, axis_name=axis, n_devices=n_dev,
+            )
+            rc = RoundCtx(params, world, kn, round_idx, st, new_st, m,
+                          offset=offset, axis_name=axis, lead=lead)
+            return (new_st, _apply_planes(planes, rc, pcs)), m
+
+        # _fused_scan honors params.rounds_per_step (bit-identical for
+        # any K; k == 1 is the classic per-round scan) — the pipelined
+        # path declares fusion unsupported instead
+        # (swim.pipelined_delivery_unsupported_reason), so auto-select
+        # falls back to this body when both knobs are on.
+        (final_state, slices), metrics = swim._fused_scan(
+            body, (state, slices), n_rounds, start_round,
+            params.rounds_per_step,
+        )
+
+    fc = FinalCtx(params, world, kn, start_round + n_rounds, final_state,
+                  metrics, offset=offset, axis_name=axis, n_local=n_local)
+    return final_state, _finalize_planes(planes, fc, slices), metrics
+
+
+# --------------------------------------------------------------------------
+# The full instrumented stack in ONE program
+# --------------------------------------------------------------------------
+
+
+def build_stack(with_trace: bool, with_metrics: bool, with_monitor: bool,
+                monitor_spec=None, trace_capacity=None, metrics_spec=None,
+                monitor_capacity=None, telemetry=None, metrics_state=None,
+                monitor=None):
+    """The observer-plane stack of :func:`run_composed`, in canonical
+    order (monitor before metrics, so the metered chaos_violations
+    counter can read the monitor's per-round count delta)."""
+    planes = []
+    if with_trace:
+        from scalecube_cluster_tpu.telemetry import trace as ttrace
+
+        planes.append(ttrace.TracePlane(
+            capacity=(ttrace.DEFAULT_CAPACITY if trace_capacity is None
+                      else trace_capacity),
+            telemetry=telemetry,
+        ))
+    if with_monitor:
+        from scalecube_cluster_tpu.chaos import monitor as cmonitor
+
+        if monitor_spec is None:
+            raise ValueError(
+                "run_composed(with_monitor=True) needs monitor_spec (use "
+                "chaos.monitor.MonitorSpec.passive(params) for the "
+                "safety-only checks)")
+        planes.append(cmonitor.MonitorPlane(
+            monitor_spec,
+            capacity=(cmonitor.DEFAULT_CAPACITY if monitor_capacity is None
+                      else monitor_capacity),
+            monitor=monitor,
+        ))
+    if with_metrics:
+        from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+
+        planes.append(tmetrics.MetricsPlane(
+            (tmetrics.MetricsSpec.default() if metrics_spec is None
+             else metrics_spec),
+            metrics_state=metrics_state,
+            chaos_from="monitor" if with_monitor else None,
+        ))
+    return tuple(planes)
+
+
+@partial(jax.jit,
+         static_argnames=("params", "n_rounds", "with_trace",
+                          "with_metrics", "with_monitor", "trace_capacity",
+                          "metrics_spec", "monitor_capacity"),
+         donate_argnames=("state",))
+def run_composed(base_key, params: "swim.SwimParams",
+                 world: "swim.SwimWorld", n_rounds: int,
+                 monitor_spec=None, with_trace: bool = True,
+                 with_metrics: bool = True, with_monitor: bool = True,
+                 trace_capacity: Optional[int] = None,
+                 metrics_spec=None, monitor_capacity: Optional[int] = None,
+                 state: Optional["swim.SwimState"] = None,
+                 start_round: int = 0,
+                 knobs: Optional["swim.Knobs"] = None, shift_key=None,
+                 telemetry=None, metrics_state=None, monitor=None):
+    """The FULL instrumented stack in one compiled program and one scan:
+    event trace ⊕ invariant monitor ⊕ health-metrics registry riding
+    the protocol scan together, sharing one :class:`RoundCtx` per
+    round.
+
+    Pre-compose, this took THREE separate entry points — run_traced +
+    run_metered + run_monitored — i.e. three XLA programs and three
+    full passes over the rounds, each re-deriving the per-round live
+    masks, status-change gates and wide decodes (``bench.py --compose``
+    measures the gap; the protocol state and each plane's output are
+    bit-identical to the corresponding single-plane alias, pinned by
+    tests/test_compose.py).
+
+    ``with_*`` (static) toggle planes; resume slices thread through
+    ``telemetry``/``metrics_state``/``monitor`` exactly like the
+    aliases' arguments.  ``state`` is DONATED (the swim.run contract);
+    plane slices are not.  Returns ``(final_state, results, metrics)``
+    where ``results`` maps each enabled plane's name to its finalized
+    slice (``results["trace"]`` etc.).
+    """
+    stack = build_stack(
+        with_trace, with_metrics, with_monitor,
+        monitor_spec=monitor_spec, trace_capacity=trace_capacity,
+        metrics_spec=metrics_spec, monitor_capacity=monitor_capacity,
+        telemetry=telemetry, metrics_state=metrics_state, monitor=monitor,
+    )
+    return composed_scan(base_key, params, world, n_rounds, planes=stack,
+                         state=state, start_round=start_round, knobs=knobs,
+                         shift_key=shift_key)
+
+
+# --------------------------------------------------------------------------
+# The plane inventory (observer planes + the in-tick planes)
+# --------------------------------------------------------------------------
+
+# The protocol core and the knob-gated in-tick planes, declared here so
+# one registry lists EVERY plane with its knob gate and carry lanes
+# (tests/test_compose.py pins knob/lane names against the real
+# dataclasses; models/sync.py and models/lifeguard.py declare their own
+# rows as plain PLANE dicts, collected below).
+_CORE_PLANES = (
+    dict(name="protocol", kind="core", knobs=(), lanes=(
+        "status", "inc", "spread_until", "suspect_deadline", "self_inc"),
+        doc="the SWIM tick itself (models/swim.swim_tick)"),
+    dict(name="delay", kind="in-tick", knobs=("max_delay_rounds",),
+         lanes=("inbox_ring", "flag_ring"),
+         doc="delayed-delivery rings (0 = same-round-or-lost)"),
+    dict(name="user_gossip", kind="in-tick", knobs=("n_user_gossips",),
+         lanes=("g_infected", "g_spread_until", "g_ring"),
+         doc="user-payload gossip riding the membership channels"),
+    dict(name="open_world", kind="in-tick",
+         knobs=("open_world", "epoch_guard"), lanes=("epoch",),
+         doc="JOIN admission into recycled slots, identity-epoch lane"),
+)
+
+_OBSERVER_PLANES = (
+    dict(name="trace", kind="observer", knobs=(), lanes=(),
+         doc="membership event trace (telemetry/trace.TracePlane)"),
+    dict(name="monitor", kind="observer", knobs=(), lanes=(),
+         doc="in-jit invariant monitor (chaos/monitor.MonitorPlane)"),
+    dict(name="metrics", kind="observer", knobs=(), lanes=(),
+         doc="health-metrics registry (telemetry/metrics.MetricsPlane)"),
+)
+
+
+def plane_registry() -> Tuple[dict, ...]:
+    """Every plane the composed runner knows: the protocol core, the
+    knob-gated in-tick planes (incl. the rows models/sync.py and
+    models/lifeguard.py declare for themselves) and the observer
+    planes — name, kind, gating knobs, SwimState carry lanes."""
+    from scalecube_cluster_tpu.models import lifeguard, sync
+
+    return _CORE_PLANES[:1] + (dict(sync.PLANE), dict(lifeguard.PLANE)) \
+        + _CORE_PLANES[1:] + _OBSERVER_PLANES

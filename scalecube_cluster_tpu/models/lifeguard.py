@@ -68,6 +68,17 @@ from scalecube_cluster_tpu import swim_math
 # base tick's draws (the lhm_max=0 bit-identity contract).
 _PROBE_GATE_FOLD = 0x11F6
 
+# This module's row in the composed-runner plane inventory
+# (models/compose.plane_registry): an IN-TICK plane gated by lhm_max,
+# carrying the [N] LHM lane inside SwimState.  A plain dict (no
+# compose import: swim imports this module, compose imports swim).
+PLANE = dict(
+    name="lifeguard", kind="in-tick",
+    knobs=("lhm_max", "dead_suppress_rounds"), lanes=("lhm",),
+    doc="Local Health Multiplier lane driving LHA Probe/Suspicion and "
+        "the buddy refute path (+ the dead-member suppression window)",
+)
+
 
 def initial_lhm(params) -> jnp.ndarray:
     """The carry lane: all-healthy (1) when the plane is on, a

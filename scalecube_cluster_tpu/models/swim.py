@@ -3973,43 +3973,23 @@ def run_metered(base_key, params: SwimParams, world: SwimWorld,
     driver); like ``state`` it is DONATED — don't reuse either after
     the call.  Rounds fuse per ``params.rounds_per_step`` exactly like
     ``run``.
+
+    Thin alias over the composed plane runner
+    (models/compose.composed_scan with a single
+    ``telemetry.metrics.MetricsPlane``); the scan body lives there.
     """
+    from scalecube_cluster_tpu.models import compose
     from scalecube_cluster_tpu.telemetry import metrics as telemetry_metrics
 
     if spec is None:
         spec = telemetry_metrics.MetricsSpec.default()
-    kn = knobs if knobs is not None else Knobs.from_params(params)
-    if state is None:
-        state = initial_state(params, world)
-    if metrics_state is None:
-        metrics_state = telemetry_metrics.MetricsState.init(spec)
-
-    def tick(carry, round_idx):
-        st, ms = carry
-        prev_status = st.status
-        prev_deadline, _ = _wide_timer_fields(st, params, round_idx)
-        new_st, m = swim_tick(st, round_idx, base_key, params, world,
-                              knobs=kn, shift_key=shift_key)
-        ms = telemetry_metrics.observe_tick(
-            ms, spec, params, kn, round_idx, prev_status, prev_deadline,
-            new_st.status, m, world,
-        )
-        return (new_st, ms), m
-
-    (final_state, ms), metrics = _fused_scan(
-        tick, (state, metrics_state), n_rounds, start_round,
-        params.rounds_per_step,
+    plane = telemetry_metrics.MetricsPlane(spec,
+                                           metrics_state=metrics_state)
+    final_state, results, metrics = compose.composed_scan(
+        base_key, params, world, n_rounds, planes=(plane,), state=state,
+        start_round=start_round, knobs=knobs, shift_key=shift_key,
     )
-    end = start_round + n_rounds
-    _, spread_wide = _wide_timer_fields(final_state, params, end)
-    ms = telemetry_metrics.sample_gauges(
-        ms, spec, params, kn, final_state.status, spread_wide,
-        world.alive_at(end), end, world,
-        last_tick_metrics={k: metrics[k][-1]
-                           for k in ("messages_gossip",) if k in metrics},
-        lhm=final_state.lhm if params.lhm_max > 0 else None,
-    )
-    return final_state, ms, metrics
+    return final_state, results["metrics"], metrics
 
 
 def _fused_scan(tick, carry, n_rounds: int, start_round, k: int,
@@ -4081,16 +4061,18 @@ def run(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
     object after passing it here — current XLA donates on CPU too, and
     the input buffers really are gone.  Need the previous carry?  Take
     a host snapshot first (``jax.device_get(state)``).
+
+    Thin alias over the composed plane runner
+    (models/compose.composed_scan with an empty plane stack); the scan
+    body lives there.
     """
-    if state is None:
-        state = initial_state(params, world)
+    from scalecube_cluster_tpu.models import compose
 
-    def tick(carry, round_idx):
-        return swim_tick(carry, round_idx, base_key, params, world,
-                         knobs=knobs, shift_key=shift_key)
-
-    return _fused_scan(tick, state, n_rounds, start_round,
-                       params.rounds_per_step)
+    final_state, _, metrics = compose.composed_scan(
+        base_key, params, world, n_rounds, planes=(), state=state,
+        start_round=start_round, knobs=knobs, shift_key=shift_key,
+    )
+    return final_state, metrics
 
 
 @partial(jax.jit, static_argnames=("params", "n_rounds", "trace_capacity"),
@@ -4121,63 +4103,19 @@ def run_traced(base_key, params: SwimParams, world: SwimWorld, n_rounds: int,
     ``telemetry.sink.stream_traced_run`` drives this in segments with
     the device→host trace offload overlapped against the next segment's
     compute.
+
+    Thin alias over the composed plane runner
+    (models/compose.composed_scan with a single
+    ``telemetry.trace.TracePlane`` — its fused-step hook batches the
+    event record exactly like the pre-compose body); the scan body
+    lives there.
     """
-    if state is None:
-        state = initial_state(params, world)
-    if telemetry is None:
-        telemetry = telemetry_trace.TelemetryState.init(
-            params.n_members, params.n_subjects, trace_capacity
-        )
+    from scalecube_cluster_tpu.models import compose
 
-    prev_ep_of = (lambda st: st.epoch) if params.epoch_bits else \
-        (lambda st: None)
-
-    def tick(carry, round_idx):
-        st, tel = carry
-        prev_status, prev_inc = st.status, st.inc
-        prev_epoch = prev_ep_of(st)
-        new_st, metrics = swim_tick(st, round_idx, base_key, params, world,
-                                    knobs=knobs, shift_key=shift_key)
-        tel = telemetry_trace.observe_round(
-            tel, round_idx, prev_status, prev_inc, new_st, world,
-            prev_epoch=prev_epoch,
-        )
-        return (new_st, tel), metrics
-
-    def fused_body(carry, rounds_k):
-        # K ticks, per-round code derivation + first-round updates, but
-        # ONE batched event record (cumsum + scatter) for the whole
-        # step — flattened round-major, so lanes/count/dropped are
-        # bit-identical to K sequential observe_round calls
-        # (telemetry_trace.record_events_batch docstring).
-        st, tel = carry
-        ms, codes_l, inc_l = [], [], []
-        for j in range(params.rounds_per_step):
-            prev_status, prev_inc = st.status, st.inc
-            prev_epoch = prev_ep_of(st)
-            st, m = swim_tick(st, rounds_k[j], base_key, params, world,
-                              knobs=knobs, shift_key=shift_key)
-            tel, codes, ev_inc = telemetry_trace.observe_round_codes(
-                tel, rounds_k[j], prev_status, prev_inc, st, world,
-                prev_epoch=prev_epoch,
-            )
-            ms.append(m)
-            codes_l.append(codes)
-            inc_l.append(ev_inc)
-        trace = telemetry_trace.record_events_batch(
-            tel.trace, rounds_k, jnp.stack(codes_l), jnp.stack(inc_l),
-            world.subject_ids,
-        )
-        tel = telemetry_trace.TelemetryState(
-            trace=trace, first_suspect=tel.first_suspect,
-            first_removed=tel.first_removed,
-        )
-        return (st, tel), jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs), *ms
-        )
-
-    (final_state, telemetry), metrics = _fused_scan(
-        tick, (state, telemetry), n_rounds, start_round,
-        params.rounds_per_step, fused_body=fused_body,
+    plane = telemetry_trace.TracePlane(capacity=trace_capacity,
+                                       telemetry=telemetry)
+    final_state, results, metrics = compose.composed_scan(
+        base_key, params, world, n_rounds, planes=(plane,), state=state,
+        start_round=start_round, knobs=knobs, shift_key=shift_key,
     )
-    return final_state, telemetry, metrics
+    return final_state, results["trace"], metrics

@@ -121,6 +121,19 @@ from scalecube_cluster_tpu import records
 _OFFSET_FOLD = 0x53CA
 _DROP_FOLD = 41
 
+# This module's row in the composed-runner plane inventory
+# (models/compose.plane_registry): an IN-TICK plane — compiled into
+# ``swim_tick`` by its knob, no extra carry lane (the exchange rides
+# the protocol's own status/inc lanes and wire buffers).  A plain dict
+# (no compose import: swim imports this module, compose imports swim).
+PLANE = dict(
+    name="sync", kind="in-tick", knobs=("sync_interval", "sync_every"),
+    lanes=(),
+    doc="anti-entropy full-table exchange for partition heal "
+        "(sync_interval > 0 arms it; sync_every is the reference's "
+        "per-round push channel)",
+)
+
 
 def due(round_idx, sync_interval: int):
     """Is ``round_idx`` an anti-entropy exchange round?

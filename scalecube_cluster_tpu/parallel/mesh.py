@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.models import compose, swim
 from scalecube_cluster_tpu.parallel import compat
 
 NODE_AXIS = "nodes"
@@ -129,75 +129,64 @@ def _resolve_pipelined(pipelined: Optional[bool], params: swim.SwimParams,
     return False
 
 
-def _pipelined_rounds(base_key, params: swim.SwimParams,
-                      world: swim.SwimWorld, state: swim.SwimState,
-                      n_rounds: int, start_round, offset, axis: str,
-                      n_dev: int, on_round=None, carry0=None):
-    """Software-pipelined scatter round loop (runs INSIDE shard_map).
+# The software-pipelined delivery loop lives with the other scan
+# drivers in models/compose.py; re-exported under the historical name.
+_pipelined_rounds = compose._pipelined_rounds
 
-    Round structure: scan body j combines + merges round j-1's carried
-    contribution (swim.swim_tick_recv) and then computes round j's
-    sends (swim.swim_tick_send); the first send runs as a prologue and
-    the last combine+merge as an epilogue.  The cross-device pmax of a
-    round therefore sits in the SAME program body as the next round's
-    state-independent draw compute (targets, drop masks, FD chains),
-    which is what lets XLA's latency-hiding scheduler run the ICI
-    transfer under it — in the serial body the pmax's only in-body
-    consumers follow it immediately, and an async collective pair
-    cannot span the scan iteration boundary.
 
-    Because delivery is already "send this round, listen next round"
-    (the merge is the tick's last phase), this is a scheduling change
-    only: outputs are BIT-IDENTICAL to the serial scan
-    (tests/test_pipelined_delivery.py), at the cost of double-buffering
-    one [N, K] contribution in the carry — a SINGLE packed-key buffer
-    under the fused wire (SwimParams.fused_wire, the default: the
-    ALIVE flags ride the key bits), the legacy key + int8 flag pair
-    under ``fused_wire=False``.
+def _composed_shard_run(base_key, params: swim.SwimParams,
+                        world: swim.SwimWorld, n_rounds: int, mesh: Mesh,
+                        state, start_round, pipelined, spec):
+    """The ONE sharded run body behind :func:`shard_run` and
+    :func:`shard_run_metered` (their world-spec / shard_map plumbing
+    was the last spec/decode twin block CHANGES.md flagged): resolve
+    the prelude + pipeline choice, then hand the per-device row slice
+    to the composed plane runner
+    (models/compose.composed_shard_scan).  ``spec`` None = no planes
+    (shard_run); a MetricsSpec = one sharded MetricsPlane
+    (shard_run_metered)."""
+    from scalecube_cluster_tpu.telemetry import metrics as tmetrics
 
-    ``on_round(extra, prev_state, round_idx, new_state, metrics)`` is
-    the per-round observation hook (the metered twin's registry fold),
-    applied after each round's merge with the round's OWN index and
-    pre-merge state — exactly the serial ordering; ``carry0`` is its
-    initial value.  Returns (final_state, extra, stacked metrics).
-    """
-    if n_rounds < 1:
-        raise ValueError("pipelined delivery needs n_rounds >= 1")
-
-    def send(st, r):
-        return swim.swim_tick_send(st, r, base_key, params, world,
-                                   offset=offset, axis_name=axis,
-                                   n_devices=n_dev)
-
-    def recv(st, pend, aux, r):
-        return swim.swim_tick_recv(st, pend, aux, r, base_key, params,
-                                   world, offset=offset, axis_name=axis,
-                                   n_devices=n_dev)
-
-    start = jnp.asarray(start_round, jnp.int32)
-    pending, send_aux = send(state, start)
-
-    def body(carry, round_idx):
-        st, pend, aux, extra = carry
-        new_st, metrics = recv(st, pend, aux, round_idx - 1)
-        if on_round is not None:
-            extra = on_round(extra, st, round_idx - 1, new_st, metrics)
-        new_pend, new_aux = send(new_st, round_idx)
-        return (new_st, new_pend, new_aux, extra), metrics
-
-    rounds = jnp.arange(1, n_rounds, dtype=jnp.int32) + start
-    (st, pend, aux, extra), ms = jax.lax.scan(
-        body, (state, pending, send_aux, carry0), rounds
+    axis, n_dev, n_local, state_specs, out_metric_specs = _shard_prelude(
+        params, mesh
     )
-    last = start + jnp.int32(n_rounds - 1)
-    final_state, last_metrics = recv(st, pend, aux, last)
-    if on_round is not None:
-        extra = on_round(extra, st, last, final_state, last_metrics)
-    metrics = jax.tree.map(
-        lambda rows, tail: jnp.concatenate([rows, tail[None]], axis=0),
-        ms, last_metrics,
-    )
-    return final_state, extra, metrics
+    use_pipeline = _resolve_pipelined(pipelined, params, world, n_rounds)
+    metered = spec is not None
+
+    if state is None:
+        state = swim.initial_state(params, world)
+    world_specs = jax.tree.map(lambda _: P(), world)
+    ms0 = tmetrics.MetricsState.init(spec) if metered else None
+    ms_specs = jax.tree.map(lambda _: P(), ms0) if metered else None
+
+    def sharded_body(base_key, world, state, *ms_args):
+        offset = jax.lax.axis_index(axis) * n_local
+        planes = ()
+        lead = None
+        if metered:
+            lead = (jax.lax.axis_index(axis) == 0).astype(jnp.int32)
+            planes = (tmetrics.MetricsPlane(spec,
+                                            metrics_state=ms_args[0]),)
+        final_state, results, metrics = compose.composed_shard_scan(
+            base_key, params, world, state, n_rounds, start_round,
+            offset, axis, n_dev, n_local, planes=planes,
+            use_pipeline=use_pipeline, lead=lead,
+        )
+        if metered:
+            return final_state, results["metrics"], metrics
+        return final_state, metrics
+
+    in_specs = (P(), world_specs, state_specs) \
+        + ((ms_specs,) if metered else ())
+    out_specs = ((state_specs, ms_specs, out_metric_specs) if metered
+                 else (state_specs, out_metric_specs))
+    return compat.shard_map(
+        sharded_body,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_replication=False,
+    )(base_key, world, state, *((ms0,) if metered else ()))
 
 
 @partial(jax.jit, static_argnames=("params", "n_rounds", "mesh", "pipelined"))
@@ -222,47 +211,13 @@ def shard_run(base_key, params: swim.SwimParams, world: swim.SwimWorld,
 
     Returns (final_state, metrics) with state rows sharded over the mesh
     and metrics replicated (already psum-combined inside the tick).
+
+    Thin alias over the composed plane runner
+    (models/compose.composed_shard_scan, via ``_composed_shard_run``);
+    the scan body lives there.
     """
-    axis, n_dev, n_local, state_specs, out_metric_specs = _shard_prelude(
-        params, mesh
-    )
-    use_pipeline = _resolve_pipelined(pipelined, params, world, n_rounds)
-
-    if state is None:
-        state = swim.initial_state(params, world)
-    world_specs = jax.tree.map(lambda _: P(), world)
-
-    def sharded_body(base_key, world, state):
-        offset = jax.lax.axis_index(axis) * n_local
-
-        if use_pipeline:
-            final_state, _, metrics = _pipelined_rounds(
-                base_key, params, world, state, n_rounds, start_round,
-                offset, axis, n_dev,
-            )
-            return final_state, metrics
-
-        def body(carry, round_idx):
-            return swim.swim_tick(
-                carry, round_idx, base_key, params, world,
-                offset=offset, axis_name=axis, n_devices=n_dev,
-            )
-
-        # _fused_scan honors params.rounds_per_step (bit-identical for
-        # any K; k == 1 is the classic per-round scan) — the pipelined
-        # path declares fusion unsupported instead
-        # (swim.pipelined_delivery_unsupported_reason), so auto-select
-        # falls back to this body when both knobs are on.
-        return swim._fused_scan(body, state, n_rounds, start_round,
-                                params.rounds_per_step)
-
-    return compat.shard_map(
-        sharded_body,
-        mesh=mesh,
-        in_specs=(P(), world_specs, state_specs),
-        out_specs=(state_specs, out_metric_specs),
-        check_replication=False,
-    )(base_key, world, state)
+    return _composed_shard_run(base_key, params, world, n_rounds, mesh,
+                               state, start_round, pipelined, None)
 
 
 @partial(jax.jit, static_argnames=("params", "n_rounds", "mesh", "spec",
@@ -287,84 +242,19 @@ def shard_run_metered(base_key, params: swim.SwimParams,
     assembled from psum'd numerators and come back replicated.
 
     ``pipelined``: same contract as :func:`shard_run` — the registry
-    hook observes each round after its (deferred) merge with the same
+    plane observes each round after its (deferred) merge with the same
     pre-merge state and round index the serial body sees, so the
     registry totals stay bit-identical too.
 
     Returns ``(final_state, metrics_state, metrics)`` with the state
     rows sharded, the registry and metrics replicated.
+
+    Thin alias over the composed plane runner (one sharded
+    ``telemetry.metrics.MetricsPlane``); the scan body lives there.
     """
     from scalecube_cluster_tpu.telemetry import metrics as tmetrics
 
     if spec is None:
         spec = tmetrics.MetricsSpec.default()
-    axis, n_dev, n_local, state_specs, out_metric_specs = _shard_prelude(
-        params, mesh
-    )
-    use_pipeline = _resolve_pipelined(pipelined, params, world, n_rounds)
-    kn = swim.Knobs.from_params(params)
-
-    if state is None:
-        state = swim.initial_state(params, world)
-    ms0 = tmetrics.MetricsState.init(spec)
-
-    world_specs = jax.tree.map(lambda _: P(), world)
-    ms_specs = jax.tree.map(lambda _: P(), ms0)
-
-    def sharded_body(base_key, world, state, ms):
-        offset = jax.lax.axis_index(axis) * n_local
-        lead = (jax.lax.axis_index(axis) == 0).astype(jnp.int32)
-
-        def observe(ms, prev_st, round_idx, new_st, m):
-            prev_deadline, _ = swim._wide_timer_fields(prev_st, params,
-                                                       round_idx)
-            return tmetrics.observe_tick(
-                ms, spec, params, kn, round_idx, prev_st.status,
-                prev_deadline, new_st.status, m, world, lead=lead,
-            )
-
-        if use_pipeline:
-            final_state, ms, metrics = _pipelined_rounds(
-                base_key, params, world, state, n_rounds, start_round,
-                offset, axis, n_dev, on_round=observe, carry0=ms,
-            )
-        else:
-            def body(carry, round_idx):
-                st, ms = carry
-                new_st, m = swim.swim_tick(
-                    st, round_idx, base_key, params, world,
-                    offset=offset, axis_name=axis, n_devices=n_dev,
-                )
-                ms = observe(ms, st, round_idx, new_st, m)
-                return (new_st, ms), m
-
-            # rounds_per_step rides the same _fused_scan as the
-            # unmetered body (bit-identical for any K).
-            (final_state, ms), metrics = swim._fused_scan(
-                body, (state, ms), n_rounds, start_round,
-                params.rounds_per_step,
-            )
-        end = start_round + n_rounds
-        _, spread_wide = swim._wide_timer_fields(final_state, params, end)
-        alive_here = jax.lax.dynamic_slice_in_dim(
-            world.alive_at(end), offset, n_local
-        )
-        ms = tmetrics.sample_gauges(
-            ms, spec, params, kn, final_state.status, spread_wide,
-            alive_here, end, world,
-            last_tick_metrics={k: metrics[k][-1]
-                               for k in ("messages_gossip",)
-                               if k in metrics},
-            axis_name=axis,
-            lhm=final_state.lhm if params.lhm_max > 0 else None,
-        )
-        ms = tmetrics.aggregate_across_devices(ms, axis)
-        return final_state, ms, metrics
-
-    return compat.shard_map(
-        sharded_body,
-        mesh=mesh,
-        in_specs=(P(), world_specs, state_specs, ms_specs),
-        out_specs=(state_specs, ms_specs, out_metric_specs),
-        check_replication=False,
-    )(base_key, world, state, ms0)
+    return _composed_shard_run(base_key, params, world, n_rounds, mesh,
+                               state, start_round, pipelined, spec)

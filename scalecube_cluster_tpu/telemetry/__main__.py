@@ -91,6 +91,7 @@ def _cmd_regress(args) -> int:
             os.path.join("artifacts", "churn_growth*.json"),
             os.path.join("artifacts", "fuzz_campaign*.json"),
             os.path.join("artifacts", "wire_fused*.json"),
+            os.path.join("artifacts", "compose_perf*.json"),
             os.path.join("artifacts", "static_analysis*.json")])
     readable = [p for p in paths if os.path.exists(p)]
     if not readable:
@@ -139,6 +140,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "artifacts/churn_growth*.json "
                         "artifacts/fuzz_campaign*.json "
                         "artifacts/wire_fused*.json "
+                        "artifacts/compose_perf*.json "
                         "artifacts/static_analysis*.json)")
     p.add_argument("--band", type=float, default=query.DEFAULT_NOISE_BAND,
                    help="relative noise band (default 0.10)")

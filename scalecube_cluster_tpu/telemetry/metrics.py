@@ -265,7 +265,8 @@ def reset_window(ms: MetricsState) -> MetricsState:
 
 def observe_tick(ms: MetricsState, spec: MetricsSpec, params, kn,
                  round_idx, prev_status, prev_deadline, new_status,
-                 tick_metrics, world, lead=None) -> MetricsState:
+                 tick_metrics, world, lead=None, alive_now=None,
+                 any_status_change=None) -> MetricsState:
     """Fold one tick's health signals into the registry.
 
     ``prev_status``/``prev_deadline`` are the carry fields BEFORE the
@@ -275,6 +276,13 @@ def observe_tick(ms: MetricsState, spec: MetricsSpec, params, kn,
     sharded-dedup weight for global quantities — 1 on the lead device,
     0 elsewhere, None (=1) on a single device — so the end-of-run
     registry psum (:func:`aggregate_across_devices`) counts them once.
+
+    ``alive_now`` / ``any_status_change``: precomputed
+    ``world.alive_at(round_idx)`` and ``any(prev != new)`` from the
+    composed runner's shared round context (models/compose.RoundCtx) —
+    the same values this function would derive itself, handed in so a
+    multi-plane stack pays each reduction once; None recomputes them
+    (identical bits either way).
 
     Counter adds are a fused delta-vector add; the suspicion-transition
     block (onset/refute/fire counters + the lifetime histogram, the
@@ -300,8 +308,10 @@ def observe_tick(ms: MetricsState, spec: MetricsSpec, params, kn,
         if name in spec.counters and key in tick_metrics:
             updates[name] = total(tick_metrics[key]) * lead_w
     if "live_observer_rounds" in spec.counters:
+        alive = (world.alive_at(round_idx) if alive_now is None
+                 else alive_now)
         updates["live_observer_rounds"] = (
-            jnp.sum(world.alive_at(round_idx), dtype=jnp.int32) * lead_w
+            jnp.sum(alive, dtype=jnp.int32) * lead_w
         )
     if (getattr(params, "open_world", False)
             and "joins_admitted" in spec.counters):
@@ -362,8 +372,9 @@ def observe_tick(ms: MetricsState, spec: MetricsSpec, params, kn,
                         had_timer)
         return m
 
-    return jax.lax.cond(jnp.any(prev_status != new_status), active,
-                        lambda m: m, ms)
+    changed = (jnp.any(prev_status != new_status)
+               if any_status_change is None else any_status_change)
+    return jax.lax.cond(changed, active, lambda m: m, ms)
 
 
 def sample_gauges(ms: MetricsState, spec: MetricsSpec, params, kn,
@@ -452,6 +463,66 @@ def aggregate_across_devices(ms: MetricsState,
         counters=compat.psum_tree(ms.counters, axis_name),
         hists=compat.psum_tree(ms.hists, axis_name),
     )
+
+
+# --------------------------------------------------------------------------
+# The compose() plane
+# --------------------------------------------------------------------------
+
+
+class MetricsPlane:
+    """The health-metrics registry as a composed-runner plane
+    (models/compose.py): carry slice = :class:`MetricsState`, per-round
+    hook = :func:`observe_tick` over the shared round context,
+    finalizer = the end-of-run :func:`sample_gauges` (+ the cross-mesh
+    registry psum under sharding) — exactly the pre-compose
+    ``run_metered`` / ``shard_run_metered`` / monitored-metered folds.
+
+    ``chaos_from`` names an earlier plane in the stack (the invariant
+    monitor) whose per-round ``code_counts`` delta feeds the
+    ``chaos_violations`` counter — the monitored-metered shape; None
+    leaves the counter untouched.  ``metrics_state`` resumes a registry
+    across windows (the ``run_metered(metrics_state=...)`` argument).
+    """
+
+    name = "metrics"
+
+    def __init__(self, spec: MetricsSpec, metrics_state=None,
+                 chaos_from: Optional[str] = None):
+        self.spec = spec
+        self.metrics_state = metrics_state
+        self.chaos_from = chaos_from
+
+    def init(self, params, world):
+        if self.metrics_state is not None:
+            return self.metrics_state
+        return MetricsState.init(self.spec)
+
+    def on_round(self, rc, ms):
+        ms = observe_tick(
+            ms, self.spec, rc.params, rc.kn, rc.round_idx,
+            rc.prev.status, rc.prev_deadline_wide, rc.new.status,
+            rc.metrics, rc.world, lead=rc.lead, alive_now=rc.alive_now,
+            any_status_change=rc.any_status_change,
+        )
+        if (self.chaos_from is not None
+                and "chaos_violations" in self.spec.counters):
+            before = rc.plane_before(self.chaos_from)
+            after = rc.plane_after(self.chaos_from)
+            ms = inc(ms, self.spec, "chaos_violations",
+                     jnp.sum(after.code_counts - before.code_counts,
+                             dtype=jnp.int32))
+        return ms
+
+    def finalize(self, fc, ms):
+        ms = sample_gauges(
+            ms, self.spec, fc.params, fc.kn, fc.final_state.status,
+            fc.spread_until_wide, fc.alive_here, fc.end_round, fc.world,
+            last_tick_metrics=fc.last_tick_metrics,
+            axis_name=fc.axis_name,
+            lhm=fc.final_state.lhm if fc.params.lhm_max > 0 else None,
+        )
+        return aggregate_across_devices(ms, fc.axis_name)
 
 
 # --------------------------------------------------------------------------

@@ -316,6 +316,7 @@ def load_bench_payload(path: str) -> Tuple[Optional[dict], Optional[str]]:
                      or "no_resurrection_violations" in payload
                      or "vmap_speedup_ratio" in payload
                      or "fused_serial_speedup_ratio" in payload
+                     or "compose_speedup_ratio" in payload
                      or "findings_total" in payload)):
             return None, stub_note
     return payload, None
@@ -364,6 +365,12 @@ def regress(paths: Sequence[str],
         planted violations with the healthy arm at 0 on the same
         slice, and (full rounds only) ``vmap_speedup_ratio`` >= 1 —
         plus the banded non-smoke ``scenario_throughput`` series;
+      - Composed-runner artifacts (``compose_speedup_ratio`` present,
+        bench.py --compose): absolute gates — the full instrumented
+        stack's one-scan route at least matches the alias-by-alias
+        route (ratio >= 1.0), its overhead vs bare stays within the
+        band of head-style's, the compile-count arm is strictly
+        reduced, and the alias parity probe was green;
       - swimlint artifacts (``findings_total`` present,
         ``python -m scalecube_cluster_tpu.analysis check``): absolute
         gates — ``findings_total`` == 0 (unsuppressed static-analysis
@@ -740,6 +747,47 @@ def regress(paths: Sequence[str],
                   parity, True, True,
                   parity.get("fused") is True
                   and parity.get("legacy") is True)
+        # Composed-runner artifacts (bench.py --compose): the full
+        # instrumented stack through ONE scan must never lose to the
+        # pre-compose alias-by-alias route.  ABSOLUTE gates on the
+        # latest round — ``compose_speedup_ratio`` (head-style seconds
+        # over composed seconds) >= 1.0 floor, the composed stack's
+        # instrumentation overhead no worse than head-style's beyond
+        # the band (both ratios share one host window, so the
+        # comparison is machine-independent), and the compile-count
+        # arm STRICTLY reduced (programs_composed < programs_head_
+        # style — one program per layout where the aliases pay three).
+        # The ratio gates apply to smoke rounds too (interleaved
+        # same-host ratios, the metrics_overhead_ratio convention);
+        # only the absolute rates are host-dependent provenance.
+        cp = [(p, pl) for p, pl in entries
+              if "compose_speedup_ratio" in pl]
+        if cp:
+            last_path, last = cp[-1]
+            ratio = last.get("compose_speedup_ratio")
+            check("slo/compose_speedup_ratio", last_path, ratio, 1.0,
+                  1.0, isinstance(ratio, (int, float))
+                  and math.isfinite(ratio) and ratio >= 1.0)
+            fso = last.get("full_stack_overhead_ratio")
+            hso = last.get("head_style_overhead_ratio")
+            limit = (hso * (1.0 + band)
+                     if isinstance(hso, (int, float)) else None)
+            check("slo/compose_full_stack_overhead", last_path, fso,
+                  hso, limit,
+                  isinstance(fso, (int, float))
+                  and isinstance(hso, (int, float))
+                  and math.isfinite(fso) and fso <= limit)
+            comp = last.get("compile") or {}
+            ph = comp.get("programs_head_style")
+            pc = comp.get("programs_composed")
+            check("slo/compose_compile_count_reduced", last_path, pc,
+                  ph, "strictly fewer",
+                  isinstance(ph, (int, float))
+                  and isinstance(pc, (int, float)) and 0 < pc < ph)
+            par = last.get("parity") or {}
+            check("slo/compose_alias_parity", last_path, par, True,
+                  True, bool(par) and all(v is True
+                                          for v in par.values()))
         # swimlint artifacts (python -m scalecube_cluster_tpu.analysis
         # check): ABSOLUTE — the committed static-analysis round must
         # be finding-free and self-reported ok.  findings_total counts

@@ -343,7 +343,8 @@ def update_first_rounds(tel: TelemetryState, codes,
 
 def observe_round_codes(tel: TelemetryState, round_idx, prev_status,
                         prev_inc, new_state, world,
-                        observer_offset: int = 0, prev_epoch=None):
+                        observer_offset: int = 0, prev_epoch=None,
+                        any_status_change=None):
     """(tel', codes, ev_inc) for one tick, with the WHOLE derivation +
     first-round update gated on a two-reduction predicate.
 
@@ -356,12 +357,18 @@ def observe_round_codes(tel: TelemetryState, round_idx, prev_status,
     instead of the full derivation.  The silent branch returns all-zero
     codes, which every consumer (record scatter, first-round updates)
     treats as the identity — bit-identical to the ungated path.
+
+    ``any_status_change``: the precomputed ``any(prev != new)`` scalar
+    from the composed runner's shared round context
+    (models/compose.RoundCtx) — the same value this function would
+    derive itself, handed in so a multi-plane stack pays the reduction
+    once; None recomputes it (the single-plane path, identical bits).
     """
     n = prev_status.shape[0]
     node_ids = jnp.arange(n, dtype=jnp.int32) + observer_offset
-    pred = jnp.any(prev_status != new_state.status) | jnp.any(
-        world.leave_at[node_ids] == round_idx
-    )
+    changed = (jnp.any(prev_status != new_state.status)
+               if any_status_change is None else any_status_change)
+    pred = changed | jnp.any(world.leave_at[node_ids] == round_idx)
     if prev_epoch is not None and jnp.asarray(prev_epoch).size:
         pred = pred | jnp.any(
             jnp.asarray(prev_epoch) != jnp.asarray(new_state.epoch))
@@ -382,7 +389,8 @@ def observe_round_codes(tel: TelemetryState, round_idx, prev_status,
 
 def observe_round(tel: TelemetryState, round_idx, prev_status, prev_inc,
                   new_state, world, observer_offset: int = 0,
-                  prev_epoch=None) -> TelemetryState:
+                  prev_epoch=None, any_status_change=None
+                  ) -> TelemetryState:
     """One round's telemetry update: derive transitions, record them,
     advance the first-suspect/first-removed matrices.
 
@@ -397,11 +405,75 @@ def observe_round(tel: TelemetryState, round_idx, prev_status, prev_inc,
     tel, codes, ev_inc = observe_round_codes(
         tel, round_idx, prev_status, prev_inc, new_state, world,
         observer_offset, prev_epoch=prev_epoch,
+        any_status_change=any_status_change,
     )
     trace = record_events(tel.trace, round_idx, codes, ev_inc,
                           world.subject_ids, observer_offset)
     return TelemetryState(trace=trace, first_suspect=tel.first_suspect,
                           first_removed=tel.first_removed)
+
+
+# --------------------------------------------------------------------------
+# The compose() plane
+# --------------------------------------------------------------------------
+
+
+class TracePlane:
+    """The membership event trace as a composed-runner plane
+    (models/compose.py): carry slice = :class:`TelemetryState`,
+    per-round hook = :func:`observe_round` reading the shared round
+    context, fused-step hook = ONE :func:`record_events_batch` scatter
+    per scan step (exactly the pre-compose ``run_traced`` fused body).
+
+    ``telemetry`` resumes an existing state across chunked scans (the
+    ``run_traced(telemetry=...)`` argument threads through here).
+    """
+
+    name = "trace"
+    fused = True
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, telemetry=None,
+                 observer_offset: int = 0):
+        self.capacity = capacity
+        self.telemetry = telemetry
+        self.observer_offset = observer_offset
+
+    def init(self, params, world):
+        if self.telemetry is not None:
+            return self.telemetry
+        return TelemetryState.init(params.n_members, params.n_subjects,
+                                   self.capacity)
+
+    def _prev_epoch(self, rc):
+        return rc.prev.epoch if rc.params.epoch_bits else None
+
+    def on_round(self, rc, tel):
+        return observe_round(
+            tel, rc.round_idx, rc.prev.status, rc.prev.inc, rc.new,
+            rc.world, observer_offset=self.observer_offset,
+            prev_epoch=self._prev_epoch(rc),
+            any_status_change=rc.any_status_change,
+        )
+
+    def on_round_fused(self, rc, tel):
+        tel, codes, ev_inc = observe_round_codes(
+            tel, rc.round_idx, rc.prev.status, rc.prev.inc, rc.new,
+            rc.world, self.observer_offset,
+            prev_epoch=self._prev_epoch(rc),
+            any_status_change=rc.any_status_change,
+        )
+        return tel, (codes, ev_inc)
+
+    def on_step(self, rounds_k, tel, stacked, world):
+        codes, ev_inc = stacked
+        trace = record_events_batch(tel.trace, rounds_k, codes, ev_inc,
+                                    world.subject_ids,
+                                    self.observer_offset)
+        return TelemetryState(trace=trace, first_suspect=tel.first_suspect,
+                              first_removed=tel.first_removed)
+
+    def finalize(self, fc, tel):
+        return tel
 
 
 # --------------------------------------------------------------------------

@@ -18,9 +18,12 @@ from typing import Dict
 # A structurally-faithful miniature of the real layering: SwimParams
 # knobs, a dispatcher (swim_tick) fanning into three sibling tick
 # bodies, the pipelined half pair sharing the dispatcher's preamble
-# (_round_context), and seven entry points across three modules.
+# (_round_context), the composed scan drivers (models/compose.py) and
+# seven THIN entry points across three modules delegating to them.
 MINI_SWIM = '''\
 import dataclasses
+
+from scalecube_cluster_tpu.models import compose
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,46 +69,61 @@ def swim_tick(state, params):
 
 
 def run(key, params, world, n_rounds):
-    return swim_tick(0, params)
+    return compose.composed_scan(key, params, world, n_rounds)
 
 
 def run_traced(key, params, world, n_rounds):
-    return swim_tick(0, params)
+    return compose.composed_scan(key, params, world, n_rounds)
 
 
 def run_metered(key, params, world, n_rounds):
-    return swim_tick(0, params)
+    return compose.composed_scan(key, params, world, n_rounds)
+'''
+
+MINI_COMPOSE = '''\
+from scalecube_cluster_tpu.models import swim
+
+
+def composed_scan(key, params, world, n_rounds, planes=()):
+    state = 0
+    for _ in range(n_rounds if isinstance(n_rounds, int) else 1):
+        state = swim.swim_tick(state, params)
+    return state
+
+
+def composed_shard_scan(key, params, world, n_rounds, planes=()):
+    pending = swim.swim_tick_send(0, params)
+    state = swim.swim_tick_recv(pending, params)
+    return swim.swim_tick(state, params)
 '''
 
 MINI_MONITOR = '''\
-from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.models import compose
 
 
 def run_monitored(key, params, world, n_rounds):
-    return swim.swim_tick(0, params)
+    return compose.composed_scan(key, params, world, n_rounds)
 
 
 def run_monitored_metered(key, params, world, n_rounds):
-    return swim.swim_tick(0, params)
+    return compose.composed_scan(key, params, world, n_rounds)
 '''
 
 MINI_MESH = '''\
-from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.models import compose
 
 
 def shard_run(key, params, world, n_rounds, mesh):
-    if mesh:
-        pending = swim.swim_tick_send(0, params)
-        return swim.swim_tick_recv(pending, params)
-    return swim.swim_tick(0, params)
+    return compose.composed_shard_scan(key, params, world, n_rounds)
 
 
 def shard_run_metered(key, params, world, n_rounds, mesh):
-    return swim.swim_tick(0, params)
+    return compose.composed_shard_scan(key, params, world, n_rounds)
 '''
 
 MINI_FILES: Dict[str, str] = {
     "models/swim.py": MINI_SWIM,
+    "models/compose.py": MINI_COMPOSE,
     "chaos/monitor.py": MINI_MONITOR,
     "parallel/mesh.py": MINI_MESH,
 }

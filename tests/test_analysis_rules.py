@@ -40,6 +40,9 @@ class TestPlaneMatrix:
                    for e in lint.ENTRY_POINTS)
         assert all(matrix["bodies"]["sync_interval"][b]
                    for b in lint.TICK_BODIES)
+        # ... and the compose column: the knob is reachable from the
+        # composed scan drivers the entries delegate to
+        assert matrix["compose"]["sync_interval"]["compose"]
         # dispatch-level-only and never-consulted knobs are all-empty
         # rows in the body matrix — allowed (the entry matrix covers
         # them)
@@ -52,16 +55,21 @@ class TestPlaneMatrix:
             "    shadow_knob: int = 0\n    entry_knob: int = 0",
         ).replace(
             "def run(key, params, world, n_rounds):\n"
-            "    return swim_tick(0, params)",
+            "    return compose.composed_scan(key, params, world, "
+            "n_rounds)",
             "def run(key, params, world, n_rounds):\n"
-            "    return swim_tick(0, params) + params.entry_knob",
+            "    return compose.composed_scan(key, params, world, "
+            "n_rounds) + params.entry_knob",
         )
         _, findings = lint.plane_matrix(
             graph_of(tmp_path, {"models/swim.py": swim_src}))
         got = ids_of(findings)
         missing = set(lint.ENTRY_POINTS) - {"run"}
+        # a knob consulted in ONE entry body bypasses compose() too —
+        # both the per-entry gaps and the compose-bypass finding fire
         assert got == {f"plane-matrix:entry_knob:entry:{e}"
-                       for e in missing}
+                       for e in missing} | {
+                           "plane-matrix:entry_knob:compose"}
 
     def test_body_gap_fires_for_the_unthreaded_body(self, tmp_path):
         swim_src = MINI_SWIM.replace(
@@ -115,21 +123,115 @@ class TestMutationPin:
         blank_consults_in_function(
             mutated_root / "models/swim.py", "_tick_shift_blocked",
             "params.sync_interval", "0")
-        # entry-level: the monitored scan's fusion consult feeds both
-        # monitored run shapes
+        # entry-level: the single-device composed scan driver's fusion
+        # consult feeds all five single-device run shapes (the sharded
+        # driver keeps its own consult, so exactly those five cells
+        # empty out)
         blank_consults_in_function(
-            mutated_root / "chaos/monitor.py", "_monitored_scan",
+            mutated_root / "models/compose.py", "composed_scan",
             "params.rounds_per_step", "1")
         _, findings = lint.plane_matrix(PackageGraph(mutated_root))
         got = ids_of(findings)
         expect = {
             "plane-matrix:sync_interval:body:k_block",
+            "plane-matrix:rounds_per_step:entry:run",
+            "plane-matrix:rounds_per_step:entry:run_traced",
+            "plane-matrix:rounds_per_step:entry:run_metered",
             "plane-matrix:rounds_per_step:entry:run_monitored",
             "plane-matrix:rounds_per_step:entry:run_monitored_metered",
         }
         assert expect <= got
         # and none of these fire at HEAD
         assert not expect & ids_of(pristine[1])
+
+
+# --------------------------------------------------------------------------
+# thin-entry
+# --------------------------------------------------------------------------
+
+class TestThinEntries:
+    def test_uniform_tree_is_clean(self, tmp_path):
+        assert lint.thin_entries(graph_of(tmp_path, {})) == []
+
+    def test_entry_touching_tick_internal_fires(self, tmp_path):
+        swim_src = MINI_SWIM.replace(
+            "def run(key, params, world, n_rounds):\n"
+            "    return compose.composed_scan(key, params, world, "
+            "n_rounds)",
+            "def run(key, params, world, n_rounds):\n"
+            "    compose.composed_scan(key, params, world, n_rounds)\n"
+            "    return swim_tick(0, params)",
+        )
+        findings = lint.thin_entries(
+            graph_of(tmp_path, {"models/swim.py": swim_src}))
+        assert ids_of(findings) == {"thin-entry:run:swim_tick"}
+
+    def test_entry_not_delegating_to_compose_fires(self, tmp_path):
+        # an entry re-growing its own scan body (no compose delegation,
+        # direct _fused_scan-style internals) fires BOTH shapes
+        swim_src = MINI_SWIM.replace(
+            "def run_metered(key, params, world, n_rounds):\n"
+            "    return compose.composed_scan(key, params, world, "
+            "n_rounds)",
+            "def run_metered(key, params, world, n_rounds):\n"
+            "    return swim_tick(0, params)",
+        )
+        findings = lint.thin_entries(
+            graph_of(tmp_path, {"models/swim.py": swim_src}))
+        assert ids_of(findings) == {
+            "thin-entry:run_metered:swim_tick",
+            "thin-entry:run_metered:no-compose-delegation",
+        }
+
+    def test_same_module_helper_is_checked_one_hop(self, tmp_path):
+        # tick logic hidden behind a same-module plain helper still
+        # fires (the shard_run -> _composed_shard_run plumbing shape is
+        # audited one hop deep)
+        mesh_src = (
+            "from scalecube_cluster_tpu.models import compose\n"
+            "from scalecube_cluster_tpu.models import swim\n\n\n"
+            "def _helper(key, params, world, n_rounds):\n"
+            "    compose.composed_shard_scan(key, params, world, "
+            "n_rounds)\n"
+            "    return swim.swim_tick(0, params)\n\n\n"
+            "def shard_run(key, params, world, n_rounds, mesh):\n"
+            "    return _helper(key, params, world, n_rounds)\n\n\n"
+            "def shard_run_metered(key, params, world, n_rounds, mesh):\n"
+            "    return compose.composed_shard_scan(key, params, world, "
+            "n_rounds)\n"
+        )
+        findings = lint.thin_entries(
+            graph_of(tmp_path, {"parallel/mesh.py": mesh_src}))
+        assert ids_of(findings) == {"thin-entry:shard_run:swim_tick"}
+
+    def test_entry_and_helper_reaching_same_internal_fire_once(
+            self, tmp_path):
+        # one defect, one finding: the entry AND its helper both
+        # touching the same internal must not double-count (or mutate
+        # the id through the engine's :x2 collapse, which would turn a
+        # baseline row stale against the real regression id)
+        mesh_src = (
+            "from scalecube_cluster_tpu.models import compose\n"
+            "from scalecube_cluster_tpu.models import swim\n\n\n"
+            "def _helper(key, params, world, n_rounds):\n"
+            "    compose.composed_shard_scan(key, params, world, "
+            "n_rounds)\n"
+            "    return swim.swim_tick(0, params)\n\n\n"
+            "def shard_run(key, params, world, n_rounds, mesh):\n"
+            "    _helper(key, params, world, n_rounds)\n"
+            "    return swim.swim_tick(0, params)\n\n\n"
+            "def shard_run_metered(key, params, world, n_rounds, mesh):\n"
+            "    return compose.composed_shard_scan(key, params, world, "
+            "n_rounds)\n"
+        )
+        findings = lint.thin_entries(
+            graph_of(tmp_path, {"parallel/mesh.py": mesh_src}))
+        assert [f.id for f in findings] == \
+            ["thin-entry:shard_run:swim_tick"]
+
+    def test_head_package_is_clean(self):
+        root = pathlib.Path(compile_audit.__file__).resolve().parents[1]
+        assert lint.thin_entries(PackageGraph(root)) == []
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,97 @@
+"""bench.py --compose --smoke: the composed full-stack A/B JSON
+contract.
+
+Like tests/test_bench_wire_smoke.py for the fused wire: the bench is
+the one entry point the composed-vs-alias measurement flows through, so
+this tier-1 test runs the real script in a subprocess (CPU) and pins
+the published contract — one JSON line with the three interleaved arms'
+rates, a finite ``compose_speedup_ratio`` consistent with the times,
+the alias-parity probe all green, the compile-count arm strictly
+reduced (one program per layout vs three), a compose_perf_smoke.json
+artifact (never the committed one), and the regress gate walking it.
+"""
+
+import json
+import math
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.compose
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_bench_compose_smoke_contract(tmp_path):
+    artifact = tmp_path / "compose_perf_smoke.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_TPU_TELEMETRY_DIR=str(tmp_path),
+        SCALECUBE_COMPOSE_ARTIFACT=str(artifact),
+        SCALECUBE_XLA_CACHE_DIR="",           # no cache writes from tests
+    )
+    env.pop("SCALECUBE_TPU_PROFILE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--compose", "--smoke"],
+        capture_output=True, text=True, timeout=540, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout      # exactly ONE JSON line
+    result = json.loads(lines[0])
+
+    assert "error" not in result, result
+    assert result["smoke"] is True
+    assert result["metric"] == \
+        "swim_compose_full_stack_member_rounds_per_sec"
+
+    # All three arms measured for real; the ratio consistent with the
+    # rates.  No floor on the smoke ratio HERE (a loaded CI box can
+    # skew one window); the committed artifacts/compose_perf.json
+    # records the pinned >= 1.0 measurement and the regress gate holds
+    # committed rounds to the floor.
+    for arm in ("composed", "head_style", "bare"):
+        assert result[f"{arm}_member_rounds_per_sec"] > 0
+    ratio = result["compose_speedup_ratio"]
+    assert math.isfinite(ratio) and ratio > 0
+    assert ratio == pytest.approx(
+        result["composed_member_rounds_per_sec"]
+        / result["head_style_member_rounds_per_sec"], rel=1e-3)
+    assert result["value"] == result["composed_member_rounds_per_sec"]
+    for key in ("full_stack_overhead_ratio", "head_style_overhead_ratio"):
+        assert math.isfinite(result[key]) and result[key] > 0
+    assert result["rounds_timed"] > 0
+
+    # The PARITY probe is a hard correctness gate even on smoke: the
+    # composed stack produced byte-identical alias outputs.
+    assert result["parity"] == {
+        "final_status": True, "trace_lanes": True, "trace_count": True,
+        "monitor_code_counts": True, "metrics_counters": True,
+    }
+
+    # Compile-count arm: head-style full instrumentation pays THREE
+    # programs per layout, the composed stack ONE — strictly reduced,
+    # per layout and in total.
+    comp = result["compile"]
+    assert comp["programs_head_style"] == 3 * len(comp["layouts"])
+    assert comp["programs_composed"] == len(comp["layouts"])
+    for row in comp["layouts"]:
+        assert row["programs_head_style"] == 3
+        assert row["programs_composed"] == 1
+
+    # The artifact round-trips through the regress loader as a
+    # measurement (not a skipped stub), and the in-bench gate ran.
+    assert result["artifact"] == str(artifact)
+    doc = json.loads(artifact.read_text())
+    assert doc["compose_speedup_ratio"] == ratio
+    sys.path.insert(0, str(REPO))
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    payload, note = tquery.load_bench_payload(str(artifact))
+    assert note is None and payload is not None
+    assert result["regress"]["ok"] is True, result["regress"]

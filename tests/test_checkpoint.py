@@ -191,6 +191,87 @@ def test_orphan_trace_beyond_cursor_is_rewritten(tmp_path):
     assert np.asarray(chunks[-1]["alive"]).sum() > 0  # re-run, not the fake
 
 
+def test_legacy_checkpoint_resumes_through_composed_runner(tmp_path):
+    """A checkpoint written BEFORE the lifeguard/open-world/user-gossip
+    plane lanes existed (its arrays lack ``lhm``/``epoch``/``g_*``)
+    resumes through the composed full-stack runner bit-identically:
+    the missing plane slices load zero-size (the PR-9/PR-10 rule), and
+    the composed carry is the same ``SwimState`` the checkpoint format
+    has always stored."""
+    from scalecube_cluster_tpu.chaos import monitor as cmonitor
+    from scalecube_cluster_tpu.models import compose
+
+    params, world = make(12, loss=0.1)
+    world = world.with_crash(4, at_round=10)
+    key = jax.random.key(17)
+    spec = cmonitor.MonitorSpec.passive(params)
+    unbroken, _, _ = compose.run_composed(key, params, world, 40,
+                                          monitor_spec=spec)
+
+    mid, _, _ = compose.run_composed(key, params, world, 20,
+                                     monitor_spec=spec)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.save(path, mid, next_round=20, key=key)
+    # Strip the plane lanes to forge the pre-plane checkpoint layout.
+    with np.load(path) as z:
+        arrays = {name: z[name] for name in z.files
+                  if not name.startswith(("state/lhm", "state/epoch",
+                                          "state/g_"))}
+    checkpoint._atomic_savez(path, arrays)
+
+    state2, next_round, key2, _ = checkpoint.load(path)
+    assert next_round == 20
+    assert state2.lhm.shape == (0,) and state2.epoch.shape == (12, 0)
+    resumed, _, _ = compose.run_composed(key2, params, world, 20,
+                                         monitor_spec=spec, state=state2,
+                                         start_round=20)
+    np.testing.assert_array_equal(np.asarray(unbroken.status),
+                                  np.asarray(resumed.status))
+    np.testing.assert_array_equal(np.asarray(unbroken.inc),
+                                  np.asarray(resumed.inc))
+
+
+def test_run_checkpointed_drives_the_composed_runner(tmp_path):
+    """``run_checkpointed`` (the simulated-preemption driver) accepts a
+    composed-runner run_fn: kill after two chunks, relaunch, and the
+    resumed final state equals one unbroken composed run — the
+    kill/resume smoke for the composed scan."""
+    from scalecube_cluster_tpu.models import compose
+
+    params, world = make(12, loss=0.1)
+    world = world.with_crash(4, at_round=10)
+    key = jax.random.key(19)
+
+    def composed_run(key, params, world, n_rounds, state=None,
+                     start_round=0):
+        final, _, metrics = compose.run_composed(
+            key, params, world, n_rounds, with_trace=False,
+            with_monitor=False, state=state, start_round=start_round)
+        return final, metrics
+
+    unbroken, _ = composed_run(key, params, world, 60)
+
+    calls = {"n": 0}
+
+    def dying_run(*args, **kwargs):
+        if calls["n"] == 2:
+            raise KeyboardInterrupt("simulated preemption")
+        calls["n"] += 1
+        return composed_run(*args, **kwargs)
+
+    path = str(tmp_path / "ckpt.npz")
+    with pytest.raises(KeyboardInterrupt):
+        checkpoint.run_checkpointed(
+            dying_run, key, params, world, 60, path, chunk=20
+        )
+    final, chunks = checkpoint.run_checkpointed(
+        composed_run, key, params, world, 60, path, chunk=20
+    )
+    assert len(chunks) == 3
+    np.testing.assert_array_equal(np.asarray(unbroken.status),
+                                  np.asarray(final.status))
+
+
 def test_atomic_write_leaves_no_tmp(tmp_path):
     params, world = make(8)
     state = swim.initial_state(params, world)

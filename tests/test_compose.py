@@ -1,0 +1,270 @@
+"""Composable plane runner (models/compose.py): plane-combination
+property suite + alias bit-identity pins.
+
+The contract under test (ISSUE 15 / ROADMAP item 1):
+
+  - any sampled subset of {trace, metrics, monitor, sync, lifeguard,
+    open_world} toggled on a seeded chaos world leaves the PROTOCOL
+    bit-identical to the bare run with the same params — observer
+    planes only observe, in-tick planes are compiled by their knobs
+    exactly as before (tier-1 samples ~8 combos; the full 2^6 sweep is
+    @slow);
+  - the seven entry points are thin aliases: the composed multi-plane
+    stack produces byte-for-byte the same trace lanes / monitor counts
+    / registry counters as the corresponding single-plane aliases on
+    the same inputs, including under round fusion (the generalized
+    fused body) and non-divisible fusion tails;
+  - the plane registry inventory names real SwimParams knobs and real
+    SwimState lanes (no rot against the dataclasses).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.chaos import monitor as cmonitor
+from scalecube_cluster_tpu.models import compose, swim
+from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+from scalecube_cluster_tpu.telemetry import trace as ttrace
+
+pytestmark = pytest.mark.compose
+
+N = 16
+ROUNDS = 36
+
+
+def chaos_params(sync=False, lifeguard=False, open_world=False,
+                 **overrides):
+    kw = dict(
+        n_members=N, n_subjects=N, fanout=3, periods_to_spread=3,
+        ping_every=2, sync_every=4, suspicion_rounds=6,
+        ping_req_members=2, loss_probability=0.05,
+        sync_interval=8 if sync else 0,
+        lhm_max=3 if lifeguard else 0,
+        open_world=open_world,
+    )
+    kw.update(overrides)
+    return swim.SwimParams(**kw)
+
+
+def chaos_world(params, open_world=False):
+    """Seeded chaos schedule: crash, leave, a lossy link rule, and —
+    when the open-world plane is armed — a JOIN into the crashed
+    slot."""
+    world = (swim.SwimWorld.healthy(params)
+             .with_crash(3, at_round=8)
+             .with_leave(5, at_round=14)
+             .with_link_fault((0, N // 2), (N // 2, N), loss=0.3,
+                              from_round=4, until_round=20))
+    if open_world:
+        world = world.with_crash(7, at_round=5).with_join(7, at_round=22)
+    else:
+        world = world.with_crash(7, at_round=5, until_round=24)
+    return world
+
+
+def states_equal(a, b):
+    for f in dataclasses.fields(swim.SwimState):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f.name)), np.asarray(getattr(b, f.name)),
+            err_msg=f"SwimState.{f.name} diverged")
+
+
+def metrics_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                      err_msg=f"metrics[{k}] diverged")
+
+
+# Sampled tier-1 combos over (trace, metrics, monitor, sync, lifeguard,
+# open_world); the full 2^6 sweep runs @slow below.
+SAMPLED_COMBOS = [
+    (False, False, False, False, False, False),
+    (True, True, True, False, False, False),
+    (True, False, False, True, False, False),
+    (False, True, False, False, True, False),
+    (False, False, True, True, True, False),
+    (True, True, False, False, False, True),
+    (False, False, True, False, True, True),
+    (True, True, True, True, True, True),
+]
+
+
+def run_combo(trace, metr, mon, sync, lifeg, ow):
+    key = jax.random.key(7)
+    params = chaos_params(sync=sync, lifeguard=lifeg, open_world=ow)
+    world = chaos_world(params, open_world=ow)
+    bare_state, bare_metrics = swim.run(key, params, world, ROUNDS)
+    spec = cmonitor.MonitorSpec.passive(params) if mon else None
+    final, results, metrics = compose.run_composed(
+        key, params, world, ROUNDS, monitor_spec=spec, with_trace=trace,
+        with_metrics=metr, with_monitor=mon,
+    )
+    # observer planes only observe: protocol table + per-round metrics
+    # bit-identical to the bare run on the same params
+    states_equal(bare_state, final)
+    metrics_equal(bare_metrics, metrics)
+    assert set(results) == ({"trace"} if trace else set()) \
+        | ({"metrics"} if metr else set()) | ({"monitor"} if mon else set())
+    if mon:
+        # the passive safety invariants hold on every sampled combo
+        assert int(np.asarray(results["monitor"].code_counts).sum()) == 0
+    return params, world, key, results
+
+
+@pytest.mark.parametrize("combo", SAMPLED_COMBOS,
+                         ids=lambda c: "".join("tmMslo"[i] if f else "-"
+                                               for i, f in enumerate(c)))
+def test_sampled_plane_combos_agree_with_bare_run(combo):
+    run_combo(*combo)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mask", range(64))
+def test_full_plane_combo_sweep(mask):
+    run_combo(*(bool(mask >> i & 1) for i in range(6)))
+
+
+def test_full_stack_matches_every_alias():
+    """The composed trace/metrics/monitor slices are byte-for-byte the
+    single-plane aliases' outputs on the same inputs — the alias
+    bit-identity pin."""
+    params, world, key, results = run_combo(
+        True, True, True, True, True, False)
+    _, tel, _ = swim.run_traced(key, params, world, ROUNDS)
+    np.testing.assert_array_equal(np.asarray(tel.trace.lanes),
+                                  np.asarray(results["trace"].trace.lanes))
+    assert int(tel.trace.count) == int(results["trace"].trace.count)
+    np.testing.assert_array_equal(
+        np.asarray(tel.first_suspect),
+        np.asarray(results["trace"].first_suspect))
+    _, ms, _ = swim.run_metered(key, params, world, ROUNDS)
+    spec = tmetrics.MetricsSpec.default()
+    for i, name in enumerate(spec.counters):
+        if name == "chaos_violations":
+            continue  # rides only the monitored registry
+        assert int(ms.counters[i]) == int(results["metrics"].counters[i]), \
+            name
+    np.testing.assert_array_equal(np.asarray(ms.gauges),
+                                  np.asarray(results["metrics"].gauges))
+    mspec = cmonitor.MonitorSpec.passive(params)
+    _, mon, _ = cmonitor.run_monitored(key, params, world, mspec, ROUNDS)
+    np.testing.assert_array_equal(np.asarray(mon.code_counts),
+                                  np.asarray(results["monitor"].code_counts))
+    np.testing.assert_array_equal(np.asarray(mon.lanes),
+                                  np.asarray(results["monitor"].lanes))
+    # ... and the monitored-metered registry (incl. chaos_violations)
+    _, mon2, ms2, _ = cmonitor.run_monitored_metered(
+        key, params, world, mspec, ROUNDS)
+    np.testing.assert_array_equal(np.asarray(ms2.counters),
+                                  np.asarray(results["metrics"].counters))
+    np.testing.assert_array_equal(np.asarray(mon2.code_counts),
+                                  np.asarray(results["monitor"].code_counts))
+
+
+def test_full_stack_under_round_fusion_with_tail():
+    """The generalized fused body (trace batching its event record per
+    step while monitor/metrics fold per round) is bit-identical to the
+    unfused composed stack, including a non-divisible fusion tail —
+    and to the aliases at the same K."""
+    key = jax.random.key(11)
+    spec_args = dict(sync=True, lifeguard=True)
+    p1 = chaos_params(**spec_args)
+    pk = chaos_params(**spec_args, rounds_per_step=5)  # 36 = 7*5 + 1
+    world = chaos_world(p1)
+    mspec = cmonitor.MonitorSpec.passive(p1)
+    f1, r1, m1 = compose.run_composed(key, p1, world, ROUNDS,
+                                      monitor_spec=mspec)
+    fk, rk, mk = compose.run_composed(key, pk, world, ROUNDS,
+                                      monitor_spec=mspec)
+    states_equal(f1, fk)
+    metrics_equal(m1, mk)
+    np.testing.assert_array_equal(np.asarray(r1["trace"].trace.lanes),
+                                  np.asarray(rk["trace"].trace.lanes))
+    assert int(r1["trace"].trace.dropped) == int(rk["trace"].trace.dropped)
+    np.testing.assert_array_equal(np.asarray(r1["monitor"].code_counts),
+                                  np.asarray(rk["monitor"].code_counts))
+    np.testing.assert_array_equal(np.asarray(r1["metrics"].counters),
+                                  np.asarray(rk["metrics"].counters))
+    # alias parity at the same fused K
+    _, telk, _ = swim.run_traced(key, pk, world, ROUNDS)
+    np.testing.assert_array_equal(np.asarray(telk.trace.lanes),
+                                  np.asarray(rk["trace"].trace.lanes))
+
+
+def test_composed_resume_matches_unbroken():
+    """Chunked composed runs resume every plane slice (state +
+    telemetry + monitor + metrics) bit-identically to one unbroken
+    composed run — the checkpoint-segment shape."""
+    key = jax.random.key(23)
+    params = chaos_params(sync=True)
+    world = chaos_world(params)
+    mspec = cmonitor.MonitorSpec.passive(params)
+    f_all, r_all, _ = compose.run_composed(key, params, world, ROUNDS,
+                                           monitor_spec=mspec)
+    half = ROUNDS // 2
+    f1, r1, _ = compose.run_composed(key, params, world, half,
+                                     monitor_spec=mspec)
+    f2, r2, _ = compose.run_composed(
+        key, params, world, ROUNDS - half, monitor_spec=mspec, state=f1,
+        start_round=half, telemetry=r1["trace"], monitor=r1["monitor"],
+        metrics_state=r1["metrics"],
+    )
+    states_equal(f_all, f2)
+    np.testing.assert_array_equal(np.asarray(r_all["trace"].trace.lanes),
+                                  np.asarray(r2["trace"].trace.lanes))
+    np.testing.assert_array_equal(np.asarray(r_all["monitor"].code_counts),
+                                  np.asarray(r2["monitor"].code_counts))
+    np.testing.assert_array_equal(np.asarray(r_all["metrics"].counters),
+                                  np.asarray(r2["metrics"].counters))
+
+
+def test_run_composed_monitor_requires_spec():
+    params = chaos_params()
+    world = chaos_world(params)
+    with pytest.raises(ValueError, match="monitor_spec"):
+        compose.run_composed(jax.random.key(0), params, world, 4)
+
+
+def test_plane_registry_names_real_knobs_and_lanes():
+    """The plane inventory cannot rot against the dataclasses: every
+    declared knob is a SwimParams field, every declared lane a
+    SwimState field, names are unique, and the known planes are all
+    listed."""
+    fields = {f.name for f in dataclasses.fields(swim.SwimParams)}
+    lanes = {f.name for f in dataclasses.fields(swim.SwimState)}
+    reg = compose.plane_registry()
+    names = [p["name"] for p in reg]
+    assert len(names) == len(set(names))
+    assert {"protocol", "sync", "lifeguard", "delay", "user_gossip",
+            "open_world", "trace", "monitor", "metrics"} <= set(names)
+    for plane in reg:
+        assert plane["kind"] in ("core", "in-tick", "observer")
+        assert set(plane["knobs"]) <= fields, plane["name"]
+        assert set(plane["lanes"]) <= lanes, plane["name"]
+
+
+def test_round_ctx_memoizes_shared_derivations():
+    """The shared round context traces each derivation once: repeated
+    property reads return the SAME traced value object (what makes the
+    composed stack pay the live-mask / emptiness / wide-decode
+    reductions once per round instead of once per plane)."""
+    params = chaos_params()
+    world = chaos_world(params)
+    state = swim.initial_state(params, world)
+    new_state, m = swim.swim_tick(state, 0, jax.random.key(0), params,
+                                  world)
+    rc = compose.RoundCtx(params, world, swim.Knobs.from_params(params),
+                          0, state, new_state, m)
+    assert rc.alive_now is rc.alive_now
+    assert rc.status_changed is rc.status_changed
+    assert rc.any_status_change is rc.any_status_change
+    assert rc.prev_wide is rc.prev_wide
+    assert rc.prev_deadline_wide is rc.prev_deadline_wide
+    # prev_deadline_wide is served FROM the already-paid wide decode
+    np.testing.assert_array_equal(
+        np.asarray(rc.prev_deadline_wide),
+        np.asarray(rc.prev_wide.suspect_deadline))

@@ -409,6 +409,87 @@ def test_regress_wire_smoke_is_provenance_beside_full_round(tmp_path):
     assert not ok
 
 
+def _compose_payload(**overrides):
+    payload = {
+        "metric": "swim_compose_full_stack_member_rounds_per_sec",
+        "value": 702646.3,
+        "compose_speedup_ratio": 2.8489,
+        "full_stack_overhead_ratio": 0.8365,
+        "head_style_overhead_ratio": 2.3831,
+        "parity": {"final_status": True, "trace_lanes": True,
+                   "trace_count": True, "monitor_code_counts": True,
+                   "metrics_counters": True},
+        "compile": {"programs_head_style": 6, "programs_composed": 2},
+    }
+    payload.update(overrides)
+    return payload
+
+
+def test_regress_compose_gates(tmp_path):
+    """The --compose artifact's gates: the one-scan full stack at least
+    matches the alias-by-alias route (absolute 1.0 floor), the
+    composed overhead stays within the band of head-style's, the
+    compile count is STRICTLY reduced, and the alias-parity probe was
+    green."""
+    art = tmp_path / "compose_perf.json"
+    with open(art, "w") as f:
+        json.dump(_compose_payload(), f)
+    ok, rows = query.regress([str(art)])
+    assert ok, rows
+    checks = {r["check"] for r in rows if r.get("ok") is not None}
+    assert {"slo/compose_speedup_ratio",
+            "slo/compose_full_stack_overhead",
+            "slo/compose_compile_count_reduced",
+            "slo/compose_alias_parity"} <= checks
+
+    # A composed stack slower than three sequential alias runs fails
+    # the absolute floor — no band: one scan losing to three is rot.
+    with open(art, "w") as f:
+        json.dump(_compose_payload(compose_speedup_ratio=0.93), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    assert any(r["check"] == "slo/compose_speedup_ratio"
+               for r in rows if r.get("ok") is False)
+
+    # Composed overhead drifting past head-style's (beyond the band)
+    # fails — the shared round context must keep paying for itself.
+    with open(art, "w") as f:
+        json.dump(_compose_payload(full_stack_overhead_ratio=3.1), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    assert any(r["check"] == "slo/compose_full_stack_overhead"
+               for r in rows if r.get("ok") is False)
+
+    # The compile matrix must stay STRICTLY reduced: head-style and
+    # composed compiling the same program count means the one-program
+    # claim rotted.
+    with open(art, "w") as f:
+        json.dump(_compose_payload(
+            compile={"programs_head_style": 6, "programs_composed": 6}), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    assert any(r["check"] == "slo/compose_compile_count_reduced"
+               for r in rows if r.get("ok") is False)
+
+    # A failed parity lane is a correctness gate, not noise.
+    with open(art, "w") as f:
+        json.dump(_compose_payload(
+            parity={"final_status": True, "trace_lanes": False}), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    assert any(r["check"] == "slo/compose_alias_parity"
+               for r in rows if r.get("ok") is False)
+
+    # The ratio gates apply to smoke rounds too (the
+    # metrics_overhead_ratio convention: same-host interleaved ratios
+    # are machine-independent) — a smoke round with a bad ratio bites.
+    with open(art, "w") as f:
+        json.dump(_compose_payload(smoke=True,
+                                   compose_speedup_ratio=0.9), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+
+
 def test_regress_static_analysis_gate(tmp_path):
     """The swimlint artifact gates ABSOLUTELY: findings_total > 0 (an
     unsuppressed static-analysis finding — a plane missing from a run
