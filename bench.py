@@ -113,6 +113,27 @@ Env overrides: SCALECUBE_LIFEGUARD_N, SCALECUBE_LIFEGUARD_LHM_MAX,
 SCALECUBE_LIFEGUARD_SEED, SCALECUBE_LIFEGUARD_SCENARIOS,
 SCALECUBE_LIFEGUARD_ARTIFACT.
 
+``--alarms``: the live SLO alarm drill — the streaming breach detector
+(telemetry/alarms.py) measured against a planted fault with a known
+onset round.  The seeded ``chaos.alarm_drill_scenario`` square loss
+pulse runs TWICE on the same world: a healthy arm (campaign-default
+Knobs) that must ride the pulse out with ZERO alarm transitions, and a
+weakened-knobs breach arm (``chaos.alarm_breach_knobs`` — probe every
+round; dynamic Knobs data, so the rerun reuses the healthy arm's
+compiled program, zero extra compiles) whose
+``false_positive_observer_rate`` breach the alarm must catch within
+ONE metrics window of the onset and RESOLVE after the heal — all gated
+absolutely by ``telemetry regress`` over the
+``artifacts/alarm_drill.json``-style artifact this mode writes.  Both
+arms journal through live ``TelemetrySink`` sinks, so the drill
+doubles as the end-to-end fixture for ``telemetry watch``.  ``--alarms
+--smoke`` is the tier-1-safe pass pinned by
+tests/test_bench_alarms_smoke.py.  Env overrides: SCALECUBE_ALARM_N,
+SCALECUBE_ALARM_SEED, SCALECUBE_ALARM_WINDOW, SCALECUBE_ALARM_ONSET,
+SCALECUBE_ALARM_PULSE, SCALECUBE_ALARM_COOL,
+SCALECUBE_ALARM_PULSE_LOSS, SCALECUBE_ALARM_THRESHOLD,
+SCALECUBE_ALARM_ARTIFACT.
+
 ``--churn``: the open-world membership workload — mid-run JOIN admission
 into recycled slots (models/swim.SwimParams.open_world) measured A/B
 against naive slot reuse under the seeded
@@ -241,6 +262,18 @@ CANARY_N = 4096
 # the "zero drops at default capacity" contract).
 TELEMETRY_N = 4096
 TELEMETRY_CRASH_AT = 10
+
+# The --alarms --smoke breach threshold.  The smoke drill geometry
+# (n=24, an eighth of the ids pulsed = 3 members vs the full drill's 6)
+# cycles false suspicions at lower per-observer rates than the full
+# n=48 drill that calibrated telemetry.alarms.DEFAULT_FP_THRESHOLD, so
+# the smoke preset rescales the threshold like every other smoke knob:
+# at pulse_loss=0.8 under the smoke default seed 7 the healthy arm's
+# pulse windows peak at 1.10 and the breach arm's first pulse window
+# measures 1.26 — 1.18 splits that gap (seed-specific on purpose: the
+# smoke pass is a fixed-seed determinism pin, not a sweep; changing
+# SCALECUBE_ALARM_SEED means recalibrating SCALECUBE_ALARM_THRESHOLD).
+SMOKE_ALARM_THRESHOLD = 1.18
 
 
 def apply_smoke_preset():
@@ -1850,6 +1883,195 @@ def run_lifeguard_bench():
     print(json.dumps(result), flush=True)
 
 
+def run_alarm_bench():
+    """The --alarms mode: the live SLO alarm engine's measured drill —
+    one JSON line out (never-ship-empty).
+
+    Workload: the seeded ``chaos.alarm_drill_scenario`` square loss
+    pulse (sharp edges — the drill measures DETECTION LAG against a
+    known onset round) run TWICE on the same world through live
+    ``TelemetrySink`` journals with ``stream_metered_run(...,
+    alarm_specs=default_specs(threshold))``:
+
+      - the HEALTHY arm (campaign-default Knobs): must ride the pulse
+        out with ZERO ``alarm_transition`` rows — the committed
+        quiet-under-stress half of the claim;
+      - the BREACH arm (``chaos.alarm_breach_knobs``: probe every
+        round — dynamic Knobs data, so this rerun REUSES the healthy
+        arm's compiled program, zero extra compiles): the planted
+        ``false_positive_observer_rate`` breach must reach FIRING
+        within ONE metrics window of the pulse onset
+        (``alarm_detection_lag_windows`` <= 1, the headline) and
+        RESOLVE after the heal.
+
+    Writes an ``artifacts/alarm_drill.json``-style artifact (smoke runs
+    get ``alarm_drill_smoke.json`` — provenance, the sync-heal
+    convention) and runs the regress gate in-bench.  The two journals
+    stay on disk next to the artifact, so ``python -m
+    scalecube_cluster_tpu.telemetry watch <journal>`` replays the drill
+    live.  ``--alarms --smoke`` is the tier-1-safe pass pinned by
+    tests/test_bench_alarms_smoke.py.  Env overrides: SCALECUBE_ALARM_N,
+    SCALECUBE_ALARM_SEED, SCALECUBE_ALARM_WINDOW, SCALECUBE_ALARM_ONSET,
+    SCALECUBE_ALARM_PULSE, SCALECUBE_ALARM_COOL,
+    SCALECUBE_ALARM_PULSE_LOSS, SCALECUBE_ALARM_THRESHOLD,
+    SCALECUBE_ALARM_ARTIFACT.
+
+    ``value`` stays None by design: detection lag is smaller-is-better
+    and must not enter the higher-is-better throughput walk — regress
+    gates the absolute alarm checks instead.
+    """
+    result = {
+        "metric": "alarm_detection_lag_windows",
+        "value": None,
+        "unit": "metrics windows",
+        "smoke": SMOKE,
+    }
+    artifact = (os.environ.get("SCALECUBE_ALARM_ARTIFACT")
+                or os.path.join("artifacts",
+                                "alarm_drill_smoke.json" if SMOKE
+                                else "alarm_drill.json"))
+    try:
+        jax, platform = init_backend()
+        result["platform"] = platform
+
+        from scalecube_cluster_tpu.chaos import scenarios as cscenarios
+        from scalecube_cluster_tpu.chaos.campaign import (
+            alarm_breach_knobs, campaign_config)
+        from scalecube_cluster_tpu.models import swim
+        from scalecube_cluster_tpu.telemetry import alarms as talarms
+        from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+        from scalecube_cluster_tpu.telemetry import sink as tsink
+
+        n = int(os.environ.get("SCALECUBE_ALARM_N", 24 if SMOKE else 48))
+        seed = int(os.environ.get("SCALECUBE_ALARM_SEED", 7))
+        window_rounds = int(os.environ.get("SCALECUBE_ALARM_WINDOW",
+                                           16 if SMOKE else 32))
+        onset = int(os.environ.get("SCALECUBE_ALARM_ONSET",
+                                   48 if SMOKE else 128))
+        pulse = int(os.environ.get("SCALECUBE_ALARM_PULSE",
+                                   48 if SMOKE else 128))
+        cool = int(os.environ.get("SCALECUBE_ALARM_COOL",
+                                  64 if SMOKE else 128))
+        pulse_loss = float(os.environ.get("SCALECUBE_ALARM_PULSE_LOSS",
+                                          0.8 if SMOKE else 0.6))
+        threshold = float(os.environ.get(
+            "SCALECUBE_ALARM_THRESHOLD",
+            SMOKE_ALARM_THRESHOLD if SMOKE
+            else talarms.DEFAULT_FP_THRESHOLD))
+        heal = onset + pulse
+
+        scen = cscenarios.alarm_drill_scenario(
+            seed, n=n, pulse_loss=pulse_loss, onset_round=onset,
+            pulse_rounds=pulse, cool_rounds=cool)
+        p = swim.SwimParams.from_config(
+            campaign_config(), n_members=n, delivery="scatter",
+            ping_known_only=False)
+        world, _mspec = scen.build(p)
+        specs = talarms.default_specs(threshold=threshold)
+        journal_dir = (os.environ.get(tsink.TELEMETRY_DIR_ENV)
+                       or os.path.dirname(artifact) or ".")
+        arms = {}
+        for arm, knobs in (("healthy", swim.Knobs.from_params(p)),
+                           ("breach", alarm_breach_knobs(scen, p))):
+            t0 = time.time()
+            journal = os.path.join(journal_dir,
+                                   f"alarm_drill_{arm}.jsonl")
+            # append=False: the drill is a fresh measurement, not a
+            # resumed run — a stale journal would replay into the
+            # engine and dedup this run's transitions away.
+            sink = tsink.TelemetrySink(path=journal)
+            _, rows = tmetrics.stream_metered_run(
+                jax.random.key(seed), p, world, scen.horizon,
+                sink=sink, window_rounds=window_rounds,
+                alarm_specs=specs, knobs=knobs)
+            sink.write_summary(metric="alarm_drill", arm=arm,
+                               windows=len(rows))
+            sink.close()
+            transitions = tsink.read_records(
+                journal, kind=talarms.TRANSITION_KIND)
+            rates = [
+                r["counters"].get("false_suspicion_onsets", 0)
+                / max(r["counters"].get("live_observer_rounds", 0), 1)
+                for r in rows]
+            arms[arm] = {
+                "journal": journal,
+                # The zero-extra-compiles witness: the breach arm's
+                # wall time is pure execution — its dynamic-Knobs rerun
+                # reuses the healthy arm's compiled program.
+                "seconds": round(time.time() - t0, 2),
+                "window_rates": [round(x, 6) for x in rates],
+                "peak_rate": round(max(rates), 6) if rates else None,
+                "transitions": transitions,
+            }
+            log(f"alarm drill arm={arm}: {len(rows)} windows, "
+                f"{len(transitions)} transition(s), peak rate "
+                f"{max(rates):.4f} ({time.time() - t0:.1f}s)")
+
+        firing = [t for t in arms["breach"]["transitions"]
+                  if t.get("to") == "firing"]
+        resolved = [t for t in arms["breach"]["transitions"]
+                    if t.get("to") == "resolved"]
+        lag = ((firing[0]["round_end"] - onset) / window_rounds
+               if firing else None)
+        breach_resolved = any(t["round_end"] >= heal for t in resolved)
+        healthy_peak = arms["healthy"]["peak_rate"]
+        first_fire_rate = firing[0]["value"] if firing else None
+        log(f"alarm drill headline: breach fired {len(firing)}x, "
+            f"detection lag {lag} window(s), resolved after heal: "
+            f"{breach_resolved}; healthy transitions "
+            f"{len(arms['healthy']['transitions'])}")
+        result.update(
+            alarm_detection_lag_windows=lag,
+            breach_fired=len(firing),
+            breach_resolved=breach_resolved,
+            healthy_transitions=len(arms["healthy"]["transitions"]),
+            healthy_peak_rate=healthy_peak,
+            breach_first_fire_rate=first_fire_rate,
+            threshold=threshold,
+            # The committed calibration evidence: how much seed/platform
+            # jitter each side of the threshold can absorb before the
+            # drill flips (alarms.DEFAULT_FP_THRESHOLD docstring).
+            margin_healthy=(round(threshold / healthy_peak - 1, 4)
+                            if healthy_peak else None),
+            margin_breach=(round(first_fire_rate / threshold - 1, 4)
+                           if first_fire_rate else None),
+            onset_round=onset,
+            heal_round=heal,
+            window_rounds=window_rounds,
+            pulse_loss=pulse_loss,
+            horizon=scen.horizon,
+            n_members=n,
+            seed=seed,
+            delivery="scatter",
+            scenario=scen.name,
+            arms=arms,
+            repro=(f"chaos.alarm_drill_scenario(seed={seed}, n={n}, "
+                   f"pulse_loss={pulse_loss}, onset_round={onset}, "
+                   f"pulse_rounds={pulse}, cool_rounds={cool})"),
+            value_note=("value stays null by design: detection lag is "
+                        "smaller-is-better and must not enter the "
+                        "throughput walk — regress gates the absolute "
+                        "alarm checks instead"),
+        )
+
+        art = dict(result)
+        os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        result["artifact"] = artifact
+        log(f"alarm artifact written to {artifact}")
+
+        apply_regress_gate(
+            result, ["BENCH_*.json", "MULTICHIP_*.json",
+                     os.path.join("artifacts", "alarm_drill*.json"),
+                     artifact])
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 def run_churn_bench():
     """The --churn mode: the open-world membership plane's headline
     robustness claim, measured A/B (never asserted) — one JSON line out
@@ -2566,6 +2788,16 @@ def main():
              "matrix, into an artifacts/compose_perf.json-style "
              "artifact; combine with --smoke for the tier-1-safe pass",
     )
+    parser.add_argument(
+        "--alarms", action="store_true",
+        help="run the live SLO alarm drill instead: the seeded square "
+             "loss pulse measured twice (healthy Knobs vs the "
+             "weakened-knobs breach arm on the same compiled program), "
+             "alarm detection lag + resolve-after-heal + "
+             "healthy-arm-quiet into an artifacts/alarm_drill.json-"
+             "style artifact; combine with --smoke for the tier-1-safe "
+             "pass",
+    )
     mode = parser.add_mutually_exclusive_group()
     mode.add_argument(
         "--untraced", action="store_true",
@@ -2659,6 +2891,14 @@ def main():
                 "--compose measures the composed-vs-alias full-stack "
                 "gap on its own interleaved windows — drop the other "
                 "mode flags")
+        if args.alarms and (args.chaos or args.resilience or args.metrics
+                            or args.multichip or args.sync
+                            or args.lifeguard or args.churn or args.fuzz
+                            or args.wire or args.compose or args.traced
+                            or args.untraced or args.gap_artifact):
+            parser.error(
+                "--alarms runs the live SLO alarm drill on its own "
+                "workload — drop the other mode flags")
     except SystemExit as e:
         # The one-JSON-line contract holds even for a bad argv: argparse
         # already printed its usage message to stderr; ship the error
@@ -2693,6 +2933,8 @@ def main():
         return run_wire_bench()
     if args.compose:
         return run_compose_bench()
+    if args.alarms:
+        return run_alarm_bench()
 
     result = {
         "metric": "swim_member_rounds_per_sec_per_chip",

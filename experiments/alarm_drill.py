@@ -1,0 +1,80 @@
+"""Live SLO alarm drill: streaming breach detection, measured.
+
+Drives ``bench.py --alarms`` (the one entry point the detection-lag
+measurement flows through, so the experiment and the driver bench
+cannot drift): the seeded ``chaos.alarm_drill_scenario`` square loss
+pulse run TWICE on the same world through live journaling
+``stream_metered_run(..., alarm_specs=...)`` — the HEALTHY arm
+(campaign-default Knobs) must ride the pulse out with zero
+``alarm_transition`` rows, the BREACH arm (``chaos.alarm_breach_knobs``
+probe-every-round weakening; dynamic Knobs, so the rerun reuses the
+healthy arm's compiled program — zero extra compiles) must reach
+FIRING within one metrics window of the pulse onset
+(``alarm_detection_lag_windows <= 1``, the headline) and RESOLVE after
+the heal.
+
+Writes ``artifacts/alarm_drill.json`` (override
+``SCALECUBE_ALARM_ARTIFACT``) plus both arms' journals next to it, and
+runs the ``telemetry regress`` gate in-bench — the committed artifact
+is the pinned detection claim, and regress exits 1 if it ever rots.
+The journals replay live::
+
+    python -m scalecube_cluster_tpu.telemetry watch \
+        artifacts/alarm_drill_breach.jsonl --json
+
+CPU-safe (the drill is seeded and threshold-calibrated per geometry —
+telemetry.alarms.DEFAULT_FP_THRESHOLD / bench.SMOKE_ALARM_THRESHOLD).
+
+Usage:
+    python experiments/alarm_drill.py               # committed shape
+    python experiments/alarm_drill.py --smoke       # tier-1-safe pass
+    python experiments/alarm_drill.py --n 48 --seed 7
+"""
+
+import argparse
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--smoke", action="store_true",
+                        help="tier-1-safe fast pass (the bench smoke "
+                             "geometry: n=24, 16-round windows)")
+    parser.add_argument("--n", type=int, default=None,
+                        help="member count (bench default: 48 full / "
+                             "24 smoke)")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="scenario seed (default 7; NOTE the smoke "
+                             "threshold is calibrated for seed 7 — a "
+                             "different seed needs "
+                             "SCALECUBE_ALARM_THRESHOLD recalibrated)")
+    parser.add_argument("--threshold", type=float, default=None,
+                        help="override the calibrated breach threshold")
+    parser.add_argument("--artifact", default=None,
+                        help="artifact path (default "
+                             "artifacts/alarm_drill.json; smoke runs "
+                             "default to alarm_drill_smoke.json)")
+    args = parser.parse_args()
+
+    env = dict(os.environ)
+    for flag, var in ((args.n, "SCALECUBE_ALARM_N"),
+                      (args.seed, "SCALECUBE_ALARM_SEED"),
+                      (args.threshold, "SCALECUBE_ALARM_THRESHOLD"),
+                      (args.artifact, "SCALECUBE_ALARM_ARTIFACT")):
+        if flag is not None:
+            env[var] = str(flag)
+
+    cmd = [sys.executable, str(REPO / "bench.py"), "--alarms"]
+    if args.smoke:
+        cmd.append("--smoke")
+    proc = subprocess.run(cmd, cwd=str(REPO), env=env)
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -479,6 +479,32 @@ def weakened_knobs(scenario: "cscenarios.Scenario",
         suspicion_rounds=jnp.int32(1 << 20))
 
 
+def alarm_breach_knobs(scenario: "cscenarios.Scenario",
+                       params: "swim.SwimParams") -> "swim.Knobs":
+    """The alarm drill's BREACH arm (bench.py --alarms): probe every
+    round (``ping_every=1``) instead of the campaign cadence.  Each
+    probe into the drill scenario's loss pulse is an independent chance
+    to falsely suspect a live member, and refutation gossip (the
+    target's outbound links stay clean) re-arms the observer within a
+    round or two — so doubling the probe cadence multiplies the
+    ``false_positive_observer_rate`` by ~1.5x measured exactly while
+    the pulse holds, and only then (both arms are exactly zero outside
+    it).  Deliberately does NOT touch ``suspicion_rounds``: shortening
+    it INVERTS the drill — false suspicions mature into false deaths on
+    the first onset, the dead targets stop being probed, and the onset
+    rate collapses below the healthy arm's.
+
+    Dynamic Knobs data like :func:`weakened_knobs`, and for the same
+    reason: the breach arm reruns the healthy arm's compiled program —
+    the drill's A/B costs zero extra compiles."""
+    import jax.numpy as jnp
+
+    del scenario  # one amplification for every drill scenario
+    return dataclasses.replace(
+        swim.Knobs.from_params(params),
+        ping_every=jnp.int32(1))
+
+
 # --------------------------------------------------------------------------
 # Minimizing reducer: violating scenario -> one-line repro
 # --------------------------------------------------------------------------

@@ -740,6 +740,52 @@ def asymmetric_degradation(seed: int, n: int = 32,
                     horizon=horizon, ops=ops, seed=seed)
 
 
+def alarm_drill_scenario(seed: int, n: int = 32,
+                         pulse_loss: float = 0.6,
+                         onset_round: int = 128,
+                         pulse_rounds: int = 128,
+                         cool_rounds: int = 128) -> Scenario:
+    """Seeded square-pulse fault for the live-alarm drill
+    (bench.py --alarms): a sharp-edged inbound :class:`LinkLoss` window
+    on the drill range's links.
+
+    During ``[onset_round, onset_round + pulse_rounds)`` messages from
+    the healthy majority INTO ids ``[0, q)`` (``q =``
+    :func:`asymmetric_degraded_range` — the lifeguard drill's rack)
+    drop at ``pulse_loss``; outside the pulse the network is pristine.
+    A square pulse on purpose, where :func:`asymmetric_degradation`
+    ramps: the drill measures DETECTION LAG against a known onset
+    round, so the fault edge must be one round wide — a ramp would
+    smear the very quantity under test.  Probes of the range fail on
+    the ping hop, false suspicions onset at the pulse edge and stop at
+    the heal, which is exactly the breach/resolve timeline the alarm's
+    pending→firing→resolved machine must track.
+
+    The horizon leaves ``cool_rounds`` after the heal so the resolve
+    hysteresis has clear windows to consume.  Pure in its arguments
+    (the pulse is deterministic; ``seed`` seeds the RUN key and names
+    the repro): ``chaos.alarm_drill_scenario(seed=S, n=N)``.
+    """
+    if n < 16:
+        raise ValueError(
+            f"alarm_drill_scenario needs n >= 16 (got {n}) — the "
+            f"pulsed range must stay a strict minority")
+    if pulse_rounds < 1 or cool_rounds < 1:
+        raise ValueError(
+            f"alarm_drill_scenario needs pulse_rounds >= 1 and "
+            f"cool_rounds >= 1 (got {pulse_rounds}, {cool_rounds}) — "
+            f"no pulse means no breach, no cooldown means no resolve")
+    q = asymmetric_degraded_range(n)
+    ops = (
+        LinkLoss(src=(q, n), dst=(0, q), loss=float(pulse_loss),
+                 from_round=int(onset_round),
+                 until_round=int(onset_round + pulse_rounds)),
+    )
+    return Scenario(name=f"alarm-drill-{seed}-n{n}", n_members=n,
+                    horizon=int(onset_round + pulse_rounds + cool_rounds),
+                    ops=ops, seed=seed)
+
+
 def churn_growth_scenario(seed: int, n: int = 32, waves: int = 3,
                           wave_size: int = 2, join_wave_size: int = 3,
                           join_lag: Optional[int] = None,

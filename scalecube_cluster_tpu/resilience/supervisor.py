@@ -328,6 +328,7 @@ class ResilientRunResult:
     events_recorded: int = 0      # traced: this process's total
     events_dropped: int = 0
     monitor_verdict: Optional[dict] = None   # monitored: final verdict
+    alarm_transitions: int = 0    # alarm_transition rows THIS process wrote
 
 
 def _spec_digest(spec) -> str:
@@ -367,6 +368,7 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
                   monitor_capacity: int = 1 << 12,
                   retry: Optional[RetryPolicy] = None,
                   kill_plan: Optional[KillPlan] = None,
+                  alarm_specs=None,
                   log=None, sleep=time.sleep) -> ResilientRunResult:
     """Drive ``shape`` over ``n_rounds`` rounds with checkpointed
     segments, retry, and a resumable journal (module docstring).
@@ -378,6 +380,16 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
     ``ValueError`` immediately (non-retryable by definition: it means
     the caller is trying to continue a DIFFERENT run).  ``spec`` is
     required for the monitored shape (chaos/monitor.MonitorSpec).
+
+    ``alarm_specs`` (``telemetry.alarms.AlarmSpec`` sequence) evaluates
+    every segment's counter row through a live alarm engine at the
+    segment boundary and journals each state change as an
+    ``alarm_transition`` record — AFTER the segment record and before
+    the checkpoint, so a preemption can strand a durable segment with
+    its transitions missing.  The resume scan replays the journal
+    through a fresh engine and writes exactly the missing tail
+    (telemetry/alarms.py replay/dedup), so alarm rows keep the
+    journal's exactly-once guarantee across any kill/relaunch sequence.
 
     ``kill_plan`` is the harness's fault lever — None in production.
     """
@@ -478,9 +490,28 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
         return with_retry(counted, retry, label=label, log=log,
                           sleep=sleep)
 
+    engine = existing = None
+    replayed_transitions: list = []
+    if alarm_specs:
+        from scalecube_cluster_tpu.telemetry import alarms as talarms
+
+        engine = talarms.AlarmEngine(alarm_specs, kinds=("segment",))
+
     try:
         fresh_journal = os.path.getsize(journal_path) == 0
-        covered = 0 if fresh_journal else tsink.covered_upto(journal_path)
+        # ONE scan of the durable journal serves every resume consumer:
+        # the segment dedup cursor AND the alarm-engine replay — a long
+        # journal is parsed once, not once per reader (the
+        # JournalFollower cursor; its covered_upto is the rebased
+        # tsink.covered_upto).
+        covered = 0
+        if not fresh_journal:
+            follower = tsink.follow_records(journal_path)
+            records = follower.poll()
+            covered = follower.covered_upto(kind="segment")
+            if engine is not None:
+                replayed_transitions, existing = talarms.replay_journal(
+                    engine, records)
         if legacy and fresh_journal:
             # Adopting a pre-journal lineage: rounds [0, cursor) were
             # run before this journal existed, so its coverage contract
@@ -521,6 +552,14 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
         segments_run = deduped = 0
         events_recorded = events_dropped = 0
         monitor_verdict = None
+        alarm_written = 0
+        if engine is not None and replayed_transitions:
+            # The dead process may have been killed between a segment
+            # record and its alarm transitions (or mid-transition-list):
+            # the replay regenerated the full deterministic list, the
+            # count dedup writes exactly what is missing.
+            alarm_written += len(talarms.write_transitions(
+                sink, replayed_transitions, existing))
         r = cursor
         while r < n_rounds:
             end = min(r + segment_rounds, n_rounds)
@@ -553,6 +592,17 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
                 deduped += 1
             if due_kill and kill_plan.stage == "post_journal":
                 kill_plan.fire()
+            if engine is not None and end > covered:
+                # Segment-boundary alarm evaluation: transitions land
+                # after the segment record and after the post_journal
+                # kill point — so that kill stage models a preemption
+                # landing mid-transition (segment durable, alarms not),
+                # the case the resume replay must repair.  Deduped
+                # segments were already replayed at startup.
+                alarm_written += len(talarms.write_transitions(
+                    sink,
+                    engine.observe({"kind": "segment", **record}),
+                    existing))
             store.save(new_carry, end, key=key, meta=full_meta)
             if due_kill and kill_plan.stage == "post_checkpoint":
                 kill_plan.fire()
@@ -581,5 +631,5 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
         journal_path=journal_path, segments_run=segments_run,
         segments_deduped=deduped, resumed_from=info, retries=retries,
         events_recorded=events_recorded, events_dropped=events_dropped,
-        monitor_verdict=monitor_verdict,
+        monitor_verdict=monitor_verdict, alarm_transitions=alarm_written,
     )

@@ -1,7 +1,7 @@
 """The telemetry CLI: ``python -m scalecube_cluster_tpu.telemetry``.
 
-Three subcommands over the JSONL manifests and BENCH artifacts
-(telemetry/query.py):
+Four subcommands over the JSONL manifests and BENCH artifacts
+(telemetry/query.py, telemetry/alarms.py):
 
   report   <manifest.jsonl> [...]   fold manifests, print the health
                                     SLO table (``--json`` for machines,
@@ -9,6 +9,15 @@ Three subcommands over the JSONL manifests and BENCH artifacts
                                     time series)
   diff     <a.jsonl> <b.jsonl>      per-SLO/counter/gauge comparison
                                     of two runs
+  watch    <journal.jsonl>          live-tail a journal another process
+                                    is writing (sink.follow_records —
+                                    never re-reads consumed bytes) and
+                                    render a refreshing alarm/SLO
+                                    table; exits when the run's
+                                    ``summary`` record lands (or after
+                                    ``--max-seconds``); ``--json``
+                                    emits one line per consumed window
+                                    / transition for machines
   regress  [paths/globs ...]        walk the BENCH_*.json +
                                     MULTICHIP_*.json trajectories
                                     (the default globs) and exit 1 on
@@ -26,6 +35,7 @@ import argparse
 import json
 import os
 import sys
+import time
 from typing import Optional, Sequence
 
 from scalecube_cluster_tpu.telemetry import query
@@ -82,6 +92,86 @@ def _cmd_diff(args) -> int:
     return 0
 
 
+def _cmd_watch(args) -> int:
+    """Tail a live journal and render the alarm/SLO table.
+
+    Read-only: the watcher runs its OWN alarm engine over the tailed
+    ``metrics_window``/``segment`` rows (it never writes to a journal
+    it does not own) and shows journaled ``alarm_transition`` rows —
+    written by the run itself — as provenance.  The follower consumes
+    each durable line exactly once, so across the whole session every
+    window is seen once and only once (tests/test_alarms.py pins this
+    against a live writer subprocess).
+    """
+    from scalecube_cluster_tpu.telemetry import alarms as talarms
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+
+    threshold = (args.threshold if args.threshold is not None
+                 else talarms.DEFAULT_FP_THRESHOLD)
+    specs = talarms.default_specs(threshold=threshold,
+                                  for_windows=args.for_windows,
+                                  clear_windows=args.clear_windows)
+    engine = talarms.AlarmEngine(specs)
+    follower = tsink.follow_records(args.journal)
+    deadline = (time.time() + args.max_seconds
+                if args.max_seconds is not None else None)
+    windows = transitions_seen = journal_transitions = 0
+    done = False
+    while True:
+        fresh = follower.poll()
+        new_rows = []
+        for rec in fresh:
+            kind = rec.get("kind")
+            if kind in talarms.WINDOW_KINDS:
+                windows += 1
+                caused = engine.observe(rec)
+                transitions_seen += len(caused)
+                if args.json:
+                    print(json.dumps({
+                        "kind": "window", "source": kind,
+                        "round_start": rec.get("round_start"),
+                        "round_end": rec.get("round_end"),
+                        "transitions": caused,
+                    }), flush=True)
+                else:
+                    new_rows.append(rec)
+            elif kind == talarms.TRANSITION_KIND:
+                journal_transitions += 1
+                if args.json:
+                    print(json.dumps({"kind": "journal_transition",
+                                      **{k: v for k, v in rec.items()
+                                         if k != "kind"}}), flush=True)
+            elif kind == "summary":
+                done = True
+        if fresh and not args.json:
+            print(f"\n# watch {args.journal}: {windows} window(s), "
+                  f"cursor at byte {follower.offset}")
+            print(query.format_table(
+                engine.state_rows(),
+                ["alarm", "state", "value", "threshold", "comparator",
+                 "fired", "resolved"]))
+            if journal_transitions:
+                print(f"({journal_transitions} alarm_transition row(s) "
+                      f"journaled by the run itself)")
+            sys.stdout.flush()
+        if done or (deadline is not None and time.time() >= deadline):
+            break
+        time.sleep(args.interval)
+    digest = {
+        "kind": "watch_summary", "journal": args.journal,
+        "windows": windows, "engine_transitions": transitions_seen,
+        "journal_transitions": journal_transitions,
+        "run_ended": done,
+        "alarms": engine.state_rows(),
+    }
+    if args.json:
+        print(json.dumps(digest), flush=True)
+    else:
+        print(f"# watch done: run {'ended' if done else 'still live'}, "
+              f"{windows} window(s), {transitions_seen} transition(s)")
+    return 0
+
+
 def _cmd_regress(args) -> int:
     paths = query.expand_paths(
         args.paths
@@ -92,7 +182,8 @@ def _cmd_regress(args) -> int:
             os.path.join("artifacts", "fuzz_campaign*.json"),
             os.path.join("artifacts", "wire_fused*.json"),
             os.path.join("artifacts", "compose_perf*.json"),
-            os.path.join("artifacts", "static_analysis*.json")])
+            os.path.join("artifacts", "static_analysis*.json"),
+            os.path.join("artifacts", "alarm_drill*.json")])
     readable = [p for p in paths if os.path.exists(p)]
     if not readable:
         print("regress: no artifacts matched", file=sys.stderr)
@@ -131,6 +222,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.set_defaults(fn=_cmd_diff)
 
     p = sub.add_parser(
+        "watch",
+        help="live-tail a journal: refreshing alarm/SLO table "
+             "(exits on the run's summary record)")
+    p.add_argument("journal")
+    p.add_argument("--interval", type=float, default=0.5,
+                   help="poll interval, seconds (default 0.5)")
+    p.add_argument("--max-seconds", type=float, default=None,
+                   help="stop after this many seconds even if the run "
+                        "is still live (default: wait for the summary "
+                        "record)")
+    p.add_argument("--threshold", type=float, default=None,
+                   help="false_positive_observer_rate breach threshold "
+                        "(default: telemetry.alarms"
+                        ".DEFAULT_FP_THRESHOLD)")
+    p.add_argument("--for-windows", type=int, default=1,
+                   help="consecutive breached windows before firing")
+    p.add_argument("--clear-windows", type=int, default=1,
+                   help="consecutive clear windows before resolving")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON line per consumed window/transition "
+                        "+ a closing watch_summary line")
+    p.set_defaults(fn=_cmd_watch)
+
+    p = sub.add_parser(
         "regress",
         help="fail on regressions along the BENCH/MULTICHIP trajectories")
     p.add_argument("paths", nargs="*",
@@ -141,7 +256,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "artifacts/fuzz_campaign*.json "
                         "artifacts/wire_fused*.json "
                         "artifacts/compose_perf*.json "
-                        "artifacts/static_analysis*.json)")
+                        "artifacts/static_analysis*.json "
+                        "artifacts/alarm_drill*.json)")
     p.add_argument("--band", type=float, default=query.DEFAULT_NOISE_BAND,
                    help="relative noise band (default 0.10)")
     p.add_argument("--json", action="store_true")

@@ -568,7 +568,8 @@ def stream_metered_run(base_key, params, world, n_rounds: int, *,
                        sink=None, window_rounds: int = 64,
                        spec: Optional[MetricsSpec] = None,
                        state=None, knobs=None, shift_key=None,
-                       start_round: int = 0, skip_covered: bool = True):
+                       start_round: int = 0, skip_covered: bool = True,
+                       alarm_specs=None):
     """Drive ``models/swim.run_metered`` in flush windows.
 
     After each ``window_rounds``-round window the registry is fetched,
@@ -580,6 +581,16 @@ def stream_metered_run(base_key, params, world, n_rounds: int, *,
     (``skip_covered``) — no duplicate rows after any kill/relaunch
     sequence, the resilient supervisor's segment semantics.
 
+    ``alarm_specs`` (a sequence of ``telemetry.alarms.AlarmSpec``;
+    needs ``sink``) evaluates each flush window through a live
+    :class:`~scalecube_cluster_tpu.telemetry.alarms.AlarmEngine` and
+    journals every state change as an ``alarm_transition`` record.  The
+    same ONE startup scan that finds the metrics cursor replays any
+    existing rows through the engine and dedups already-durable
+    transitions, so alarms inherit the exactly-once resume guarantee
+    (telemetry/alarms.py module docstring); windows the cursor skips
+    were already replayed and are not re-observed.
+
     Returns ``(final_state, window_rows)`` where ``window_rows`` is the
     host-side list of every window payload (including skipped-write
     ones), each ``{"round_start", "round_end", "counters", "gauges",
@@ -590,9 +601,28 @@ def stream_metered_run(base_key, params, world, n_rounds: int, *,
 
     spec = spec or MetricsSpec.default()
     window_rounds = max(1, int(window_rounds))
+    engine = existing = None
+    if alarm_specs:
+        from scalecube_cluster_tpu.telemetry import alarms as talarms
+
+        if sink is None:
+            raise ValueError(
+                "alarm_specs needs a sink: transitions are journal "
+                "records (telemetry/alarms.py)")
+        engine = talarms.AlarmEngine(alarm_specs,
+                                     kinds=("metrics_window",))
     covered = 0
-    if sink is not None and skip_covered:
-        covered = tsink.covered_upto(sink.path, kind="metrics_window")
+    if sink is not None and (skip_covered or engine is not None):
+        # One scan serves both cursors: the metrics-window dedup AND
+        # the alarm replay (satellite rule: a long journal is parsed
+        # once, not once per consumer).
+        follower = tsink.follow_records(sink.path)
+        records = follower.poll()
+        if skip_covered:
+            covered = follower.covered_upto(kind="metrics_window")
+        if engine is not None:
+            replayed, existing = talarms.replay_journal(engine, records)
+            talarms.write_transitions(sink, replayed, existing)
 
     ms = MetricsState.init(spec)
     if state is None:
@@ -612,6 +642,10 @@ def stream_metered_run(base_key, params, world, n_rounds: int, *,
         rows.append(row)
         if sink is not None and w_end > covered:
             sink.write_metrics_window(row)
+            if engine is not None:
+                talarms.write_transitions(
+                    sink, engine.observe({"kind": "metrics_window", **row}),
+                    existing)
         ms = reset_window(ms)
         r += step
     return state, rows

@@ -317,7 +317,8 @@ def load_bench_payload(path: str) -> Tuple[Optional[dict], Optional[str]]:
                      or "vmap_speedup_ratio" in payload
                      or "fused_serial_speedup_ratio" in payload
                      or "compose_speedup_ratio" in payload
-                     or "findings_total" in payload)):
+                     or "findings_total" in payload
+                     or "alarm_detection_lag_windows" in payload)):
             return None, stub_note
     return payload, None
 
@@ -374,7 +375,12 @@ def regress(paths: Sequence[str],
       - swimlint artifacts (``findings_total`` present,
         ``python -m scalecube_cluster_tpu.analysis check``): absolute
         gates — ``findings_total`` == 0 (unsuppressed static-analysis
-        findings are never noise) and the artifact self-reports ok.
+        findings are never noise) and the artifact self-reports ok;
+      - Alarm-drill artifacts (``alarm_detection_lag_windows`` present,
+        bench.py --alarms): absolute gates — the breach arm's planted
+        SLO breach fired (>= 1 firing transition) within one metrics
+        window of onset, resolved after the heal, and the healthy arm
+        fired ZERO alarms.
 
     Returns (ok, check rows); each row {"check", "latest", "reference",
     "threshold", "ok", "source"}.  Unreadable/failed artifacts — and
@@ -804,6 +810,45 @@ def regress(paths: Sequence[str],
                   total == 0)
             check("slo/static_analysis_ok", last_path,
                   last.get("ok"), True, True, last.get("ok") is True)
+        # Alarm-drill artifacts (bench.py --alarms): the live SLO alarm
+        # engine's measured detection claim.  ABSOLUTE gates on the
+        # latest round — the weakened-knobs breach arm FIRED (>= 1
+        # firing transition) with ``alarm_detection_lag_windows`` <= 1
+        # (the breach is caught within one metrics window of onset),
+        # the alarm RESOLVED after the fault healed, and the healthy
+        # arm — same world, same compiled program — fired ZERO alarms.
+        # Smoke drills are provenance unless the walk holds only smoke
+        # rounds (the sync-heal fallback rule: `--alarms --smoke`'s
+        # in-bench check of its own fresh artifact still bites).
+        al_all = [(p, pl) for p, pl in entries
+                  if "alarm_detection_lag_windows" in pl
+                  and "healthy_transitions" in pl]
+        al = [(p, pl) for p, pl in al_all
+              if not pl.get("smoke")] or al_all
+        if al is not al_all:
+            for p, pl in al_all:
+                if pl.get("smoke"):
+                    rows.append({
+                        "check": "slo/alarm_drill", "source":
+                        os.path.basename(p), "ok": None,
+                        "note": "smoke alarm drill — different scale, "
+                                "not a trajectory datum",
+                    })
+        if al:
+            last_path, last = al[-1]
+            fired = last.get("breach_fired")
+            check("slo/alarm_breach_fired", last_path, fired, ">= 1",
+                  1, isinstance(fired, (int, float)) and fired >= 1)
+            lag = last.get("alarm_detection_lag_windows")
+            check("slo/alarm_detection_lag", last_path, lag, 1.0, 1.0,
+                  isinstance(lag, (int, float)) and math.isfinite(lag)
+                  and lag <= 1.0)
+            check("slo/alarm_resolved_after_heal", last_path,
+                  last.get("breach_resolved"), True, True,
+                  last.get("breach_resolved") is True)
+            quiet = last.get("healthy_transitions")
+            check("slo/alarm_healthy_quiet", last_path, quiet, 0, 0,
+                  quiet == 0)
     return ok, rows
 
 

@@ -436,6 +436,92 @@ def covered_upto(path: str, kind: str = "segment") -> int:
     return max(ends, default=0)
 
 
+class JournalFollower:
+    """Incremental reader of a journal another process may still be
+    writing: a byte-offset cursor over NEWLINE-TERMINATED lines.
+
+    :func:`iter_records`'s durability rule, applied live: a record is
+    durable iff its line is newline-terminated, so each :meth:`poll`
+    consumes bytes only up to the LAST newline currently in the file —
+    an in-progress (or torn) trailing fragment is simply left for the
+    next poll, which is the streaming equivalent of iter_records'
+    torn-tail skip.  Consumed bytes are NEVER re-read (the cursor only
+    advances, and always lands just after a newline), so tailing a
+    long-running journal — or rebasing ``covered_upto`` across
+    supervisor relaunch segments — costs one scan of the new bytes, not
+    a fresh parse from byte 0 (pinned by tests/test_alarms.py).
+
+    A terminated-but-unparseable line still raises ``ValueError``
+    (interior corruption, iter_records' rule).  The file SHRINKING
+    below the cursor also raises: ``_heal_torn_tail`` can only ever
+    truncate an unterminated fragment this follower never consumed, so
+    a shorter-than-cursor file means the journal was rewritten
+    out-of-band and every downstream dedup cursor is void.
+
+    Per-kind ``round_end`` maxima fold incrementally as lines are
+    consumed — :meth:`covered_upto` is :func:`covered_upto` rebased on
+    the cursor.
+    """
+
+    def __init__(self, path: str, kind: Optional[str] = None):
+        self.path = path
+        self.kind = kind
+        self.offset = 0
+        self._covered: Dict[str, int] = {}
+
+    def covered_upto(self, kind: str = "segment") -> int:
+        """Max ``round_end`` over ``kind`` records consumed SO FAR
+        (module-level :func:`covered_upto` semantics, incremental)."""
+        return self._covered.get(kind, 0)
+
+    def poll(self) -> List[dict]:
+        """Consume every newly-durable record; [] when nothing new
+        (including a missing file — the writer may not have started)."""
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if size < self.offset:
+                raise ValueError(
+                    f"{self.path}: journal shrank below the follower "
+                    f"cursor ({size} < {self.offset}) — rewritten "
+                    f"out-of-band; the consumed-record cursor is void")
+            if size == self.offset:
+                return []
+            f.seek(self.offset)
+            chunk = f.read(size - self.offset)
+        nl = chunk.rfind(b"\n")
+        if nl < 0:
+            return []            # only an unterminated fragment so far
+        lineno_base = self.offset   # byte position, for error messages
+        self.offset += nl + 1
+        out: List[dict] = []
+        for raw in chunk[:nl + 1].split(b"\n"):
+            if not raw.strip():
+                continue
+            try:
+                rec = json.loads(raw.decode("utf-8"))
+            except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                raise ValueError(
+                    f"{self.path}: unparseable newline-terminated "
+                    f"record after byte {lineno_base} — interior "
+                    f"corruption, not a torn tail") from e
+            k = rec.get("kind")
+            if "round_end" in rec and k is not None:
+                self._covered[k] = max(self._covered.get(k, 0),
+                                       int(rec["round_end"]))
+            if self.kind is None or k == self.kind:
+                out.append(rec)
+        return out
+
+
+def follow_records(path: str, kind: Optional[str] = None) -> JournalFollower:
+    """A :class:`JournalFollower` over ``path`` — the live-tail reader
+    (``telemetry watch``) and the supervisor's scan-once resume cursor."""
+    return JournalFollower(path, kind=kind)
+
+
 def read_events(path: str) -> List[MembershipTraceEvent]:
     events: List[MembershipTraceEvent] = []
     for rec in read_records(path, kind="events"):

@@ -1,0 +1,490 @@
+"""The live SLO alarm engine (telemetry/alarms.py) + journal follower
+(sink.JournalFollower) + their integrations.
+
+Pins: the pending→firing→resolved state machine (debounce, clear-side
+hysteresis, pending-cancel, sliding windows, no-signal rules), the
+follower's consumed-bytes-are-never-re-read cursor (torn-tail wait,
+shrink refusal, interior-corruption refusal), exactly-once transition
+resume through ``replay_journal``/``write_transitions`` — unit-level
+AND through ``stream_metered_run`` re-runs and a supervisor
+``KillPlan(stage="post_journal")`` kill/relaunch (the preemption that
+strands a durable segment with its alarm rows missing) — and the
+``telemetry watch`` CLI tailing a journal a live subprocess is still
+writing without dropping or duplicating a single window.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from scalecube_cluster_tpu.telemetry import alarms
+from scalecube_cluster_tpu.telemetry import sink as tsink
+
+pytestmark = pytest.mark.alarm
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def row(start, end, onsets, observers=40, kind="metrics_window"):
+    c = {"false_suspicion_onsets": onsets}
+    if observers is not None:
+        c["live_observer_rounds"] = observers
+    return {"kind": kind, "round_start": start, "round_end": end,
+            "counters": c}
+
+
+def fp_engine(threshold=0.5, **kw):
+    return alarms.AlarmEngine(
+        alarms.default_specs(threshold=threshold, **kw))
+
+
+def transitions_of(records):
+    return [(r["alarm"], r["from"], r["to"], r["round_end"])
+            for r in records]
+
+
+# --------------------------------------------------------------------------
+# Spec validation
+# --------------------------------------------------------------------------
+
+
+def test_spec_rejects_unknown_comparator():
+    with pytest.raises(ValueError, match="comparator"):
+        alarms.AlarmSpec(name="x", numerator="a", comparator="!=")
+
+
+@pytest.mark.parametrize("field", ["window", "for_windows",
+                                   "clear_windows"])
+def test_spec_rejects_nonpositive_windows(field):
+    with pytest.raises(ValueError, match=field):
+        alarms.AlarmSpec(name="x", numerator="a", **{field: 0})
+
+
+def test_engine_rejects_duplicate_names():
+    spec = alarms.AlarmSpec(name="dup", numerator="a")
+    with pytest.raises(ValueError, match="duplicate"):
+        alarms.AlarmEngine([spec, spec])
+
+
+# --------------------------------------------------------------------------
+# State machine
+# --------------------------------------------------------------------------
+
+
+def test_fires_immediately_then_resolves():
+    eng = fp_engine(threshold=0.5)
+    assert eng.observe(row(0, 4, onsets=0)) == []
+    fired = eng.observe(row(4, 8, onsets=40))     # rate 1.0 > 0.5
+    assert transitions_of(fired) == [
+        ("false_positive_observer_rate", "ok", "firing", 8)]
+    assert eng.state_of("false_positive_observer_rate") == alarms.FIRING
+    resolved = eng.observe(row(8, 12, onsets=0))
+    assert transitions_of(resolved) == [
+        ("false_positive_observer_rate", "firing", "resolved", 12)]
+    # resolved is a transition, not a resting state: back at ok, the
+    # alarm can fire again.
+    assert eng.state_of("false_positive_observer_rate") == alarms.OK
+    assert eng.observe(row(12, 16, onsets=40))[0]["to"] == alarms.FIRING
+    [st] = eng.state_rows()
+    assert st["fired"] == 2 and st["resolved"] == 1
+
+
+def test_for_windows_debounce_goes_pending_first():
+    eng = fp_engine(threshold=0.5, for_windows=3)
+    t1 = eng.observe(row(0, 4, onsets=40))
+    assert transitions_of(t1) == [
+        ("false_positive_observer_rate", "ok", "pending", 4)]
+    assert eng.observe(row(4, 8, onsets=40)) == []   # still pending
+    t3 = eng.observe(row(8, 12, onsets=40))
+    assert transitions_of(t3) == [
+        ("false_positive_observer_rate", "pending", "firing", 12)]
+    assert t3[0]["streak"] == 3
+
+
+def test_pending_cancels_on_clear_window():
+    eng = fp_engine(threshold=0.5, for_windows=3)
+    eng.observe(row(0, 4, onsets=40))
+    t = eng.observe(row(4, 8, onsets=0))
+    assert transitions_of(t) == [
+        ("false_positive_observer_rate", "pending", "ok", 8)]
+    [st] = eng.state_rows()
+    assert st["fired"] == 0
+    # And the streak reset: a fresh breach starts the debounce over.
+    assert eng.observe(row(8, 12, onsets=40))[0]["to"] == alarms.PENDING
+
+
+def test_clear_windows_hysteresis_prevents_flapping():
+    eng = fp_engine(threshold=0.5, clear_windows=2)
+    eng.observe(row(0, 4, onsets=40))
+    assert eng.observe(row(4, 8, onsets=0)) == []    # 1 clear: holds
+    # A re-breach inside the incident resets the clear streak.
+    assert eng.observe(row(8, 12, onsets=40)) == []
+    assert eng.observe(row(12, 16, onsets=0)) == []
+    t = eng.observe(row(16, 20, onsets=0))
+    assert transitions_of(t) == [
+        ("false_positive_observer_rate", "firing", "resolved", 20)]
+
+
+def test_sliding_window_is_ratio_of_sums():
+    spec = alarms.AlarmSpec(
+        name="fp", numerator="false_suspicion_onsets",
+        denominator="live_observer_rounds", threshold=0.5, window=2)
+    eng = alarms.AlarmEngine([spec])
+    eng.observe(row(0, 4, onsets=0, observers=40))
+    eng.observe(row(4, 8, onsets=40, observers=40))
+    # (0 + 40) / (40 + 40) = 0.5, not the instantaneous 1.0 — the
+    # sliding mean must not breach the strict > 0.5 threshold.
+    [st] = eng.state_rows()
+    assert st["value"] == pytest.approx(0.5)
+    assert st["state"] == alarms.OK
+
+
+def test_absent_lane_and_zero_denominator_are_not_evaluations():
+    eng = fp_engine(threshold=0.5)
+    eng.observe(row(0, 4, onsets=40))
+    assert eng.state_of("false_positive_observer_rate") == alarms.FIRING
+    # Segment rows without the SLO's lanes must not touch the state:
+    # absence of signal is not health.
+    assert eng.observe({"kind": "metrics_window", "round_start": 4,
+                        "round_end": 8, "counters": {}}) == []
+    assert eng.observe(row(8, 12, onsets=0, observers=0)) == []
+    assert eng.state_of("false_positive_observer_rate") == alarms.FIRING
+
+
+def test_rounds_denominator_and_segment_kind():
+    spec = alarms.AlarmSpec(name="gossip", numerator="messages_gossip",
+                            denominator="rounds", threshold=2.0)
+    eng = alarms.AlarmEngine([spec], kinds=("segment",))
+    rec = {"kind": "segment", "round_start": 0, "round_end": 8,
+           "counters": {"messages_gossip": 24}}
+    t = eng.observe(rec)
+    assert transitions_of(t) == [("gossip", "ok", "firing", 8)]
+    assert t[0]["value"] == pytest.approx(3.0)       # 24 / 8 rounds
+    # Kinds outside the engine's filter pass through untouched.
+    assert eng.observe({"kind": "metrics_window", **rec}) == []
+    assert eng.observe({"kind": "histogram"}) == []
+
+
+# --------------------------------------------------------------------------
+# Replay + exactly-once dedup (unit level)
+# --------------------------------------------------------------------------
+
+
+def test_replay_dedup_writes_exactly_the_missing_tail(tmp_path):
+    windows = [row(0, 4, onsets=40), row(4, 8, onsets=0),
+               row(8, 12, onsets=40)]
+    ref = fp_engine(threshold=0.5)
+    all_transitions = [t for w in windows for t in ref.observe(w)]
+    assert len(all_transitions) == 3          # fire, resolve, fire
+
+    # The dead process journaled every window but only the FIRST two
+    # transitions (killed mid-transition-list).
+    path = tmp_path / "resume.jsonl"
+    with tsink.TelemetrySink(path=str(path)) as sink:
+        for w in windows:
+            sink.write_metrics_window(
+                {k: v for k, v in w.items() if k != "kind"})
+        alarms.write_transitions(sink, all_transitions[:2])
+
+    records = tsink.read_records(str(path))
+    fresh = fp_engine(threshold=0.5)
+    replayed, existing = alarms.replay_journal(fresh, records)
+    assert transitions_of(replayed) == transitions_of(all_transitions)
+    with tsink.TelemetrySink(path=str(path), append=True) as sink:
+        written = alarms.write_transitions(sink, replayed, existing)
+    assert transitions_of(written) == transitions_of(all_transitions[2:])
+    durable = tsink.read_records(str(path), kind=alarms.TRANSITION_KIND)
+    assert transitions_of(durable) == transitions_of(all_transitions)
+
+    # Idempotence: a second replay finds nothing missing.
+    eng2 = fp_engine(threshold=0.5)
+    replayed2, existing2 = alarms.replay_journal(
+        eng2, tsink.read_records(str(path)))
+    with tsink.TelemetrySink(path=str(path), append=True) as sink:
+        assert alarms.write_transitions(sink, replayed2, existing2) == []
+
+
+# --------------------------------------------------------------------------
+# JournalFollower
+# --------------------------------------------------------------------------
+
+
+def test_follower_consumes_only_terminated_lines(tmp_path):
+    path = tmp_path / "live.jsonl"
+    line1 = json.dumps({"kind": "metrics_window", "round_start": 0,
+                        "round_end": 4}) + "\n"
+    frag = '{"kind": "metrics_window", "round_st'
+    path.write_text(line1 + frag)
+    f = tsink.follow_records(str(path))
+    recs = f.poll()
+    assert [r["round_end"] for r in recs] == [4]
+    assert f.offset == len(line1)             # cursor stops at the newline
+    assert f.poll() == []                     # fragment: wait, don't parse
+    with open(path, "a") as fh:
+        fh.write('art": 4, "round_end": 8}\n')
+    assert [r["round_end"] for r in f.poll()] == [8]
+    assert f.covered_upto(kind="metrics_window") == 8
+
+
+def test_follower_never_rereads_consumed_bytes(tmp_path):
+    """The satellite pin: a long journal is scanned ONCE.  After a
+    poll, the consumed prefix is overwritten in place with garbage —
+    if any later poll re-parsed those bytes it would raise; instead
+    only the appended tail is returned."""
+    path = tmp_path / "prefix.jsonl"
+    with tsink.TelemetrySink(path=str(path)) as sink:
+        for i in range(50):
+            sink.write_metrics_window(
+                {"round_start": 4 * i, "round_end": 4 * (i + 1),
+                 "counters": {}})
+    f = tsink.follow_records(str(path))
+    first = f.poll()
+    assert len(first) == 50
+    consumed = f.offset
+    with open(path, "r+b") as fh:             # same length: offsets hold
+        fh.write(b"X" * consumed)
+    with open(path, "a") as fh:
+        fh.write(json.dumps({"kind": "metrics_window",
+                             "round_start": 200,
+                             "round_end": 204}) + "\n")
+    tail = f.poll()
+    assert [r["round_end"] for r in tail] == [204]
+    assert f.covered_upto(kind="metrics_window") == 204
+
+
+def test_follower_refuses_shrunk_journal(tmp_path):
+    path = tmp_path / "shrink.jsonl"
+    path.write_text('{"kind": "segment", "round_end": 8}\n')
+    f = tsink.follow_records(str(path))
+    assert len(f.poll()) == 1
+    os.truncate(path, 3)
+    with pytest.raises(ValueError, match="shrank"):
+        f.poll()
+
+
+def test_follower_refuses_interior_corruption(tmp_path):
+    path = tmp_path / "corrupt.jsonl"
+    path.write_text("not json at all\n")
+    f = tsink.follow_records(str(path))
+    with pytest.raises(ValueError, match="corrupt"):
+        f.poll()
+
+
+def test_follower_kind_filter_still_tracks_all_cursors(tmp_path):
+    path = tmp_path / "filter.jsonl"
+    path.write_text(
+        '{"kind": "segment", "round_end": 8}\n'
+        '{"kind": "metrics_window", "round_end": 4}\n')
+    f = tsink.follow_records(str(path), kind="segment")
+    assert [r["kind"] for r in f.poll()] == ["segment"]
+    # The per-kind cursors rebase from everything scanned, matching
+    # the whole-file covered_upto on the same bytes.
+    assert f.covered_upto(kind="segment") == 8
+    assert f.covered_upto(kind="metrics_window") == 4
+    assert tsink.covered_upto(str(path), kind="segment") == 8
+
+
+def test_follower_missing_file_waits(tmp_path):
+    f = tsink.follow_records(str(tmp_path / "notyet.jsonl"))
+    assert f.poll() == []
+
+
+# --------------------------------------------------------------------------
+# stream_metered_run integration: live transitions + resumed dedup
+# --------------------------------------------------------------------------
+
+
+def small_workload(n=12, loss=0.0):
+    import jax
+
+    from scalecube_cluster_tpu.config import ClusterConfig
+    from scalecube_cluster_tpu.models import swim
+
+    cfg = ClusterConfig.default().replace(
+        gossip_interval=100, ping_interval=200, ping_timeout=100,
+        sync_interval=1_000, suspicion_mult=3)
+    params = swim.SwimParams.from_config(cfg, n_members=n,
+                                         loss_probability=loss)
+    return jax.random.key(3), params, swim.SwimWorld.healthy(params)
+
+
+# Fires on the first window of any live run: every member observes.
+ACTIVITY_SPEC = alarms.AlarmSpec(
+    name="observers_present", numerator="live_observer_rounds",
+    denominator="rounds", comparator=">", threshold=0.0)
+
+
+def test_stream_metered_run_journals_transitions(tmp_path):
+    from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+
+    key, params, world = small_workload()
+    path = tmp_path / "run.jsonl"
+    with tsink.TelemetrySink(path=str(path)) as sink:
+        _, rows = tmetrics.stream_metered_run(
+            key, params, world, 16, sink=sink, window_rounds=4,
+            alarm_specs=[ACTIVITY_SPEC])
+    assert len(rows) == 4
+    durable = tsink.read_records(str(path), kind=alarms.TRANSITION_KIND)
+    assert transitions_of(durable) == [
+        ("observers_present", "ok", "firing", 4)]
+
+
+def test_stream_metered_run_resume_is_exactly_once(tmp_path):
+    """A full re-run over the same journal (the supervisor's relaunch
+    shape) recomputes every window but writes NOTHING new: windows
+    dedup through the cursor, transitions through the replay."""
+    from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+
+    key, params, world = small_workload()
+    path = tmp_path / "resumed.jsonl"
+    with tsink.TelemetrySink(path=str(path)) as sink:
+        tmetrics.stream_metered_run(
+            key, params, world, 16, sink=sink, window_rounds=4,
+            alarm_specs=[ACTIVITY_SPEC])
+    before = [json.dumps(r) for r in tsink.read_records(str(path))]
+    with tsink.TelemetrySink(path=str(path), append=True) as sink:
+        tmetrics.stream_metered_run(
+            key, params, world, 16, sink=sink, window_rounds=4,
+            alarm_specs=[ACTIVITY_SPEC])
+    after = [json.dumps(r) for r in tsink.read_records(str(path))]
+    assert after == before
+
+
+def test_alarm_specs_without_sink_refused():
+    from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+
+    key, params, world = small_workload()
+    with pytest.raises(ValueError, match="sink"):
+        tmetrics.stream_metered_run(key, params, world, 8,
+                                    alarm_specs=[ACTIVITY_SPEC])
+
+
+# --------------------------------------------------------------------------
+# Supervisor integration: kill mid-transition, relaunch, exactly once
+# --------------------------------------------------------------------------
+
+
+SUPERVISOR_SPECS = (
+    # Fires at the first segment of any live run.
+    alarms.AlarmSpec(name="gossip_active", numerator="messages_gossip",
+                     denominator="rounds", comparator=">",
+                     threshold=0.0),
+    # Debounced twin: pending at segment 1, firing at segment 2 — the
+    # transition the post_journal kill strands.
+    alarms.AlarmSpec(name="gossip_active_debounced",
+                     numerator="messages_gossip", denominator="rounds",
+                     comparator=">", threshold=0.0, for_windows=2),
+)
+
+
+def run_supervised(tmp_path, sub, kill_plan=None):
+    from scalecube_cluster_tpu.resilience import harness as rh
+    from scalecube_cluster_tpu.resilience import store as rstore
+    from scalecube_cluster_tpu.resilience import supervisor as rsup
+
+    base = tmp_path / sub
+    os.makedirs(base, exist_ok=True)
+    cfg = rh.DrillConfig(shape="plain", base_path=str(base / "ck"),
+                         n_members=12, n_rounds=24, segment_rounds=8)
+    key, params, world, _ = rh.build_workload(cfg)
+    return rsup.run_resilient(
+        "plain", key, params, world, cfg.n_rounds,
+        store=rstore.CheckpointStore(cfg.base_path),
+        segment_rounds=cfg.segment_rounds,
+        alarm_specs=SUPERVISOR_SPECS, kill_plan=kill_plan)
+
+
+def test_supervisor_kill_relaunch_transitions_exactly_once(tmp_path):
+    from scalecube_cluster_tpu.resilience import supervisor as rsup
+
+    ref = run_supervised(tmp_path, "ref")
+    ref_rows = tsink.read_records(ref.journal_path,
+                                  kind=alarms.TRANSITION_KIND)
+    assert transitions_of(ref_rows) == [
+        ("gossip_active", "ok", "firing", 8),
+        ("gossip_active_debounced", "ok", "pending", 8),
+        ("gossip_active_debounced", "pending", "firing", 16),
+    ]
+    assert ref.alarm_transitions == 3
+
+    # Kill at the nastiest stage: the round-16 segment record is
+    # durable, its firing transition is NOT.
+    with pytest.raises(rsup.SimulatedPreemption):
+        run_supervised(tmp_path, "kill", kill_plan=rsup.KillPlan(
+            round=12, stage="post_journal", mode="raise"))
+    killed = tsink.read_records(
+        str(tmp_path / "kill" / "ck.journal.jsonl"),
+        kind=alarms.TRANSITION_KIND)
+    assert transitions_of(killed) == transitions_of(ref_rows)[:2]
+
+    res = run_supervised(tmp_path, "kill")
+    assert res.resumed_from is not None
+    rows = tsink.read_records(res.journal_path,
+                              kind=alarms.TRANSITION_KIND)
+    # The relaunch replayed the journal, wrote EXACTLY the stranded
+    # firing row, and the resumed segments added nothing new: the
+    # kill/relaunch journal is row-for-row the uninterrupted one.
+    assert transitions_of(rows) == transitions_of(ref_rows)
+    assert res.alarm_transitions == 1
+
+
+# --------------------------------------------------------------------------
+# The watch CLI against a live writer subprocess
+# --------------------------------------------------------------------------
+
+
+WRITER = r"""
+import sys, time
+from scalecube_cluster_tpu.telemetry import sink as tsink
+
+path, n = sys.argv[1], int(sys.argv[2])
+with tsink.TelemetrySink(path=path) as s:
+    s.write_manifest(params={"n": 8})
+    for i in range(n):
+        breach = 40 if (n // 3) <= i < (2 * n // 3) else 0
+        s.write_metrics_window({
+            "round_start": 4 * i, "round_end": 4 * (i + 1),
+            "counters": {"false_suspicion_onsets": breach,
+                         "live_observer_rounds": 40}})
+        time.sleep(0.02)
+    s.write_summary(windows=n)
+"""
+
+
+def test_watch_tails_live_subprocess_exactly_once(tmp_path):
+    """End-to-end acceptance pin: watch tails a journal ANOTHER process
+    is still writing and sees every window exactly once, fires on the
+    mid-stream breach plateau, and exits on the summary record."""
+    n = 30
+    path = tmp_path / "live_run.jsonl"
+    env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu")
+    writer = subprocess.Popen(
+        [sys.executable, "-c", WRITER, str(path), str(n)], env=env)
+    try:
+        watch = subprocess.run(
+            [sys.executable, "-m", "scalecube_cluster_tpu.telemetry",
+             "watch", str(path), "--json", "--interval", "0.05",
+             "--threshold", "0.5", "--max-seconds", "60"],
+            env=env, capture_output=True, text=True, timeout=120)
+    finally:
+        writer.wait(timeout=60)
+    assert watch.returncode == 0, watch.stderr
+    lines = [json.loads(ln) for ln in watch.stdout.splitlines()]
+    windows = [ln for ln in lines if ln["kind"] == "window"]
+    # Every window exactly once, in order — no drops, no duplicates.
+    assert [w["round_end"] for w in windows] == [
+        4 * (i + 1) for i in range(n)]
+    fired = [t for w in windows for t in w["transitions"]
+             if t["to"] == "firing"]
+    resolved = [t for w in windows for t in w["transitions"]
+                if t["to"] == "resolved"]
+    assert len(fired) == 1 and len(resolved) == 1
+    assert fired[0]["round_end"] == 4 * (n // 3 + 1)
+    summary = lines[-1]
+    assert summary["kind"] == "watch_summary"
+    assert summary["windows"] == n and summary["run_ended"] is True
+    assert summary["engine_transitions"] == 2

@@ -1,0 +1,203 @@
+"""bench.py --alarms --smoke: the live SLO alarm drill JSON contract.
+
+Like tests/test_bench_lifeguard_smoke.py for the health plane: the
+bench is the one entry point the detection-lag measurement flows
+through, so this tier-1 test runs the real script in a subprocess
+(CPU) and pins the published contract — one JSON line with the drill
+fields (breach arm fires within one window of onset and resolves after
+the heal, healthy arm stays silent through the same pulse, zero extra
+compiles witnessed per-arm), an artifacts/alarm_drill.json-style
+artifact the query layer loads as a real payload, and the regress gate
+walking it with the absolute alarm checks.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.alarm
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run_alarm_bench(tmp_path, flags=("--alarms", "--smoke"),
+                     extra_env=None, timeout=540):
+    artifact = tmp_path / "alarm_drill_smoke.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_TPU_TELEMETRY_DIR=str(tmp_path),
+        SCALECUBE_ALARM_ARTIFACT=str(artifact),
+        SCALECUBE_XLA_CACHE_DIR="",           # no cache writes from tests
+    )
+    env.pop("SCALECUBE_TPU_PROFILE_DIR", None)
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), *flags],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout      # exactly ONE JSON line
+    return json.loads(lines[0]), artifact
+
+
+def test_bench_alarms_smoke_contract(tmp_path):
+    result, artifact = _run_alarm_bench(tmp_path)
+
+    assert "error" not in result, result
+    assert result["smoke"] is True
+    assert result["metric"] == "alarm_detection_lag_windows"
+    # value stays None BY DESIGN (detection lag is smaller-is-better
+    # and must not enter the generic throughput walk); the payload
+    # says so.
+    assert result["value"] is None
+    assert "value_note" in result
+
+    # The headline acceptance: the planted breach reaches FIRING
+    # within one metrics window of the pulse onset, resolves after the
+    # heal, and the healthy arm rides the same pulse out silently.
+    assert result["breach_fired"] >= 1
+    assert result["alarm_detection_lag_windows"] <= 1.0
+    assert result["breach_resolved"] is True
+    assert result["healthy_transitions"] == 0
+    # The calibration evidence: real margin on both sides of the
+    # threshold (alarms.DEFAULT_FP_THRESHOLD / SMOKE_ALARM_THRESHOLD
+    # docstrings).
+    assert result["healthy_peak_rate"] < result["threshold"]
+    assert result["breach_first_fire_rate"] > result["threshold"]
+    assert result["margin_healthy"] > 0
+    assert result["margin_breach"] > 0
+
+    # Workload provenance + both arms' journals, live-tailable.
+    assert result["delivery"] == "scatter"
+    assert "alarm_drill_scenario" in result["repro"]
+    assert set(result["arms"]) == {"healthy", "breach"}
+    for arm, row in result["arms"].items():
+        assert os.path.exists(row["journal"]), arm
+        assert row["seconds"] > 0            # zero-extra-compiles witness
+        assert len(row["window_rates"]) == (result["horizon"]
+                                            // result["window_rounds"])
+    breach_fires = [t for t in result["arms"]["breach"]["transitions"]
+                    if t["to"] == "firing"]
+    assert breach_fires and breach_fires[0]["round_end"] == (
+        result["onset_round"] + result["window_rounds"])
+    assert result["arms"]["healthy"]["transitions"] == []
+
+    # The artifact round-trips and loads as a REAL (non-stub) payload.
+    art = json.loads(artifact.read_text())
+    assert art["metric"] == result["metric"]
+    assert (art["alarm_detection_lag_windows"]
+            == result["alarm_detection_lag_windows"])
+
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    payload, skip_note = tquery.load_bench_payload(str(artifact))
+    assert skip_note is None
+    assert payload["breach_fired"] == result["breach_fired"]
+
+    # The in-bench regress gate ran and the dedicated absolute checks
+    # are present and green for the fresh artifact.
+    assert result["regress"]["ok"] is True
+    assert result["regress"]["artifacts"] >= 1
+    ok, rows = tquery.regress([str(artifact)])
+    assert ok
+    names = {r["check"] for r in rows}
+    assert {"slo/alarm_breach_fired", "slo/alarm_detection_lag",
+            "slo/alarm_resolved_after_heal",
+            "slo/alarm_healthy_quiet"} <= names
+
+
+def test_alarms_flag_is_exclusive(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--alarms", "--sync"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode != 0
+    assert "--alarms" in proc.stderr
+
+
+def test_regress_fails_on_rotted_alarm_drill(tmp_path):
+    """An artifact recording a missed/late detection, a stuck alarm or
+    a noisy healthy arm must fail the gate — the committed claim
+    cannot silently rot."""
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    bad = tmp_path / "alarm_drill_bad.json"
+    bad.write_text(json.dumps({
+        "metric": "alarm_detection_lag_windows", "value": None,
+        "alarm_detection_lag_windows": 3.0, "breach_fired": 0,
+        "breach_resolved": False, "healthy_transitions": 2,
+    }))
+    ok, rows = tquery.regress([str(bad)])
+    assert not ok
+    failed = {r["check"] for r in rows if r.get("ok") is False}
+    assert {"slo/alarm_breach_fired", "slo/alarm_detection_lag",
+            "slo/alarm_resolved_after_heal",
+            "slo/alarm_healthy_quiet"} <= failed
+
+
+def test_regress_never_fired_lag_is_a_failure(tmp_path):
+    """``breach_fired = 0`` leaves the lag null — that must read as a
+    FAILED detection gate, not a vacuous pass."""
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    bad = tmp_path / "alarm_drill_nofire.json"
+    bad.write_text(json.dumps({
+        "metric": "alarm_detection_lag_windows", "value": None,
+        "alarm_detection_lag_windows": None, "breach_fired": 0,
+        "breach_resolved": True, "healthy_transitions": 0,
+    }))
+    ok, rows = tquery.regress([str(bad)])
+    assert not ok
+    failed = {r["check"] for r in rows if r.get("ok") is False}
+    assert "slo/alarm_detection_lag" in failed
+
+
+def test_regress_smoke_artifacts_are_provenance_next_to_full(tmp_path):
+    """A smoke alarm drill sitting next to a full one is a provenance
+    row; the full round carries the gates."""
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    def art(path, smoke, fired):
+        path.write_text(json.dumps({
+            "metric": "alarm_detection_lag_windows", "value": None,
+            "smoke": smoke, "alarm_detection_lag_windows":
+            1.0 if fired else None, "breach_fired": int(fired),
+            "breach_resolved": fired, "healthy_transitions": 0,
+        }))
+        return str(path)
+
+    full = art(tmp_path / "alarm_drill.json", False, True)
+    smoke = art(tmp_path / "alarm_drill_smoke.json", True, False)
+    ok, rows = tquery.regress([full, smoke])
+    assert ok                              # the bad smoke round skips
+    notes = [r for r in rows if r.get("ok") is None
+             and r["check"] == "slo/alarm_drill"]
+    assert notes and "smoke" in notes[0]["note"]
+
+
+@pytest.mark.slow
+def test_bench_alarms_full_drill(tmp_path):
+    """The full (non-smoke) drill: the committed-artifact geometry
+    (n=48, pulse_loss=0.6, DEFAULT_FP_THRESHOLD) through the real
+    bench, the aggregate gates green."""
+    artifact = tmp_path / "alarm_drill_full.json"
+    result, _ = _run_alarm_bench(
+        tmp_path, flags=("--alarms",),
+        extra_env={"SCALECUBE_ALARM_ARTIFACT": str(artifact)},
+        timeout=3000)
+    assert "error" not in result, result
+    assert result["smoke"] is False
+    assert result["breach_fired"] >= 1
+    assert result["alarm_detection_lag_windows"] <= 1.0
+    assert result["breach_resolved"] is True
+    assert result["healthy_transitions"] == 0
+    assert result["regress"]["ok"] is True

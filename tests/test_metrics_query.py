@@ -571,3 +571,88 @@ def test_cli_module_entry_point(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     out = json.loads(proc.stdout)
     assert out["ok"] is True
+
+# --------------------------------------------------------------------------
+# Degraded inputs: torn journals, overlapping merges, one-sided diffs
+# --------------------------------------------------------------------------
+
+
+def test_report_degrades_on_torn_trailing_window(tmp_path, capsys):
+    """A journal whose writer was SIGKILLed mid-``metrics_window`` line
+    still reports: the torn trailing record is skipped WITH a warning
+    (never silently, never a crash) and the durable prefix carries the
+    SLOs — exit code 0, the CI-stable contract."""
+    path = write_manifest(
+        tmp_path / "torn.jsonl",
+        [window(0, 32, counters={"false_suspicion_onsets": 2}),
+         window(32, 64, counters={"false_suspicion_onsets": 1})])
+    with open(path, "a") as f:      # half a window row, no newline
+        f.write('{"kind": "metrics_window", "round_start": 64, '
+                '"round_end": 96, "counters": {"false_susp')
+    with pytest.warns(UserWarning, match="torn trailing"):
+        assert cli_main(["report", path, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    # The durable prefix only: the torn window's rounds/counters are
+    # not in the fold.
+    assert out["slos"]["rounds_covered"] == 64
+    assert out["counters"]["false_suspicion_onsets"] == 3
+    assert out["slos"]["false_positive_observer_rate"] \
+        == pytest.approx(3 / (64 * 8))
+    # Interior corruption stays a hard input error (exit 2): a
+    # terminated-but-unparseable line cannot come from a torn write.
+    bad = tmp_path / "corrupt.jsonl"
+    bad.write_text("not json at all\n")
+    assert cli_main(["report", str(bad)]) == 2
+    capsys.readouterr()
+
+
+def test_merge_reports_overlapping_out_of_order_windows(tmp_path):
+    """Merging runs whose windows overlap and arrive out of round
+    order: counters stay plain sums (window totals — the defined
+    semantics, double-counted rounds and all), every raw window
+    survives for time-resolved rendering, and rounds_covered is the
+    max round_end, not the concatenation order's last."""
+    a = query.load_report(write_manifest(
+        tmp_path / "a.jsonl",
+        [window(32, 64, counters={"false_suspicion_onsets": 1},
+                gauges={"suspect_entries": 5.0}),
+         window(0, 32, counters={"false_suspicion_onsets": 2})]))
+    b = query.load_report(write_manifest(
+        tmp_path / "b.jsonl",
+        [window(16, 48, counters={"false_suspicion_onsets": 4},
+                gauges={"suspect_entries": 7.0})]))
+    merged = query.merge_reports([a, b])
+    assert merged.counters["false_suspicion_onsets"] == 7
+    assert merged.counters["live_observer_rounds"] == (64 + 32) * 8
+    assert len(merged.windows) == 3
+    assert merged.rounds_covered == 64          # max end, order-proof
+    assert merged.gauges["suspect_entries"] == 7.0   # last report wins
+    slos = query.compute_slos(merged)
+    assert slos["false_positive_observer_rate"] \
+        == pytest.approx(7 / ((64 + 32) * 8))
+    # And the CLI multi-manifest path folds the same way.
+    assert cli_main(["report", a.path, b.path, "--json"]) == 0
+
+
+def test_diff_reports_one_sided_keys(tmp_path):
+    """A metric present in only one run must diff as a row with the
+    missing side None and delta/rel None — never a KeyError, never a
+    fabricated zero."""
+    a = query.load_report(write_manifest(
+        tmp_path / "a.jsonl",
+        [window(0, 32, counters={"fd_probes_sent": 5})]))
+    b = query.load_report(write_manifest(
+        tmp_path / "b.jsonl",
+        [window(0, 32)],
+        summary={"sync_rounds_to_converge": 9}))
+    rows = {r["metric"]: r for r in query.diff_reports(a, b)}
+    one_sided = rows["counter/fd_probes_sent"]
+    assert (one_sided["a"], one_sided["b"]) == (5, None)
+    assert one_sided["delta"] is None and one_sided["rel"] is None
+    slo = rows["slo/sync_rounds_to_converge"]
+    assert (slo["a"], slo["b"]) == (None, 9)
+    assert slo["delta"] is None and slo["rel"] is None
+    # Symmetric direction: b-only keys diff against a None a-side too.
+    back = {r["metric"]: r for r in query.diff_reports(b, a)}
+    assert (back["counter/fd_probes_sent"]["a"],
+            back["counter/fd_probes_sent"]["b"]) == (None, 5)
