@@ -2698,6 +2698,268 @@ def run_compose_bench():
     print(json.dumps(result), flush=True)
 
 
+def run_tune_bench():
+    """The --tune mode: device-parallel protocol autotuning
+    (tune/search.py) — one JSON line out (never-ship-empty).
+
+    Four stages over one seeded tune workload (generated campaign
+    scenarios, join storms excluded, health planes enabled at the knob
+    ceilings):
+
+      1. *sweep* — every knob-grid config and every shipped profile
+         over every scenario shape bucket through the scored plane
+         stack (trace ⊕ passive monitor on the batched composed scan).
+         Knob data is traced, so the whole grid reuses ONE compiled
+         program per shape bucket — the jit-cache miss counts are the
+         witness (``tune_compiles == tune_shape_buckets``, and the
+         timed warm pass adds ZERO);
+      2. *throughput* — a second full grid pass over the warm
+         programs: ``tune_grid_throughput`` = configs x member-rounds
+         per wall second, scoring included;
+      3. *speedup* — what the traced-knob batching actually buys: the
+         grid swept with dynamic knobs (ONE compile per shape bucket,
+         every config a warm rerun) vs the same grid swept the static
+         way (each config baked into ``SwimParams`` -> a FRESH compile
+         per config x bucket, measured on real cold configs and
+         extrapolated to the grid): ``batch_speedup_ratio`` with a
+         >= 1.0 regress floor.  The warm-path control — one
+         ``composed_batch_scan`` call per bucket vs one
+         ``composed_scan`` call per scenario, interleaved best-of
+         windows — ships alongside as ``batch_dispatch_ratio``
+         (informational: on CPU at small widths the two warm paths
+         are within noise of parity; the compile amortization is the
+         win);
+      4. *profiles* — the Pareto frontier over green rows, and every
+         shipped profile (``SwimParams.tuned``) checked non-dominated
+         vs the reference row and revalidated by the FULL fuzz oracle
+         (completeness deadlines rebuilt under the profile's static
+         schedule) on held-out seeds.
+
+    ``value`` stays None by design: grid throughput is host-dependent
+    and the quality gates are absolute — regress walks the dedicated
+    tune checks instead (telemetry/query.py).
+    """
+    result = {
+        "metric": "tune_pareto",
+        "value": None,
+        "unit": "config-member-rounds/sec",
+        "smoke": SMOKE,
+    }
+    artifact = (os.environ.get("SCALECUBE_TUNE_ARTIFACT")
+                or os.path.join("artifacts",
+                                "tune_pareto_smoke.json" if SMOKE
+                                else "tune_pareto.json"))
+    try:
+        jax, platform = init_backend()
+        result["platform"] = platform
+
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from scalecube_cluster_tpu.chaos import campaign as ccampaign
+        from scalecube_cluster_tpu.chaos import monitor as cmonitor
+        from scalecube_cluster_tpu.models import swim
+        from scalecube_cluster_tpu.tune import profiles as tprofiles
+        from scalecube_cluster_tpu.tune import search as tsearch
+        from scalecube_cluster_tpu.utils import runlog
+
+        n = int(os.environ.get("SCALECUBE_TUNE_N", 16 if SMOKE else 32))
+        n_scen = int(os.environ.get("SCALECUBE_TUNE_SCENARIOS",
+                                    6 if SMOKE else 12))
+        seed = int(os.environ.get("SCALECUBE_TUNE_SEED", 500))
+        held_out = int(os.environ.get("SCALECUBE_TUNE_HELDOUT_SEED",
+                                      7001))
+        per_tier = int(os.environ.get("SCALECUBE_TUNE_FUZZ_PER_TIER",
+                                      1 if SMOKE else 2))
+        reps = int(os.environ.get("SCALECUBE_TUNE_REPS", 2))
+        capacity = int(os.environ.get("SCALECUBE_TUNE_CAPACITY", 256))
+        trace_cap = tsearch.DEFAULT_TRACE_CAPACITY
+
+        scens = tsearch.tune_scenarios(seed, n_scen, n=n, log=log)
+        result.update(scenarios=len(scens), n_members=n, seed=seed,
+                      delivery="shift", capacity=capacity)
+
+        # ---- stage 1: the sweep (compiles included) -------------------
+        t0 = time.time()
+        rows, info = tsearch.sweep(scens, seed=seed, smoke=SMOKE,
+                                   capacity=capacity, log=log)
+        sweep_s = time.time() - t0
+        log(f"tune sweep: {info['configs']} configs x "
+            f"{info['scenarios']} scenarios in {sweep_s:.1f}s "
+            f"— {info['calls']} device calls, {info['compiles']} "
+            f"compiles ({info['shape_buckets']} shape buckets)")
+
+        # ---- stage 2: timed warm grid pass ----------------------------
+        configs = ([{"name": r["name"], "overrides": r["overrides"],
+                     "profile": r["profile"]} for r in rows])
+        t0 = time.perf_counter()
+        _, warm_info = tsearch.sweep(scens, configs=configs, seed=seed,
+                                     capacity=capacity)
+        grid_s = time.perf_counter() - t0
+        throughput = warm_info["member_rounds"] * warm_info["configs"] / grid_s
+        log(f"tune warm grid pass: {grid_s:.2f}s -> "
+            f"{throughput:,.0f} config-member-rounds/sec "
+            f"({warm_info['compiles']} recompiles)")
+
+        # ---- stage 3: batched-vs-sequential speedup -------------------
+        buckets = ccampaign.build_buckets(
+            scens, seed=seed, delivery="shift",
+            **tsearch.TUNE_PARAM_OVERRIDES)
+        specs = [tsearch.passive_specs(b.params, b.size)
+                 for b in buckets]
+        row_specs = [cmonitor.MonitorSpec.passive(b.params)
+                     for b in buckets]
+        batch_kn = [tsearch.config_knobs(b.params, {}, b.size)
+                    for b in buckets]
+        row_kn = [jax.tree.map(jnp.asarray, swim.Knobs.from_params(b.params))
+                  for b in buckets]
+
+        def force(mon):
+            runlog.completion_barrier(mon.code_counts)
+
+        def batch_sweep(rep=0):
+            mon = None
+            for b, sp, kn in zip(buckets, specs, batch_kn):
+                _, mon, _ = tsearch._sweep_bucket(
+                    b.keys, b.params, b.worlds, sp, b.horizon, kn,
+                    capacity, trace_cap)
+            force(mon)
+
+        def seq_sweep(rep=0):
+            mon = None
+            for b, sp, kn in zip(buckets, row_specs, row_kn):
+                for i, (world, _spec) in zip(b.indices, b.members):
+                    _, mon, _ = tsearch._row_run(
+                        jax.random.key(seed + i), b.params, world, sp,
+                        b.horizon, kn, capacity, trace_cap)
+            force(mon)
+
+        # The warm-path control arm is full-mode only: it exists to
+        # show the vmap costs nothing once compiled (parity), and the
+        # per-bucket _row_run compiles it needs are the wrong place to
+        # spend the smoke budget.
+        dispatch_ratio = None
+        if not SMOKE:
+            t0 = time.perf_counter()
+            seq_sweep()
+            log(f"tune: sequential compile+first sweep "
+                f"{time.perf_counter() - t0:.1f}s")
+            s_best, b_best = interleaved_best_of(seq_sweep, batch_sweep,
+                                                 reps)
+            dispatch_ratio = round(s_best / b_best, 4)
+            log(f"tune: warm sequential {s_best:.3f}s vs warm batched "
+                f"{b_best:.3f}s per reference sweep (best of {reps}, "
+                f"interleaved) -> dispatch ratio x{dispatch_ratio}")
+
+        # The gated headline: the static counterfactual.  Without
+        # traced knobs the ONLY way to sweep a schedule config is to
+        # bake it into SwimParams — a fresh XLA program per config x
+        # shape bucket.  Measure that cost on k real cold configs
+        # (overrides applied via dataclasses.replace -> guaranteed
+        # jit-cache misses), extrapolate to the grid, and compare
+        # against the measured stage-1 dynamic sweep (its own compiles
+        # AND host scoring included — the conservative side).
+        k_static = int(os.environ.get("SCALECUBE_TUNE_STATIC_CONFIGS",
+                                      1 if SMOKE else 2))
+        static_cfgs = [c for c in tsearch.default_grid(
+            buckets[0].params, smoke=SMOKE) if c["overrides"]][:k_static]
+        t0 = time.perf_counter()
+        for cfg in static_cfgs:
+            mon = None
+            for b in buckets:
+                sparams = dataclasses.replace(b.params, **{
+                    k: type(getattr(b.params, k))(v)
+                    for k, v in cfg["overrides"].items()})
+                _, mon, _ = tsearch._sweep_bucket(
+                    b.keys, sparams, b.worlds,
+                    tsearch.passive_specs(sparams, b.size), b.horizon,
+                    tsearch.config_knobs(sparams, {}, b.size),
+                    capacity, trace_cap)
+            force(mon)
+        static_s = (time.perf_counter() - t0) / len(static_cfgs)
+        static_grid_s = static_s * info["configs"]
+        ratio = round(static_grid_s / sweep_s, 4)
+        log(f"tune: static sweep {static_s:.1f}s/config cold "
+            f"({len(static_cfgs)} config(s) measured, compile per "
+            f"config x bucket) -> {static_grid_s:.0f}s for the "
+            f"{info['configs']}-config grid vs {sweep_s:.1f}s dynamic "
+            f"-> batch speedup x{ratio}")
+
+        # ---- stage 4: frontier + shipped profiles ---------------------
+        ref = rows[0]
+        assert ref["name"] == "reference"
+        green_idx = [i for i, r in enumerate(rows) if r["green"]]
+        front = [green_idx[i] for i in tsearch.pareto_front(
+            [rows[i]["slos"] for i in green_idx])]
+        profiles = {}
+        for name in sorted(tprofiles.PROFILES):
+            prow = next(r for r in rows if r["name"] == name)
+            target = tprofiles.PROFILES[name]["target"]
+            val = tsearch.validate_profile(
+                name, seed=held_out, seeds_per_tier=per_tier, n=n,
+                capacity=capacity, log=log)
+            profiles[name] = {
+                "target": target,
+                "overrides": prow["overrides"],
+                "slos": prow["slos"],
+                "monitor_green": prow["green"],
+                "nondominated_vs_reference":
+                    not tsearch.dominates(ref["slos"], prow["slos"]),
+                "target_vs_reference": round(
+                    prow["slos"][target] - ref["slos"][target], 6),
+                "fuzz_green": val["green"],
+                "fuzz": val,
+            }
+
+        result.update(
+            tune_grid_throughput=round(throughput, 1),
+            batch_speedup_ratio=ratio,
+            batch_dispatch_ratio=dispatch_ratio,
+            tune_compiles=info["compiles"],
+            tune_warm_recompiles=warm_info["compiles"],
+            tune_shape_buckets=info["shape_buckets"],
+            grid={"configs": info["configs"],
+                  "scenarios": info["scenarios"],
+                  "bucket_sizes": info["bucket_sizes"],
+                  "member_rounds": info["member_rounds"],
+                  "param_overrides": info["param_overrides"],
+                  "seconds_dynamic_sweep": round(sweep_s, 3),
+                  "seconds_static_per_config": round(static_s, 3),
+                  "static_configs_measured": len(static_cfgs),
+                  "seconds_warm_pass": round(grid_s, 3)},
+            objectives=list(tsearch.OBJECTIVES),
+            reference_slos=ref["slos"],
+            rows=[{"name": r["name"], "overrides": r["overrides"],
+                   "green": r["green"], "profile": r["profile"],
+                   "slos": r["slos"]} for r in rows],
+            frontier=[rows[i]["name"] for i in front],
+            profiles=profiles,
+            held_out_seed=held_out,
+            value_note=("value stays null by design: grid throughput "
+                        "is host-dependent and the tune gates are "
+                        "absolute — regress walks the dedicated tune "
+                        "checks instead"),
+        )
+
+        art = dict(result)
+        os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        result["artifact"] = artifact
+        log(f"tune artifact written to {artifact}")
+
+        apply_regress_gate(
+            result, ["BENCH_*.json",
+                     os.path.join("artifacts", "tune_pareto*.json"),
+                     artifact])
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -2797,6 +3059,17 @@ def main():
              "healthy-arm-quiet into an artifacts/alarm_drill.json-"
              "style artifact; combine with --smoke for the tier-1-safe "
              "pass",
+    )
+    parser.add_argument(
+        "--tune", action="store_true",
+        help="run the protocol autotuner instead: the knob-grid x "
+             "scenario-batch sweep through one compiled program per "
+             "shape bucket (knob data never recompiles), PR-5 SLO "
+             "scoring, the Pareto frontier + shipped tuned profiles "
+             "(fuzz-oracle-validated) and the batched-vs-sequential "
+             "speedup ratio into an artifacts/tune_pareto.json-style "
+             "artifact; combine with --smoke for the tier-1-safe "
+             "mini grid",
     )
     mode = parser.add_mutually_exclusive_group()
     mode.add_argument(
@@ -2899,6 +3172,15 @@ def main():
             parser.error(
                 "--alarms runs the live SLO alarm drill on its own "
                 "workload — drop the other mode flags")
+        if args.tune and (args.chaos or args.resilience or args.metrics
+                          or args.multichip or args.sync
+                          or args.lifeguard or args.churn or args.fuzz
+                          or args.wire or args.compose or args.alarms
+                          or args.traced or args.untraced
+                          or args.gap_artifact):
+            parser.error(
+                "--tune runs the protocol autotuner on its own "
+                "workload — drop the other mode flags")
     except SystemExit as e:
         # The one-JSON-line contract holds even for a bad argv: argparse
         # already printed its usage message to stderr; ship the error
@@ -2935,6 +3217,8 @@ def main():
         return run_compose_bench()
     if args.alarms:
         return run_alarm_bench()
+    if args.tune:
+        return run_tune_bench()
 
     result = {
         "metric": "swim_member_rounds_per_sec_per_chip",

@@ -1,0 +1,83 @@
+"""Protocol autotuner: sweep the knob grid in one compile per shape
+bucket, ship the Pareto frontier and the tuned-default profiles.
+
+Drives ``bench.py --tune`` (the one entry point the tune measurement
+flows through, so the experiment and the driver bench cannot drift):
+the config grid (probe cadence, timeouts, suspicion, SYNC cadence,
+Lifeguard ceilings, dead-suppression) runs over the seeded scenario
+batch through ``tune/search.sweep`` — knob data is TRACED operands on
+the batched composed scan, so the whole grid compiles once per
+scenario shape bucket and never per config (the witness lands in the
+artifact: ``tune_compiles == tune_shape_buckets``, warm recompiles 0).
+The gated ``batch_speedup_ratio`` compares that one-compile dynamic
+sweep against the static counterfactual — every config baked into
+``SwimParams`` and recompiled — measured on real cold configs.  Each
+shipped profile must be monitor-green, STRICTLY better than the
+reference default on its target objective, Pareto-non-dominated, and
+fuzz-oracle green on a held-out seed.
+
+Writes ``artifacts/tune_pareto.json`` (override
+``SCALECUBE_TUNE_ARTIFACT``) and runs the ``telemetry regress`` gate
+in-bench — the committed artifact is the pinned frontier claim, and
+regress exits 1 if it ever rots.  Apply a shipped profile::
+
+    params = SwimParams.tuned("fast-detect", n_members=4096)
+
+CPU-safe (the committed artifact's scale); on an accelerator raise
+``--scenarios``/``--n`` for a denser frontier.
+
+Usage:
+    python experiments/tune_pareto.py               # committed shape
+    python experiments/tune_pareto.py --smoke       # tier-1-safe pass
+    python experiments/tune_pareto.py --n 32 --scenarios 12
+"""
+
+import argparse
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--smoke", action="store_true",
+                        help="tier-1-safe fast pass (core grid, n=16, "
+                             "6 scenarios, 1 fuzz seed/tier)")
+    parser.add_argument("--n", type=int, default=None,
+                        help="member count (bench default: 32 full / "
+                             "16 smoke)")
+    parser.add_argument("--scenarios", type=int, default=None,
+                        help="scenario-batch size (default 12 full / "
+                             "6 smoke)")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="scenario seed (default 500)")
+    parser.add_argument("--held-out-seed", type=int, default=None,
+                        help="fuzz-oracle validation seed (default "
+                             "7001; must differ from --seed)")
+    parser.add_argument("--artifact", default=None,
+                        help="artifact path (default "
+                             "artifacts/tune_pareto.json; smoke runs "
+                             "default to tune_pareto_smoke.json)")
+    args = parser.parse_args()
+
+    env = dict(os.environ)
+    for flag, var in ((args.n, "SCALECUBE_TUNE_N"),
+                      (args.scenarios, "SCALECUBE_TUNE_SCENARIOS"),
+                      (args.seed, "SCALECUBE_TUNE_SEED"),
+                      (args.held_out_seed, "SCALECUBE_TUNE_HELDOUT_SEED"),
+                      (args.artifact, "SCALECUBE_TUNE_ARTIFACT")):
+        if flag is not None:
+            env[var] = str(flag)
+
+    cmd = [sys.executable, str(REPO / "bench.py"), "--tune"]
+    if args.smoke:
+        cmd.append("--smoke")
+    proc = subprocess.run(cmd, cwd=str(REPO), env=env)
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

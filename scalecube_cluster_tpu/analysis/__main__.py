@@ -53,8 +53,8 @@ def _print_summary(result: engine.AnalysisResult, verbose: bool) -> None:
     print(f"rules: {', '.join(result.rules_ran)}")
     print(f"plane matrix: {len(result.fields)} SwimParams knobs x "
           f"{n_entries} run shapes + {len(engine.TICK_BODIES)} tick "
-          f"bodies ({uniform}/{len(result.fields)} knobs uniformly "
-          f"threaded)")
+          f"bodies + batch driver ({uniform}/{len(result.fields)} "
+          f"knobs uniformly threaded)")
     if result.suppressed:
         print(f"suppressed (baselined): {len(result.suppressed)}")
         if verbose:

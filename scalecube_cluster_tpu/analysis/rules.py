@@ -125,6 +125,21 @@ COMPOSE_ROOTS: Sequence[Tuple[str, str]] = (
 )
 COMPOSE_MODULE = "models/compose.py"
 
+# The batched scan driver (PR 17): one more run shape, one more matrix
+# column — a knob consulted by ANY run shape but unreachable from
+# composed_batch_scan is a plane the batch axis silently ignores (the
+# tune sweep would report identical SLOs for every setting of it).
+BATCH_ROOTS: Sequence[Tuple[str, str]] = (
+    ("models/compose.py", "composed_batch_scan"),
+)
+
+# Batch entry points: thin aliases over composed_batch_scan, held to
+# the same thin-entry rule as the seven plain entries (and counted
+# into the trace-safety device cone).
+BATCH_ENTRY_POINTS: Dict[str, Tuple[str, str]] = {
+    "run_monitored_batch": ("chaos/monitor.py", "run_monitored_batch"),
+}
+
 # Scan/tick internals a THIN alias entry point must never touch
 # directly — tick-body logic lives in compose.py and the plane
 # modules, entries only assemble a plane stack and delegate
@@ -207,6 +222,8 @@ def plane_matrix(graph: PackageGraph):
                  for name, specs in TICK_BODIES.items()}
     compose_col = _column_sites(
         graph, _resolve_roots(graph, COMPOSE_ROOTS), fset)
+    batch_col = _column_sites(
+        graph, _resolve_roots(graph, BATCH_ROOTS), fset)
 
     matrix = {
         "entries": {f: {e: [f"{r}:{ln}" for r, ln in entry_cols[e].get(f, [])]
@@ -218,6 +235,9 @@ def plane_matrix(graph: PackageGraph):
         "compose": {f: {"compose": [f"{r}:{ln}"
                                     for r, ln in compose_col.get(f, [])]}
                     for f in fields},
+        "batch": {f: {"batch": [f"{r}:{ln}"
+                                for r, ln in batch_col.get(f, [])]}
+                  for f in fields},
     }
 
     findings: List[Finding] = []
@@ -238,6 +258,23 @@ def plane_matrix(graph: PackageGraph):
                     f"nothing reachable from the composed scan drivers "
                     f"({'/'.join(n for _, n in COMPOSE_ROOTS)}) reads "
                     f"it — the plane bypasses compose()"
+                ),
+            ))
+        # ... and from the batched driver too: the batch axis runs the
+        # same tick, so a knob any run shape consults that is
+        # unreachable from composed_batch_scan is a plane the (knobs ×
+        # scenarios) sweep cannot observe.
+        if reached and not batch_col.get(f):
+            findings.append(Finding(
+                rule="plane-matrix",
+                id=f"plane-matrix:{f}:batch",
+                path=BATCH_ROOTS[0][0], line=0,
+                message=(
+                    f"SwimParams.{f} is consulted on the "
+                    f"{'/'.join(sorted(reached))} run shape(s) but "
+                    f"nothing reachable from the batched scan driver "
+                    f"({'/'.join(n for _, n in BATCH_ROOTS)}) reads "
+                    f"it — the batch axis bypasses the plane"
                 ),
             ))
         if reached and reached != set(ENTRY_POINTS):
@@ -289,8 +326,9 @@ def plane_matrix(graph: PackageGraph):
 # --------------------------------------------------------------------------
 
 def thin_entries(graph: PackageGraph) -> List[Finding]:
-    """Each of the seven run entry points must be a THIN alias: it
-    assembles a plane stack and delegates to a models/compose.py scan
+    """Each of the seven run entry points — and each batch entry
+    (``BATCH_ENTRY_POINTS``) — must be a THIN alias: it assembles a
+    plane stack and delegates to a models/compose.py scan
     driver, and neither its own body nor a same-module plain-function
     helper it directly calls (the ``shard_run`` -> shard_map plumbing
     shape) may mention a scan/tick internal (``TICK_INTERNALS``) —
@@ -302,7 +340,8 @@ def thin_entries(graph: PackageGraph) -> List[Finding]:
     internals = {q for rel, name in TICK_INTERNALS
                  if (q := graph.find(rel, name)) is not None}
     findings: List[Finding] = []
-    for entry, (rel, name) in ENTRY_POINTS.items():
+    for entry, (rel, name) in {**ENTRY_POINTS,
+                               **BATCH_ENTRY_POINTS}.items():
         qual = graph.find(rel, name)
         if qual is None:
             continue
@@ -415,8 +454,10 @@ def trace_safety(graph: PackageGraph) -> List[Finding]:
     # lenient: fixture trees (tests) may define only a subset of the
     # entry points — the plane matrix is the strict guardian of the
     # seven-entry contract
-    entry_roots = _resolve_roots(graph, ENTRY_POINTS.values(),
-                                 strict=False)
+    entry_roots = _resolve_roots(
+        graph,
+        list(ENTRY_POINTS.values()) + list(BATCH_ENTRY_POINTS.values()),
+        strict=False)
     device_cone = graph.cone(entry_roots)
 
     for qual, info in sorted(graph.functions.items()):

@@ -386,7 +386,7 @@ def _check_cells(spec: MonitorSpec, params: "swim.SwimParams",
     if params.dead_suppress_rounds > 0:
         dead_hold = (ns == records.DEAD) & has_timer
         v_dead_hold = dead_hold & (
-            dl > round_idx + params.dead_suppress_rounds)
+            dl > round_idx + swim.knob_dead_suppress(kn, params))
     else:
         dead_hold = zero
         v_dead_hold = zero
@@ -621,6 +621,44 @@ class MonitorPlane:
                            rc.prev_wide, rc.new_wide, rc.world,
                            alive_now=rc.alive_now)
 
+    def on_round_batch(self, rc, mon):
+        """The batched fold (models/compose.composed_batch_scan):
+        ``self.spec`` and the ctx lanes carry a leading batch axis;
+        the checks vmap per row, but the evidence-recording pass keeps
+        ONE ``lax.cond`` gated on the whole batch's fresh-trip
+        predicate — any row freshly tripping any code.  For rows with
+        nothing fresh ``_record_round`` is an exact no-op, so the
+        batch-level gate records the same per-row lanes the sequential
+        path records (verdict parity pinned by tests/test_chaos_fuzz.py
+        and tests/test_compose_batch.py).
+        """
+        cells = jax.vmap(
+            lambda spec, kn, prev, new, world, alive: _check_cells(
+                spec, rc.params, kn, rc.round_idx, prev, new, world,
+                alive_now=alive)
+        )(self.spec, rc.kn, rc.prev_wide, rc.new_wide, rc.world,
+          rc.alive_now)
+        vio, details, v_self_inc, v_self_sat, self_inc, totals = cells
+        fresh = mon.code_counts == 0            # [B, N_CODES]
+        trip = fresh & (totals > 0)
+        subj = jnp.asarray(rc.world.subject_ids, jnp.int32)
+        mon = jax.lax.cond(
+            jnp.any(trip),
+            lambda m: jax.vmap(
+                _record_round,
+                in_axes=(0, None, 0, 0, 0, 0, 0, 0, 0),
+            )(m, rc.round_idx, vio, details, v_self_inc, v_self_sat,
+              self_inc, subj, fresh),
+            lambda m: m, mon,
+        )
+        return dataclasses.replace(
+            mon,
+            code_counts=mon.code_counts + totals,
+            code_first_round=jnp.where(
+                trip, jnp.asarray(rc.round_idx, jnp.int32),
+                mon.code_first_round),
+        )
+
     def finalize(self, fc, mon):
         return mon
 
@@ -700,60 +738,20 @@ def run_monitored_batch(base_keys, params: "swim.SwimParams", worlds,
     batch axis; row i is exactly what ``run_monitored(base_keys[i],
     params, world_i, spec_i, n_rounds, capacity)`` would have produced
     (verdict parity pinned by tests/test_chaos_fuzz.py).
+
+    Thin alias over the batched composed runner
+    (models/compose.composed_batch_scan with a single
+    :class:`MonitorPlane` whose ``on_round_batch`` carries the
+    batch-level evidence cond); the scan body lives there.
     """
-    batch = base_keys.shape[0]
-    if knobs is None:
-        kn1 = swim.Knobs.from_params(params)
-        knobs = jax.tree_util.tree_map(
-            lambda x: jnp.broadcast_to(x, (batch,) + x.shape), kn1)
-    states = jax.vmap(lambda w: swim.initial_state(params, w))(worlds)
-    monitors = jax.tree_util.tree_map(
-        lambda x: jnp.broadcast_to(x, (batch,) + jnp.shape(x)),
-        MonitorState.init(capacity))
+    from scalecube_cluster_tpu.models import compose
 
-    def tick(carry, round_idx):
-        st, mon = carry
-
-        def step(key, world, spec, kn, s):
-            prev = _wide(params, s, round_idx)
-            new_st, metrics = swim.swim_tick(s, round_idx, key, params,
-                                             world, knobs=kn)
-            cells = _check_cells(
-                spec, params, kn, round_idx, prev,
-                _wide(params, new_st, round_idx + 1), world)
-            return new_st, metrics, cells
-
-        new_st, metrics, cells = jax.vmap(step)(base_keys, worlds, specs,
-                                                knobs, st)
-        vio, details, v_self_inc, v_self_sat, self_inc, totals = cells
-        fresh = mon.code_counts == 0            # [B, N_CODES]
-        trip = fresh & (totals > 0)
-        subj = jnp.asarray(worlds.subject_ids, jnp.int32)
-        mon = jax.lax.cond(
-            jnp.any(trip),
-            lambda m: jax.vmap(
-                _record_round,
-                in_axes=(0, None, 0, 0, 0, 0, 0, 0, 0),
-            )(m, round_idx, vio, details, v_self_inc, v_self_sat,
-              self_inc, subj, fresh),
-            lambda m: m, mon,
-        )
-        mon = dataclasses.replace(
-            mon,
-            code_counts=mon.code_counts + totals,
-            code_first_round=jnp.where(
-                trip, jnp.asarray(round_idx, jnp.int32),
-                mon.code_first_round),
-        )
-        return (new_st, mon), metrics
-
-    (final_states, monitors), metrics = swim._fused_scan(
-        tick, (states, monitors), n_rounds, 0, params.rounds_per_step)
-    # The scan stacks rounds ahead of the batch axis; present the
-    # batch-major [B, rounds, ...] layout a per-row consumer expects
-    # (row i's metrics == the sequential run's [rounds, ...] traces).
-    metrics = {k: jnp.moveaxis(v, 0, 1) for k, v in metrics.items()}
-    return final_states, monitors, metrics
+    plane = MonitorPlane(specs, capacity=capacity)
+    final_states, results, metrics = compose.composed_batch_scan(
+        base_keys, params, worlds, n_rounds, planes=(plane,),
+        knobs=knobs,
+    )
+    return final_states, results["monitor"], metrics
 
 
 def unstack_monitor(mon: MonitorState) -> List[MonitorState]:

@@ -31,13 +31,14 @@ decode independently).  :func:`composed_shard_scan` is the row-sharded
 twin (serial or software-pipelined delivery — ``_pipelined_rounds``
 lives here too, so every scan driver is in one module).
 
-All seven run entry points are thin aliases over these two drivers:
+All eight run entry points are thin aliases over these three drivers:
 
   ``models/swim.run``                    -> composed_scan, no planes
   ``models/swim.run_traced``             -> + TracePlane
   ``models/swim.run_metered``            -> + MetricsPlane
   ``chaos/monitor.run_monitored``        -> + MonitorPlane
   ``chaos/monitor.run_monitored_metered``-> + MonitorPlane ⊕ MetricsPlane
+  ``chaos/monitor.run_monitored_batch``  -> composed_batch_scan + MonitorPlane
   ``parallel/mesh.shard_run``            -> composed_shard_scan
   ``parallel/mesh.shard_run_metered``    -> + MetricsPlane (sharded)
 
@@ -481,6 +482,230 @@ def composed_shard_scan(base_key, params: "swim.SwimParams",
     fc = FinalCtx(params, world, kn, start_round + n_rounds, final_state,
                   metrics, offset=offset, axis_name=axis, n_local=n_local)
     return final_state, _finalize_planes(planes, fc, slices), metrics
+
+
+# --------------------------------------------------------------------------
+# The batched scan driver — (scenarios × knobs) on one device program
+# --------------------------------------------------------------------------
+
+
+#: RoundCtx memo keys whose batched values vmap row-wise into a per-row
+#: fold's cache (leading batch axis maps off).  ``any_status_change`` is
+#: deliberately ABSENT: the batched value is the GLOBAL reduce over all
+#: rows (the batch-level cond predicate), not any row's own scalar — a
+#: per-row fold must recompute its own from the seeded status_changed.
+_ROW_CACHE_KEYS = ("alive_now", "status_changed", "prev_wide", "new_wide",
+                   "prev_deadline_wide")
+
+
+class BatchRoundCtx(RoundCtx):
+    """The batched :class:`RoundCtx`: ``world``/``kn``/``prev``/``new``/
+    ``metrics`` carry a leading batch axis; the shared derivations are
+    computed ONCE over the whole batch (vmapped) and memoized exactly
+    like the unbatched ctx, so every plane in the stack reads the same
+    batched matrices.
+
+    :attr:`any_status_change` (inherited — ``jnp.any`` over the
+    [B, N, K] compare matrix) is the BATCH-LEVEL emptiness predicate:
+    a ``lax.cond`` gated on it sits OUTSIDE the row vmap and fires iff
+    ANY row has fresh evidence — the PR-12 trick that keeps per-round
+    gates as real branches instead of vmap-lowered select-both-branches
+    (which made naive vmap-of-scan 4-5x slower).  Planes whose silent
+    branch is an exact identity per row (trace's drop-scatter, the
+    monitor's zero-total record) stay bit-identical per row under it.
+    """
+
+    __slots__ = ()
+
+    @property
+    def alive_now(self):
+        """[B, N] ground-truth liveness at this round, per row."""
+        return self._memo(
+            "alive_now",
+            lambda: jax.vmap(lambda w: w.alive_at(self.round_idx))(
+                self.world))
+
+    @property
+    def prev_wide(self):
+        return self._memo(
+            "prev_wide",
+            lambda: jax.vmap(
+                lambda st: wide_view(self.params, st, self.round_idx))(
+                    self.prev))
+
+    @property
+    def new_wide(self):
+        return self._memo(
+            "new_wide",
+            lambda: jax.vmap(
+                lambda st: wide_view(self.params, st, self.round_idx + 1))(
+                    self.new))
+
+    @property
+    def prev_deadline_wide(self):
+        def derive():
+            if "prev_wide" in self._cache:
+                return self._cache["prev_wide"].suspect_deadline
+            return jax.vmap(
+                lambda st: swim._wide_timer_fields(st, self.params,
+                                                   self.round_idx)[0])(
+                    self.prev)
+        return self._memo("prev_deadline_wide", derive)
+
+    def per_row_fold(self, plane, sl):
+        """Run a plane's plain (unbatched) ``on_round`` vmapped over the
+        rows — the fallback for planes without an ``on_round_batch``.
+
+        Each row sees a plain :class:`RoundCtx` seeded with the row
+        slice of every batch-level memo already paid
+        (:data:`_ROW_CACHE_KEYS`) and of every already-published plane
+        slice, so cross-plane reads and the computed-once contract
+        survive the vmap boundary.  Inside the vmap, per-row
+        ``lax.cond`` gates lower to select-both-branches — values are
+        bit-identical to the sequential per-row fold (both branches are
+        pure), only the skip-when-empty economics change, which is
+        exactly what ``on_round_batch`` exists to recover.
+        """
+        cache_keys = [k for k in _ROW_CACHE_KEYS if k in self._cache]
+        cache_vals = tuple(self._cache[k] for k in cache_keys)
+        prev_names = list(self._plane_prev)
+        prev_vals = tuple(self._plane_prev[n] for n in prev_names)
+        new_names = list(self._plane_new)
+        new_vals = tuple(self._plane_new[n] for n in new_names)
+
+        def row(world, kn, prev, new, metrics, sl_row, cvals, pvals,
+                nvals):
+            rc = RoundCtx(self.params, world, kn, self.round_idx, prev,
+                          new, metrics)
+            rc._cache.update(zip(cache_keys, cvals))
+            rc._plane_prev.update(zip(prev_names, pvals))
+            rc._plane_new.update(zip(new_names, nvals))
+            return plane.on_round(rc, sl_row)
+
+        return jax.vmap(row)(self.world, self.kn, self.prev, self.new,
+                             self.metrics, sl, cache_vals, prev_vals,
+                             new_vals)
+
+
+def _apply_planes_batch(planes, rc: BatchRoundCtx, slices) -> Tuple:
+    """One round's plane folds over the batched ctx: a plane that
+    declares ``on_round_batch`` gets the whole batch (and can gate its
+    evidence recording on the batch-level predicates); any other plane
+    folds per row via :meth:`BatchRoundCtx.per_row_fold`."""
+    out = []
+    for plane, sl in zip(planes, slices):
+        rc._plane_prev[plane.name] = sl
+        fold = getattr(plane, "on_round_batch", None)
+        new_sl = (fold(rc, sl) if fold is not None
+                  else rc.per_row_fold(plane, sl))
+        rc._plane_new[plane.name] = new_sl
+        out.append(new_sl)
+    return tuple(out)
+
+
+def composed_batch_scan(base_keys, params: "swim.SwimParams", worlds,
+                        n_rounds: int, planes=(), states=None,
+                        start_round: int = 0,
+                        knobs: Optional["swim.Knobs"] = None):
+    """The batched analogue of :func:`composed_scan`: ``base_keys`` /
+    ``worlds`` / ``knobs`` (and optional resume ``states``) stacked on
+    a leading batch axis, ONE scan over the rounds with the protocol
+    tick vmapped inside it — so B independent scenarios (or one
+    scenario under B knob settings, or any product of both: stack the
+    product) advance in lockstep through one compiled program.
+
+    Structure, and why it is this way and not vmap-of-scan:
+
+      - the scan is OUTSIDE the vmap: per-round ``lax.cond`` gates in
+        plane folds stay real branches, fired on BATCH-LEVEL predicates
+        (:class:`BatchRoundCtx`), where vmapping the whole scan would
+        lower every cond to select-both-branches per row (measured
+        4-5x slower on the fuzz campaign, PR 12);
+      - ``knobs`` are traced DATA: sweeping a knob grid reuses one
+        compile for the whole grid (zero recompiles per config — the
+        tune/search.py contract, pinned via ``_cache_size`` deltas);
+      - planes ride batched: ``on_round_batch`` where a plane defines
+        it, vmapped plain ``on_round`` otherwise, one memoized
+        :class:`BatchRoundCtx` either way.
+
+    Round fusion (``params.rounds_per_step``) unrolls K vmapped ticks
+    per scan step exactly like the unbatched driver; the fused
+    ``on_round_fused``/``on_step`` pair is NOT used here — the
+    batch-level evidence cond already amortizes the per-round scatter
+    the fused pair exists to batch, and the pair's step-stacked layout
+    does not commute with the row vmap.  Sharding does not compose with
+    the batch axis either (:func:`batch_shard_unsupported_reason`).
+
+    ``knobs=None`` broadcasts :meth:`swim.Knobs.from_params` over the
+    batch; resume ``states`` must already be batch-stacked.  Pinned
+    contracts (tests/test_compose_batch.py): B=1 equals the unbatched
+    :func:`composed_scan` bit-exactly, and row i of any batch equals
+    the sequential run of that row's (key, world, knobs) alone.
+
+    Returns ``(final_states, {plane name: finalized slice}, metrics)``
+    with every output batch-leading (metrics ``[B, n_rounds, ...]``).
+    """
+    batch = jax.tree_util.tree_leaves(base_keys)[0].shape[0]
+    kn = knobs
+    if kn is None:
+        kn = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (batch,) + x.shape),
+            swim.Knobs.from_params(params))
+    if states is None:
+        states = jax.vmap(lambda w: swim.initial_state(params, w))(worlds)
+    slices = tuple(jax.vmap(lambda w, p=p: p.init(params, w))(worlds)
+                   for p in planes)
+
+    def tick(carry, round_idx):
+        sts, pcs = carry
+        new_sts, ms = jax.vmap(
+            lambda st, key, w, k: swim.swim_tick(st, round_idx, key,
+                                                 params, w, knobs=k)
+        )(sts, base_keys, worlds, kn)
+        rc = BatchRoundCtx(params, worlds, kn, round_idx, sts, new_sts,
+                           ms)
+        return (new_sts, _apply_planes_batch(planes, rc, pcs)), ms
+
+    (final_states, slices), metrics = swim._fused_scan(
+        tick, (states, slices), n_rounds, start_round,
+        params.rounds_per_step,
+    )
+    # Scan stacks rounds on axis 0 with the batch axis inside; every
+    # public output is batch-leading.
+    metrics = {k: jnp.moveaxis(v, 0, 1) for k, v in metrics.items()}
+
+    results = {}
+    if planes:
+        end_round = start_round + n_rounds
+
+        def fin(world, k, st, ms, sls):
+            fc = FinalCtx(params, world, k, end_round, st, ms)
+            return tuple(p.finalize(fc, s) for p, s in zip(planes, sls))
+
+        finalized = jax.vmap(fin)(worlds, kn, final_states, metrics,
+                                  slices)
+        results = {p.name: r for p, r in zip(planes, finalized)}
+    return final_states, results, metrics
+
+
+def batch_shard_unsupported_reason(params: "swim.SwimParams") -> str:
+    """Why :func:`composed_batch_scan` does not compose with the row
+    mesh — a declared reason (the ``pipelined_delivery_unsupported``
+    pattern), never a silent wrong answer.
+
+    The batch axis vmaps INDEPENDENT worlds on one device; the sharded
+    driver's shard_map collectives (the delivery pmax / metrics psum
+    over the row mesh) reduce over rows of ONE world split across
+    devices.  Vmapping those collectives over a scenario batch would
+    need a second mesh axis per batch row — shard the members or batch
+    the scenarios, not both in one program.  For batch throughput on a
+    multi-chip host, run one :func:`composed_batch_scan` per device
+    over disjoint scenario sub-batches instead (no cross-talk to
+    reduce)."""
+    return ("batch axis is single-device: composed_shard_scan's "
+            "shard_map collectives reduce over member rows of one "
+            "world and cannot be vmapped over independent batched "
+            "worlds")
 
 
 # --------------------------------------------------------------------------

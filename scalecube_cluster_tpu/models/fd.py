@@ -52,7 +52,8 @@ def fd_only_knobs(params: swim.SwimParams) -> swim.Knobs:
     )
 
 
-def effective_probe_budgets(params: swim.SwimParams, lhm):
+def effective_probe_budgets(params: swim.SwimParams, lhm,
+                            ping_timeout_ms=None):
     """Per-member FD budgets under the Lifeguard health plane
     (models/lifeguard.py): ``(ping_budget_ms, ping_req_budget_ms)``,
     each the base budget scaled by the member's Local Health Multiplier
@@ -66,10 +67,16 @@ def effective_probe_budgets(params: swim.SwimParams, lhm):
     both equal the base values exactly (the healthy-member no-op the
     plane's bit-identity tests pin); they never drop below base
     (lhm >= 1 by clamp).
+
+    ``ping_timeout_ms`` overrides the static base timeout with a traced
+    knob value (swim.Knobs.ping_timeout_ms, clamped to the interval at
+    the call site); None = ``params.ping_timeout_ms``.  The interval
+    itself stays static — the knob splits it, never grows it.
     """
     m = jnp.asarray(lhm, jnp.float32)
-    return (params.ping_timeout_ms * m,
-            (params.ping_interval_ms - params.ping_timeout_ms) * m)
+    pt = (params.ping_timeout_ms if ping_timeout_ms is None
+          else ping_timeout_ms)
+    return (pt * m, (params.ping_interval_ms - pt) * m)
 
 
 def probe_outcome_updates(tick_metrics: dict) -> dict:

@@ -107,7 +107,8 @@ def probe_gate(k_ping_net, lhm, n_local: int) -> jnp.ndarray:
     return u * lhm.astype(jnp.float32) < 1.0
 
 
-def lha_probe_setup(params, lhm, k_ping_net, n_local: int):
+def lha_probe_setup(params, lhm, k_ping_net, n_local: int,
+                    ping_timeout_ms=None):
     """The LHA Probe ingredients of one tick's FD phase:
     ``(ping_budget_ms, ping_req_budget_ms, probe_gate)`` — health-scaled
     chain budgets (models/fd.effective_probe_budgets) plus the 1/lhm
@@ -115,13 +116,17 @@ def lha_probe_setup(params, lhm, k_ping_net, n_local: int):
     out.  ONE place for the block all three tick bodies (scatter,
     shift, blocked) share, so the budgets/gate cannot drift apart and
     break the pinned shift==blocked bit-identity.
+
+    ``ping_timeout_ms`` overrides the static base budget (the
+    ``Knobs.ping_timeout_ms`` sweep axis, pre-clamped by
+    ``swim.knob_ping_timeout``); None = ``params.ping_timeout_ms``.
     """
     if params.lhm_max == 0:
         return None, None, None
     from scalecube_cluster_tpu.models import fd as fd_model
 
     ping_budget, ping_req_budget = fd_model.effective_probe_budgets(
-        params, lhm)
+        params, lhm, ping_timeout_ms=ping_timeout_ms)
     return ping_budget, ping_req_budget, probe_gate(k_ping_net, lhm,
                                                     n_local)
 

@@ -545,6 +545,22 @@ class SwimParams:
     def full_view(self) -> bool:
         return self.n_subjects == self.n_members
 
+    @classmethod
+    def tuned(cls, profile: str, base: Optional["SwimParams"] = None,
+              **overrides) -> "SwimParams":
+        """Named tuned-default constructor: the autotuner's shipped
+        Pareto picks ("fast-detect", "low-traffic", "churn-hardened" —
+        tune/profiles.py) baked into static params.  ``base`` defaults
+        to the chaos-campaign timing preset (``n_members=32``; pass
+        ``n_members=...`` through ``overrides`` to rescale); explicit
+        ``overrides`` win over the profile's.  Every shipped profile
+        is fuzz-oracle-validated and Pareto-gated by ``telemetry
+        regress`` over artifacts/tune_pareto.json."""
+        from scalecube_cluster_tpu.tune import profiles as _profiles
+        n_members = overrides.pop("n_members", 32)
+        return _profiles.tuned_params(profile, base=base,
+                                      n_members=n_members, **overrides)
+
 
 # --------------------------------------------------------------------------
 # Sweepable knobs (dynamic overrides of SwimParams schedule fields)
@@ -556,13 +572,86 @@ class Knobs:
     """Traced overrides of the protocol schedule — the sweep axes.
 
     ``SwimParams`` is a static jit argument (it fixes shapes and unrolled
-    channel counts); these five knobs are the subset that can vary as
-    *data*, which is what lets one compiled program sweep a whole
-    hyperparameter grid with ``jax.vmap`` (BASELINE config 5: fanout ×
-    ping-interval × suspicion-mult; sweep.py).  ``fanout`` must be
-    <= params.fanout (extra channels are masked off); ``ping_every``
-    sweeps the probe rate (the millisecond sub-round budgets stay at the
-    params values).
+    channel counts); these knobs are the subset that can vary as *data*,
+    which is what lets one compiled program sweep a whole hyperparameter
+    grid with ``jax.vmap`` (BASELINE config 5: fanout × ping-interval ×
+    suspicion-mult; sweep.py) or rerun a scenario batch across a knob
+    grid with ZERO recompiles (tune/search.py — knob values are traced
+    operands, so the compiled program is knob-oblivious).
+
+    Static-vs-dynamic, all 31 ``SwimParams`` fields (why each side):
+
+    ==================== === =====================================
+    field                dyn one-line reason
+    ==================== === =====================================
+    n_members            no  array shapes ([N, K] carries)
+    n_subjects           no  array shapes (the K axis)
+    fanout               YES data mask over the params.fanout
+                             pre-built channels (ceiling)
+    periods_to_spread    no  int8 remaining-spread lane ceiling,
+                             validated at construction
+    ping_every           YES probe-round modulus — pure data in
+                             the round gate
+    sync_every           YES push-SYNC modulus — pure data in the
+                             round gate (and the buddy fallback)
+    suspicion_rounds     YES timer length — data in the deadline
+                             arithmetic (the weakened coverage arm
+                             sweeps it far ABOVE the params value)
+    ping_req_members     no  unrolled proxy-chain count (program
+                             structure)
+    ping_timeout_ms      YES direct-ping sub-round budget — data
+                             in the closed-form chain compares;
+                             ceiling params.ping_interval_ms (the
+                             indirect budget is the complement)
+    ping_interval_ms     no  the round's total FD budget — it IS
+                             the ping_timeout_ms ceiling
+    mean_delay_ms        no  paired with the max_delay_rounds ring
+                             sizing/quantization thresholds
+    loss_probability     YES per-message drop chance — pure data
+                             in the drop draws
+    ping_known_only      no  FD-targeting branch structure
+    per_subject_metrics  no  metrics output shapes
+    delivery             no  tick-body dispatch
+    round_ms             no  delay→round quantization constant
+    max_delay_rounds     no  inbox-ring buffer shape
+    compact_carry        no  carry dtype/layout
+    int16_wire           no  wire dtype/layout
+    wire24               no  wire dtype/layout
+    fused_wire           no  wire buffer structure
+    shift_roll_payloads  no  delivery graph structure
+    link_counters        no  metrics output shapes
+    k_block              no  loop structure / block shapes
+    n_user_gossips       no  gossip lane shape
+    rounds_per_step      no  scan unroll factor
+    sync_interval        no  0-vs-on compiles the anti-entropy
+                             plane in/out; keeping the cadence
+                             static keeps that off-switch
+                             bit-identity contract compile-time
+    lhm_max              YES dynamic CLAMP CAP of the LHM lane
+                             (lifeguard.update's clip) — the
+                             static field stays the lane-shape
+                             gate ([N] vs [0]) and the
+                             TIMER_BOUND / int16 ceiling
+    dead_suppress_rounds YES tombstone reopen-window length —
+                             data in the expiry arithmetic; the
+                             static >0 gate (suppression in/out)
+                             and the int16 deadline-lane ceiling
+                             stay compile-time
+    open_world           no  identity-epoch lane/wire layout
+    epoch_guard          no  wire-key layout (epoch field width)
+    ==================== === =====================================
+
+    Each dynamic knob with a static ceiling is masked/clamped at its
+    use site against the params value (the ``fanout <= params.fanout``
+    pattern: ``knob_dead_suppress`` / ``knob_lhm_cap`` /
+    ``knob_ping_timeout`` below), so an out-of-range traced value can
+    never overflow a lane the params validated; :meth:`for_params`
+    additionally REJECTS concrete out-of-range overrides at
+    construction (tests/test_tune.py pins the raises).
+
+    The three newer knobs default to ``None`` = "use the params value"
+    — pre-existing five-field constructions (sweep.knob_grid,
+    experiments/northstar.py) behave exactly as before.
     """
 
     loss_probability: jnp.ndarray
@@ -570,6 +659,9 @@ class Knobs:
     ping_every: jnp.ndarray
     sync_every: jnp.ndarray
     fanout: jnp.ndarray
+    dead_suppress_rounds: Optional[jnp.ndarray] = None
+    lhm_max: Optional[jnp.ndarray] = None
+    ping_timeout_ms: Optional[jnp.ndarray] = None
 
     @staticmethod
     def from_params(params: "SwimParams") -> "Knobs":
@@ -579,15 +671,110 @@ class Knobs:
             ping_every=jnp.int32(params.ping_every),
             sync_every=jnp.int32(params.sync_every),
             fanout=jnp.int32(params.fanout),
+            dead_suppress_rounds=jnp.int32(params.dead_suppress_rounds),
+            lhm_max=jnp.int32(params.lhm_max),
+            ping_timeout_ms=jnp.float32(params.ping_timeout_ms),
         )
+
+    @staticmethod
+    def for_params(params: "SwimParams", **overrides) -> "Knobs":
+        """:meth:`from_params` plus validated overrides — the checked
+        construction path the autotuner's grid goes through.
+
+        Concrete (non-traced) override values are range-checked against
+        their static ceilings and raise ``ValueError`` when invalid;
+        traced values skip the host-side check (the use-site clamps
+        still bound them).  Unknown knob names always raise.
+        """
+        field_names = {f.name for f in dataclasses.fields(Knobs)}
+        unknown = sorted(set(overrides) - field_names)
+        if unknown:
+            raise ValueError(f"unknown Knobs field(s) {unknown}; "
+                             f"sweepable knobs are {sorted(field_names)}")
+        # (low, high, why) ceilings for the knobs that have one; None
+        # bounds are unchecked.  suspicion_rounds deliberately has NO
+        # ceiling — the weakened coverage arm sweeps it above params.
+        ceilings = {
+            "fanout": (0, params.fanout,
+                       "the static channel count params.fanout"),
+            "ping_every": (0, None, "probe cadence must be >= 0"),
+            "sync_every": (0, None, "SYNC cadence must be >= 0"),
+            "loss_probability": (0.0, 1.0, "a probability"),
+            "dead_suppress_rounds": (
+                0, params.dead_suppress_rounds,
+                "the params window (the int16 deadline-lane ceiling "
+                "was validated against the params value; size the "
+                "params field as the grid maximum and sweep below)"),
+            "lhm_max": (
+                1, params.lhm_max,
+                "the static LHM cap (lane shape + TIMER_BOUND ceiling)"),
+            "ping_timeout_ms": (
+                0.0, params.ping_interval_ms,
+                "params.ping_interval_ms (the indirect probe budget "
+                "is the complement and must stay >= 0)"),
+        }
+        for name, val in overrides.items():
+            if isinstance(val, jax.core.Tracer) or name not in ceilings:
+                continue
+            lo, hi = ceilings[name][0], ceilings[name][1]
+            why = ceilings[name][2]
+            v = float(jnp.asarray(val))
+            if (lo is not None and v < lo) or (hi is not None and v > hi):
+                raise ValueError(
+                    f"Knobs.{name}={v:g} outside [{lo}, {hi}] — "
+                    f"ceiling: {why}")
+        base = Knobs.from_params(params)
+        # Normalize concrete overrides to the from_params dtypes so a
+        # knob-grid sweep never splits the jit cache on weak types —
+        # every config must rerun the SAME compiled program.
+        coerced = {
+            name: (val if isinstance(val, jax.core.Tracer)
+                   else jnp.asarray(val, getattr(base, name).dtype))
+            for name, val in overrides.items()
+        }
+        return dataclasses.replace(base, **coerced)
 
 
 jax.tree_util.register_dataclass(
     Knobs,
     data_fields=["loss_probability", "suspicion_rounds", "ping_every",
-                 "sync_every", "fanout"],
+                 "sync_every", "fanout", "dead_suppress_rounds",
+                 "lhm_max", "ping_timeout_ms"],
     meta_fields=[],
 )
+
+
+def knob_dead_suppress(kn: "Knobs", params: "SwimParams"):
+    """Effective dead-suppression window: the dynamic knob masked by
+    its static ceiling (the ``fanout <= params.fanout`` pattern — the
+    int16 deadline lane was validated against the PARAMS value, so the
+    knob sweeps at-or-below it).  ``None`` (a pre-knob Knobs
+    construction) falls back to the params value, bit-identically."""
+    if kn.dead_suppress_rounds is None:
+        return params.dead_suppress_rounds
+    return jnp.minimum(jnp.asarray(kn.dead_suppress_rounds, jnp.int32),
+                       params.dead_suppress_rounds)
+
+
+def knob_lhm_cap(kn: "Knobs", params: "SwimParams"):
+    """Effective LHM clamp cap: the dynamic knob clipped into
+    [1, params.lhm_max] — the static field keeps the lane shape and
+    the TIMER_BOUND/int16 ceilings; the knob only lowers the cap.
+    Consulted exclusively under the static ``params.lhm_max > 0``
+    plane gate."""
+    if kn.lhm_max is None:
+        return params.lhm_max
+    return jnp.clip(jnp.asarray(kn.lhm_max, jnp.int32), 1, params.lhm_max)
+
+
+def knob_ping_timeout(kn: "Knobs", params: "SwimParams"):
+    """Effective direct-ping budget (ms): the dynamic knob clipped into
+    [0, params.ping_interval_ms] so the complementary indirect budget
+    (interval - timeout) can never go negative."""
+    if kn.ping_timeout_ms is None:
+        return params.ping_timeout_ms
+    return jnp.clip(jnp.asarray(kn.ping_timeout_ms, jnp.float32),
+                    jnp.float32(0.0), jnp.float32(params.ping_interval_ms))
 
 
 # --------------------------------------------------------------------------
@@ -2070,7 +2257,7 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
         # (``changed`` already includes ``fired`` by this point).
         became_dead = (new_status == records.DEAD) & changed
         deadline = jnp.where(
-            became_dead, round_idx + params.dead_suppress_rounds, deadline
+            became_dead, round_idx + knob_dead_suppress(kn, params), deadline
         )
 
     # Crashed/left nodes are frozen (a stopped JVM): no state updates.
@@ -2106,7 +2293,7 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
         probe_fail, probe_clean = lhm_signals
         new_lhm = lifeguard.update(
             state.lhm, probe_fail, probe_clean, refuted & alive_here,
-            alive_here, params.lhm_max,
+            alive_here, knob_lhm_cap(kn, params),
         )
 
     new_state = SwimState(
@@ -2336,10 +2523,11 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
     # lhm_max=0; at lhm=1 the gate always passes and the budgets equal
     # the base values, so healthy runs stay bit-identical.
     ping_budget, ping_req_budget, lhm_gate = lifeguard.lha_probe_setup(
-        params, state.lhm, k_ping_net, n_local)
+        params, state.lhm, k_ping_net, n_local,
+        ping_timeout_ms=knob_ping_timeout(kn, params))
     if lhm_gate is None:
-        ping_budget = params.ping_timeout_ms
-        ping_req_budget = params.ping_interval_ms - params.ping_timeout_ms
+        ping_budget = knob_ping_timeout(kn, params)
+        ping_req_budget = params.ping_interval_ms - ping_budget
     # Direct ping: 2 hops within ping_timeout (FailureDetectorImpl.java:128-176).
     loss_it, delay_it = link_eval(world.faults, round_idx, node_ids, t,
                                   kn.loss_probability, params.mean_delay_ms)
@@ -2987,9 +3175,9 @@ def _shift_fd_chains(eng, d_ids, d_alive, d_part, fd_shift, proxy_shifts,
     each prober's target id and ``ack_ok`` includes the proxy rescues.
     """
     if ping_budget is None:
-        ping_budget = params.ping_timeout_ms
+        ping_budget = knob_ping_timeout(kn, params)
     if ping_req_budget is None:
-        ping_req_budget = params.ping_interval_ms - params.ping_timeout_ms
+        ping_req_budget = params.ping_interval_ms - knob_ping_timeout(kn, params)
     t = eng.look_replicated(d_ids, fd_shift)
     alive_t = eng.look_replicated(d_alive, fd_shift)
     part_t = eng.look_replicated(d_part, fd_shift)
@@ -3090,7 +3278,8 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     # health-scaled budgets + the 1/lhm probe gate; compiled out at
     # lhm_max=0 (None budgets = _shift_fd_chains' base defaults).
     lhm_ping_budget, lhm_pr_budget, lhm_gate = lifeguard.lha_probe_setup(
-        params, state.lhm, k_ping_net, n_local)
+        params, state.lhm, k_ping_net, n_local,
+        ping_timeout_ms=knob_ping_timeout(kn, params))
 
     def fd_phase(_):
         t, _alive_t, _part_t, direct_ok, ack_ok = _shift_fd_chains(
@@ -3529,7 +3718,8 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
     # Lifeguard LHA Probe — the same shared setup as _tick_shift, drawn
     # from the same keys so the blocked tick stays bit-identical.
     lhm_ping_budget, lhm_pr_budget, lhm_gate = lifeguard.lha_probe_setup(
-        params, state.lhm, k_ping_net, n)
+        params, state.lhm, k_ping_net, n,
+        ping_timeout_ms=knob_ping_timeout(kn, params))
     t, _alive_t, _part_t, direct_ok, ack_ok = _shift_fd_chains(
         eng, d_ids, d_alive, d_part, fd_shift, proxy_shifts,
         k_ping_net, k_proxy_net, params, kn, world, round_idx,
@@ -3830,7 +4020,7 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
     if params.lhm_max > 0:
         new_lhm = lifeguard.update(
             state.lhm, ping_req_launches, probes_sent & direct_ok,
-            refuted & alive_here, alive_here, params.lhm_max,
+            refuted & alive_here, alive_here, knob_lhm_cap(kn, params),
         )
 
     new_state = SwimState(

@@ -183,7 +183,8 @@ def _cmd_regress(args) -> int:
             os.path.join("artifacts", "wire_fused*.json"),
             os.path.join("artifacts", "compose_perf*.json"),
             os.path.join("artifacts", "static_analysis*.json"),
-            os.path.join("artifacts", "alarm_drill*.json")])
+            os.path.join("artifacts", "alarm_drill*.json"),
+            os.path.join("artifacts", "tune_pareto*.json")])
     readable = [p for p in paths if os.path.exists(p)]
     if not readable:
         print("regress: no artifacts matched", file=sys.stderr)
@@ -257,7 +258,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "artifacts/wire_fused*.json "
                         "artifacts/compose_perf*.json "
                         "artifacts/static_analysis*.json "
-                        "artifacts/alarm_drill*.json)")
+                        "artifacts/alarm_drill*.json "
+                        "artifacts/tune_pareto*.json)")
     p.add_argument("--band", type=float, default=query.DEFAULT_NOISE_BAND,
                    help="relative noise band (default 0.10)")
     p.add_argument("--json", action="store_true")

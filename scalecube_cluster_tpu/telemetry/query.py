@@ -318,7 +318,8 @@ def load_bench_payload(path: str) -> Tuple[Optional[dict], Optional[str]]:
                      or "fused_serial_speedup_ratio" in payload
                      or "compose_speedup_ratio" in payload
                      or "findings_total" in payload
-                     or "alarm_detection_lag_windows" in payload)):
+                     or "alarm_detection_lag_windows" in payload
+                     or "batch_speedup_ratio" in payload)):
             return None, stub_note
     return payload, None
 
@@ -380,7 +381,15 @@ def regress(paths: Sequence[str],
         bench.py --alarms): absolute gates — the breach arm's planted
         SLO breach fired (>= 1 firing transition) within one metrics
         window of onset, resolved after the heal, and the healthy arm
-        fired ZERO alarms.
+        fired ZERO alarms;
+      - Autotuner artifacts (``batch_speedup_ratio`` + ``profiles``
+        present, bench.py --tune): absolute gates — the traced-knob
+        grid sweep (one compile per shape bucket) at least matches the
+        static recompile-per-config sweep (ratio >= 1.0), >= 2
+        named tuned profiles shipped, each Pareto-non-dominated by the
+        reference default over the recorded objectives (dominance
+        recomputed from the payload) and fuzz-oracle green on
+        held-out seeds.
 
     Returns (ok, check rows); each row {"check", "latest", "reference",
     "threshold", "ok", "source"}.  Unreadable/failed artifacts — and
@@ -849,6 +858,60 @@ def regress(paths: Sequence[str],
             quiet = last.get("healthy_transitions")
             check("slo/alarm_healthy_quiet", last_path, quiet, 0, 0,
                   quiet == 0)
+        # Autotuner artifacts (bench.py --tune): ABSOLUTE gates on the
+        # latest round — the traced-knob grid sweep at least matches
+        # the static recompile-per-config counterfactual
+        # (``batch_speedup_ratio`` >= 1.0), at least
+        # two named tuned profiles shipped, every profile
+        # Pareto-non-dominated by the reference default over the
+        # recorded objectives (dominance RECOMPUTED here from the
+        # payload's SLO rows, not trusted from the writer's flag) and
+        # fuzz-oracle green on its held-out seeds.  Smoke sweeps are
+        # provenance unless the walk holds only smoke rounds (the
+        # sync-heal fallback rule: `--tune --smoke`'s in-bench check
+        # of its own fresh artifact still bites).
+        tn_all = [(p, pl) for p, pl in entries
+                  if "batch_speedup_ratio" in pl and "profiles" in pl]
+        tn = [(p, pl) for p, pl in tn_all
+              if not pl.get("smoke")] or tn_all
+        if tn is not tn_all:
+            for p, pl in tn_all:
+                if pl.get("smoke"):
+                    rows.append({
+                        "check": "slo/tune_pareto", "source":
+                        os.path.basename(p), "ok": None,
+                        "note": "smoke tune sweep — different scale, "
+                                "not a trajectory datum",
+                    })
+        if tn:
+            last_path, last = tn[-1]
+            ratio = last.get("batch_speedup_ratio")
+            check("slo/tune_batch_speedup", last_path, ratio, 1.0, 1.0,
+                  isinstance(ratio, (int, float)) and ratio >= 1.0)
+            profs = last.get("profiles") or {}
+            check("slo/tune_profiles_shipped", last_path,
+                  sorted(profs), ">= 2 named profiles", 2,
+                  len(profs) >= 2)
+            objs = last.get("objectives") or []
+            ref = last.get("reference_slos") or {}
+            nondom = {}
+            for name, prof in sorted(profs.items()):
+                slos = prof.get("slos") or {}
+                complete = bool(objs) and all(
+                    isinstance(ref.get(o), (int, float))
+                    and isinstance(slos.get(o), (int, float))
+                    for o in objs)
+                ref_dominates = complete and all(
+                    ref[o] <= slos[o] for o in objs) and any(
+                    ref[o] < slos[o] for o in objs)
+                nondom[name] = complete and not ref_dominates
+            check("slo/tune_profiles_nondominated", last_path, nondom,
+                  True, True, bool(nondom) and all(nondom.values()))
+            fuzz = {name: prof.get("fuzz_green")
+                    for name, prof in sorted(profs.items())}
+            check("slo/tune_profiles_fuzz_green", last_path, fuzz,
+                  True, True,
+                  bool(fuzz) and all(v is True for v in fuzz.values()))
     return ok, rows
 
 

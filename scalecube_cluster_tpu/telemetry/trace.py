@@ -472,6 +472,38 @@ class TracePlane:
         return TelemetryState(trace=trace, first_suspect=tel.first_suspect,
                               first_removed=tel.first_removed)
 
+    def on_round_batch(self, rc, tel):
+        """The batched fold (models/compose.composed_batch_scan): one
+        ``lax.cond`` on the BATCH-LEVEL emptiness predicate — any row's
+        status change, scheduled leave, or epoch advance — wrapping the
+        vmapped per-row :func:`observe_round`.  A globally-silent round
+        (the steady-state majority across the whole batch) costs the
+        predicate reductions only; when any row has events, silent rows
+        ride the active branch with all-zero codes, which the record
+        scatter and first-round updates treat as the identity — so
+        every row stays bit-identical to its sequential run.
+        """
+        node_ids = jnp.arange(rc.params.n_members, dtype=jnp.int32) \
+            + self.observer_offset
+        pred = rc.any_status_change | jnp.any(
+            rc.world.leave_at[:, node_ids] == rc.round_idx)
+        prev_epoch = self._prev_epoch(rc)
+        if prev_epoch is not None and jnp.asarray(prev_epoch).size:
+            pred = pred | jnp.any(
+                jnp.asarray(prev_epoch) != jnp.asarray(rc.new.epoch))
+
+        def active(t):
+            def row(tel_r, prev, new, world):
+                return observe_round(
+                    tel_r, rc.round_idx, prev.status, prev.inc, new,
+                    world, observer_offset=self.observer_offset,
+                    prev_epoch=(prev.epoch if rc.params.epoch_bits
+                                else None),
+                )
+            return jax.vmap(row)(t, rc.prev, rc.new, rc.world)
+
+        return jax.lax.cond(pred, active, lambda t: t, tel)
+
     def finalize(self, fc, tel):
         return tel
 

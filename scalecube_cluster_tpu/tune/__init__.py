@@ -1,0 +1,12 @@
+"""Protocol autotuning: one-compile knob-grid sweeps
+(:mod:`~scalecube_cluster_tpu.tune.search`) and the shipped
+tuned-default profiles (:mod:`~scalecube_cluster_tpu.tune.profiles`,
+surfaced as ``swim.SwimParams.tuned``)."""
+
+from scalecube_cluster_tpu.tune.profiles import (  # noqa: F401
+    PROFILES, profile_knobs, tuned_params,
+)
+from scalecube_cluster_tpu.tune.search import (  # noqa: F401
+    OBJECTIVES, default_grid, dominates, pareto_front, sweep,
+    tune_scenarios, validate_profile,
+)

@@ -1,0 +1,108 @@
+"""Named tuned-default profiles — the autotuner's shipped picks.
+
+Each profile is a small set of *schedule* overrides resolved against a
+base :class:`~scalecube_cluster_tpu.models.swim.SwimParams` (all of
+them fields that :class:`~scalecube_cluster_tpu.models.swim.Knobs` can
+also sweep dynamically, so the sweep that selected them and the params
+that ship them describe the same program).  Three ways to consume one:
+
+  - ``swim.SwimParams.tuned("fast-detect")`` — static params with the
+    profile baked in (new deployments);
+  - :func:`profile_knobs` — the same overrides as validated dynamic
+    :class:`Knobs` data for an EXISTING compiled program (same shapes,
+    zero recompiles — retuning a running cluster);
+  - :func:`tune.search.sweep` rows named after the profile — how the
+    bench measures them against the reference default.
+
+Every shipped profile is regress-gated (telemetry/query.py): it must
+stay Pareto-non-dominated by the reference default on the sweep
+objectives and pass the held-out chaos fuzz oracle
+(:func:`tune.search.validate_profile`) with zero violations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from scalecube_cluster_tpu.models import swim
+
+# name -> {target objective, rationale, resolve(params) -> overrides}.
+# ``resolve`` returns CONCRETE values for a given base so the same
+# profile scales with the base schedule instead of hardcoding one
+# cluster's round quantization.
+PROFILES: Dict[str, dict] = {
+    "fast-detect": {
+        "target": "detection_latency_p99_rounds",
+        "why": ("probe every round, half the probe timeout and half the "
+                "suspicion window: crashes mature into DEAD verdicts in "
+                "roughly half the rounds, trading a higher (still "
+                "monitor-green) false-suspicion rate"),
+        "resolve": lambda p: {
+            "ping_every": 1,
+            "ping_timeout_ms": max(1.0, float(p.ping_timeout_ms) / 2),
+            "suspicion_rounds": max(1, p.suspicion_rounds // 2),
+        },
+    },
+    "low-traffic": {
+        "target": "wire_bytes_per_member_round",
+        "why": ("half the probe cadence and half the anti-entropy "
+                "cadence: the dominant per-round wire costs (PING "
+                "fan-out and SYNC table exchanges) are issued half as "
+                "often while gossip dissemination is untouched"),
+        "resolve": lambda p: {
+            "ping_every": 2 * p.ping_every,
+            **({"sync_every": 2 * p.sync_every} if p.sync_every else {}),
+        },
+    },
+    "churn-hardened": {
+        "target": "false_positive_observer_rate",
+        "why": ("half the probe cadence, probe timeout stretched to "
+                "90% of the interval and a 1.5x suspicion window: each "
+                "flaky link gets half as many chances per horizon to "
+                "produce a false suspicion, slow (not lost) replies "
+                "stop counting as timeouts, and the suspicions that do "
+                "fire have time to be refuted before maturing into "
+                "false removals — at the cost of slower true-crash "
+                "detection (unlike low-traffic, anti-entropy keeps its "
+                "default cadence, so partitions still heal on time)"),
+        "resolve": lambda p: {
+            "ping_every": 2 * p.ping_every,
+            "ping_timeout_ms": 0.9 * float(p.ping_interval_ms),
+            "suspicion_rounds":
+                p.suspicion_rounds + (p.suspicion_rounds + 1) // 2,
+        },
+    },
+}
+
+
+def resolve(profile: str, params: "swim.SwimParams") -> dict:
+    """The profile's concrete override dict for ``params``."""
+    if profile not in PROFILES:
+        raise ValueError(f"unknown tuned profile {profile!r} "
+                         f"(have {sorted(PROFILES)})")
+    return dict(PROFILES[profile]["resolve"](params))
+
+
+def profile_knobs(profile: str, params: "swim.SwimParams") -> "swim.Knobs":
+    """The profile as validated dynamic knob data for ``params`` —
+    reruns an already-compiled program (knobs are traced operands).
+    Only overrides that stay within the params ceilings can ship this
+    way (``Knobs.for_params`` raises otherwise)."""
+    return swim.Knobs.for_params(params, **resolve(profile, params))
+
+
+def tuned_params(profile: str, base: Optional["swim.SwimParams"] = None,
+                 n_members: int = 32, **overrides) -> "swim.SwimParams":
+    """Static params with ``profile`` baked in (the
+    ``SwimParams.tuned`` constructor body).  ``base`` defaults to the
+    chaos-campaign timing preset at ``n_members``; explicit
+    ``**overrides`` win over the profile's."""
+    if base is None:
+        from scalecube_cluster_tpu.chaos import campaign
+        base = swim.SwimParams.from_config(
+            campaign.campaign_config(), n_members=n_members,
+            delivery="shift")
+    vals = resolve(profile, base)
+    vals.update(overrides)
+    return dataclasses.replace(base, **vals)

@@ -1,0 +1,436 @@
+"""Device-parallel protocol autotuning: sweep the knob grid in ONE
+compiled program per shape bucket, score on the PR-5 SLOs, keep the
+Pareto frontier.
+
+The sweep is the payoff of the dynamic-:class:`~.models.swim.Knobs`
+split: ``SwimParams`` stays a static jit argument (shapes, channel
+counts), the swept schedule fields are traced DATA.  A knob-grid ×
+scenario-batch product therefore runs as
+
+  - one :func:`~.models.compose.composed_batch_scan` call per
+    (config, shape-bucket) pair — scenarios vmapped on the batch axis,
+    the scan outside the vmap (the PR-12 batching layout);
+  - ZERO recompiles across the whole grid: every config reruns the
+    bucket's already-compiled program with different knob operands.
+    :func:`sweep` returns the jit cache size as the witness
+    (``info["compiles"] == info["shape_buckets"]``, pinned by
+    tests/test_tune.py and recorded in artifacts/tune_pareto.json).
+
+Scoring rides the composed plane stack — event trace ⊕ SAFETY-ONLY
+monitor (``MonitorSpec.passive``) — so every config is scored on:
+
+  ==============================  =======================================
+  objective (minimize)            source
+  ==============================  =======================================
+  false_positive_observer_rate    trace ``first_suspect`` strictly before
+                                  the subject's scheduled crash round
+                                  (never-faulty subjects included), over
+                                  eligible (live observer, live subject)
+                                  pairs
+  detection_latency_p99_rounds    ``first_suspect`` - ``down_from`` P99
+                                  over (live observer, permanently
+                                  crashed subject) pairs, censored at the
+                                  horizon
+  removal_latency_p99_rounds      same, ``first_removed``
+  wire_bytes_per_member_round     measured ``messages_*`` counters priced
+                                  by the parallel/traffic.py wire format
+                                  (gossip/SYNC payloads, probe headers)
+  ==============================  =======================================
+
+The monitor runs the *passive* spec on purpose: scenario-derived
+completeness deadlines are built for the DEFAULT schedule, and a
+slower-but-valid config (low-traffic) would trip them spuriously —
+knob data cannot rebuild host-side deadlines.  Safety invariants
+(monotone incarnations, timer bounds, wire saturation) gate every
+config; liveness is what the objectives measure.  Shipped profiles
+additionally rerun the FULL fuzz oracle as static params
+(:func:`validate_profile`), where the deadlines DO adapt.
+"""
+
+from __future__ import annotations
+
+import itertools
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalecube_cluster_tpu.chaos import campaign as ccampaign
+from scalecube_cluster_tpu.chaos import monitor as cmonitor
+from scalecube_cluster_tpu.chaos import scenarios as cscenarios
+from scalecube_cluster_tpu.models import compose, swim
+from scalecube_cluster_tpu.parallel import traffic
+from scalecube_cluster_tpu.telemetry import trace as ttrace
+from scalecube_cluster_tpu.tune import profiles as tprofiles
+
+INT32_MAX = np.iinfo(np.int32).max
+
+OBJECTIVES = (
+    "false_positive_observer_rate",
+    "detection_latency_p99_rounds",
+    "removal_latency_p99_rounds",
+    "wire_bytes_per_member_round",
+)
+
+# Default tune-workload params overrides: the health planes the grid
+# sweeps must be ON in the static params (their knobs clamp AGAINST
+# these ceilings — Knobs.for_params) and the campaign preset ships
+# them disabled.
+TUNE_PARAM_OVERRIDES = {"lhm_max": 8, "dead_suppress_rounds": 16}
+
+# Event-lane capacity for the scoring trace plane: the SLOs read the
+# ``first_suspect``/``first_removed`` matrices, which update regardless
+# of lane occupancy — a small lane keeps the batched carry cheap.
+DEFAULT_TRACE_CAPACITY = 64
+
+
+# --------------------------------------------------------------------------
+# Grid construction
+# --------------------------------------------------------------------------
+
+
+def default_grid(params: "swim.SwimParams",
+                 smoke: bool = False) -> List[dict]:
+    """The default knob grid for ``params``: config dicts
+    ``{"name", "overrides"}``, reference default FIRST (empty
+    overrides — the row every shipped profile must stay
+    Pareto-non-dominated against).
+
+    The probe axes (cadence × timeout × suspicion window) form a full
+    product — they interact directly in the FD chain; the suppression
+    and health caps (``dead_suppress_rounds``, ``lhm_max``,
+    ``sync_every``) get one-off arms off the reference — second-order
+    interactions, and each arm is free anyway (the compiled program is
+    shared).  Axes for planes the params disable are skipped; smoke
+    keeps only the cadence × timeout core.  Every override is
+    validated by ``Knobs.for_params`` at sweep time."""
+    half_to = max(1.0, float(params.ping_timeout_ms) / 2)
+    axes = {
+        "ping_every": sorted({1, int(params.ping_every)}),
+        "ping_timeout_ms": [half_to, float(params.ping_timeout_ms)],
+    }
+    if not smoke:
+        axes["suspicion_rounds"] = sorted({
+            max(1, params.suspicion_rounds // 2),
+            params.suspicion_rounds,
+            2 * params.suspicion_rounds,
+        })
+    names = sorted(axes)
+    configs = [{"name": "reference", "overrides": {}}]
+    seen = {()}
+
+    def add(ov: dict) -> None:
+        key = tuple(sorted(ov.items()))
+        if key in seen:
+            return
+        seen.add(key)
+        label = ",".join(f"{n}={ov[n]:g}" if isinstance(ov[n], float)
+                         else f"{n}={ov[n]}" for n in sorted(ov))
+        configs.append({"name": label, "overrides": ov})
+
+    for combo in itertools.product(*(axes[n] for n in names)):
+        add({n: v for n, v in zip(names, combo)
+             if _differs(v, getattr(params, n))})
+    if not smoke:
+        if params.lhm_max > 1:
+            add({"lhm_max": 1})
+        if params.dead_suppress_rounds > 1:
+            add({"dead_suppress_rounds":
+                 max(1, params.dead_suppress_rounds // 2)})
+        if params.sync_every > 0:
+            add({"sync_every": 2 * params.sync_every})
+    return configs
+
+
+def _differs(value, base) -> bool:
+    return float(value) != float(base)
+
+
+def profile_configs(params: "swim.SwimParams") -> List[dict]:
+    """The shipped profiles as sweep configs (same row schema as
+    :func:`default_grid`), overrides resolved against ``params``."""
+    return [{"name": name,
+             "overrides": tprofiles.resolve(name, params),
+             "profile": True}
+            for name in sorted(tprofiles.PROFILES)]
+
+
+# --------------------------------------------------------------------------
+# The compiled sweep arms
+# --------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("params", "n_rounds", "capacity",
+                                   "trace_capacity"))
+def _sweep_bucket(base_keys, params, worlds, specs, n_rounds, knobs,
+                  capacity, trace_capacity):
+    """One (config, bucket) arm: the scored plane stack over the
+    batched composed scan.  Knobs are traced operands — every config
+    reruns this program; ``_sweep_bucket._cache_size()`` is the
+    one-compile-per-shape-bucket witness."""
+    planes = (ttrace.TracePlane(capacity=trace_capacity),
+              cmonitor.MonitorPlane(specs, capacity=capacity))
+    _, results, metrics = compose.composed_batch_scan(
+        base_keys, params, worlds, n_rounds, planes=planes, knobs=knobs)
+    return results["trace"], results["monitor"], metrics
+
+
+@partial(jax.jit, static_argnames=("params", "n_rounds", "capacity",
+                                   "trace_capacity"))
+def _row_run(key, params, world, spec, n_rounds, knobs, capacity,
+             trace_capacity):
+    """The sequential control arm (bench.py --tune speedup ratio): the
+    SAME plane stack through the single-scenario composed scan."""
+    planes = (ttrace.TracePlane(capacity=trace_capacity),
+              cmonitor.MonitorPlane(spec, capacity=capacity))
+    _, results, metrics = compose.composed_scan(
+        key, params, world, n_rounds, planes=planes, knobs=knobs)
+    return results["trace"], results["monitor"], metrics
+
+
+def passive_specs(params: "swim.SwimParams", batch: int):
+    """``MonitorSpec.passive`` stacked to the bucket batch size."""
+    spec = cmonitor.MonitorSpec.passive(params)
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (batch,) + x.shape), spec)
+
+
+def config_knobs(params: "swim.SwimParams", overrides: dict,
+                 batch: int) -> "swim.Knobs":
+    """One config's overrides as VALIDATED batched knob data (the same
+    knob row broadcast to every scenario in the bucket)."""
+    kn = swim.Knobs.for_params(params, **overrides)
+    kn = jax.tree.map(jnp.asarray, kn)
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (batch,) + x.shape), kn)
+
+
+# --------------------------------------------------------------------------
+# Scoring
+# --------------------------------------------------------------------------
+
+
+def wire_bytes_total(params: "swim.SwimParams", metrics: dict) -> float:
+    """Measured message counters priced by the wire format
+    (parallel/traffic.py byte model): gossip and anti-entropy messages
+    carry full K-record payloads, probe-plane messages one packed
+    record header.  ``_sent`` counters only — received/verdict
+    counters would double-count the same wire bytes."""
+    kb = traffic._key_bytes(params)
+    payload = params.n_subjects * kb
+    per_message = {
+        "messages_gossip": payload,
+        "messages_ping_sent": kb,
+        "messages_ping_req_sent": kb,
+        "messages_anti_entropy": 2 * params.n_subjects * kb,
+    }
+    total = 0.0
+    for name, cost in per_message.items():
+        if name in metrics:
+            total += float(np.asarray(metrics[name]).sum()) * cost
+    return total
+
+
+def _score_bucket(bucket, tel, metrics) -> dict:
+    """Host-side partial SLO aggregates for one (config, bucket) arm."""
+    horizon = bucket.horizon
+    down = np.asarray(bucket.worlds.down_from)          # [B, N]
+    down_until = np.asarray(bucket.worlds.down_until)   # [B, N]
+    leave = np.asarray(bucket.worlds.leave_at)          # [B, N]
+    sids = np.asarray(bucket.worlds.subject_ids)        # [B, K]
+    fs = np.asarray(tel.first_suspect)                  # [B, N, K]
+    fr = np.asarray(tel.first_removed)                  # [B, N, K]
+    rows = np.arange(fs.shape[0])[:, None]
+    subj_down = down[rows, sids]                        # [B, K]
+    subj_down_until = down_until[rows, sids]
+    subj_leave = leave[rows, sids]
+
+    # Eligible pairs: observers that never crash or leave, subjects
+    # that never leave (graceful LEAVE makes any suspicion moot).
+    obs_ok = (down == INT32_MAX) & (leave == INT32_MAX)     # [B, N]
+    subj_ok = subj_leave == INT32_MAX                       # [B, K]
+    pair_ok = obs_ok[:, :, None] & subj_ok[:, None, :]      # [B, N, K]
+
+    # False positive: first suspicion strictly before the subject's
+    # crash round (INT32_MAX when it never crashes).
+    false = pair_ok & (fs < subj_down[:, None, :])
+
+    # Latency pools: permanently crashed subjects only (revivals make
+    # "detected" ambiguous), suspicion at-or-after the crash (earlier
+    # ones are already counted as false positives), censored at the
+    # horizon when the observer never converged.
+    dead = subj_ok & (subj_down < horizon) & (subj_down_until == INT32_MAX)
+    det_pair = obs_ok[:, :, None] & dead[:, None, :] & (
+        fs >= subj_down[:, None, :])
+    rem_pair = obs_ok[:, :, None] & dead[:, None, :] & (
+        fr >= subj_down[:, None, :])
+    lat_det = np.minimum(fs, horizon) - subj_down[:, None, :]
+    lat_rem = np.minimum(fr, horizon) - subj_down[:, None, :]
+
+    return {
+        "fp_pairs": int(false.sum()),
+        "eligible_pairs": int(pair_ok.sum()),
+        "detection_rounds": lat_det[det_pair],
+        "removal_rounds": lat_rem[rem_pair],
+        "wire_bytes": wire_bytes_total(bucket.params, metrics),
+        "member_rounds": bucket.size * bucket.params.n_members * horizon,
+    }
+
+
+def _finalize_slos(parts: List[dict]) -> dict:
+    det = np.concatenate([p["detection_rounds"] for p in parts]) \
+        if parts else np.zeros((0,))
+    rem = np.concatenate([p["removal_rounds"] for p in parts]) \
+        if parts else np.zeros((0,))
+    eligible = sum(p["eligible_pairs"] for p in parts)
+    member_rounds = sum(p["member_rounds"] for p in parts)
+    return {
+        "false_positive_observer_rate":
+            (sum(p["fp_pairs"] for p in parts) / eligible)
+            if eligible else 0.0,
+        "detection_latency_p99_rounds":
+            float(np.percentile(det, 99)) if det.size else 0.0,
+        "removal_latency_p99_rounds":
+            float(np.percentile(rem, 99)) if rem.size else 0.0,
+        "wire_bytes_per_member_round":
+            (sum(p["wire_bytes"] for p in parts) / member_rounds)
+            if member_rounds else 0.0,
+        "latency_samples": int(det.size),
+    }
+
+
+# --------------------------------------------------------------------------
+# The sweep
+# --------------------------------------------------------------------------
+
+
+def tune_scenarios(seed: int, n_scenarios: int, n: int = 32,
+                   log=None) -> list:
+    """The tune workload: generated campaign scenarios WITHOUT
+    open-world joins (join storms flip ``open_world`` params and the
+    latency accounting has no fault round for joiners).  Dropped
+    scenarios are logged, never silent."""
+    scens = cscenarios.generate_campaign(seed, n_scenarios, n=n)
+    kept = [s for s in scens if not s.has_joins]
+    if log is not None and len(kept) < len(scens):
+        log(f"tune: dropped {len(scens) - len(kept)}/{len(scens)} "
+            f"join-storm scenarios (open-world rows are out of the "
+            f"latency accounting)")
+    return kept
+
+
+def sweep(scenarios: Sequence, configs: Optional[List[dict]] = None,
+          seed: int = 0, delivery: str = "shift", capacity: int = 256,
+          trace_capacity: int = DEFAULT_TRACE_CAPACITY,
+          smoke: bool = False, log=None, **param_overrides):
+    """Run every config over every scenario bucket; returns
+    ``(rows, info)``.
+
+    ``rows[i]`` = ``{"name", "overrides", "green", "slos"}`` for
+    ``configs[i]`` (default: :func:`default_grid` + the shipped
+    profiles); ``green`` is the passive safety monitor's verdict over
+    ALL scenarios.  ``info`` carries the compile witness: with B
+    shape buckets and C configs, ``calls == B * C`` but
+    ``compiles == B`` — knob data never recompiles.
+    ``param_overrides`` (default :data:`TUNE_PARAM_OVERRIDES`) shape
+    the STATIC tune-workload params, identical for every config."""
+    overrides = dict(TUNE_PARAM_OVERRIDES)
+    overrides.update(param_overrides)
+    buckets = ccampaign.build_buckets(scenarios, seed=seed,
+                                      delivery=delivery, **overrides)
+    if configs is None:
+        configs = default_grid(buckets[0].params, smoke=smoke)
+        configs += profile_configs(buckets[0].params)
+    cache_before = _sweep_bucket._cache_size()
+    specs = [passive_specs(b.params, b.size) for b in buckets]
+    rows = []
+    for cfg in configs:
+        parts, green = [], True
+        for b, spec in zip(buckets, specs):
+            kn = config_knobs(b.params, cfg["overrides"], b.size)
+            tel, mon, metrics = _sweep_bucket(
+                b.keys, b.params, b.worlds, spec, b.horizon, kn,
+                capacity, trace_capacity)
+            green &= all(cmonitor.verdict(m)["green"]
+                         for m in cmonitor.unstack_monitor(mon))
+            parts.append(_score_bucket(b, tel, metrics))
+        rows.append({"name": cfg["name"],
+                     "overrides": dict(cfg["overrides"]),
+                     "profile": bool(cfg.get("profile")),
+                     "green": bool(green),
+                     "slos": _finalize_slos(parts)})
+        if log is not None:
+            s = rows[-1]["slos"]
+            log(f"tune config {cfg['name']}: green={green} "
+                + " ".join(f"{k}={s[k]:.4g}" for k in OBJECTIVES))
+    info = {
+        "shape_buckets": len(buckets),
+        "bucket_sizes": [b.size for b in buckets],
+        "configs": len(configs),
+        "calls": len(buckets) * len(configs),
+        "compiles": _sweep_bucket._cache_size() - cache_before,
+        "scenarios": sum(b.size for b in buckets),
+        "member_rounds": sum(b.size * b.params.n_members * b.horizon
+                             for b in buckets),
+        "param_overrides": overrides,
+    }
+    return rows, info
+
+
+# --------------------------------------------------------------------------
+# Pareto frontier
+# --------------------------------------------------------------------------
+
+
+def dominates(a: Dict[str, float], b: Dict[str, float],
+              objectives: Sequence[str] = OBJECTIVES) -> bool:
+    """True when ``a`` is at-least-as-good on every objective and
+    strictly better on one (minimization)."""
+    return (all(a[o] <= b[o] for o in objectives)
+            and any(a[o] < b[o] for o in objectives))
+
+
+def pareto_front(slos: Sequence[Dict[str, float]],
+                 objectives: Sequence[str] = OBJECTIVES) -> List[int]:
+    """Indices of the non-dominated rows (stable order; duplicates of
+    a frontier point all stay on the frontier)."""
+    return [i for i, a in enumerate(slos)
+            if not any(dominates(b, a, objectives)
+                       for j, b in enumerate(slos) if j != i)]
+
+
+# --------------------------------------------------------------------------
+# Profile validation: the held-out fuzz oracle
+# --------------------------------------------------------------------------
+
+
+def validate_profile(profile: str, seed: int = 7001,
+                     seeds_per_tier: int = 1, n: int = 16,
+                     capacity: int = 256, delivery: str = "shift",
+                     log=None) -> dict:
+    """Rerun the chaos fuzz oracle with ``profile`` baked into the
+    STATIC params on held-out seeds: the full per-scenario
+    ``MonitorSpec`` (completeness deadlines and all) is rebuilt under
+    the profile's schedule, so a profile that breaks liveness — not
+    just safety — goes red.  Returns the campaign summary dict plus
+    ``green``."""
+    scens = cscenarios.generate_fuzz_campaign(seed, seeds_per_tier, n=n)
+    base = ccampaign.campaign_params(scens[0], delivery=delivery)
+    overrides = tprofiles.resolve(profile, base)
+    buckets = ccampaign.build_buckets(scens, seed=seed,
+                                      delivery=delivery, **overrides)
+    res = ccampaign.run_campaign_vmapped(
+        scens, seed=seed, delivery=delivery, capacity=capacity,
+        buckets=buckets)
+    summary = res.summary()
+    if log is not None:
+        log(f"tune profile {profile}: fuzz oracle "
+            f"{summary['green_scenarios']}/{summary['scenarios']} green "
+            f"on held-out seed {seed} (overrides {overrides})")
+    return {"profile": profile, "seed": seed, "overrides": overrides,
+            "green": bool(summary["green"]),
+            "green_scenarios": summary["green_scenarios"],
+            "scenarios": summary["scenarios"],
+            "violations_by_code": summary["violations_by_code"]}

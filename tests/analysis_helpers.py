@@ -95,6 +95,13 @@ def composed_shard_scan(key, params, world, n_rounds, planes=()):
     pending = swim.swim_tick_send(0, params)
     state = swim.swim_tick_recv(pending, params)
     return swim.swim_tick(state, params)
+
+
+def composed_batch_scan(keys, params, worlds, n_rounds, planes=()):
+    state = 0
+    for _ in range(n_rounds if isinstance(n_rounds, int) else 1):
+        state = swim.swim_tick(state, params)
+    return state
 '''
 
 MINI_MONITOR = '''\
@@ -107,6 +114,10 @@ def run_monitored(key, params, world, n_rounds):
 
 def run_monitored_metered(key, params, world, n_rounds):
     return compose.composed_scan(key, params, world, n_rounds)
+
+
+def run_monitored_batch(keys, params, worlds, n_rounds):
+    return compose.composed_batch_scan(keys, params, worlds, n_rounds)
 '''
 
 MINI_MESH = '''\

@@ -43,6 +43,9 @@ class TestPlaneMatrix:
         # ... and the compose column: the knob is reachable from the
         # composed scan drivers the entries delegate to
         assert matrix["compose"]["sync_interval"]["compose"]
+        # ... and the batch column: the batched driver runs the same
+        # tick, so the knob is sweepable on the batch axis too
+        assert matrix["batch"]["sync_interval"]["batch"]
         # dispatch-level-only and never-consulted knobs are all-empty
         # rows in the body matrix — allowed (the entry matrix covers
         # them)
@@ -66,10 +69,13 @@ class TestPlaneMatrix:
         got = ids_of(findings)
         missing = set(lint.ENTRY_POINTS) - {"run"}
         # a knob consulted in ONE entry body bypasses compose() too —
-        # both the per-entry gaps and the compose-bypass finding fire
+        # the per-entry gaps, the compose-bypass finding AND the
+        # batch-bypass finding all fire (the batched driver cannot
+        # reach an entry-body-only consult either)
         assert got == {f"plane-matrix:entry_knob:entry:{e}"
                        for e in missing} | {
-                           "plane-matrix:entry_knob:compose"}
+                           "plane-matrix:entry_knob:compose",
+                           "plane-matrix:entry_knob:batch"}
 
     def test_body_gap_fires_for_the_unthreaded_body(self, tmp_path):
         swim_src = MINI_SWIM.replace(
@@ -102,6 +108,31 @@ class TestPlaneMatrix:
         assert ids_of(findings) == {
             "plane-matrix:sync_interval:body:pipelined"}
 
+    def test_batch_gap_fires_for_the_batch_driver_only(self, tmp_path):
+        # the batched driver loses its tick delegation: every knob the
+        # entries still consult becomes unreachable from the batch
+        # axis — exactly the per-knob batch cells fire, nothing else
+        compose_src = (
+            "from scalecube_cluster_tpu.models import swim\n\n\n"
+            "def composed_scan(key, params, world, n_rounds, planes=()):\n"
+            "    return swim.swim_tick(0, params)\n\n\n"
+            "def composed_shard_scan(key, params, world, n_rounds,\n"
+            "                        planes=()):\n"
+            "    pending = swim.swim_tick_send(0, params)\n"
+            "    state = swim.swim_tick_recv(pending, params)\n"
+            "    return swim.swim_tick(state, params)\n\n\n"
+            "def composed_batch_scan(keys, params, worlds, n_rounds,\n"
+            "                        planes=()):\n"
+            "    return 0\n"
+        )
+        _, findings = lint.plane_matrix(
+            graph_of(tmp_path, {"models/compose.py": compose_src}))
+        got = ids_of(findings)
+        assert {"plane-matrix:sync_interval:batch",
+                "plane-matrix:n_members:batch",
+                "plane-matrix:lhm_max:batch"} <= got
+        assert all(":batch" in fid for fid in got)
+
     def test_missing_entry_root_is_an_input_error(self, tmp_path):
         swim_src = MINI_SWIM.replace(
             "def run_metered(key", "def run_metered_renamed(key")
@@ -130,6 +161,13 @@ class TestMutationPin:
         blank_consults_in_function(
             mutated_root / "models/compose.py", "composed_scan",
             "params.rounds_per_step", "1")
+        # batch-level: the batched driver's own fusion consult is the
+        # ONLY rounds_per_step site in composed_batch_scan's cone, so
+        # blanking it empties exactly the batch cell (the unbatched
+        # drivers keep theirs)
+        blank_consults_in_function(
+            mutated_root / "models/compose.py", "composed_batch_scan",
+            "params.rounds_per_step", "1")
         _, findings = lint.plane_matrix(PackageGraph(mutated_root))
         got = ids_of(findings)
         expect = {
@@ -139,8 +177,13 @@ class TestMutationPin:
             "plane-matrix:rounds_per_step:entry:run_metered",
             "plane-matrix:rounds_per_step:entry:run_monitored",
             "plane-matrix:rounds_per_step:entry:run_monitored_metered",
+            "plane-matrix:rounds_per_step:batch",
         }
         assert expect <= got
+        # the batch mutation fired no OTHER batch cell: every other
+        # knob's batch column survives both blanks
+        assert {fid for fid in got if fid.endswith(":batch")} == {
+            "plane-matrix:rounds_per_step:batch"}
         # and none of these fire at HEAD
         assert not expect & ids_of(pristine[1])
 
@@ -182,6 +225,28 @@ class TestThinEntries:
             "thin-entry:run_metered:swim_tick",
             "thin-entry:run_metered:no-compose-delegation",
         }
+
+    def test_batch_entry_touching_tick_internal_fires(self, tmp_path):
+        # the batch entry is held to the same thin-alias bar: private
+        # scan plumbing next to the composed delegation fires
+        monitor_src = (
+            "from scalecube_cluster_tpu.models import compose\n"
+            "from scalecube_cluster_tpu.models import swim\n\n\n"
+            "def run_monitored(key, params, world, n_rounds):\n"
+            "    return compose.composed_scan(key, params, world, "
+            "n_rounds)\n\n\n"
+            "def run_monitored_metered(key, params, world, n_rounds):\n"
+            "    return compose.composed_scan(key, params, world, "
+            "n_rounds)\n\n\n"
+            "def run_monitored_batch(keys, params, worlds, n_rounds):\n"
+            "    compose.composed_batch_scan(keys, params, worlds, "
+            "n_rounds)\n"
+            "    return swim.swim_tick(0, params)\n"
+        )
+        findings = lint.thin_entries(
+            graph_of(tmp_path, {"chaos/monitor.py": monitor_src}))
+        assert ids_of(findings) == {
+            "thin-entry:run_monitored_batch:swim_tick"}
 
     def test_same_module_helper_is_checked_one_hop(self, tmp_path):
         # tick logic hidden behind a same-module plain helper still

@@ -1,0 +1,283 @@
+"""Batched composed runner (models/compose.composed_batch_scan):
+bit-identity pins for the batch axis.
+
+The contract under test (ISSUE 17 tentpole):
+
+  - B=1 equals the unbatched ``composed_scan`` BIT-EXACTLY — protocol
+    state, per-round metrics and every plane's finalized slice — across
+    plane stacks, both carry layouts and under round fusion (the scan
+    stays outside the vmap, so the per-round gates see the same
+    predicates a single row would produce);
+  - row i of any batch equals the sequential run of that row's
+    (key, world, knobs) alone — including per-row VARIED knob data,
+    the autotuner's whole premise (tune/search.py sweeps are only
+    trustworthy if batching never leaks state across rows);
+  - ``run_monitored_batch`` is a thin alias over the same runner
+    (byte-for-byte monitor outputs);
+  - sharding does not compose with the batch axis, and says so
+    (``batch_shard_unsupported_reason`` — a declared reason, never a
+    silent wrong answer).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.chaos import monitor as cmonitor
+from scalecube_cluster_tpu.models import compose, swim
+from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+from scalecube_cluster_tpu.telemetry import trace as ttrace
+
+from tests.test_compose import (N, ROUNDS, chaos_params, chaos_world,
+                                metrics_equal, states_equal)
+
+pytestmark = pytest.mark.compose
+
+CAPACITY = 128
+TRACE_CAP = 64
+
+
+def stack_rows(*rows):
+    """Stack pytree rows on a new leading batch axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *rows)
+
+
+def broadcast_spec(spec, batch):
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (batch,) + x.shape), spec)
+
+
+def batch_planes(params, batch, trace=True, monitor=True, metr=False):
+    planes = []
+    if trace:
+        planes.append(ttrace.TracePlane(capacity=TRACE_CAP))
+    if monitor:
+        planes.append(cmonitor.MonitorPlane(
+            broadcast_spec(cmonitor.MonitorSpec.passive(params), batch),
+            capacity=CAPACITY))
+    if metr:
+        planes.append(tmetrics.MetricsPlane(
+            tmetrics.MetricsSpec.default(),
+            chaos_from="monitor" if monitor else None))
+    return tuple(planes)
+
+
+def row_planes(params, trace=True, monitor=True, metr=False):
+    planes = []
+    if trace:
+        planes.append(ttrace.TracePlane(capacity=TRACE_CAP))
+    if monitor:
+        planes.append(cmonitor.MonitorPlane(
+            cmonitor.MonitorSpec.passive(params), capacity=CAPACITY))
+    if metr:
+        planes.append(tmetrics.MetricsPlane(
+            tmetrics.MetricsSpec.default(),
+            chaos_from="monitor" if monitor else None))
+    return tuple(planes)
+
+
+def tree_row(tree, i):
+    return jax.tree.map(lambda x: x[i], tree)
+
+
+def assert_trees_equal(a, b, label):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb), label
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=label)
+
+
+# Plane-stack x layout x fusion grid for the B=1 pin: the bare scan,
+# the tune stack (trace + passive monitor), the full observer stack,
+# and in-tick planes armed (sync + lifeguard + open_world under the
+# full stack) — each on the wide and compact carry layouts, plus one
+# fused-with-tail cell.  Tier-1 samples the three distinct runner
+# shapes (no planes / batched plane folds / fused body); the full grid
+# runs @slow.
+B1_CASES = [
+    ("bare", dict(), dict(trace=False, monitor=False, metr=False)),
+    ("tune-stack", dict(), dict(trace=True, monitor=True, metr=False)),
+    # smallest fused shape with a non-divisible tail (33 = 16*2 + 1):
+    # the unroll factor drives the compile cost, and tier-1 only needs
+    # the fused-body runner SHAPE pinned — the full fused stack at
+    # rounds_per_step=5 runs @slow below
+    ("fused-tail", dict(rounds_per_step=2, rounds=33),
+     dict(trace=True, monitor=False, metr=False)),
+]
+B1_SLOW_CASES = [
+    ("fused-full", dict(rounds_per_step=5),  # 36 = 7*5 + 1
+     dict(trace=True, monitor=True, metr=True)),
+    ("full-stack", dict(sync=True, lifeguard=True),
+     dict(trace=True, monitor=True, metr=True)),
+    ("openworld-full", dict(sync=True, lifeguard=True, open_world=True),
+     dict(trace=True, monitor=True, metr=True)),
+    ("compact-carry", dict(compact_carry=True),
+     dict(trace=True, monitor=True, metr=False)),
+    ("compact-full", dict(compact_carry=True, sync=True, lifeguard=True),
+     dict(trace=True, monitor=True, metr=True)),
+]
+
+
+def check_b1_bit_identity(name, pkw, stack):
+    """Pinned B=1 == unbatched: every output of the batch runner at
+    batch size one is byte-for-byte the ``composed_scan`` output on
+    the same (key, world)."""
+    ow = pkw.pop("open_world", False)
+    rounds = pkw.pop("rounds", ROUNDS)
+    params = chaos_params(open_world=ow, **pkw)
+    world = chaos_world(params, open_world=ow)
+    key = jax.random.key(31)
+
+    f1, r1, m1 = compose.composed_scan(
+        key, params, world, rounds, planes=row_planes(params, **stack))
+    fb, rb, mb = compose.composed_batch_scan(
+        stack_rows(key), params, stack_rows(world), rounds,
+        planes=batch_planes(params, 1, **stack))
+
+    states_equal(f1, tree_row(fb, 0))
+    metrics_equal(m1, {k: v[0] for k, v in mb.items()})
+    assert set(r1) == set(rb)
+    for pname in r1:
+        assert_trees_equal(r1[pname], tree_row(rb[pname], 0),
+                           f"{name}: plane {pname!r} diverged at B=1")
+
+
+@pytest.mark.parametrize("name,pkw,stack", B1_CASES,
+                         ids=[c[0] for c in B1_CASES])
+def test_b1_bit_identity_with_unbatched(name, pkw, stack):
+    check_b1_bit_identity(name, pkw, stack)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,pkw,stack", B1_SLOW_CASES,
+                         ids=[c[0] for c in B1_SLOW_CASES])
+def test_b1_bit_identity_full_grid(name, pkw, stack):
+    check_b1_bit_identity(name, pkw, stack)
+
+
+def test_rows_equal_sequential_with_varied_knobs():
+    """Row i of a batch == the sequential run of row i alone, with the
+    batch rows deliberately HETEROGENEOUS: three different chaos
+    worlds under three different knob settings (the autotuner's
+    config-grid shape).  Any cross-row leak in the batched scan would
+    break at least one row's parity."""
+    params = chaos_params(sync=True, lifeguard=True, lhm_max=4,
+                          dead_suppress_rounds=6)
+    # Batch rows must share the fault-rule arity (leaf shapes stack on
+    # the batch axis), so every row carries exactly one link rule.
+    worlds = [
+        chaos_world(params),
+        swim.SwimWorld.healthy(params).with_crash(2, at_round=6)
+        .with_crash(11, at_round=18)
+        .with_link_fault((0, 4), (4, 8), loss=0.2, from_round=5,
+                         until_round=15),
+        swim.SwimWorld.healthy(params)
+        .with_link_fault((0, N // 2), (N // 2, N), loss=0.5,
+                         from_round=2, until_round=30)
+        .with_leave(9, at_round=10),
+    ]
+    knob_rows = [
+        swim.Knobs.from_params(params),
+        swim.Knobs.for_params(params, ping_every=1,
+                              ping_timeout_ms=float(params.ping_timeout_ms)
+                              / 2),
+        swim.Knobs.for_params(params, ping_every=4, suspicion_rounds=9,
+                              lhm_max=2, dead_suppress_rounds=3),
+    ]
+    keys = [jax.random.key(100 + i) for i in range(3)]
+
+    fb, rb, mb = compose.composed_batch_scan(
+        stack_rows(*keys), params, stack_rows(*worlds), ROUNDS,
+        planes=batch_planes(params, 3),
+        knobs=jax.tree.map(lambda *xs: jnp.stack(
+            [jnp.asarray(x) for x in xs]), *knob_rows))
+
+    for i in range(3):
+        fi, ri, mi = compose.composed_scan(
+            keys[i], params, worlds[i], ROUNDS,
+            planes=row_planes(params), knobs=knob_rows[i])
+        states_equal(fi, tree_row(fb, i))
+        metrics_equal(mi, {k: v[i] for k, v in mb.items()})
+        for pname in ri:
+            assert_trees_equal(ri[pname], tree_row(rb[pname], i),
+                               f"row {i}: plane {pname!r} diverged")
+
+
+@pytest.mark.slow
+def test_default_knobs_broadcast_matches_explicit():
+    """``knobs=None`` broadcasts ``Knobs.from_params`` — same bits as
+    passing the stacked default explicitly."""
+    params = chaos_params()
+    world = chaos_world(params)
+    keys = stack_rows(jax.random.key(1), jax.random.key(2))
+    worlds = stack_rows(world, world)
+    kn = swim.Knobs.from_params(params)
+    explicit = jax.tree.map(
+        lambda x: jnp.broadcast_to(jnp.asarray(x)[None],
+                                   (2,) + jnp.asarray(x).shape), kn)
+    fa, _, ma = compose.composed_batch_scan(keys, params, worlds, ROUNDS)
+    fb, _, mb = compose.composed_batch_scan(keys, params, worlds, ROUNDS,
+                                            knobs=explicit)
+    states_equal(fa, fb)
+    metrics_equal(ma, mb)
+
+
+@pytest.mark.slow
+def test_run_monitored_batch_is_thin_alias():
+    """The batched monitored sweep entry is a THIN alias over
+    ``composed_batch_scan`` — byte-for-byte the same monitor slice and
+    final states (the PR-12 private scan plumbing is gone; the fuzz
+    suite pins the same parity campaign-wide, tests/test_chaos_fuzz)."""
+    params = chaos_params(sync=True)
+    worlds = stack_rows(chaos_world(params),
+                        swim.SwimWorld.healthy(params)
+                        .with_crash(4, at_round=7)
+                        .with_link_fault((0, 4), (4, 8), loss=0.2,
+                                         from_round=5, until_round=15))
+    keys = stack_rows(jax.random.key(5), jax.random.key(6))
+    spec = broadcast_spec(cmonitor.MonitorSpec.passive(params), 2)
+    f_alias, mon_alias, m_alias = cmonitor.run_monitored_batch(
+        keys, params, worlds, spec, ROUNDS, capacity=CAPACITY)
+    fb, rb, mb = compose.composed_batch_scan(
+        keys, params, worlds, ROUNDS,
+        planes=(cmonitor.MonitorPlane(spec, capacity=CAPACITY),))
+    states_equal(f_alias, fb)
+    metrics_equal(m_alias, mb)
+    assert_trees_equal(mon_alias, rb["monitor"], "monitor alias diverged")
+
+
+@pytest.mark.slow
+def test_batch_resume_matches_unbroken():
+    """Chunked batched runs resume batch-stacked states bit-identically
+    to one unbroken batched run (the checkpoint-segment shape on the
+    batch axis)."""
+    params = chaos_params()
+    worlds = stack_rows(chaos_world(params),
+                        swim.SwimWorld.healthy(params)
+                        .with_crash(1, at_round=20)
+                        .with_link_fault((0, 4), (4, 8), loss=0.2,
+                                         from_round=5, until_round=15))
+    keys = stack_rows(jax.random.key(8), jax.random.key(9))
+    f_all, _, m_all = compose.composed_batch_scan(keys, params, worlds,
+                                                  ROUNDS)
+    half = ROUNDS // 2
+    f1, _, _ = compose.composed_batch_scan(keys, params, worlds, half)
+    f2, _, _ = compose.composed_batch_scan(keys, params, worlds,
+                                           ROUNDS - half, states=f1,
+                                           start_round=half)
+    states_equal(f_all, f2)
+
+
+def test_batch_shard_unsupported_reason_is_declared():
+    """Batch x shard is declared unsupported with a real reason (the
+    ``pipelined_delivery_unsupported`` pattern) — never a silent wrong
+    answer."""
+    params = chaos_params()
+    reason = compose.batch_shard_unsupported_reason(params)
+    assert isinstance(reason, str) and reason
+    assert "shard" in reason and "batch" in reason

@@ -520,6 +520,159 @@ def test_regress_static_analysis_gate(tmp_path):
     assert "slo/static_analysis_clean" in bad
 
 
+_TUNE_OBJS = ["false_positive_observer_rate",
+              "detection_latency_p99_rounds",
+              "removal_latency_p99_rounds",
+              "wire_bytes_per_member_round"]
+_TUNE_REF = {"false_positive_observer_rate": 0.30,
+             "detection_latency_p99_rounds": 30.0,
+             "removal_latency_p99_rounds": 44.0,
+             "wire_bytes_per_member_round": 120.0}
+
+
+def _tune_profile(target, **slo_overrides):
+    slos = dict(_TUNE_REF)
+    slos.update(slo_overrides)
+    return {"target": target, "slos": slos, "fuzz_green": True}
+
+
+def _tune_payload(**overrides):
+    payload = {
+        "metric": "tune_pareto",
+        "value": None,
+        "smoke": False,
+        "batch_speedup_ratio": 12.5,
+        "objectives": list(_TUNE_OBJS),
+        "reference_slos": dict(_TUNE_REF),
+        "profiles": {
+            "fast-detect": _tune_profile(
+                "detection_latency_p99_rounds",
+                detection_latency_p99_rounds=16.0,
+                wire_bytes_per_member_round=190.0),
+            "low-traffic": _tune_profile(
+                "wire_bytes_per_member_round",
+                wire_bytes_per_member_round=70.0,
+                detection_latency_p99_rounds=48.0),
+        },
+    }
+    payload.update(overrides)
+    return payload
+
+
+def test_regress_tune_gates(tmp_path):
+    """The --tune artifact's gates: the traced-knob grid sweep at least
+    matches the static recompile-per-config counterfactual (absolute
+    1.0 floor), >= 2 named profiles ship, every profile is
+    Pareto-non-dominated by the reference (dominance RECOMPUTED from
+    the payload's SLO rows) and fuzz-oracle green on held-out seeds."""
+    art = tmp_path / "tune_pareto.json"
+    with open(art, "w") as f:
+        json.dump(_tune_payload(), f)
+    ok, rows = query.regress([str(art)])
+    assert ok, rows
+    checks = {r["check"] for r in rows if r.get("ok") is not None}
+    assert {"slo/tune_batch_speedup", "slo/tune_profiles_shipped",
+            "slo/tune_profiles_nondominated",
+            "slo/tune_profiles_fuzz_green"} <= checks
+
+    # The dynamic sweep losing to per-config recompilation is the
+    # tentpole claim rotting — absolute floor, no noise band.
+    with open(art, "w") as f:
+        json.dump(_tune_payload(batch_speedup_ratio=0.9), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    assert any(r["check"] == "slo/tune_batch_speedup"
+               for r in rows if r.get("ok") is False)
+    # ... and a missing ratio fails the same gate, never passes it.
+    with open(art, "w") as f:
+        json.dump(_tune_payload(batch_speedup_ratio=None), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+
+    # Fewer than two shipped profiles is not a tuned-defaults release.
+    with open(art, "w") as f:
+        json.dump(_tune_payload(profiles={
+            "fast-detect": _tune_profile(
+                "detection_latency_p99_rounds",
+                detection_latency_p99_rounds=16.0)}), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    assert any(r["check"] == "slo/tune_profiles_shipped"
+               for r in rows if r.get("ok") is False)
+
+    # A profile the reference Pareto-dominates (worse on one objective,
+    # no better anywhere) fails — recomputed here, not trusted from
+    # the writer's nondominated_vs_reference flag.
+    dominated = dict(_tune_payload()["profiles"])
+    dominated["low-traffic"] = _tune_profile(
+        "wire_bytes_per_member_round",
+        wire_bytes_per_member_round=150.0)
+    with open(art, "w") as f:
+        json.dump(_tune_payload(profiles=dominated), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    assert any(r["check"] == "slo/tune_profiles_nondominated"
+               for r in rows if r.get("ok") is False)
+
+    # An SLO row missing an objective can't prove non-dominance.
+    incomplete = dict(_tune_payload()["profiles"])
+    del incomplete["low-traffic"]["slos"]["removal_latency_p99_rounds"]
+    with open(art, "w") as f:
+        json.dump(_tune_payload(profiles=incomplete), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    assert any(r["check"] == "slo/tune_profiles_nondominated"
+               for r in rows if r.get("ok") is False)
+
+    # The held-out fuzz oracle is a correctness gate: False or missing
+    # both fail (only an explicit True passes).
+    for fg in (False, None):
+        flaky = dict(_tune_payload()["profiles"])
+        flaky["fast-detect"] = dict(flaky["fast-detect"], fuzz_green=fg)
+        with open(art, "w") as f:
+            json.dump(_tune_payload(profiles=flaky), f)
+        ok, rows = query.regress([str(art)])
+        assert not ok, fg
+        assert any(r["check"] == "slo/tune_profiles_fuzz_green"
+                   for r in rows if r.get("ok") is False)
+
+
+def test_regress_tune_smoke_is_provenance_beside_full_round(tmp_path):
+    """A smoke tune sweep beside a full round is provenance (ok=None
+    note row); alone it gates itself — the sync-heal fallback rule, so
+    ``--tune --smoke``'s in-bench check of its own artifact bites."""
+    full = tmp_path / "tune_pareto.json"
+    smoke = tmp_path / "tune_pareto_smoke.json"
+    with open(full, "w") as f:
+        json.dump(_tune_payload(), f)
+    with open(smoke, "w") as f:
+        json.dump(_tune_payload(smoke=True, batch_speedup_ratio=0.7), f)
+    # Beside the full round the failing smoke ratio must NOT gate.
+    ok, rows = query.regress([str(full), str(smoke)])
+    assert ok, rows
+    assert any(r["check"] == "slo/tune_pareto" and r.get("ok") is None
+               for r in rows)
+    gated = [r for r in rows if r["check"] == "slo/tune_batch_speedup"]
+    assert gated and all(r["source"] == "tune_pareto.json"
+                         for r in gated)
+    # Alone, the smoke round gates itself and the bad ratio bites.
+    ok, rows = query.regress([str(smoke)])
+    assert not ok
+    assert any(r["check"] == "slo/tune_batch_speedup"
+               for r in rows if r.get("ok") is False)
+
+
+def test_load_bench_payload_accepts_tune_artifact(tmp_path):
+    """A tune artifact is a real measurement payload (ratio-bearing,
+    ``value: null`` by design) — never skipped as a stub."""
+    art = tmp_path / "tune_pareto.json"
+    with open(art, "w") as f:
+        json.dump(_tune_payload(), f)
+    payload, note = query.load_bench_payload(str(art))
+    assert note is None
+    assert payload["batch_speedup_ratio"] == 12.5
+
+
 def test_cli_regress_default_globs_include_static_analysis(
         tmp_path, capsys, monkeypatch):
     """Bare ``regress`` walks artifacts/static_analysis.json — the
