@@ -201,6 +201,25 @@ vs the head-style overhead, and the compile counts — all gated by
 pinned by tests/test_bench_compose_smoke.py.  Env overrides:
 SCALECUBE_COMPOSE_ARTIFACT, SCALECUBE_BENCH_N, SCALECUBE_BENCH_ROUNDS.
 
+``--soak``: production soak mode — one long-lived service lifetime
+under the continuous seeded chaos stream (soak/schedule.py) through the
+resilient supervisor's composed shape (soak/driver.py): the full plane
+stack with live alarms, checkpointed segments, one JSONL journal, and
+per-segment drift invariants (compile cache flat after segment 1, host
+RSS bounded, zero monitor violations), plus a seeded mid-soak
+SIGKILL/relaunch drill whose merged journal content rows must be
+byte-identical to the uninterrupted run's with a bit-identical final
+state digest.  Forces CPU (a correctness harness).  Writes an
+``artifacts/soak_report.json``-style artifact (smoke runs get
+``soak_report_smoke.json`` — provenance, the sync-heal convention) and
+copies the soak journal next to it for ``telemetry watch`` replay.
+``--soak --smoke`` is the tier-1-safe pass pinned by
+tests/test_bench_soak_smoke.py.  Env overrides: SCALECUBE_SOAK_N,
+SCALECUBE_SOAK_SEED, SCALECUBE_SOAK_SEVERITY, SCALECUBE_SOAK_SEGMENT,
+SCALECUBE_SOAK_SEGMENTS, SCALECUBE_SOAK_ROUNDS (round target — rounded
+UP to whole segments so the compile-flat invariant stays meaningful),
+SCALECUBE_SOAK_TIMEOUT, SCALECUBE_SOAK_ARTIFACT.
+
 Env overrides for debugging: SCALECUBE_BENCH_N, SCALECUBE_BENCH_ROUNDS,
 SCALECUBE_BENCH_DELIVERY, SCALECUBE_BENCH_SKIP_CANARY,
 SCALECUBE_BENCH_COMPACT (=1: the capacity-oriented compact carry layout,
@@ -2072,6 +2091,209 @@ def run_alarm_bench():
     print(json.dumps(result), flush=True)
 
 
+def run_soak_bench():
+    """The --soak mode: one long-lived service lifetime under the
+    seeded chaos stream, with kill/resume and drift invariants — one
+    JSON line out (never-ship-empty).  Forces CPU: a correctness
+    harness — the drill children must not fight over an attached TPU,
+    and the guarantees under test are backend-independent.
+
+    Three acts, one artifact:
+
+      - the MAIN soak: ``soak.driver.run_soak`` in-process — the
+        composed shape (trace ⊕ metrics ⊕ monitor ⊕ sync ⊕ lifeguard ⊕
+        open-world) over ``soak.schedule.soak_schedule``'s stream, live
+        alarms armed, drift sampled per segment (flat compile cache,
+        bounded RSS, zero monitor violations);
+      - the KILL DRILL: a sibling lineage of the SAME config is
+        SIGKILLed mid-soak in a subprocess at a seeded write-stage,
+        relaunched, and its merged journal's content rows
+        (segment/metrics_window/alarm_transition) must be
+        BYTE-identical to the main soak's with a bit-identical final
+        state digest;
+      - the journal is copied next to the artifact so ``python -m
+        scalecube_cluster_tpu.telemetry watch`` replays the whole
+        lifetime (segment boundaries included).
+
+    ``value`` stays None by design: rounds survived is configured, not
+    measured — the headline is the absolute invariant gates
+    (``telemetry regress`` walks artifacts/soak_report*.json), not a
+    throughput number.  ``--soak --smoke`` is the tier-1-safe pass
+    pinned by tests/test_bench_soak_smoke.py.  Env overrides: module
+    docstring.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    result = {
+        "metric": "soak_rounds_survived",
+        "value": None,
+        "unit": "rounds",
+        "smoke": SMOKE,
+        "platform": "cpu(forced)",
+    }
+    artifact = (os.environ.get("SCALECUBE_SOAK_ARTIFACT")
+                or os.path.join("artifacts",
+                                "soak_report_smoke.json" if SMOKE
+                                else "soak_report.json"))
+    try:
+        import logging
+        import shutil
+        import signal
+        import tempfile
+
+        import numpy as np
+
+        from scalecube_cluster_tpu.resilience import (
+            supervisor as rsup)
+        from scalecube_cluster_tpu.soak import driver as sdrv
+
+        n = int(os.environ.get("SCALECUBE_SOAK_N", 16 if SMOKE else 32))
+        seed = int(os.environ.get("SCALECUBE_SOAK_SEED", 7))
+        severity = os.environ.get("SCALECUBE_SOAK_SEVERITY", "moderate")
+        segment_rounds = int(os.environ.get(
+            "SCALECUBE_SOAK_SEGMENT", 128 if SMOKE else 256))
+        n_segments = int(os.environ.get(
+            "SCALECUBE_SOAK_SEGMENTS", 2 if SMOKE else 8))
+        # The slow-arm scaling lever: a round TARGET, rounded UP to
+        # whole segments (a partial tail segment would compile a second
+        # program and void the compile-flat invariant by construction).
+        rounds_env = os.environ.get("SCALECUBE_SOAK_ROUNDS")
+        if rounds_env:
+            n_segments = max(
+                1, -(-int(rounds_env) // segment_rounds))
+        timeout = float(os.environ.get("SCALECUBE_SOAK_TIMEOUT",
+                                       600.0 if SMOKE else 3600.0))
+
+        t0 = time.time()
+        with tempfile.TemporaryDirectory(prefix="soak-") as workdir:
+            cfg = sdrv.SoakConfig(
+                base_path=os.path.join(workdir, "main", "soak.ckpt"),
+                seed=seed, n_members=n, severity=severity,
+                segment_rounds=segment_rounds, n_segments=n_segments)
+            os.makedirs(os.path.dirname(cfg.base_path))
+            # The supervisor logs through the logging API; adapt the
+            # bench's stderr print to it.
+            slog = logging.getLogger("bench.soak")
+            if not slog.handlers:
+                handler = logging.StreamHandler(sys.stderr)
+                handler.setFormatter(
+                    logging.Formatter("[bench] %(message)s"))
+                slog.addHandler(handler)
+                slog.setLevel(logging.INFO)
+                slog.propagate = False
+            soak = sdrv.run_soak(cfg, log=slog)
+            main_digest = sdrv.result_digest(soak)
+            main_rows = sdrv.content_rows(cfg.journal_path)
+            log(f"soak main: {cfg.n_rounds} rounds / "
+                f"{n_segments} segments, drift ok={soak.drift['ok']}, "
+                f"{soak.alarms['transitions']} alarm transition(s) "
+                f"({time.time() - t0:.1f}s)")
+
+            # The seeded mid-soak kill: same config, own lineage; the
+            # MAIN soak is the uninterrupted reference (same process
+            # env, both on forced CPU — no backend seam to cross).
+            rng = np.random.default_rng(
+                np.random.SeedSequence([seed, 0x50AC]))
+            kill_round = int(rng.integers(
+                1, n_segments) * segment_rounds) if n_segments > 1 \
+                else segment_rounds
+            stage = rsup.KILL_STAGES[
+                int(rng.integers(len(rsup.KILL_STAGES)))]
+            kcfg = sdrv.SoakConfig(
+                base_path=os.path.join(workdir, "killed", "soak.ckpt"),
+                seed=seed, n_members=n, severity=severity,
+                segment_rounds=segment_rounds, n_segments=n_segments)
+            os.makedirs(os.path.dirname(kcfg.base_path))
+            cfg_path = os.path.join(workdir, "killed_config.json")
+            plan = rsup.KillPlan(round=kill_round, stage=stage)
+            t1 = time.time()
+            killed = sdrv.launch_child(
+                kcfg, cfg_path, kill_plan=plan, timeout=timeout,
+                extra_env={"JAX_PLATFORMS": "cpu"})
+            drill = {"kill": plan.encode(), "ok": False}
+            if killed.returncode != -signal.SIGKILL:
+                drill["error"] = (f"kill did not land "
+                                  f"(rc={killed.returncode})")
+                drill["stderr_tail"] = killed.stderr[-2000:]
+            else:
+                relaunch = sdrv.launch_child(
+                    kcfg, cfg_path, timeout=timeout,
+                    extra_env={"JAX_PLATFORMS": "cpu"})
+                if relaunch.returncode != 0:
+                    drill["error"] = "relaunch failed"
+                    drill["stderr_tail"] = relaunch.stderr[-2000:]
+                else:
+                    summary = json.loads(
+                        [ln for ln in
+                         relaunch.stdout.strip().splitlines()
+                         if ln][-1])
+                    got_rows = sdrv.content_rows(kcfg.journal_path)
+                    drill.update(
+                        ok=bool(got_rows == main_rows
+                                and summary["state_digest"]
+                                == main_digest),
+                        journal_match=got_rows == main_rows,
+                        state_match=(summary["state_digest"]
+                                     == main_digest),
+                        content_rows=len(got_rows),
+                        resumed_segments=summary["segments_run"],
+                        seconds=round(time.time() - t1, 2),
+                    )
+            log(f"soak kill drill at {plan.encode()}: "
+                f"{'green' if drill['ok'] else 'RED ' + json.dumps(drill)}")
+
+            journal_copy = os.path.join(
+                os.path.dirname(artifact) or ".",
+                "soak_journal_smoke.jsonl" if SMOKE
+                else "soak_journal.jsonl")
+            os.makedirs(os.path.dirname(artifact) or ".",
+                        exist_ok=True)
+            shutil.copyfile(cfg.journal_path, journal_copy)
+
+        result.update(
+            rounds_survived=cfg.n_rounds,
+            segments=n_segments,
+            segment_rounds=segment_rounds,
+            violations=soak.drift["violations"],
+            drift=soak.drift,
+            alarms=soak.alarms,
+            kill_drill=drill,
+            state_digest=main_digest,
+            journal=journal_copy,
+            n_members=n,
+            seed=seed,
+            severity=severity,
+            scenario=soak.scenario_name,
+            seconds=round(time.time() - t0, 2),
+            repro=(f"soak.driver.run_soak(SoakConfig(base_path=..., "
+                   f"seed={seed}, n_members={n}, "
+                   f"severity={severity!r}, "
+                   f"segment_rounds={segment_rounds}, "
+                   f"n_segments={n_segments}))"),
+            value_note=("value stays null by design: rounds survived "
+                        "is configured, not measured — regress gates "
+                        "the absolute drift/drill invariants instead"),
+        )
+        log(f"soak headline: {cfg.n_rounds} rounds survived, "
+            f"violations={soak.drift['violations']}, compile flat="
+            f"{soak.drift['compile_flat']}, drill ok={drill['ok']}")
+
+        art = dict(result)
+        with open(artifact, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        result["artifact"] = artifact
+        log(f"soak artifact written to {artifact}")
+
+        apply_regress_gate(
+            result, ["BENCH_*.json",
+                     os.path.join("artifacts", "soak_report*.json"),
+                     artifact])
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 def run_churn_bench():
     """The --churn mode: the open-world membership plane's headline
     robustness claim, measured A/B (never asserted) — one JSON line out
@@ -3071,6 +3293,17 @@ def main():
              "artifact; combine with --smoke for the tier-1-safe "
              "mini grid",
     )
+    parser.add_argument(
+        "--soak", action="store_true",
+        help="run production soak mode instead: one long-lived service "
+             "lifetime under the seeded chaos stream through the "
+             "supervisor's composed shape — live alarms, per-segment "
+             "drift invariants (flat compile cache, bounded RSS, zero "
+             "monitor violations) and a seeded mid-soak SIGKILL/"
+             "relaunch drill with byte-identical journals, into an "
+             "artifacts/soak_report.json-style artifact; combine with "
+             "--smoke for the tier-1-safe pass",
+    )
     mode = parser.add_mutually_exclusive_group()
     mode.add_argument(
         "--untraced", action="store_true",
@@ -3181,6 +3414,15 @@ def main():
             parser.error(
                 "--tune runs the protocol autotuner on its own "
                 "workload — drop the other mode flags")
+        if args.soak and (args.chaos or args.resilience or args.metrics
+                          or args.multichip or args.sync
+                          or args.lifeguard or args.churn or args.fuzz
+                          or args.wire or args.compose or args.alarms
+                          or args.tune or args.traced or args.untraced
+                          or args.gap_artifact):
+            parser.error(
+                "--soak runs production soak mode on its own "
+                "workload — drop the other mode flags")
     except SystemExit as e:
         # The one-JSON-line contract holds even for a bad argv: argparse
         # already printed its usage message to stderr; ship the error
@@ -3219,6 +3461,8 @@ def main():
         return run_alarm_bench()
     if args.tune:
         return run_tune_bench()
+    if args.soak:
+        return run_soak_bench()
 
     result = {
         "metric": "swim_member_rounds_per_sec_per_chip",

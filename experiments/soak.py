@@ -1,0 +1,86 @@
+"""Production soak: one long-lived service under streaming chaos.
+
+Drives ``bench.py --soak`` (the one entry point the drift invariants
+flow through, so the experiment and the driver bench cannot drift):
+the never-repeating seeded chaos stream (``soak.schedule`` — every
+segment boundary straddled by an in-flight fault) run through the
+resilient supervisor's ``composed`` shape with the full plane stack
+(trace ⊕ metrics ⊕ monitor ⊕ sync ⊕ lifeguard ⊕ open-world) and the
+live alarm engine armed, all rows streaming to ONE exactly-once JSONL
+journal.  Per-segment drift invariants: compile cache flat after
+segment 1, host RSS bounded, zero monitor violations.  Then the
+drill: a seeded mid-soak SIGKILL in a child process, relaunch over
+the rotated checkpoints — the merged journal's content rows must be
+BYTE-IDENTICAL to an uninterrupted reference run and the final state
+digest must match bit-for-bit.
+
+Writes ``artifacts/soak_report.json`` (override
+``SCALECUBE_SOAK_ARTIFACT``) plus the soak journal next to it, and
+runs the ``telemetry regress`` gate in-bench — the committed artifact
+is the pinned robustness claim, and regress exits 1 if it ever rots.
+The journal replays live (segment boundaries + cumulative rounds)::
+
+    python -m scalecube_cluster_tpu.telemetry watch \
+        artifacts/soak_journal.jsonl
+
+CPU-safe (the stream is seeded; ``SCALECUBE_SOAK_ROUNDS=100000``
+scales the lifetime — also reachable as the ``@slow`` arm of
+``tests/test_soak.py``).
+
+Usage:
+    python experiments/soak.py                  # committed shape
+    python experiments/soak.py --smoke          # tier-1-safe pass
+    python experiments/soak.py --rounds 100000  # the long arm
+"""
+
+import argparse
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--smoke", action="store_true",
+                        help="tier-1-safe fast pass (the bench smoke "
+                             "geometry: n=16, 2 x 128-round segments)")
+    parser.add_argument("--n", type=int, default=None,
+                        help="member count (bench default: 32 full / "
+                             "16 smoke)")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="stream seed (default 7; the stream is "
+                             "pure in (seed, segment, n, severity))")
+    parser.add_argument("--severity", default=None,
+                        choices=("mild", "moderate", "severe"),
+                        help="chaos severity tier (default moderate)")
+    parser.add_argument("--rounds", type=int, default=None,
+                        help="total lifetime in rounds (rounded up to "
+                             "whole segments; default 8 x 256 full / "
+                             "2 x 128 smoke)")
+    parser.add_argument("--artifact", default=None,
+                        help="artifact path (default "
+                             "artifacts/soak_report.json; smoke runs "
+                             "default to soak_report_smoke.json)")
+    args = parser.parse_args()
+
+    env = dict(os.environ)
+    for flag, var in ((args.n, "SCALECUBE_SOAK_N"),
+                      (args.seed, "SCALECUBE_SOAK_SEED"),
+                      (args.severity, "SCALECUBE_SOAK_SEVERITY"),
+                      (args.rounds, "SCALECUBE_SOAK_ROUNDS"),
+                      (args.artifact, "SCALECUBE_SOAK_ARTIFACT")):
+        if flag is not None:
+            env[var] = str(flag)
+
+    cmd = [sys.executable, str(REPO / "bench.py"), "--soak"]
+    if args.smoke:
+        cmd.append("--smoke")
+    proc = subprocess.run(cmd, cwd=str(REPO), env=env)
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,6 +140,19 @@ BATCH_ENTRY_POINTS: Dict[str, Tuple[str, str]] = {
     "run_monitored_batch": ("chaos/monitor.py", "run_monitored_batch"),
 }
 
+# Supervised entry points (PR 18): long-lived drivers that must reach
+# the compose scan ONLY through the resilient supervisor — they
+# assemble a workload and delegate to resilience/supervisor.py
+# (run_resilient owns the segment loop, journal, and checkpoint
+# discipline), and may touch neither a scan/tick internal nor a
+# models/compose.py driver directly: a soak that bypassed the
+# supervisor would lose the exactly-once journal contract its drift
+# invariants are defined over.
+SUPERVISOR_MODULE = "resilience/supervisor.py"
+SUPERVISED_ENTRY_POINTS: Dict[str, Tuple[str, str]] = {
+    "run_soak": ("soak/driver.py", "run_soak"),
+}
+
 # Scan/tick internals a THIN alias entry point must never touch
 # directly — tick-body logic lives in compose.py and the plane
 # modules, entries only assemble a plane stack and delegate
@@ -334,6 +347,13 @@ def thin_entries(graph: PackageGraph) -> List[Finding]:
     shape) may mention a scan/tick internal (``TICK_INTERNALS``) —
     tick-body logic lives in compose.py and the plane modules only.
 
+    Supervised entries (``SUPERVISED_ENTRY_POINTS`` — the soak
+    driver) invert the delegation target: they must reach
+    resilience/supervisor.py (which owns the compose delegation), and
+    a DIRECT edge into models/compose.py or a tick internal is itself
+    the finding — the supervisor's journal/checkpoint discipline is
+    not optional for a long-lived run.
+
     Lenient on missing roots (fixture trees may define a subset — the
     plane matrix is the strict guardian of the seven-entry contract).
     """
@@ -390,6 +410,55 @@ def thin_entries(graph: PackageGraph) -> List[Finding]:
                     f"entry point {entry} never delegates to a "
                     f"models/compose.py scan driver — every run shape "
                     f"is a thin alias over the composed runner"
+                ),
+            ))
+    for entry, (rel, name) in SUPERVISED_ENTRY_POINTS.items():
+        qual = graph.find(rel, name)
+        if qual is None:
+            continue
+        frontier = [qual]
+        for tgt in sorted(graph._edges.get(qual, ())):
+            info = graph.functions.get(tgt)
+            if (info is not None and info.rel == rel and info.cls is None
+                    and tgt not in internals):
+                frontier.append(tgt)
+        touches_supervisor = False
+        emitted = set()
+        for q in frontier:
+            for tgt in sorted(graph._edges.get(q, ())):
+                info = graph.functions.get(tgt)
+                if info is None:
+                    continue
+                if info.rel == SUPERVISOR_MODULE:
+                    touches_supervisor = True
+                if tgt in internals or info.rel == COMPOSE_MODULE:
+                    fid = f"thin-entry:{entry}:{info.name}"
+                    if fid in emitted:
+                        continue
+                    emitted.add(fid)
+                    findings.append(Finding(
+                        rule="thin-entry",
+                        id=fid,
+                        path=rel,
+                        line=graph.functions[qual].node.lineno,
+                        message=(
+                            f"supervised entry {entry} reaches "
+                            f"{info.rel}::{info.name} directly (via "
+                            f"{graph.functions[q].name}) — a "
+                            f"long-lived driver delegates to the "
+                            f"resilient supervisor, never to the scan "
+                            f"or tick layer itself"
+                        ),
+                    ))
+        if not touches_supervisor:
+            findings.append(Finding(
+                rule="thin-entry",
+                id=f"thin-entry:{entry}:no-supervisor-delegation",
+                path=rel, line=graph.functions[qual].node.lineno,
+                message=(
+                    f"supervised entry {entry} never delegates to "
+                    f"resilience/supervisor.py — the segment loop, "
+                    f"journal, and checkpoint discipline live there"
                 ),
             ))
     return findings

@@ -1,11 +1,14 @@
 """The resilient run supervisor: every run shape, in checkpointed
 segments, with retry, rotation and gap-free resumable telemetry.
 
-One driver for the repo's three run shapes —
+One driver for the repo's four run shapes —
 
   - ``plain``     ``models/swim.run``
   - ``traced``    ``models/swim.run_traced`` (membership event trace)
   - ``monitored`` ``chaos/monitor.run_monitored`` (invariant monitor)
+  - ``composed``  ``models/compose.run_composed`` (the FULL stack:
+    trace ⊕ monitor ⊕ metrics in one program — the soak shape; each
+    segment additionally journals a windowed ``metrics_window`` row)
 
 — each executed as a sequence of ``segment_rounds``-round segments.
 After every segment, in this order (the trace-first/checkpoint-second
@@ -51,7 +54,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
-RUN_SHAPES = ("plain", "traced", "monitored")
+RUN_SHAPES = ("plain", "traced", "monitored", "composed")
 
 # Env var the kill harness uses to arm a kill inside a child process:
 # "<round>:<stage>" (see KillPlan.from_env).
@@ -192,12 +195,13 @@ class KillPlan:
 
 
 class RunShape:
-    """Names for the three run shapes (plain str values so they embed
+    """Names for the four run shapes (plain str values so they embed
     directly in meta/journal JSON)."""
 
     PLAIN = "plain"
     TRACED = "traced"
     MONITORED = "monitored"
+    COMPOSED = "composed"
 
 
 def _default_trace_capacity(params) -> int:
@@ -227,7 +231,43 @@ def _initial_carry(shape: str, params, world, opts: dict) -> dict:
         arrays.update(
             cmon.MonitorState.init(opts["monitor_capacity"]).to_arrays()
         )
+    elif shape == RunShape.COMPOSED:
+        from scalecube_cluster_tpu.chaos import monitor as cmon
+        from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+
+        full = np.full((params.n_members, params.n_subjects),
+                       np.iinfo(np.int32).max, dtype=np.int32)
+        arrays["telemetry/first_suspect"] = full
+        arrays["telemetry/first_removed"] = full.copy()
+        arrays.update(
+            cmon.MonitorState.init(opts["monitor_capacity"]).to_arrays()
+        )
+        ms = tmetrics.MetricsState.init(opts["metrics_spec"])
+        arrays.update(_metrics_to_arrays(ms))
     return arrays
+
+
+def _metrics_to_arrays(ms) -> dict:
+    """MetricsState -> flat checkpoint-payload keys (the ``metrics/``
+    namespace of the composed shape's carry)."""
+    out = {"metrics/counters": np.asarray(ms.counters),
+           "metrics/gauges": np.asarray(ms.gauges)}
+    for name, v in ms.hists.items():
+        out[f"metrics/hist/{name}"] = np.asarray(v)
+    return out
+
+
+def _metrics_from_arrays(carry: dict, spec):
+    """The inverse of :func:`_metrics_to_arrays` (hist order from the
+    spec — the carry dict is flat and unordered)."""
+    from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+
+    return tmetrics.MetricsState(
+        counters=carry["metrics/counters"],
+        gauges=carry["metrics/gauges"],
+        hists={name: carry[f"metrics/hist/{name}"]
+               for name, _ in spec.histograms},
+    )
 
 
 def _run_segment(shape: str, key, params, world, start: int, end: int,
@@ -293,6 +333,60 @@ def _run_segment(shape: str, key, params, world, start: int, end: int,
         mon_host = jax.device_get(mon_out)
         aux_out = mon_host.to_arrays()
         extras = {"monitor": cmon.verdict(mon_host, max_evidence=8)}
+    elif shape == RunShape.COMPOSED:
+        from scalecube_cluster_tpu.chaos import monitor as cmon
+        from scalecube_cluster_tpu.models import compose
+        from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+        from scalecube_cluster_tpu.telemetry import trace as ttrace
+
+        cap = opts["trace_capacity"]
+        mspec = opts["metrics_spec"]
+        tel_in = ttrace.TelemetryState.resume(
+            carry["telemetry/first_suspect"],
+            carry["telemetry/first_removed"], capacity=cap,
+        )
+        new_state, results, metrics = compose.run_composed(
+            key, params, world, step,
+            monitor_spec=opts["spec"], trace_capacity=cap,
+            metrics_spec=mspec,
+            monitor_capacity=opts["monitor_capacity"],
+            telemetry=tel_in,
+            monitor=cmon.MonitorState.from_arrays(carry),
+            metrics_state=_metrics_from_arrays(carry, mspec),
+            **common,
+        )
+        tel_out = results["trace"]
+        (lanes, count, dropped), fs, fr = jax.device_get((
+            (tel_out.trace.lanes, tel_out.trace.count,
+             tel_out.trace.dropped),
+            tel_out.first_suspect, tel_out.first_removed,
+        ))
+        events = ttrace.decode_events(ttrace.EventTrace(
+            lanes=lanes, count=count, dropped=dropped,
+        ))
+        mon_host = jax.device_get(results["monitor"])
+        ms_host = jax.device_get(results["metrics"])
+        aux_out = {"telemetry/first_suspect": np.asarray(fs),
+                   "telemetry/first_removed": np.asarray(fr)}
+        aux_out.update(mon_host.to_arrays())
+        # The metrics registry is WINDOWED per segment: this segment's
+        # values journal as their own metrics_window row (the
+        # stream_metered_run row shape) and the carry resumes from the
+        # reset — gauges sample through, counters/hists restart.
+        aux_out.update(_metrics_to_arrays(tmetrics.reset_window(ms_host)))
+        extras = {
+            "events": [e.to_json() for e in events],
+            "events_recorded": int(count),
+            "events_dropped": int(dropped),
+            "monitor": cmon.verdict(mon_host, max_evidence=8),
+            # Popped (never journaled inside the segment record) by
+            # run_resilient and written as a metrics_window row with
+            # its own dedup cursor.
+            "_metrics_window": {
+                "round_start": start, "round_end": end,
+                **tmetrics.to_json(ms_host, mspec),
+            },
+        }
     else:
         raise ValueError(f"unknown run shape {shape!r}; "
                          f"expected one of {RUN_SHAPES}")
@@ -366,9 +460,11 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
                   knobs=None, shift_key=None, spec=None,
                   trace_capacity: Optional[int] = None,
                   monitor_capacity: int = 1 << 12,
+                  metrics_spec=None,
                   retry: Optional[RetryPolicy] = None,
                   kill_plan: Optional[KillPlan] = None,
                   alarm_specs=None,
+                  on_segment: Optional[Callable[[dict], None]] = None,
                   log=None, sleep=time.sleep) -> ResilientRunResult:
     """Drive ``shape`` over ``n_rounds`` rounds with checkpointed
     segments, retry, and a resumable journal (module docstring).
@@ -391,6 +487,16 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
     (telemetry/alarms.py replay/dedup), so alarm rows keep the
     journal's exactly-once guarantee across any kill/relaunch sequence.
 
+    The ``composed`` shape (the soak harness's) runs the FULL
+    instrumented stack through ``models/compose.run_composed`` and
+    journals each segment's windowed metrics registry as a
+    ``metrics_window`` row right after the segment record, deduped on
+    its OWN journal cursor — a kill between the two writes duplicates
+    neither on resume.  ``on_segment(record)`` (host callback, never
+    journaled — keep it deterministic-output-free) fires once per
+    segment EXECUTED by this process, after its checkpoint: the soak
+    driver's drift-invariant sampling point.
+
     ``kill_plan`` is the harness's fault lever — None in production.
     """
     from scalecube_cluster_tpu.telemetry import sink as tsink
@@ -399,8 +505,12 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
     if shape not in RUN_SHAPES:
         raise ValueError(f"unknown run shape {shape!r}; "
                          f"expected one of {RUN_SHAPES}")
-    if shape == RunShape.MONITORED and spec is None:
-        raise ValueError("monitored shape needs a MonitorSpec (spec=)")
+    if shape in (RunShape.MONITORED, RunShape.COMPOSED) and spec is None:
+        raise ValueError(f"{shape} shape needs a MonitorSpec (spec=)")
+    if shape == RunShape.COMPOSED and metrics_spec is None:
+        from scalecube_cluster_tpu.telemetry import metrics as tmetrics
+
+        metrics_spec = tmetrics.MetricsSpec()
     if segment_rounds < 1:
         raise ValueError(f"segment_rounds must be >= 1, "
                          f"got {segment_rounds}")
@@ -411,6 +521,7 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
         "knobs": knobs, "shift_key": shift_key, "spec": spec,
         "monitor_capacity": monitor_capacity,
         "trace_capacity": trace_capacity or _default_trace_capacity(params),
+        "metrics_spec": metrics_spec,
     }
     # The resume-identity pin: everything that must not change under a
     # relaunch.  segment_rounds is included because the journal's dedup
@@ -428,9 +539,11 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
         # segment drop points; the monitor buffer's lane shape), so
         # they join the pin where they matter and stay None elsewhere.
         "trace_capacity": (opts["trace_capacity"]
-                           if shape == RunShape.TRACED else None),
+                           if shape in (RunShape.TRACED,
+                                        RunShape.COMPOSED) else None),
         "monitor_capacity": (monitor_capacity
-                             if shape == RunShape.MONITORED else None),
+                             if shape in (RunShape.MONITORED,
+                                          RunShape.COMPOSED) else None),
         "user": meta or {},
     }))
 
@@ -504,11 +617,12 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
         # journal is parsed once, not once per reader (the
         # JournalFollower cursor; its covered_upto is the rebased
         # tsink.covered_upto).
-        covered = 0
+        covered = covered_win = 0
         if not fresh_journal:
             follower = tsink.follow_records(journal_path)
             records = follower.poll()
             covered = follower.covered_upto(kind="segment")
+            covered_win = follower.covered_upto(kind="metrics_window")
             if engine is not None:
                 replayed_transitions, existing = talarms.replay_journal(
                     engine, records)
@@ -568,6 +682,7 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
                                      carry, opts),
                 label=f"{shape}-segment@{r}",
             )
+            window = record.pop("_metrics_window", None)
             record["checkpoint_generation"] = end
             events_recorded += record.get("events_recorded", 0)
             events_dropped += record.get("events_dropped", 0)
@@ -590,6 +705,12 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
                 sink.write_record("segment", record)
             else:
                 deduped += 1
+            if window is not None and end > covered_win:
+                # The composed shape's windowed registry row, deduped
+                # on its OWN cursor: a kill after the segment write but
+                # before this one re-runs the segment on resume, dedups
+                # the segment record, and writes exactly this row.
+                sink.write_metrics_window(window)
             if due_kill and kill_plan.stage == "post_journal":
                 kill_plan.fire()
             if engine is not None and end > covered:
@@ -609,6 +730,8 @@ def run_resilient(shape: str, key, params, world, n_rounds: int, *,
             carry = new_carry
             r = end
             segments_run += 1
+            if on_segment is not None:
+                on_segment(record)
             if log is not None:
                 log.info("%s: segment [%d, %d) journaled + "
                          "checkpointed (gen %d)", shape, record

@@ -1,0 +1,29 @@
+"""Production soak mode: one long-lived service under continuous
+streaming chaos, with kill/resume and drift invariants.
+
+- ``soak/schedule.py``  the never-repeating seeded chaos stream: an
+  open-horizon sequence of per-segment scenario slices, pure in
+  ``(seed, segment_index, n, severity)``, every segment boundary
+  straddled by an in-flight fault so a kill never lands on a clean
+  edge.
+- ``soak/drift.py``     host-side drift probes (compose compile-cache
+  size, RSS) + the per-segment invariant verdict — sampled, never
+  journaled, so the journal stays byte-reproducible.
+- ``soak/driver.py``    ``run_soak``: the full plane stack
+  (trace ⊕ metrics ⊕ monitor ⊕ sync ⊕ lifeguard ⊕ open-world) through
+  the resilient supervisor's ``composed`` shape, streaming
+  segment/metrics_window/alarm_transition rows to one JSONL journal,
+  with per-segment drift invariants (flat compile cache, bounded RSS,
+  zero monitor violations) and a SIGKILL/relaunch drill whose merged
+  journal is byte-identical to an uninterrupted reference run.
+
+``bench.py --soak [--smoke]`` is the measured entry
+(``artifacts/soak_report.json``); ``experiments/soak.py`` the
+repro driver.
+"""
+
+from scalecube_cluster_tpu.soak.schedule import (  # noqa: F401
+    SoakSegment,
+    soak_schedule,
+    soak_segment,
+)

@@ -1,0 +1,75 @@
+"""Drift probes + the per-segment invariant verdict for the soak.
+
+Host-side only, never journaled: RSS is nondeterministic and the
+compile-cache size is process-local, so these samples live in the
+soak artifact (bench.py --soak), keeping the journal byte-reproducible
+across kill/relaunch — the property the kill drill asserts.
+
+Kept OUT of soak/driver.py on purpose: the swimlint supervised-entry
+rule (analysis/rules.py SUPERVISED_ENTRY_POINTS) forbids the soak
+driver any direct reach into models/compose.py — the cache-size probe
+reads ``run_composed``'s jit cache ATTRIBUTE (introspection, not scan
+access), which the call-graph rule can't tell apart from a call, so
+the probe lives outside the driver's frontier.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+def cache_size_probe() -> int:
+    """Compile count of the composed program so far in this process
+    (-1 when the jit cache API is absent).  Module-level so the
+    drift-trip test can monkeypatch a deliberately-growing probe."""
+    from scalecube_cluster_tpu.models import compose
+
+    fn = compose.run_composed
+    if hasattr(fn, "_cache_size"):
+        return int(fn._cache_size())
+    return -1  # pragma: no cover — current JAX exposes it
+
+
+def rss_kb() -> int:
+    """Current resident set size in KiB (/proc/self/statm; 0 where
+    unavailable — the bound check then degrades to vacuous truth
+    rather than a crash on exotic hosts)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE") // 1024
+    except (OSError, ValueError, IndexError):  # pragma: no cover
+        return 0
+
+
+def drift_verdict(samples: List[dict], rss_limit_mb: float,
+                  monitor: Optional[dict]) -> dict:
+    """Fold per-segment drift samples into the invariant verdict.
+
+    ``compile_flat``: the compose program's cache size is identical
+    across every sample AFTER the first executed segment of this
+    process (the first pays the one legitimate compile; any later
+    growth is recompile drift).  ``rss_bounded``: RSS growth from the
+    first sample stays under ``rss_limit_mb``.  ``violations``: the
+    monitor's exact total (0 required)."""
+    sizes = [s["cache_size"] for s in samples]
+    rss = [s["rss_kb"] for s in samples]
+    compile_flat = (len(sizes) > 0
+                    and all(s == sizes[0] for s in sizes)
+                    and sizes[0] >= 0)
+    rss_growth_mb = ((max(rss) - rss[0]) / 1024.0) if rss else 0.0
+    violations = int((monitor or {}).get("total_violations", -1))
+    return {
+        "segments_sampled": len(samples),
+        "cache_sizes": sizes,
+        "compile_flat": bool(compile_flat),
+        "rss_first_kb": rss[0] if rss else 0,
+        "rss_peak_kb": max(rss) if rss else 0,
+        "rss_growth_mb": round(rss_growth_mb, 3),
+        "rss_bounded": bool(rss_growth_mb <= rss_limit_mb),
+        "violations": violations,
+        "monitor_green": bool((monitor or {}).get("green", False)),
+        "ok": bool(compile_flat and rss_growth_mb <= rss_limit_mb
+                   and violations == 0),
+    }

@@ -1,0 +1,355 @@
+"""The soak driver: one long-lived composed run under streaming chaos.
+
+``run_soak`` wires the pieces the prior PRs built into one service
+lifetime:
+
+- the schedule streamer (soak/schedule.py) materializes the seeded
+  chaos stream into one Scenario + MonitorSpec (one compile for every
+  segment);
+- the resilient supervisor's ``composed`` shape runs the FULL plane
+  stack (trace ⊕ monitor ⊕ metrics, with SYNC / Lifeguard /
+  open-world armed on the params) in checkpointed segments, streaming
+  ``segment`` / ``metrics_window`` / ``alarm_transition`` rows to one
+  JSONL journal with the exactly-once resume guarantee;
+- per-segment **drift invariants** sample the host side through the
+  supervisor's ``on_segment`` hook: the compose program's compile
+  cache must stay FLAT after the first executed segment (the PR-14
+  compile-cache audit as a runtime soak invariant), host RSS must stay
+  bounded, and the monitor must end green.
+
+Drift samples stay OUT of the journal (RSS is nondeterministic; cache
+size is process-local) — the journal remains byte-reproducible, which
+is what :func:`kill_resume_drill` asserts: SIGKILL a soak mid-flight,
+relaunch, and the merged journal's content rows (``segment`` /
+``metrics_window`` / ``alarm_transition``) are byte-identical to an
+uninterrupted reference run's, with a bit-identical final state
+digest.  ``manifest``/``resume``/``summary`` rows are process metadata
+(wall-clock, relaunch provenance) and are excluded by definition.
+
+Subprocess child entry::
+
+    python -m scalecube_cluster_tpu.soak.driver --config soak.json
+
+prints one JSON summary line (state digest + drift verdict) — the
+resilience-harness child contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+from typing import List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: Journal record kinds that are CONTENT (deterministic protocol
+#: output, byte-reproducible across kill/relaunch) as opposed to
+#: process metadata (manifest wall-time, resume provenance, summary).
+CONTENT_KINDS = ("segment", "metrics_window", "alarm_transition")
+
+DEFAULT_RSS_LIMIT_MB = 512.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SoakConfig:
+    """One soak run, JSON-serializable (the subprocess config unit)."""
+
+    base_path: str
+    seed: int = 7
+    n_members: int = 32
+    severity: str = "moderate"
+    segment_rounds: int = 128
+    n_segments: int = 4
+    delivery: str = "shift"
+    lhm_max: int = 2
+    keep_generations: int = 3
+    rss_limit_mb: float = DEFAULT_RSS_LIMIT_MB
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(obj: dict) -> "SoakConfig":
+        return SoakConfig(**obj)
+
+    @property
+    def n_rounds(self) -> int:
+        return self.n_segments * self.segment_rounds
+
+    @property
+    def journal_path(self) -> str:
+        return f"{self.base_path}.journal.jsonl"
+
+
+def build_workload(cfg: SoakConfig):
+    """(key, params, world, spec, scenario) for one soak config: the
+    stream's scenario compiled against the campaign timing preset with
+    the Lifeguard plane armed (``lhm_max``) and open-world on whenever
+    the stream schedules joins (campaign_params does that part)."""
+    import jax
+
+    from scalecube_cluster_tpu.chaos import campaign as cc
+    from scalecube_cluster_tpu.soak import schedule as sched
+
+    scenario = sched.soak_schedule(
+        cfg.seed, cfg.n_segments, n=cfg.n_members,
+        severity=cfg.severity, segment_rounds=cfg.segment_rounds)
+    params = cc.campaign_params(scenario, delivery=cfg.delivery,
+                                lhm_max=cfg.lhm_max)
+    world, spec = scenario.build(params)
+    return jax.random.key(cfg.seed), params, world, spec, scenario
+
+
+# --------------------------------------------------------------------------
+# The soak run
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SoakResult:
+    """run_soak's host-side return: the supervisor result plus the
+    drift verdict and the journal's alarm summary."""
+
+    result: object            # supervisor.ResilientRunResult
+    drift: dict
+    alarms: dict
+    scenario_name: str
+    rounds: int
+    segments: int
+
+
+def run_soak(cfg: SoakConfig, kill_plan=None, alarm_specs=None,
+             log=None) -> SoakResult:
+    """One soak lifetime (or one relaunch of it — resume is the
+    supervisor's job).  ``alarm_specs`` default to the live FP-rate
+    alarm (telemetry/alarms.default_specs); pass ``()`` to disarm."""
+    from scalecube_cluster_tpu.resilience import store as rstore
+    from scalecube_cluster_tpu.resilience import supervisor as rsup
+    from scalecube_cluster_tpu.soak import drift as sdrift
+    from scalecube_cluster_tpu.telemetry import alarms as talarms
+    from scalecube_cluster_tpu.telemetry import sink as tsink
+
+    if alarm_specs is None:
+        alarm_specs = talarms.default_specs()
+    key, params, world, spec, scenario = build_workload(cfg)
+    store = rstore.CheckpointStore(cfg.base_path,
+                                   keep=cfg.keep_generations)
+
+    samples: List[dict] = []
+
+    def sample(record: dict) -> None:
+        # Late-bound through the module so the drift-trip test can
+        # monkeypatch soak.drift.cache_size_probe mid-run.
+        samples.append({
+            "round_end": int(record["round_end"]),
+            "cache_size": sdrift.cache_size_probe(),
+            "rss_kb": sdrift.rss_kb(),
+        })
+
+    result = rsup.run_resilient(
+        rsup.RunShape.COMPOSED, key, params, world, cfg.n_rounds,
+        store=store, segment_rounds=cfg.segment_rounds,
+        journal_path=cfg.journal_path, spec=spec,
+        alarm_specs=alarm_specs, kill_plan=kill_plan,
+        on_segment=sample, log=log,
+        meta={"workload": "soak", "scenario": scenario.name,
+              "severity": cfg.severity, "seed": cfg.seed},
+    )
+
+    transitions = tsink.read_records(cfg.journal_path,
+                                     kind=talarms.TRANSITION_KIND)
+    firing = sum(1 for t in transitions if t.get("to") == "firing")
+    alarms = {
+        "specs": [s.name for s in alarm_specs],
+        "transitions": len(transitions),
+        "firing": firing,
+        "quiet": len(transitions) == 0,
+    }
+    drift = sdrift.drift_verdict(samples, cfg.rss_limit_mb,
+                                 result.monitor_verdict)
+    return SoakResult(
+        result=result, drift=drift, alarms=alarms,
+        scenario_name=scenario.name, rounds=cfg.n_rounds,
+        segments=cfg.n_segments,
+    )
+
+
+def result_digest(result) -> str:
+    """Content digest of the full final carry (state + every plane
+    aux lane) — the bit-identity the kill drill asserts."""
+    from scalecube_cluster_tpu.resilience import store as rstore
+
+    return rstore.payload_checksum(result.result.carry_arrays)
+
+
+# --------------------------------------------------------------------------
+# Journal identity + the kill/resume drill
+# --------------------------------------------------------------------------
+
+
+def content_rows(path: str) -> List[bytes]:
+    """The journal's CONTENT rows as raw byte lines, in file order —
+    the byte-identity unit of the kill drill (module docstring).  Only
+    newline-terminated lines count (the durability rule); a torn tail
+    is skipped like read_records does."""
+    out: List[bytes] = []
+    with open(path, "rb") as f:
+        data = f.read()
+    for raw in data.split(b"\n")[:-1]:
+        if not raw.strip():
+            continue
+        try:
+            kind = json.loads(raw).get("kind")
+        except json.JSONDecodeError:
+            continue   # torn mid-journal kill fragment, reader-skipped
+        if kind in CONTENT_KINDS:
+            out.append(raw)
+    return out
+
+
+def _child_env(extra_env: Optional[dict] = None) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (_REPO_ROOT, env.get("PYTHONPATH")) if p)
+    env.update(extra_env or {})
+    return env
+
+
+def launch_child(cfg: SoakConfig, cfg_path: str, kill_plan=None,
+                 timeout: float = 600.0,
+                 extra_env: Optional[dict] = None):
+    """One soak child launch (the resilience-harness subprocess
+    contract: kill plan rides SCALECUBE_RESILIENCE_KILL, paths
+    absolutized, cwd pinned to the repo root)."""
+    from scalecube_cluster_tpu.resilience import supervisor as rsup
+
+    cfg = dataclasses.replace(
+        cfg, base_path=os.path.abspath(cfg.base_path))
+    with open(cfg_path, "w") as f:
+        json.dump(cfg.to_json(), f)
+    env = _child_env(extra_env)
+    if kill_plan is not None:
+        env[rsup.KILL_ENV] = kill_plan.encode()
+    else:
+        env.pop(rsup.KILL_ENV, None)
+    return subprocess.run(
+        [sys.executable, "-m", "scalecube_cluster_tpu.soak.driver",
+         "--config", cfg_path],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=_REPO_ROOT,
+    )
+
+
+def kill_resume_drill(cfg: SoakConfig, workdir: str,
+                      kill_round: Optional[int] = None,
+                      stage: str = "post_journal",
+                      timeout: float = 600.0,
+                      extra_env: Optional[dict] = None) -> dict:
+    """SIGKILL one soak mid-flight, relaunch it to completion, and
+    compare against an uninterrupted reference run in its own lineage:
+    the merged journal's content rows must be BYTE-identical and the
+    final carry digest bit-identical (both children share env, so the
+    comparison never crosses backends)."""
+    from scalecube_cluster_tpu.resilience import harness as rharness
+    from scalecube_cluster_tpu.resilience import supervisor as rsup
+
+    os.makedirs(workdir, exist_ok=True)
+    if kill_round is None:
+        kill_round = (cfg.n_segments // 2) * cfg.segment_rounds or \
+            cfg.segment_rounds
+
+    ref_cfg = dataclasses.replace(
+        cfg, base_path=os.path.join(workdir, "ref", "soak.ckpt"))
+    os.makedirs(os.path.dirname(ref_cfg.base_path), exist_ok=True)
+    ref = launch_child(ref_cfg, os.path.join(workdir, "ref_config.json"),
+                       timeout=timeout, extra_env=extra_env)
+    if ref.returncode != 0:
+        return {"ok": False, "error": "reference soak failed",
+                "stderr_tail": ref.stderr[-2000:]}
+    ref_summary = json.loads(
+        [ln for ln in ref.stdout.strip().splitlines() if ln][-1])
+
+    killed_cfg = dataclasses.replace(
+        cfg, base_path=os.path.join(workdir, "killed", "soak.ckpt"))
+    os.makedirs(os.path.dirname(killed_cfg.base_path), exist_ok=True)
+    cfg_path = os.path.join(workdir, "killed_config.json")
+    plan = rsup.KillPlan(round=kill_round, stage=stage)
+    killed = launch_child(killed_cfg, cfg_path, kill_plan=plan,
+                          timeout=timeout, extra_env=extra_env)
+    if killed.returncode != -signal.SIGKILL:
+        return {"ok": False, "error": "kill did not land",
+                "returncode": killed.returncode,
+                "stderr_tail": killed.stderr[-2000:]}
+    relaunch = launch_child(killed_cfg, cfg_path, timeout=timeout,
+                            extra_env=extra_env)
+    if relaunch.returncode != 0:
+        return {"ok": False, "error": "relaunch failed",
+                "stderr_tail": relaunch.stderr[-2000:]}
+    summary = json.loads(
+        [ln for ln in relaunch.stdout.strip().splitlines() if ln][-1])
+
+    ref_rows = content_rows(ref_summary["journal"])
+    got_rows = content_rows(summary["journal"])
+    journal_match = got_rows == ref_rows
+    state_match = summary["state_digest"] == ref_summary["state_digest"]
+    coverage = rharness.verify_journal(summary["journal"], cfg.n_rounds)
+    return {
+        "ok": bool(journal_match and state_match
+                   and coverage["complete"]),
+        "kill": plan.encode(),
+        "journal_match": journal_match,
+        "state_match": state_match,
+        "journal_complete": coverage["complete"],
+        "journal_problems": coverage["problems"],
+        "content_rows": len(got_rows),
+        "resumed_segments": summary["segments_run"],
+        "state_digest": summary["state_digest"],
+        "ref_digest": ref_summary["state_digest"],
+        "ref_summary": ref_summary,
+    }
+
+
+# --------------------------------------------------------------------------
+# Child mode
+# --------------------------------------------------------------------------
+
+
+def child_main(argv=None) -> int:
+    """Run one soak to completion (the subprocess body): arm the kill
+    plan from the env, print one JSON summary line."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    parser.add_argument("--config", required=True,
+                        help="path to a SoakConfig JSON file")
+    args = parser.parse_args(argv)
+    with open(args.config) as f:
+        cfg = SoakConfig.from_json(json.load(f))
+
+    from scalecube_cluster_tpu.resilience import supervisor as rsup
+    from scalecube_cluster_tpu.utils import runlog
+
+    runlog.enable_compilation_cache()
+    soak = run_soak(cfg, kill_plan=rsup.KillPlan.from_env())
+    print(json.dumps({
+        "state_digest": result_digest(soak),
+        "journal": soak.result.journal_path,
+        "rounds": soak.rounds,
+        "segments_run": soak.result.segments_run,
+        "segments_deduped": soak.result.segments_deduped,
+        "resumed": soak.result.resumed_from is not None,
+        "drift": soak.drift,
+        "alarms": soak.alarms,
+        "scenario": soak.scenario_name,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(child_main())

@@ -1,0 +1,307 @@
+"""The soak schedule streamer: a never-repeating seeded chaos stream.
+
+``chaos/scenarios.generate_scenario`` draws ONE bounded scenario from
+``(seed, n, severity)``; this module extends the same idiom to an
+open-horizon *stream* of per-segment scenario slices:
+
+- :func:`soak_segment` is PURE in ``(seed, segment_index, n, severity,
+  segment_rounds)`` — segment 400 is computable without materializing
+  segments 0..399, so any slice of a soak's lifetime is its own
+  one-line repro (the campaign purity contract, streamed).
+- Draw order follows the PR-10/PR-12 **trailing-draw contract**: the
+  boundary straddler is drawn first, then the severity-tier interior
+  ops, then the trailing open-world rung — future tiers must APPEND
+  draws after the existing ones, never reshuffle them
+  (tests/test_soak.py pins the historical (seed, segment) → op-kind
+  table exactly like the generate_scenario pin in
+  tests/test_chaos_fuzz.py).
+- Every segment's FIRST draw is an op that *straddles* the segment's
+  trailing edge (a crash whose revive lands in the next segment, a
+  flapping link mid-cycle across the boundary, a loss window spanning
+  it), so fault state — open partitions, suspicion in flight, pending
+  joins — is always live at a segment boundary and a checkpoint/kill
+  never lands on a "clean" edge.
+
+Node-schedule ops (crash/burst/churn) get ONE down window per node in
+``SwimWorld`` (``with_crash`` overwrites — the leave-clobbers-crash
+composition edge), so the stream partitions the node space: a global
+severity-seeded permutation hands each segment a disjoint quota, a
+quorum reserve is never node-faulted, and segments past the quota
+degrade to link-level weather (flaps, brownouts, loss windows — the
+``LinkFaults`` rule list appends without bound).  The trailing
+open-world rung is a NET-ZERO join storm: permanent crashes whose
+slots are re-admitted as fresh identities ``join_lag`` rounds later —
+slot occupancy returns to full, so the stream never exhausts the
+cluster.
+
+:func:`soak_schedule` concatenates segments ``[0, n_segments)`` into
+one :class:`chaos.scenarios.Scenario` (horizon =
+``n_segments * segment_rounds``) that compiles through the existing
+``Scenario.build`` path — one world, one MonitorSpec, one XLA program
+for every segment of the soak.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from scalecube_cluster_tpu.chaos import scenarios as cs
+
+# Seed-stream namespace: decorrelates the soak stream from
+# generate_scenario's [seed, severity] SeedSequence space so soaking
+# seed 7 and campaigning seed 7 never share draws.
+_STREAM_DOMAIN = 18
+
+#: Minimum segment length: draws need room for a revive window plus
+#: the boundary straddler, and the horizon quantum keeps compiled
+#: shapes shared (chaos/scenarios._HORIZON_QUANTUM).
+MIN_SEGMENT_ROUNDS = 2 * cs._HORIZON_QUANTUM
+DEFAULT_SEGMENT_ROUNDS = 256
+
+#: Per-segment node-fault quota by severity (disjoint slices of the
+#: global permutation — module docstring).
+_NODE_QUOTA = {"mild": 2, "moderate": 6, "severe": 8}
+
+#: Background symmetric wire loss per severity (the generate_scenario
+#: tiers, pinned to one value per tier so the whole stream shares one
+#: params — and therefore one compile).
+_STREAM_LOSS = {"mild": 0.0, "moderate": 0.02, "severe": 0.05}
+
+
+@dataclasses.dataclass(frozen=True)
+class SoakSegment:
+    """One slice of the stream: ops carry GLOBAL round numbers
+    (``round_start`` + local draw), ``kinds`` the draw-order op-kind
+    names (the seed-stability pin unit), ``spans_boundary`` that the
+    first op straddles ``round_end`` (True by construction — asserted,
+    not assumed, by tests/test_soak.py)."""
+
+    index: int
+    round_start: int
+    round_end: int
+    kinds: Tuple[str, ...]
+    ops: Tuple[object, ...]
+    spans_boundary: bool
+
+
+def _fault_pool(seed: int, n: int, severity: str):
+    """The stream-global faultable-node permutation (pure in
+    (seed, n, severity); segment-independent so every segment can
+    compute its own disjoint slice).  A quarter of the cluster is a
+    quorum reserve that never takes a node-schedule fault."""
+    rng = np.random.default_rng(np.random.SeedSequence(
+        [seed, _STREAM_DOMAIN, cs.SEVERITIES.index(severity)]))
+    faultable = n - n // 4
+    return [int(x) for x in rng.permutation(n)[:faultable]]
+
+
+def soak_segment(seed: int, segment_index: int, n: int = 32,
+                 severity: str = "moderate",
+                 segment_rounds: int = DEFAULT_SEGMENT_ROUNDS,
+                 params=None) -> SoakSegment:
+    """Segment ``segment_index`` of the stream — pure in every
+    argument (module docstring).  ``params`` only shapes the revive /
+    join-lag arithmetic (defaults to the campaign timing preset at n,
+    exactly like generate_scenario)."""
+    if severity not in cs.SEVERITIES:
+        raise ValueError(f"unknown severity {severity!r} "
+                         f"(choose from {cs.SEVERITIES})")
+    if n < 16:
+        raise ValueError(f"soak streams need n >= 16 (got {n})")
+    if segment_index < 0:
+        raise ValueError(f"segment_index must be >= 0, "
+                         f"got {segment_index}")
+    if (segment_rounds < MIN_SEGMENT_ROUNDS
+            or segment_rounds % cs._HORIZON_QUANTUM):
+        raise ValueError(
+            f"segment_rounds must be a multiple of "
+            f"{cs._HORIZON_QUANTUM} and >= {MIN_SEGMENT_ROUNDS}, "
+            f"got {segment_rounds}")
+    if params is None:
+        from scalecube_cluster_tpu.chaos.campaign import campaign_config
+        from scalecube_cluster_tpu.models import swim
+
+        params = swim.SwimParams.from_config(campaign_config(),
+                                             n_members=n)
+    rng = np.random.default_rng(np.random.SeedSequence(
+        [seed, _STREAM_DOMAIN, cs.SEVERITIES.index(severity),
+         segment_index]))
+    start = segment_index * segment_rounds
+    end = start + segment_rounds
+    revive_down = int(2 * params.suspicion_rounds + 24)
+
+    quota = _NODE_QUOTA[severity]
+    pool = _fault_pool(seed, n, severity)
+    lo = segment_index * quota
+    nodes = pool[lo:lo + quota] if lo + quota <= len(pool) else []
+
+    def take(k):
+        out, nodes[:] = nodes[:k], nodes[k:]
+        return out
+
+    def link_pair():
+        s = int(rng.integers(0, n))
+        d = int(rng.integers(0, n - 1))
+        return s, d if d < s else d + 1
+
+    ops, kinds = [], []
+
+    def add(kind, op):
+        kinds.append(kind)
+        ops.append(op)
+
+    # --- Draw 1: the boundary straddler (always first; the trailing-
+    # draw contract anchors every later rung after it).  Each variant
+    # is mid-fault at round ``end`` — suspicion in flight, a link
+    # mid-outage, or a loss window across the edge.
+    def edge_crash():
+        at = end - int(rng.integers(4, 17))
+        add("edge_crash", cs.Crash(take(1)[0], at_round=at,
+                                   until_round=end
+                                   + int(rng.integers(8, 33))))
+
+    def edge_flap():
+        s, d = link_pair()
+        add("edge_flap", cs.FlappingLink(
+            s, d, from_round=end - 12, n_cycles=2,
+            down_rounds=6, up_rounds=6))
+
+    def edge_loss():
+        s, d = link_pair()
+        add("edge_loss", cs.LinkLoss(
+            s, d, loss=float(rng.choice([0.4, 0.6])),
+            from_round=end - int(rng.integers(8, 17)),
+            until_round=end + int(rng.integers(8, 17))))
+
+    edges = [edge_flap, edge_loss] + ([edge_crash] if nodes else [])
+    edges[int(rng.integers(0, len(edges)))]()
+
+    # --- Severity-tier interior draws (the generate_scenario menus,
+    # revive-only: the stream must return to full strength so it can
+    # run forever).
+    def op_crash_revive():
+        at = start + int(rng.integers(8, segment_rounds // 2))
+        add("crash_revive", cs.Crash(take(1)[0], at_round=at,
+                                     until_round=at + revive_down))
+
+    def op_flap():
+        s, d = link_pair()
+        add("flap", cs.FlappingLink(
+            s, d,
+            from_round=start + int(rng.integers(0, segment_rounds - 64)),
+            n_cycles=3, down_rounds=4, up_rounds=6))
+
+    def op_brownout():
+        half = n // 2
+        add("brownout", cs.Brownout(
+            src=(0, half), dst=(half, n),
+            peak_loss=float(rng.choice([0.3, 0.5])),
+            from_round=start + int(rng.integers(0, segment_rounds - 64)),
+            ramp_rounds=12, hold_rounds=10))
+
+    def op_loss_window():
+        s, d = link_pair()
+        at = start + int(rng.integers(0, segment_rounds - 72))
+        add("loss_window", cs.LinkLoss(
+            s, d, loss=float(rng.choice([0.3, 0.5])),
+            from_round=at, until_round=at + int(rng.integers(24, 65))))
+
+    def op_burst():
+        sz = int(rng.integers(2, 4))
+        at = start + int(rng.integers(8, segment_rounds // 2))
+        picked = take(sz)
+        if len(picked) < 2:       # quota exhausted mid-draw: degrade
+            nodes[:0] = picked    # (put back; link weather instead)
+            return op_loss_window()
+        add("burst", cs.CrashBurst(tuple(picked), at_round=at,
+                                   until_round=at + revive_down))
+
+    def op_churn():
+        picked = take(4)
+        if len(picked) < 4:
+            nodes[:0] = picked
+            return op_loss_window()
+        add("churn", cs.ChurnStorm(
+            tuple(picked), wave_size=2,
+            start_round=start + int(rng.integers(2, 17)),
+            wave_every=int(rng.integers(6, 13)),
+            down_rounds=revive_down))
+
+    if severity == "mild":
+        menu = [op_crash_revive if nodes else op_loss_window,
+                op_flap, op_loss_window]
+        menu[int(rng.integers(0, len(menu)))]()
+    elif severity == "moderate":
+        menu = [op_crash_revive if nodes else op_loss_window,
+                op_flap, op_brownout, op_burst, op_loss_window]
+        for f in rng.choice(len(menu), size=2, replace=False):
+            menu[int(f)]()
+    else:                                           # severe
+        menu = [op_churn, op_brownout, op_flap, op_burst,
+                op_crash_revive if nodes else op_loss_window]
+        for f in rng.choice(len(menu), size=3, replace=False):
+            menu[int(f)]()
+
+    # --- Trailing open-world rung: a NET-ZERO join storm for half the
+    # moderate/severe segments with node quota left — permanent
+    # crashes re-admitted as fresh identities, slot occupancy restored
+    # (pending joins straddle the boundary when the lag carries them
+    # past ``end``).  TRAILS every tier draw, the growth contract.
+    if (severity != "mild" and len(nodes) >= 4
+            and rng.integers(0, 2)):
+        lag = int(params.suspicion_rounds) + int(rng.integers(4, 13))
+        add("join_storm", cs.ChurnStorm(
+            tuple(take(4)), wave_size=2,
+            start_round=start + int(rng.integers(8,
+                                                 segment_rounds - 63)),
+            wave_every=lag + int(rng.integers(2, 7)),
+            join_wave_size=2, join_lag=lag, arrivals=()))
+
+    return SoakSegment(
+        index=segment_index, round_start=start, round_end=end,
+        kinds=tuple(kinds), ops=tuple(ops),
+        spans_boundary=_spans(ops[0], end),
+    )
+
+
+def _spans(op, edge: int) -> bool:
+    """Does ``op``'s fault window contain ``edge``?  (The boundary
+    straddler's defining property; computed from the op itself so the
+    pin test asserts it rather than trusting the draw.)"""
+    if isinstance(op, cs.Crash):
+        return op.at_round < edge < op.until_round
+    if isinstance(op, cs.FlappingLink):
+        span = op.n_cycles * (op.down_rounds + op.up_rounds)
+        return op.from_round < edge < op.from_round + span
+    if isinstance(op, cs.LinkLoss):
+        return op.from_round < edge < op.until_round
+    return False
+
+
+def soak_schedule(seed: int, n_segments: int, n: int = 32,
+                  severity: str = "moderate",
+                  segment_rounds: int = DEFAULT_SEGMENT_ROUNDS,
+                  params=None) -> "cs.Scenario":
+    """Materialize segments ``[0, n_segments)`` into ONE scenario:
+    ``horizon = n_segments * segment_rounds``, ops concatenated in
+    stream order (each already carrying global rounds), background
+    loss fixed per severity.  The last segment's straddler spills past
+    the horizon — scheduled rounds beyond it simply never execute, the
+    open-horizon property."""
+    if n_segments < 1:
+        raise ValueError(f"n_segments must be >= 1, got {n_segments}")
+    segments = [
+        soak_segment(seed, i, n=n, severity=severity,
+                     segment_rounds=segment_rounds, params=params)
+        for i in range(n_segments)
+    ]
+    ops = tuple(op for seg in segments for op in seg.ops)
+    return cs.Scenario(
+        name=f"soak-{severity}-{seed}-x{n_segments}",
+        n_members=n, horizon=n_segments * segment_rounds, ops=ops,
+        loss_probability=_STREAM_LOSS[severity], seed=seed,
+        severity=severity,
+    )

@@ -116,23 +116,40 @@ def _cmd_watch(args) -> int:
     deadline = (time.time() + args.max_seconds
                 if args.max_seconds is not None else None)
     windows = transitions_seen = journal_transitions = 0
+    segments = rounds_covered = 0
     done = False
     while True:
         fresh = follower.poll()
         new_rows = []
+        boundaries = []
         for rec in fresh:
             kind = rec.get("kind")
             if kind in talarms.WINDOW_KINDS:
                 windows += 1
+                # Segment rows are checkpoint boundaries, not just
+                # windows: count them so a multi-segment soak tail is
+                # distinguishable from a single run (segment index +
+                # cumulative rounds surface in the live table).
+                if kind == "segment":
+                    segments += 1
+                    rounds_covered = max(rounds_covered,
+                                         int(rec.get("round_end", 0)))
+                    boundaries.append(
+                        (segments, rec.get("round_start"),
+                         rec.get("round_end")))
                 caused = engine.observe(rec)
                 transitions_seen += len(caused)
                 if args.json:
-                    print(json.dumps({
+                    row = {
                         "kind": "window", "source": kind,
                         "round_start": rec.get("round_start"),
                         "round_end": rec.get("round_end"),
                         "transitions": caused,
-                    }), flush=True)
+                    }
+                    if kind == "segment":
+                        row["segment"] = segments
+                        row["rounds_cumulative"] = rounds_covered
+                    print(json.dumps(row), flush=True)
                 else:
                     new_rows.append(rec)
             elif kind == talarms.TRANSITION_KIND:
@@ -144,8 +161,14 @@ def _cmd_watch(args) -> int:
             elif kind == "summary":
                 done = True
         if fresh and not args.json:
-            print(f"\n# watch {args.journal}: {windows} window(s), "
-                  f"cursor at byte {follower.offset}")
+            header = f"\n# watch {args.journal}: {windows} window(s)"
+            if segments:
+                header += (f", segment {segments} · "
+                           f"{rounds_covered} round(s)")
+            print(header + f", cursor at byte {follower.offset}")
+            for seg, start, end in boundaries:
+                print(f"# segment {seg} boundary: rounds "
+                      f"[{start}, {end}) · {end} cumulative")
             print(query.format_table(
                 engine.state_rows(),
                 ["alarm", "state", "value", "threshold", "comparator",
@@ -161,6 +184,7 @@ def _cmd_watch(args) -> int:
         "kind": "watch_summary", "journal": args.journal,
         "windows": windows, "engine_transitions": transitions_seen,
         "journal_transitions": journal_transitions,
+        "segments": segments, "rounds_covered": rounds_covered,
         "run_ended": done,
         "alarms": engine.state_rows(),
     }
@@ -184,7 +208,8 @@ def _cmd_regress(args) -> int:
             os.path.join("artifacts", "compose_perf*.json"),
             os.path.join("artifacts", "static_analysis*.json"),
             os.path.join("artifacts", "alarm_drill*.json"),
-            os.path.join("artifacts", "tune_pareto*.json")])
+            os.path.join("artifacts", "tune_pareto*.json"),
+            os.path.join("artifacts", "soak_report*.json")])
     readable = [p for p in paths if os.path.exists(p)]
     if not readable:
         print("regress: no artifacts matched", file=sys.stderr)
@@ -259,7 +284,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "artifacts/compose_perf*.json "
                         "artifacts/static_analysis*.json "
                         "artifacts/alarm_drill*.json "
-                        "artifacts/tune_pareto*.json)")
+                        "artifacts/tune_pareto*.json "
+                        "artifacts/soak_report*.json)")
     p.add_argument("--band", type=float, default=query.DEFAULT_NOISE_BAND,
                    help="relative noise band (default 0.10)")
     p.add_argument("--json", action="store_true")

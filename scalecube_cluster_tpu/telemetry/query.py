@@ -319,7 +319,8 @@ def load_bench_payload(path: str) -> Tuple[Optional[dict], Optional[str]]:
                      or "compose_speedup_ratio" in payload
                      or "findings_total" in payload
                      or "alarm_detection_lag_windows" in payload
-                     or "batch_speedup_ratio" in payload)):
+                     or "batch_speedup_ratio" in payload
+                     or "rounds_survived" in payload)):
             return None, stub_note
     return payload, None
 
@@ -389,7 +390,16 @@ def regress(paths: Sequence[str],
         named tuned profiles shipped, each Pareto-non-dominated by the
         reference default over the recorded objectives (dominance
         recomputed from the payload) and fuzz-oracle green on
-        held-out seeds.
+        held-out seeds;
+      - Soak artifacts (``rounds_survived`` + ``drift`` present,
+        bench.py --soak): absolute gates — zero monitor violations
+        across the whole lifetime, the compose program's compile cache
+        FLAT after segment 1 (runtime recompile drift), host RSS
+        bounded, the seeded mid-soak SIGKILL/relaunch drill
+        byte-identical to the uninterrupted run (journal AND state
+        digest), and the live alarm engine quiet.  Smoke soaks are
+        provenance unless the walk holds only smoke rounds (the
+        sync-heal fallback rule).
 
     Returns (ok, check rows); each row {"check", "latest", "reference",
     "threshold", "ok", "source"}.  Unreadable/failed artifacts — and
@@ -912,6 +922,56 @@ def regress(paths: Sequence[str],
             check("slo/tune_profiles_fuzz_green", last_path, fuzz,
                   True, True,
                   bool(fuzz) and all(v is True for v in fuzz.values()))
+        # Soak artifacts (bench.py --soak): the production soak's drift
+        # invariants.  ABSOLUTE gates on the latest round — every one
+        # of these is a "never" claim, not a trajectory: a single
+        # monitor violation, one recompile after segment 1, or one
+        # byte of journal divergence under SIGKILL is a regression at
+        # any scale.  Smoke soaks are provenance unless the walk holds
+        # only smoke rounds (the sync-heal fallback rule: `--soak
+        # --smoke`'s in-bench check of its own fresh artifact still
+        # bites).
+        sk_all = [(p, pl) for p, pl in entries
+                  if "rounds_survived" in pl and "drift" in pl]
+        sk = [(p, pl) for p, pl in sk_all
+              if not pl.get("smoke")] or sk_all
+        if sk is not sk_all:
+            for p, pl in sk_all:
+                if pl.get("smoke"):
+                    rows.append({
+                        "check": "slo/soak", "source":
+                        os.path.basename(p), "ok": None,
+                        "note": "smoke soak — different scale, "
+                                "not a trajectory datum",
+                    })
+        if sk:
+            last_path, last = sk[-1]
+            drift = last.get("drift") or {}
+            viol = drift.get("violations")
+            check("slo/soak_violations", last_path, viol, 0, 0,
+                  viol == 0)
+            sizes = drift.get("cache_sizes")
+            check("slo/soak_compile_flat", last_path, sizes,
+                  "one program, every segment", True,
+                  drift.get("compile_flat") is True
+                  and isinstance(sizes, list) and len(sizes) >= 1)
+            check("slo/soak_rss_bounded", last_path,
+                  drift.get("rss_growth_mb"),
+                  "bounded growth", True,
+                  drift.get("rss_bounded") is True)
+            drill = last.get("kill_drill") or {}
+            check("slo/soak_kill_exactly_once", last_path,
+                  {k: drill.get(k) for k in
+                   ("ok", "journal_match", "state_match")},
+                  True, True,
+                  drill.get("ok") is True
+                  and drill.get("journal_match") is True
+                  and drill.get("state_match") is True)
+            alarms = last.get("alarms") or {}
+            check("slo/soak_alarms_quiet", last_path,
+                  alarms.get("transitions"), 0, 0,
+                  alarms.get("quiet") is True
+                  and alarms.get("transitions") == 0)
     return ok, rows
 
 

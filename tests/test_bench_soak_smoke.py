@@ -1,0 +1,219 @@
+"""bench.py --soak --smoke: the production-soak JSON contract.
+
+Like tests/test_bench_alarms_smoke.py for the alarm drill: the bench
+is the one entry point the soak's drift invariants flow through, so
+this tier-1 test runs the real script in a subprocess (CPU) and pins
+the published contract — one JSON line with the soak fields (zero
+monitor violations across the lifetime, the compose compile cache flat
+after segment 1, bounded RSS, the seeded mid-soak SIGKILL/relaunch
+drill byte-identical to the uninterrupted run, alarms quiet), an
+artifacts/soak_report.json-style artifact the query layer loads as a
+real payload, and the regress gate walking it with the absolute soak
+checks.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.soak
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run_soak_bench(tmp_path, flags=("--soak", "--smoke"),
+                    extra_env=None, timeout=840):
+    artifact = tmp_path / "soak_report_smoke.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_SOAK_ARTIFACT=str(artifact),
+        SCALECUBE_XLA_CACHE_DIR="",           # no cache writes from tests
+    )
+    env.pop("SCALECUBE_TPU_PROFILE_DIR", None)
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), *flags],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout      # exactly ONE JSON line
+    return json.loads(lines[0]), artifact
+
+
+def test_bench_soak_smoke_contract(tmp_path):
+    result, artifact = _run_soak_bench(tmp_path)
+
+    assert "error" not in result, result
+    assert result["smoke"] is True
+    assert result["metric"] == "soak_rounds_survived"
+    # value stays None BY DESIGN (rounds survived is configured, not
+    # measured — the absolute invariant gates carry the claim); the
+    # payload says so.
+    assert result["value"] is None
+    assert "value_note" in result
+    assert result["platform"] == "cpu(forced)"
+
+    # The headline acceptance: the whole lifetime survived with zero
+    # invariant violations and one compiled program.
+    assert result["rounds_survived"] == (result["segments"]
+                                         * result["segment_rounds"])
+    assert result["violations"] == 0
+    drift = result["drift"]
+    assert drift["ok"], drift
+    assert drift["compile_flat"] is True
+    assert len(set(drift["cache_sizes"])) == 1
+    assert drift["segments_sampled"] == result["segments"]
+    assert drift["rss_bounded"] is True
+    assert drift["monitor_green"] is True
+
+    # The seeded mid-soak SIGKILL/relaunch drill: byte-identical
+    # journal content rows, bit-identical final state digest.
+    drill = result["kill_drill"]
+    assert drill["ok"], drill
+    assert drill["journal_match"] is True
+    assert drill["state_match"] is True
+    assert drill["content_rows"] == 2 * result["segments"]
+    assert ":" in drill["kill"]              # "<round>:<stage>"
+
+    # Live alarms were armed and stayed quiet.
+    assert result["alarms"]["quiet"] is True
+    assert result["alarms"]["transitions"] == 0
+    assert result["alarms"]["specs"]         # armed, not disarmed
+
+    # Workload provenance + the copied journal, live-tailable.
+    assert result["scenario"].startswith("soak-")
+    assert "run_soak" in result["repro"]
+    assert os.path.exists(result["journal"])
+
+    # The artifact round-trips and loads as a REAL (non-stub) payload.
+    art = json.loads(artifact.read_text())
+    assert art["metric"] == result["metric"]
+    assert art["violations"] == 0
+
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    payload, skip_note = tquery.load_bench_payload(str(artifact))
+    assert skip_note is None
+    assert payload["rounds_survived"] == result["rounds_survived"]
+
+    # The in-bench regress gate ran and the dedicated absolute checks
+    # are present and green for the fresh artifact.
+    assert result["regress"]["ok"] is True
+    assert result["regress"]["artifacts"] >= 1
+    ok, rows = tquery.regress([str(artifact)])
+    assert ok
+    names = {r["check"] for r in rows}
+    assert {"slo/soak_violations", "slo/soak_compile_flat",
+            "slo/soak_rss_bounded", "slo/soak_kill_exactly_once",
+            "slo/soak_alarms_quiet"} <= names
+
+
+def test_soak_flag_is_exclusive(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--soak", "--alarms"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode != 0
+    assert "--soak" in proc.stderr
+
+
+def _soak_payload(**over):
+    base = {
+        "metric": "soak_rounds_survived", "value": None,
+        "rounds_survived": 2048, "segments": 8, "segment_rounds": 256,
+        "violations": 0,
+        "drift": {"ok": True, "compile_flat": True,
+                  "cache_sizes": [1] * 8, "rss_bounded": True,
+                  "rss_growth_mb": 3.0, "violations": 0,
+                  "monitor_green": True, "segments_sampled": 8},
+        "kill_drill": {"ok": True, "journal_match": True,
+                       "state_match": True},
+        "alarms": {"quiet": True, "transitions": 0},
+    }
+    base.update(over)
+    return base
+
+
+def test_regress_fails_on_rotted_soak_report(tmp_path):
+    """A soak recording a violation, a recompile, a diverged drill or
+    a noisy alarm engine must fail the gate — the committed claim
+    cannot silently rot."""
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    bad = tmp_path / "soak_report_bad.json"
+    doc = _soak_payload()
+    doc["violations"] = 2
+    doc["drift"] = {"ok": False, "compile_flat": False,
+                    "cache_sizes": [1, 2, 3], "rss_bounded": False,
+                    "rss_growth_mb": 900.0, "violations": 2,
+                    "monitor_green": False, "segments_sampled": 3}
+    doc["kill_drill"] = {"ok": False, "journal_match": False,
+                         "state_match": True}
+    doc["alarms"] = {"quiet": False, "transitions": 4}
+    bad.write_text(json.dumps(doc))
+    ok, rows = tquery.regress([str(bad)])
+    assert not ok
+    failed = {r["check"] for r in rows if r.get("ok") is False}
+    assert {"slo/soak_violations", "slo/soak_compile_flat",
+            "slo/soak_rss_bounded", "slo/soak_kill_exactly_once",
+            "slo/soak_alarms_quiet"} <= failed
+
+
+def test_regress_missing_drill_is_a_failure(tmp_path):
+    """A report with no kill_drill block must read as a FAILED
+    exactly-once gate, not a vacuous pass."""
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    bad = tmp_path / "soak_report_nodrill.json"
+    doc = _soak_payload()
+    del doc["kill_drill"]
+    bad.write_text(json.dumps(doc))
+    ok, rows = tquery.regress([str(bad)])
+    assert not ok
+    failed = {r["check"] for r in rows if r.get("ok") is False}
+    assert "slo/soak_kill_exactly_once" in failed
+
+
+def test_regress_smoke_soak_is_provenance_next_to_full(tmp_path):
+    """A smoke soak sitting next to a full one is a provenance row;
+    the full round carries the gates (the sync-heal fallback rule)."""
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    full = tmp_path / "soak_report.json"
+    full.write_text(json.dumps(_soak_payload()))
+    smoke = tmp_path / "soak_report_smoke.json"
+    bad = _soak_payload(smoke=True)
+    bad["violations"] = 7                      # would fail if gated
+    smoke.write_text(json.dumps(bad))
+    ok, rows = tquery.regress([str(full), str(smoke)])
+    assert ok                                  # the bad smoke round skips
+    notes = [r for r in rows if r.get("ok") is None
+             and r["check"] == "slo/soak"]
+    assert notes and "smoke" in notes[0]["note"]
+
+
+@pytest.mark.slow
+def test_bench_soak_full(tmp_path):
+    """The full (non-smoke) soak: the committed-artifact geometry
+    (n=32, 8 x 256 rounds, moderate) through the real bench, the
+    aggregate gates green."""
+    artifact = tmp_path / "soak_report_full.json"
+    result, _ = _run_soak_bench(
+        tmp_path, flags=("--soak",),
+        extra_env={"SCALECUBE_SOAK_ARTIFACT": str(artifact)},
+        timeout=7200)
+    assert "error" not in result, result
+    assert result["smoke"] is False
+    assert result["violations"] == 0
+    assert result["drift"]["ok"]
+    assert result["kill_drill"]["ok"]
+    assert result["regress"]["ok"] is True

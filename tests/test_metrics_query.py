@@ -673,6 +673,135 @@ def test_load_bench_payload_accepts_tune_artifact(tmp_path):
     assert payload["batch_speedup_ratio"] == 12.5
 
 
+def _soak_report_payload(**overrides):
+    payload = {
+        "metric": "soak_rounds_survived", "value": None,
+        "rounds_survived": 2048, "segments": 8, "segment_rounds": 256,
+        "violations": 0,
+        "drift": {"ok": True, "compile_flat": True,
+                  "cache_sizes": [1] * 8, "rss_bounded": True,
+                  "rss_growth_mb": 4.0, "violations": 0,
+                  "monitor_green": True, "segments_sampled": 8},
+        "kill_drill": {"ok": True, "journal_match": True,
+                       "state_match": True, "content_rows": 16},
+        "alarms": {"quiet": True, "transitions": 0},
+    }
+    payload.update(overrides)
+    return payload
+
+
+def test_regress_soak_gates(tmp_path):
+    """The --soak artifact's ABSOLUTE gates: zero violations over the
+    whole lifetime, compile cache flat after segment 1, RSS bounded,
+    the SIGKILL/relaunch drill exactly-once (byte-identical journal +
+    state digest), the live alarm engine quiet."""
+    art = tmp_path / "soak_report.json"
+    with open(art, "w") as f:
+        json.dump(_soak_report_payload(), f)
+    ok, rows = query.regress([str(art)])
+    assert ok, rows
+    checks = {r["check"] for r in rows if r.get("ok") is not None}
+    assert {"slo/soak_violations", "slo/soak_compile_flat",
+            "slo/soak_rss_bounded", "slo/soak_kill_exactly_once",
+            "slo/soak_alarms_quiet"} <= checks
+
+    # One monitor violation anywhere in the soak is a failed release.
+    with open(art, "w") as f:
+        json.dump(_soak_report_payload(
+            violations=1,
+            drift=dict(_soak_report_payload()["drift"],
+                       violations=1, monitor_green=False,
+                       ok=False)), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    assert any(r["check"] == "slo/soak_violations"
+               for r in rows if r.get("ok") is False)
+
+    # A recompile after segment 1 is a drift leak, not noise.
+    with open(art, "w") as f:
+        json.dump(_soak_report_payload(
+            drift=dict(_soak_report_payload()["drift"],
+                       compile_flat=False, cache_sizes=[1, 1, 2],
+                       ok=False)), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    assert any(r["check"] == "slo/soak_compile_flat"
+               for r in rows if r.get("ok") is False)
+    # ... and an empty probe trace can't prove flatness (only an
+    # explicit True with at least one sample passes).
+    with open(art, "w") as f:
+        json.dump(_soak_report_payload(
+            drift=dict(_soak_report_payload()["drift"],
+                       cache_sizes=[])), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+
+    # Unbounded host RSS fails even with the scan itself green.
+    with open(art, "w") as f:
+        json.dump(_soak_report_payload(
+            drift=dict(_soak_report_payload()["drift"],
+                       rss_bounded=False, rss_growth_mb=900.0,
+                       ok=False)), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    assert any(r["check"] == "slo/soak_rss_bounded"
+               for r in rows if r.get("ok") is False)
+
+    # The kill drill diverging — journal OR state — fails; so does a
+    # report that never ran the drill (missing block is not a pass).
+    for drill in ({"ok": False, "journal_match": False,
+                   "state_match": True},
+                  {"ok": False, "journal_match": True,
+                   "state_match": False},
+                  None):
+        doc = _soak_report_payload()
+        if drill is None:
+            del doc["kill_drill"]
+        else:
+            doc["kill_drill"] = drill
+        with open(art, "w") as f:
+            json.dump(doc, f)
+        ok, rows = query.regress([str(art)])
+        assert not ok, drill
+        assert any(r["check"] == "slo/soak_kill_exactly_once"
+                   for r in rows if r.get("ok") is False)
+
+    # An alarm transition during the soak means the SLO engine saw a
+    # breach the drift verdict didn't — never quiet-pass it.
+    with open(art, "w") as f:
+        json.dump(_soak_report_payload(
+            alarms={"quiet": False, "transitions": 2}), f)
+    ok, rows = query.regress([str(art)])
+    assert not ok
+    assert any(r["check"] == "slo/soak_alarms_quiet"
+               for r in rows if r.get("ok") is False)
+
+
+def test_load_bench_payload_accepts_soak_artifact(tmp_path):
+    """A soak report is a real measurement payload (gate-bearing,
+    ``value: null`` by design) — never skipped as a stub."""
+    art = tmp_path / "soak_report.json"
+    with open(art, "w") as f:
+        json.dump(_soak_report_payload(), f)
+    payload, note = query.load_bench_payload(str(art))
+    assert note is None
+    assert payload["rounds_survived"] == 2048
+
+
+def test_cli_regress_default_globs_include_soak(tmp_path, capsys,
+                                                monkeypatch):
+    """Bare ``regress`` walks artifacts/soak_report*.json — the
+    committed soak round passes its absolute gates."""
+    monkeypatch.chdir(REPO)
+    assert cli_main(["regress", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] is True
+    soak_rows = [r for r in out["checks"]
+                 if r.get("source", "").startswith("soak_report")]
+    assert any(r["check"] == "slo/soak_kill_exactly_once"
+               and r.get("ok") is True for r in soak_rows)
+
+
 def test_cli_regress_default_globs_include_static_analysis(
         tmp_path, capsys, monkeypatch):
     """Bare ``regress`` walks artifacts/static_analysis.json — the
