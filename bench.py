@@ -99,6 +99,28 @@ SCALECUBE_SYNC_INTERVAL, SCALECUBE_SYNC_PROBE_STEP,
 SCALECUBE_SYNC_MONITOR_N, SCALECUBE_SYNC_SEED,
 SCALECUBE_SYNC_ARTIFACT.
 
+``--rollout``: the config-propagation workload — the metadata KV plane
+(models/metadata.py) measured for its headline robustness claim: a
+STAGED config rollout (chaos.StagedRollout — seeded owner waves, each
+gated on cluster-wide convergence before the next fires) completes
+under fire (a revive churn storm + a partition split/heal crossing the
+stages) with every stage inside its convergence deadline
+(chaos/scenarios.metadata_convergence_bound, partition-extended like
+the monitor's completeness deadlines), while the gossip-only control
+(metadata on, SYNC off) demonstrably never re-converges through the
+heal.  Three arms: a monitored composite (zero violations required), a
+gated segment-driven rollout probe (per-push convergence latencies →
+``metadata_convergence_p99``; a deadline breach would roll the flipped
+stages back via StagedRollout.rollback_ops and fail the gate), and the
+control.  Writes an ``artifacts/config_rollout.json``-style artifact
+the ``telemetry regress`` gate walks (absolute convergence/control/
+monitor gates + banded p99 series).  ``--rollout --smoke`` is the
+tier-1-safe pass pinned by tests/test_bench_rollout_smoke.py.  Env
+overrides: SCALECUBE_ROLLOUT_N, SCALECUBE_ROLLOUT_STAGES,
+SCALECUBE_ROLLOUT_STAGE_SIZE, SCALECUBE_ROLLOUT_SYNC_INTERVAL,
+SCALECUBE_ROLLOUT_PROBE_STEP, SCALECUBE_ROLLOUT_SEED,
+SCALECUBE_ROLLOUT_ARTIFACT.
+
 ``--lifeguard``: the adaptivity workload — the Lifeguard health plane
 (models/lifeguard.py) measured A/B against its own control under the
 seeded ``chaos.asymmetric_degradation`` scenario (Brownout loss+delay
@@ -1705,6 +1727,295 @@ def run_sync_bench():
     print(json.dumps(result), flush=True)
 
 
+def run_rollout_bench():
+    """The --rollout mode: staged config rollout through the metadata
+    KV plane (models/metadata.py) under fire, one JSON line out
+    (never-ship-empty).
+
+    One composite scenario on the chaos-campaign timing preset — a
+    revive churn storm, a quiesced partition split/heal, and a seeded
+    :class:`chaos.StagedRollout` whose stages CROSS the split — run
+    three ways:
+
+      1. *monitored* — the full composite through ``chaos.run_monitored``
+         with the agreement window armed (zero violations required: the
+         KV plane must not perturb membership convergence);
+      2. *gated rollout* — the same program segment-by-segment, probing
+         every few rounds for per-push convergence (every live table
+         holds the pushed word).  Each push's deadline is
+         ``max(push round, heal round) + metadata_convergence_bound``
+         (the monitor's completeness convention: no promise under an
+         active disruption).  A breach would roll the flipped stages
+         back (``StagedRollout.rollback_ops``) and fail the in-bench
+         gate; the happy path records per-push latencies from the
+         deadline clock start → ``metadata_convergence_p99``;
+      3. *control* — gossip-only dissemination (metadata ON,
+         ``sync_interval=0``): the hot piggyback window expires inside
+         the split, so the control stays DIVERGENT through the heal —
+         the A/B that shows the full-table anti-entropy lane is what
+         makes config propagation survive partitions.
+
+    Results land in an ``artifacts/config_rollout.json``-style artifact
+    (override SCALECUBE_ROLLOUT_ARTIFACT) gated by ``telemetry
+    regress`` (absolute convergence/control/monitor gates + the banded
+    p99 series), and a JSONL manifest summary row feeds the
+    ``metadata_convergence_p99`` SLO (telemetry/query.compute_slos).
+    ``--rollout --smoke`` is the tier-1-safe pass
+    (tests/test_bench_rollout_smoke.py pins the contract).  Env
+    overrides: SCALECUBE_ROLLOUT_N, SCALECUBE_ROLLOUT_STAGES,
+    SCALECUBE_ROLLOUT_STAGE_SIZE, SCALECUBE_ROLLOUT_SYNC_INTERVAL,
+    SCALECUBE_ROLLOUT_PROBE_STEP, SCALECUBE_ROLLOUT_SEED,
+    SCALECUBE_ROLLOUT_ARTIFACT.
+
+    ``value`` stays None by design: convergence latency is
+    smaller-is-better, so it must not enter the generic
+    higher-is-better throughput walk — regress gates the dedicated
+    ``metadata_convergence_p99`` series instead.
+    """
+    result = {
+        "metric": "config_rollout_convergence",
+        "value": None,
+        "unit": "rounds",
+        "smoke": SMOKE,
+    }
+    artifact = (os.environ.get("SCALECUBE_ROLLOUT_ARTIFACT")
+                or os.path.join("artifacts",
+                                "config_rollout_smoke.json" if SMOKE
+                                else "config_rollout.json"))
+    try:
+        jax, platform = init_backend()
+        result["platform"] = platform
+
+        import numpy as np
+
+        from scalecube_cluster_tpu.chaos import campaign as ccampaign
+        from scalecube_cluster_tpu.chaos import monitor as cmonitor
+        from scalecube_cluster_tpu.chaos import scenarios as cscenarios
+        from scalecube_cluster_tpu.models import metadata as md_plane
+        from scalecube_cluster_tpu.models import swim
+        from scalecube_cluster_tpu.telemetry import sink as tsink
+
+        cfg = ccampaign.campaign_config()
+        seed = int(os.environ.get("SCALECUBE_ROLLOUT_SEED", 11))
+        n = int(os.environ.get("SCALECUBE_ROLLOUT_N",
+                               24 if SMOKE else 48))
+        sync_interval = int(os.environ.get(
+            "SCALECUBE_ROLLOUT_SYNC_INTERVAL", 8))
+        n_stages = int(os.environ.get("SCALECUBE_ROLLOUT_STAGES",
+                                      2 if SMOKE else 3))
+        stage_size = int(os.environ.get("SCALECUBE_ROLLOUT_STAGE_SIZE",
+                                        2 if SMOKE else 4))
+        probe_step = int(os.environ.get("SCALECUBE_ROLLOUT_PROBE_STEP", 2))
+        new_value, rollback_value = 641, 7
+
+        # Geometry: one quiesced split/heal (the sync bench's bound
+        # arithmetic), the storm before it, the rollout stages crossing
+        # it.  stage_every covers the convergence bound by construction
+        # (StagedRollout.validate_gate re-checks).
+        p0 = swim.SwimParams.from_config(
+            cfg, n_members=n, delivery="shift", sync_every=0,
+            sync_interval=sync_interval, metadata_keys=1)
+        phase = -(-cscenarios.quiesce_bound(p0, n) // 16) * 16
+        bound = cscenarios.metadata_convergence_bound(p0, n)
+        stage_every = -(-bound // 16) * 16
+        split_at, heal_at = phase, 2 * phase
+        start = phase + phase // 2            # stage 0 fires mid-split
+
+        rng = np.random.default_rng(np.random.SeedSequence([seed, 0x19C0]))
+        perm = [int(x) for x in rng.permutation(n)]
+        storm_nodes = tuple(perm[:4])
+        owners = tuple(perm[4:4 + n_stages * stage_size])
+        revive_down = cscenarios.quiesce_bound(p0, n)
+        storm = cscenarios.ChurnStorm(
+            nodes=storm_nodes, wave_size=2, start_round=8,
+            wave_every=24, down_rounds=revive_down)
+        rollout = cscenarios.StagedRollout(
+            members=owners, n_stages=n_stages, key=0, value=new_value,
+            start_round=start, stage_every=stage_every,
+            rollback_value=rollback_value)
+        rollout.validate_gate(p0, n)
+        last_stage = rollout.stage_round(n_stages - 1)
+        horizon = -(-(max(last_stage, heal_at) + bound + 32) // 64) * 64
+        scen = cscenarios.Scenario(
+            name=f"config-rollout-n{n}", n_members=n, horizon=horizon,
+            ops=(storm,
+                 cscenarios.RollingPartition(from_round=phase,
+                                             phase_rounds=phase,
+                                             n_cycles=1),
+                 rollout),
+            seed=seed)
+
+        params = ccampaign.campaign_params(
+            scen, delivery="shift", sync_every=0,
+            sync_interval=sync_interval)
+        world, spec = scen.build(params)
+        key = jax.random.key(seed)
+
+        # ---- Arm 1: monitored composite ---------------------------------
+        t0 = time.time()
+        _, mon, _ = cmonitor.run_monitored(key, params, world, spec,
+                                           horizon)
+        verdict = cmonitor.verdict(mon)
+        violations = sum(d["violations"]
+                         for d in verdict["codes"].values())
+        log(f"rollout monitored arm (n={n}, split [{split_at},{heal_at}), "
+            f"horizon {horizon}): "
+            f"{'green' if verdict['green'] else 'RED'} "
+            f"({violations} violation(s), {time.time() - t0:.1f}s)")
+
+        # ---- Arm 2: gated segment-driven rollout ------------------------
+        # Per-push deadline clock starts at max(push, heal) — the
+        # completeness convention: no convergence promise while the
+        # split still partitions the readers.
+        pushes = []
+        for node, k_, value, at in rollout.push_schedule():
+            eff = heal_at if split_at <= at < heal_at else at
+            pushes.append({"owner": node, "key": k_, "value": value,
+                           "push_round": at, "clock_from": eff,
+                           "deadline": eff + bound, "converged_at": None})
+        df = np.asarray(world.down_from)
+        du = np.asarray(world.down_until)
+
+        t0 = time.time()
+        state = swim.initial_state(params, world)
+        r, rolled_back, breaches = 0, False, []
+        while r < horizon:
+            step = min(probe_step, horizon - r)
+            state, _ = swim.run(key, params, world, step, state=state,
+                                start_round=r)
+            r += step
+            open_pushes = [p for p in pushes
+                           if p["converged_at"] is None
+                           and p["push_round"] < r]
+            if open_pushes:
+                md = np.asarray(state.md)
+                alive = ~((df <= r - 1) & (r - 1 < du))
+                obs = np.flatnonzero(alive)
+                for p in open_pushes:
+                    vals = (md[obs, p["owner"], p["key"]]
+                            & md_plane.MD_VALUE_MAX)
+                    if bool((vals == p["value"]).all()):
+                        p["converged_at"] = r
+            for p in pushes:
+                if p["converged_at"] is None and r >= p["deadline"]:
+                    breaches.append(p)
+            if breaches and not rolled_back:
+                # Convergence-deadline breach: roll the flipped stages
+                # back — rebuild the remaining schedule with the
+                # rollback pushes and drive it to the horizon (the
+                # drill keeps the run honest; the gate below fails).
+                rolled_back = True
+                failed_stage = max(
+                    s for s in range(n_stages)
+                    if rollout.stage_round(s) <= breaches[0]["push_round"])
+                rb_world = world
+                for op in rollout.rollback_ops(failed_stage, r + 1):
+                    rb_world = op.apply(rb_world, n, horizon)
+                state, _ = swim.run(key, params, rb_world, horizon - r,
+                                    state=state, start_round=r)
+                r = horizon
+            if all(p["converged_at"] is not None for p in pushes):
+                break
+        lats = [p["converged_at"] - p["clock_from"] for p in pushes
+                if p["converged_at"] is not None]
+        converged = (not rolled_back
+                     and all(p["converged_at"] is not None
+                             and p["converged_at"] <= p["deadline"]
+                             for p in pushes))
+        p99 = float(np.percentile(lats, 99)) if lats and converged else None
+        # Drive the survivors to the horizon and take the global probe:
+        # every table (including the revived storm nodes) must agree.
+        if r < horizon and not rolled_back:
+            state, _ = swim.run(key, params, world, horizon - r,
+                                state=state, start_round=r)
+        final_div = int(md_plane.divergence_probe(state, params, world,
+                                                  horizon))
+        log(f"rollout gated arm: {len(pushes)} push(es) over "
+            f"{n_stages} stage(s), converged={converged} "
+            f"(p99 {p99} rounds from clock start, bound {bound}; "
+            f"final divergent cells {final_div}; "
+            f"rolled_back={rolled_back}, {time.time() - t0:.1f}s)")
+
+        # ---- Arm 3: gossip-only control ---------------------------------
+        params_off = ccampaign.campaign_params(
+            scen, delivery="shift", sync_every=0, sync_interval=0)
+        world_off, _ = scen.build(params_off)
+        t0 = time.time()
+        st_off, _ = swim.run(key, params_off, world_off, horizon)
+        control_div = int(md_plane.divergence_probe(
+            st_off, params_off, world_off, horizon))
+        log(f"rollout control (gossip-only): divergent cells at horizon: "
+            f"{control_div} ({time.time() - t0:.1f}s)")
+
+        result.update(
+            metadata_convergence_p99=p99,
+            rollout_converged=bool(converged and final_div == 0),
+            rolled_back=rolled_back,
+            convergence_deadline_rounds=bound,
+            stage_converge_rounds=[p["converged_at"] for p in pushes],
+            stage_rounds=[rollout.stage_round(s)
+                          for s in range(n_stages)],
+            final_divergent_cells=final_div,
+            control_divergent_cells=control_div,
+            control_converged=bool(control_div == 0),
+            monitored_green=bool(verdict["green"]),
+            monitor_violations=int(violations),
+            n_members=n,
+            metadata_keys=int(params.metadata_keys),
+            n_stages=n_stages,
+            stage_size=stage_size,
+            owners=list(owners),
+            delivery="shift",
+            sync_interval=sync_interval,
+            split_rounds=phase,
+            horizon_rounds=horizon,
+            probe_step=probe_step,
+            seed=seed,
+            value_note=("value stays null by design: convergence latency "
+                        "is smaller-is-better and must not enter the "
+                        "throughput walk — regress gates "
+                        "metadata_convergence_p99 instead"),
+        )
+
+        # SLO surface: one manifest summary row the query layer folds
+        # into the metadata_convergence_p99 SLO.
+        with tsink.TelemetrySink.from_env(
+                default_dir=os.path.join("artifacts", "telemetry"),
+                prefix=("config-rollout-smoke" if SMOKE
+                        else "config-rollout")) as sink:
+            sink.write_manifest(
+                params=cfg,
+                workload={"kind": "config_rollout", "n_members": n,
+                          "sync_interval": sync_interval,
+                          "stages": n_stages, "stage_size": stage_size,
+                          "split_rounds": phase, "horizon": horizon,
+                          "seed": seed},
+            )
+            sink.write_record("summary", {
+                "metadata_convergence_p99": p99,
+                "rollout_converged": bool(converged and final_div == 0),
+                "control_divergent_cells": control_div,
+            })
+            result["manifest"] = sink.path
+
+        art = dict(result)
+        os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        result["artifact"] = artifact
+        log(f"rollout artifact written to {artifact}")
+
+        apply_regress_gate(
+            result, ["BENCH_*.json", "MULTICHIP_*.json",
+                     os.path.join("artifacts", "config_rollout*.json"),
+                     artifact])
+    except BaseException as e:  # noqa: BLE001 — partial result by contract
+        log(traceback.format_exc())
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 def run_lifeguard_bench():
     """The --lifeguard mode: the Lifeguard health plane's headline
     robustness claim, measured A/B (never asserted) — one JSON line out
@@ -3236,6 +3547,16 @@ def main():
              "tier-1-safe single-scenario pass",
     )
     parser.add_argument(
+        "--rollout", action="store_true",
+        help="measure staged config rollout through the metadata KV "
+             "plane under fire (revive churn storm + partition "
+             "split/heal crossing the stages; gated per-push "
+             "convergence deadlines + metadata_convergence_p99, "
+             "gossip-only control stays divergent) into an "
+             "artifacts/config_rollout.json-style artifact; combine "
+             "with --smoke for the tier-1-safe pass",
+    )
+    parser.add_argument(
         "--lifeguard", action="store_true",
         help="measure the Lifeguard health plane A/B under the seeded "
              "asymmetric-degradation scenario (false-positive observer "
@@ -3357,6 +3678,15 @@ def main():
             parser.error(
                 "--sync measures partition-heal convergence on its own "
                 "workload — drop the other mode flags")
+        if args.rollout and (args.chaos or args.resilience or args.metrics
+                             or args.multichip or args.sync
+                             or args.lifeguard or args.churn or args.fuzz
+                             or args.wire or args.compose or args.alarms
+                             or args.tune or args.soak or args.traced
+                             or args.untraced or args.gap_artifact):
+            parser.error(
+                "--rollout measures staged config propagation on its "
+                "own workload — drop the other mode flags")
         if args.lifeguard and (args.chaos or args.resilience
                                or args.metrics or args.multichip
                                or args.sync or args.traced
@@ -3447,6 +3777,8 @@ def main():
         return run_multichip_bench()
     if args.sync:
         return run_sync_bench()
+    if args.rollout:
+        return run_rollout_bench()
     if args.lifeguard:
         return run_lifeguard_bench()
     if args.churn:

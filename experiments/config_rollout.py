@@ -1,0 +1,85 @@
+"""Staged config-rollout drill: the metadata KV plane under fire.
+
+Drives ``bench.py --rollout`` (the one entry point the rollout
+measurement flows through, so the experiment and the driver bench
+cannot drift): a staged ``ConfigPush`` wave schedule rolled through a
+live cluster while a partition splits and heals mid-rollout, then
+
+  - the gated arm polls ``models/metadata.divergence_probe`` at every
+    stage boundary and advances only while each stage converges inside
+    its deadline — otherwise it rebuilds the tail as a rollback push
+    (``StagedRollout.rollback_ops``); the committed claim is that NO
+    rollback fires and the final table is globally agreed;
+  - the monitored chaos-campaign arm (``chaos.run_monitored``) must
+    come back green with zero invariant violations;
+  - the gossip-only control (``sync_interval=0``) demonstrably stays
+    divergent at the horizon: without the SYNC full-table exchange a
+    push landing inside the split never heals.
+
+Writes ``artifacts/config_rollout.json`` (override ``--artifact``) and
+runs the ``telemetry regress`` gate in-bench — the committed artifact
+is the pinned robustness claim: versioned config propagates, staged
+rollouts converge within ``metadata_convergence_p99`` of the deadline,
+and without the anti-entropy leg they provably do not.
+
+CPU-safe; the committed shape is N=48, three stages of four owners.
+
+Usage:
+    python experiments/config_rollout.py            # committed shape
+    python experiments/config_rollout.py --smoke    # tier-1-safe pass
+    python experiments/config_rollout.py --n 256 --stages 4
+    python experiments/config_rollout.py --sync-interval 16 --seed 7
+"""
+
+import argparse
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    parser.add_argument("--smoke", action="store_true",
+                        help="tier-1-safe fast pass (small N)")
+    parser.add_argument("--n", type=int, default=None,
+                        help="member count (default 48)")
+    parser.add_argument("--stages", type=int, default=None,
+                        help="rollout stage count (default 3)")
+    parser.add_argument("--stage-size", type=int, default=None,
+                        help="owners flipped per stage (default 4)")
+    parser.add_argument("--sync-interval", type=int, default=None,
+                        help="anti-entropy exchange cadence in rounds "
+                             "(default 8)")
+    parser.add_argument("--probe-step", type=int, default=None,
+                        help="divergence-probe cadence in rounds "
+                             "(default 2)")
+    parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--artifact", default=None,
+                        help="artifact path (default "
+                             "artifacts/config_rollout.json)")
+    args = parser.parse_args()
+
+    env = dict(os.environ)
+    for flag, var in ((args.n, "SCALECUBE_ROLLOUT_N"),
+                      (args.stages, "SCALECUBE_ROLLOUT_STAGES"),
+                      (args.stage_size, "SCALECUBE_ROLLOUT_STAGE_SIZE"),
+                      (args.sync_interval,
+                       "SCALECUBE_ROLLOUT_SYNC_INTERVAL"),
+                      (args.probe_step, "SCALECUBE_ROLLOUT_PROBE_STEP"),
+                      (args.seed, "SCALECUBE_ROLLOUT_SEED"),
+                      (args.artifact, "SCALECUBE_ROLLOUT_ARTIFACT")):
+        if flag is not None:
+            env[var] = str(flag)
+
+    cmd = [sys.executable, str(REPO / "bench.py"), "--rollout"]
+    if args.smoke:
+        cmd.append("--smoke")
+    return subprocess.run(cmd, env=env, cwd=str(REPO)).returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

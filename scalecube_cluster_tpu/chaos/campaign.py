@@ -34,7 +34,7 @@ import numpy as np
 from scalecube_cluster_tpu.chaos import monitor as cmonitor
 from scalecube_cluster_tpu.chaos import scenarios as cscenarios
 from scalecube_cluster_tpu.config import ClusterConfig
-from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.models import metadata, swim
 
 INT32_MAX = cscenarios.INT32_MAX
 
@@ -58,14 +58,19 @@ def campaign_params(scenario: "cscenarios.Scenario",
                     **overrides) -> "swim.SwimParams":
     """SwimParams for one scenario: full view (every member a tracked
     subject — chaos verdicts are about the whole membership matrix),
-    the scenario's background wire loss baked in, and the open-world
+    the scenario's background wire loss baked in, the open-world
     plane enabled automatically when the scenario schedules JOINs
     (without it the joins would degrade to same-identity revivals —
-    Scenario.has_joins).  Explicit overrides win."""
+    Scenario.has_joins), and the metadata KV plane enabled — sized by
+    Scenario.metadata_keys_needed — when any op pushes a config word
+    (without it the pushes compile to no-ops).  Explicit overrides
+    win."""
     kwargs = dict(loss_probability=scenario.loss_probability,
                   delivery=delivery)
     if scenario.has_joins:
         kwargs["open_world"] = True
+    if scenario.has_metadata:
+        kwargs["metadata_keys"] = scenario.metadata_keys_needed()
     kwargs.update(overrides)
     return swim.SwimParams.from_config(
         campaign_config(), n_members=scenario.n_members, **kwargs)
@@ -1011,4 +1016,97 @@ def cross_validate_partition(scenario: "cscenarios.Scenario", seed: int = 0,
         "halves": [len(half_a), len(half_b)],
         "sync_interval": sync_interval,
         "victims": {str(k): d for k, d in per_victim.items()},
+    }
+
+
+def _metadata_push_schedule(scenario: "cscenarios.Scenario"):
+    """Flat ``[(node, key, value, at_round)]`` when every op is a
+    metadata push on a lossless network (ConfigPush / StagedRollout —
+    membership stays quiet, which is what makes per-member terminal KV
+    parity exact rather than timing-dependent); None otherwise."""
+    if scenario.loss_probability or not scenario.ops:
+        return None
+    pushes = []
+    for op in scenario.ops:
+        if isinstance(op, (cscenarios.ConfigPush,
+                           cscenarios.StagedRollout)):
+            pushes.extend(op.push_schedule())
+        else:
+            return None
+    return sorted(pushes, key=lambda p: p[3])
+
+
+def cross_validate_metadata(scenario: "cscenarios.Scenario",
+                            seed: int = 0, delivery: str = "shift",
+                            round_ms: int = 100) -> Optional[dict]:
+    """Replay a pure config-push scenario on the event-driven oracle —
+    each push is the reference's ``Cluster.update_metadata`` (an
+    incarnation-bumping local write whose new words peers re-fetch,
+    oracle/cluster.py) — and require PER-MEMBER CONVERGED-KV PARITY:
+    after the horizon, every observer on BOTH layers must hold exactly
+    the last-pushed value for every (owner, key), and the two layers'
+    terminal tables must agree.  This is the ground-truth check for the
+    jit KV plane's LWW merge (models/metadata.py): the oracle converges
+    by demand-fetch on incarnation bumps, the model by versioned
+    piggyback + anti-entropy, and on a quiet lossless network both must
+    land on the same terminal table — any model cell stuck below the
+    last write (a lost version) or above it (a resurrected word) breaks
+    parity.  Returns the diff digest, or None when the scenario isn't
+    expressible (any non-push op, or background loss)."""
+    import jax
+
+    sched = _metadata_push_schedule(scenario)
+    if sched is None:
+        return None
+    n, horizon = scenario.n_members, scenario.horizon
+    cfg = campaign_config()
+
+    # --- oracle side --------------------------------------------------
+    sim, clusters, _ = _oracle_cluster(seed, n, cfg, round_ms)
+    for r in range(horizon):
+        for node, key, value, at in sched:
+            if r == at:
+                clusters[node].update_metadata_property(
+                    f"k{key}", str(value))
+        sim.run_for(round_ms)
+
+    # Terminal expectation: last push wins per (owner, key) — the LWW
+    # fixed point both layers must reach on a quiet network.
+    expected: dict = {}
+    for node, key, value, _ in sched:
+        expected.setdefault(node, {})[key] = value
+
+    # --- model side (metadata plane ON via campaign_params) -----------
+    params = campaign_params(scenario, delivery=delivery)
+    world, _ = scenario.build(params)
+    state, _ = swim.run(jax.random.key(seed), params, world, horizon)
+    md = np.asarray(state.md)            # [n, K=n, M], full view
+
+    agree = True
+    per_push = {}
+    for owner in sorted(expected):
+        for key, value in sorted(expected[owner].items()):
+            model_vals = [
+                int(np.asarray(metadata.word_value(md[o, owner, key])))
+                for o in range(n)
+            ]
+            oracle_vals = []
+            for o in range(n):
+                mem = next(m for m in clusters[o].members()
+                           if int(m.id[1:]) == owner)
+                kv = clusters[o].metadata(mem) or {}
+                oracle_vals.append(kv.get(f"k{key}"))
+            model_div = sum(v != value for v in model_vals)
+            oracle_div = sum(v != str(value) for v in oracle_vals)
+            per_push[f"{owner}:k{key}"] = {
+                "value": value,
+                "model_divergent": model_div,
+                "oracle_divergent": oracle_div,
+            }
+            agree &= model_div == 0 and oracle_div == 0
+    return {
+        "agree": agree,
+        "observers": n,
+        "pushes": len(sched),
+        "per_push": per_push,
     }

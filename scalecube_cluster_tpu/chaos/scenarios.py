@@ -62,7 +62,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from scalecube_cluster_tpu.chaos.monitor import MonitorSpec
-from scalecube_cluster_tpu.models import swim
+from scalecube_cluster_tpu.models import metadata, swim
 
 INT32_MAX = int(jnp.iinfo(jnp.int32).max)
 
@@ -98,6 +98,22 @@ def post_heal_agreement_bound(params: "swim.SwimParams", n: int) -> int:
     ``sync_interval + dissemination_bound`` contract, deliberately
     generous — it is a convergence CONTRACT, not a latency benchmark
     (``bench.py --sync`` measures the actual figure)."""
+    log2n = math.ceil(math.log2(n + 1))
+    return (params.sync_interval
+            + 4 * log2n + params.periods_to_spread
+            + 2 * max(1, params.ping_every) + 16)
+
+
+def metadata_convergence_bound(params: "swim.SwimParams", n: int) -> int:
+    """Rounds within which a pushed metadata word must reach every live
+    table: one anti-entropy exchange interval (the full-table lane that
+    crosses healed partitions — models/metadata.py) + the piggyback
+    dissemination bound for the hot window + probe slack.  Like
+    :func:`post_heal_agreement_bound` this is a convergence CONTRACT,
+    deliberately generous — ``bench.py --rollout`` measures the actual
+    p99 (``metadata_convergence_p99``); the staged-rollout gate and the
+    telemetry regress consume THIS bound so the deadline arithmetic
+    lives in one place."""
     log2n = math.ceil(math.log2(n + 1))
     return (params.sync_interval
             + 4 * log2n + params.periods_to_spread
@@ -470,6 +486,125 @@ class RollingPartition:
         return (self.from_round, end)
 
 
+@dataclasses.dataclass(frozen=True)
+class ConfigPush:
+    """Owner-local config write: ``node`` sets its metadata cell ``key``
+    to ``value`` at ``at_round`` (``SwimWorld.with_metadata_push`` — the
+    jit analog of the reference's ``Cluster.updateMetadata``).  Requires
+    the metadata plane: ``SwimParams.metadata_keys > key``
+    (chaos/campaign.campaign_params enables it automatically via
+    :attr:`Scenario.has_metadata`).  Not a fault: no disruption window,
+    no effect on membership schedules — a scenario of pushes over a
+    pristine network stays pristine."""
+
+    node: int
+    key: int
+    value: int
+    at_round: int
+
+    def apply(self, world, n, horizon):
+        return world.with_metadata_push(self.node, self.key, self.value,
+                                        self.at_round)
+
+    def disruption(self, n, horizon):
+        return None                      # config data, not network
+
+    def push_schedule(self):
+        """[(node, key, value, round)] — the flat form the staged-rollout
+        driver and the oracle replay consume."""
+        return [(self.node, self.key, self.value, self.at_round)]
+
+
+@dataclasses.dataclass(frozen=True)
+class StagedRollout:
+    """Staged config rollout: ``members`` (the rollout order) split into
+    ``n_stages`` equal waves; stage s's members each push ``key`` =
+    ``value`` on themselves at ``start_round + s * stage_every``.
+
+    The op compiles the OPTIMISTIC forward schedule — every stage fires
+    on time.  The convergence GATE between stages is the driver's job
+    (``bench.py --rollout``): it runs segment-by-segment, polls
+    ``models/metadata.divergence_probe`` at each stage boundary, and
+    rolls the remaining stages forward only while each stage converges
+    within its deadline (``stage_every`` must cover
+    :func:`metadata_convergence_bound`, validated here so a rollout
+    whose stages cannot possibly converge in time is a build-time
+    error, not a mystery breach) — otherwise it REBUILDS the tail as a
+    rollback push of ``rollback_value`` on the already-flipped members
+    (:meth:`rollback_ops`).  A gate cannot live inside the compiled
+    schedule: the world arrays are pure data, and a data-dependent push
+    round would break the one-compile-per-shape campaign contract.
+    """
+
+    members: Tuple[int, ...]
+    n_stages: int
+    key: int
+    value: int
+    start_round: int
+    stage_every: int
+    rollback_value: int = 0
+
+    def __post_init__(self):
+        if self.n_stages < 1 or len(self.members) % self.n_stages:
+            raise ValueError(
+                f"n_stages {self.n_stages} must be >= 1 and divide the "
+                f"member count {len(self.members)}")
+        if len(set(self.members)) != len(self.members):
+            raise ValueError(
+                f"StagedRollout members must be distinct (got "
+                f"{self.members}) — one owner cannot join two stages")
+        if self.stage_every < 1:
+            raise ValueError(
+                f"stage_every {self.stage_every} must be >= 1")
+
+    @property
+    def stage_size(self) -> int:
+        return len(self.members) // self.n_stages
+
+    def stage_round(self, s: int) -> int:
+        return self.start_round + s * self.stage_every
+
+    def stage_members(self, s: int) -> Tuple[int, ...]:
+        return self.members[s * self.stage_size:(s + 1) * self.stage_size]
+
+    def validate_gate(self, params, n) -> None:
+        """Raise unless ``stage_every`` covers the convergence bound —
+        a stage that CANNOT meet its own deadline is a schedule bug,
+        not a finding (the driver calls this before running)."""
+        bound = metadata_convergence_bound(params, n)
+        if self.stage_every < bound:
+            raise ValueError(
+                f"StagedRollout stage_every={self.stage_every} is below "
+                f"the convergence bound {bound} for this config — no "
+                f"stage could ever pass its gate "
+                f"(chaos/scenarios.metadata_convergence_bound)")
+
+    def apply(self, world, n, horizon):
+        for node, key, value, at in self.push_schedule():
+            world = world.with_metadata_push(node, key, value, at)
+        return world
+
+    def disruption(self, n, horizon):
+        return None
+
+    def push_schedule(self):
+        return [(m, self.key, self.value, self.stage_round(s))
+                for s in range(self.n_stages)
+                for m in self.stage_members(s)]
+
+    def rollback_ops(self, failed_stage: int, at_round: int
+                     ) -> Tuple[ConfigPush, ...]:
+        """The rollback tail after ``failed_stage`` breached its gate:
+        one :class:`ConfigPush` of ``rollback_value`` at ``at_round``
+        for every member of stages ``0..failed_stage`` (the flipped
+        set — later stages never fired, nothing to undo)."""
+        flipped = [m for s in range(failed_stage + 1)
+                   for m in self.stage_members(s)]
+        return tuple(ConfigPush(node=m, key=self.key,
+                                value=self.rollback_value,
+                                at_round=at_round) for m in flipped)
+
+
 # --------------------------------------------------------------------------
 # Scenario
 # --------------------------------------------------------------------------
@@ -539,6 +674,22 @@ class Scenario:
             or (isinstance(op, ChurnStorm) and op.join_wave_size > 0)
             for op in self.ops
         )
+
+    @property
+    def has_metadata(self) -> bool:
+        """True when any op pushes a metadata word — the runner must
+        enable ``SwimParams.metadata_keys`` or the pushes compile to
+        no-ops (chaos/campaign.campaign_params does this
+        automatically, sized by :meth:`metadata_keys_needed`)."""
+        return any(isinstance(op, (ConfigPush, StagedRollout))
+                   for op in self.ops)
+
+    def metadata_keys_needed(self) -> int:
+        """Smallest ``SwimParams.metadata_keys`` covering every pushed
+        key (0 when no op pushes — the plane stays off)."""
+        keys = [op.key for op in self.ops
+                if isinstance(op, (ConfigPush, StagedRollout))]
+        return max(keys) + 1 if keys else 0
 
     def build(self, params: "swim.SwimParams",
               rule_pad: int = _RULE_PAD):
@@ -672,6 +823,8 @@ class Scenario:
             return op.down_rounds == 0 or op.down_rounds >= qb
         if isinstance(op, RollingPartition):
             return op.phase_rounds >= qb
+        if isinstance(op, (ConfigPush, StagedRollout)):
+            return True                  # config data: no fault to cool
         return False
 
 
@@ -998,6 +1151,19 @@ def generate_scenario(seed: int, n: int = 32, severity: str = "moderate",
     # severity), and historical seeds keep their historical faults).
     if severity != "mild" and n >= 24 and rng.integers(0, 2):
         op_churn_arrivals()
+
+    # Metadata rung (PR 19): every tier additionally pushes one config
+    # word for half the seeds — a live owner (drawn from the untouched
+    # remainder of the pool) flips a key mid-faults, so the campaign
+    # invariant monitor exercises the KV plane under the tier's own
+    # chaos.  The draw TRAILS every existing one including the arrival
+    # coin above (the PR-10 rule: historical seeds keep their historical
+    # ops — the tier grows, it does not reshuffle).
+    if rng.integers(0, 2):
+        add("config_push", ConfigPush(
+            node=take(1)[0], key=0,
+            value=int(rng.integers(1, metadata.MD_VALUE_MAX + 1)),
+            at_round=int(rng.integers(4, 17))))
 
     # Horizon: every fault/disruption resolved, plus the completeness
     # bound and a margin — quantized so campaigns share compilations.

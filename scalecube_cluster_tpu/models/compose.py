@@ -837,10 +837,12 @@ _OBSERVER_PLANES = (
 
 def plane_registry() -> Tuple[dict, ...]:
     """Every plane the composed runner knows: the protocol core, the
-    knob-gated in-tick planes (incl. the rows models/sync.py and
-    models/lifeguard.py declare for themselves) and the observer
-    planes — name, kind, gating knobs, SwimState carry lanes."""
-    from scalecube_cluster_tpu.models import lifeguard, sync
+    knob-gated in-tick planes (incl. the rows models/sync.py,
+    models/lifeguard.py and models/metadata.py declare for themselves)
+    and the observer planes — name, kind, gating knobs, SwimState
+    carry lanes."""
+    from scalecube_cluster_tpu.models import lifeguard, metadata, sync
 
-    return _CORE_PLANES[:1] + (dict(sync.PLANE), dict(lifeguard.PLANE)) \
+    return _CORE_PLANES[:1] + (dict(sync.PLANE), dict(lifeguard.PLANE),
+                               dict(metadata.PLANE)) \
         + _CORE_PLANES[1:] + _OBSERVER_PLANES

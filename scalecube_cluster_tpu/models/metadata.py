@@ -1,0 +1,332 @@
+"""Metadata KV plane: versioned per-member config propagation.
+
+The reference's ``MetadataStoreImpl`` attaches a small KV map to every
+member and disseminates *versions* of it alongside membership: an
+updated member bumps its incarnation, peers notice the new record and
+re-fetch the map (MetadataStoreImpl.java; the oracle facade
+``oracle/metadata.py`` keeps those pull semantics for parity tests).
+The dense model cannot afford per-pair RPC fetches, so this plane ships
+the *payload itself* infection-style — the SWIM-paper dissemination
+substrate carrying config instead of liveness — with the SYNC
+anti-entropy full-table exchange (models/sync.py) guaranteeing
+convergence through partition heal exactly as it does for membership.
+
+Lanes and the packed word
+-------------------------
+``SwimParams.metadata_keys`` (M; 0 = the default = the plane compiles
+out) sizes a fixed-shape per-member KV lane:
+
+  ``md``        [n_local, K, M] int32 — observer i's belief about
+                subject k's M metadata cells, one packed word each;
+  ``md_spread`` [n_local, K]    int32 — the absolute round until which
+                row (i, k) is hot for piggyback gossip (the membership
+                ``spread_until`` rule applied per metadata row).
+
+Each cell is ONE packed int32 word (sign bit clear, so the wire's
+max-fold and the scatter fill value behave exactly like record keys)::
+
+    word = (epoch & 0x7F) << 24 | version << 10 | value
+    word == 0  <=>  unset
+
+``value`` is a 10-bit application payload (0..1023 — a config enum /
+shard-map generation, not a string store), ``version`` a 14-bit
+per-(slot, epoch) write counter saturating at 16383, ``epoch`` the low
+7 bits of the PR-10 identity epoch.  A version is meaningful only per
+(slot, epoch): the merge gate drops words whose epoch bits disagree
+with the receiver's current identity belief for that slot, and zeroes
+stale local cells on a belief change — a reused slot starts from an
+empty map at version 0, never inheriting the previous occupant's
+config (the identity-epoch rule that makes LWW sound under churn).
+
+Last-writer-wins by construction
+--------------------------------
+Within one (slot, epoch) the packed word is monotone in (version,
+value), so the merge is a plain ``jnp.maximum`` — associative and
+commutative, which is what lets the payload ride every existing
+delivery substrate unchanged: the scatter max-fold, the shift
+channels' per-message delivery, the pipelined double-buffer's deferred
+pmax, and the sharded combines.  Ties (same version, different value)
+deterministically prefer the larger value — a documented
+determinization of concurrent same-version writes; the owner is the
+only writer in this model (pushes land at the owner's own row), so
+ties do not occur on the write path.
+
+Dissemination — hot rows on gossip, full table on anti-entropy
+--------------------------------------------------------------
+Hot rows (``round < md_spread``) piggyback the gossip channels and the
+SYNC/refute channel, masked per sender exactly like hot membership
+records.  The FULL table rides only the anti-entropy paired exchange
+(``sync_interval > 0``) — which is the A/B story ``bench.py --rollout``
+measures: with the exchange off, a push that quiesced inside a
+partition is no longer hot at heal time and the stale half stays
+divergent forever (the membership tombstone argument of
+models/sync.py, verbatim, applied to config).
+
+No new PRNG draws, no new channels: the plane reuses the round's
+existing targets and drop masks, so ``metadata_keys=0`` bit-identity
+is structural — there is nothing to perturb.  Delivery is same-round
+only under ``max_delay_rounds`` (the anti-entropy precedent; config
+convergence is measured in rounds, not sub-round latency).
+
+Deviations, documented: values are small ints, not strings (fixed
+shape; the oracle parity map is int-valued str()s); propagation is
+push-payload, not pull-on-version (the reference's fetch RPC has no
+dense analog — convergence semantics, not wire timing, are the pinned
+contract); ``k_block`` (the >10M capacity path) excludes the plane —
+an [N, N, M] metadata table is itself infeasible at that scale
+(SwimParams.__post_init__ validates).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# Packed-word layout (see module docstring).  31 bits used; the sign
+# bit stays clear so packed words order like non-negative ints under
+# the wire's max-fold and the scatter fill (-1) stays strictly below
+# every real word.
+MD_VALUE_BITS = 10
+MD_VERSION_BITS = 14
+MD_EPOCH_BITS = 7
+MD_VALUE_MAX = (1 << MD_VALUE_BITS) - 1
+MD_VERSION_MAX = (1 << MD_VERSION_BITS) - 1
+MD_EPOCH_MASK = (1 << MD_EPOCH_BITS) - 1
+
+# This module's row in the composed-runner plane inventory
+# (models/compose.plane_registry): an IN-TICK plane — compiled into
+# ``swim_tick`` by its knob — with two carry lanes.  A plain dict (no
+# compose import: swim imports this module, compose imports swim).
+PLANE = dict(
+    name="metadata", kind="in-tick", knobs=("metadata_keys",),
+    lanes=("md", "md_spread"),
+    doc="per-member versioned KV (config) lane: LWW merge per "
+        "(slot, epoch) identity, hot rows on piggyback gossip, full "
+        "table on the anti-entropy exchange (metadata_keys > 0 arms "
+        "it)",
+)
+
+
+def pack_word(epoch, version, value):
+    """Pack (epoch, version, value) int32 lanes into one md word.
+
+    Callers clamp ``version``/``value`` to their field widths; ``epoch``
+    is masked to its low bits here (identity epochs grow without
+    bound, the word only needs enough to disambiguate a slot's recent
+    occupants — the wire-key epoch-bits argument).
+    """
+    ep = jnp.asarray(epoch, jnp.int32) & MD_EPOCH_MASK
+    return ((ep << (MD_VERSION_BITS + MD_VALUE_BITS))
+            | (jnp.asarray(version, jnp.int32) << MD_VALUE_BITS)
+            | jnp.asarray(value, jnp.int32))
+
+
+def word_epoch(word):
+    return (jnp.asarray(word, jnp.int32)
+            >> (MD_VERSION_BITS + MD_VALUE_BITS)) & MD_EPOCH_MASK
+
+
+def word_version(word):
+    return (jnp.asarray(word, jnp.int32) >> MD_VALUE_BITS) & MD_VERSION_MAX
+
+
+def word_value(word):
+    return jnp.asarray(word, jnp.int32) & MD_VALUE_MAX
+
+
+def initial_lanes(params, n_local: int):
+    """The plane's carry slice for ``initial_state``: empty tables.
+
+    Off (``metadata_keys == 0``): zero-size lanes — zero bytes, zero
+    compute, and every lane op below is statically gated out (the
+    ``initial_epoch`` zero-size pattern).
+    """
+    m = params.metadata_keys
+    if m == 0:
+        return dict(md=jnp.zeros((n_local, 0, 0), dtype=jnp.int32),
+                    md_spread=jnp.zeros((n_local, 0), dtype=jnp.int32))
+    k = params.n_subjects
+    return dict(md=jnp.zeros((n_local, k, m), dtype=jnp.int32),
+                md_spread=jnp.zeros((n_local, k), dtype=jnp.int32))
+
+
+def inject_pushes(md, md_spread, round_idx, params, world, node_ids,
+                  own_epoch, alive_here):
+    """Apply the world's scheduled config pushes landing this round.
+
+    A push is an OWNER-LOCAL write (the reference's updateMetadata runs
+    on the member itself): at ``md_push_at[p]`` node ``md_push_node[p]``
+    writes ``md_push_value[p]`` into its own row's cell
+    ``md_push_key[p]`` at version ``stored + 1`` (saturating) under its
+    current identity epoch, and opens the row's gossip window.  The
+    schedule length P is static and small, so the loop unrolls.  A
+    crashed member cannot push config — ``alive_here`` gates the write
+    like the user-gossip spread() injection (the oracle's stopped
+    member runs nothing).
+
+    Pure in (md, md_spread, round_idx): the pipelined send/recv halves
+    re-derive the identical injection from the same carried state, the
+    same way the self-pin does.
+    """
+    n_push = world.md_push_at.shape[0]
+    if n_push == 0 or params.metadata_keys == 0:
+        return md, md_spread
+    k = params.n_subjects
+    m = params.metadata_keys
+    own_col = (jnp.arange(k, dtype=jnp.int32)[None, :]
+               == node_ids[:, None])                        # [n_local, K]
+    own_ep = (jnp.asarray(own_epoch, jnp.int32) if own_epoch is not None
+              else jnp.zeros(node_ids.shape, jnp.int32))
+    for p in range(n_push):
+        here = ((node_ids == world.md_push_node[p])
+                & (round_idx == world.md_push_at[p])
+                & alive_here)                               # [n_local]
+        key_onehot = (jnp.arange(m, dtype=jnp.int32)
+                      == world.md_push_key[p])              # [M]
+        cell = (here[:, None, None] & own_col[:, :, None]
+                & key_onehot[None, None, :])                # [n_local,K,M]
+        new_ver = jnp.minimum(word_version(md) + 1, MD_VERSION_MAX)
+        new_word = pack_word(own_ep[:, None, None], new_ver,
+                             world.md_push_value[p])
+        md = jnp.where(cell, new_word, md)
+        md_spread = jnp.where(
+            here[:, None] & own_col,
+            round_idx + 1 + params.periods_to_spread, md_spread,
+        )
+    return md, md_spread
+
+
+def hot_payload(md, md_spread, round_idx):
+    """[n_local, K*M] flattened gossip payload: hot rows only.
+
+    Sender-side mask exactly like hot membership records; sender
+    liveness/partition/loss gating is the delivering channel's own
+    mask, shared with the membership payload (no new draws).
+    """
+    n_local, k, m = md.shape
+    hot = (round_idx < md_spread)[:, :, None]
+    return jnp.where(hot, md, 0).reshape(n_local, k * m)
+
+
+def full_payload(md):
+    """[n_local, K*M] flattened anti-entropy payload: the full table."""
+    n_local, k, m = md.shape
+    return md.reshape(n_local, k * m)
+
+
+def merge(md, md_spread, arrivals_flat, round_idx, params, is_self,
+          epoch_belief, frozen_rows):
+    """Fold one round's delivered metadata words into the carry.
+
+    ``arrivals_flat`` [n_local, K*M] is the max-folded delivery buffer
+    (scatter fill -1 clamps to the unset word).  Gates, in order:
+
+      1. *identity*: a word whose epoch bits disagree with the
+         receiver's POST-MERGE identity belief for the slot is dropped,
+         and stale local cells are zeroed on a belief change (versions
+         are per (slot, epoch); a reused slot starts empty);
+      2. *self-pin*: a member never accepts external words about its
+         OWN cells — it is the sole authority for its map (the
+         reference's metadata lives on the owner);
+      3. *LWW*: ``jnp.maximum`` — the packed word is monotone in
+         (version, value) within one epoch.
+
+    Strictly-improved rows open a gossip window; frozen (crashed/left)
+    rows keep their old lanes like every other carry field.  Returns
+    ``(md, md_spread)``.
+    """
+    n_local, k, m = md.shape
+    arr = jnp.maximum(arrivals_flat.reshape(n_local, k, m), 0)
+    if params.epoch_bits and epoch_belief is not None:
+        belief = jnp.asarray(epoch_belief, jnp.int32) & MD_EPOCH_MASK
+        arr = jnp.where(
+            (arr != 0) & (word_epoch(arr) == belief[:, :, None]), arr, 0
+        )
+        md = jnp.where(
+            (md != 0) & (word_epoch(md) != belief[:, :, None]), 0, md
+        )
+    arr = jnp.where(is_self[:, :, None], 0, arr)
+    new_md = jnp.maximum(md, arr)
+    improved = jnp.any(new_md != md, axis=2)                # [n_local, K]
+    new_spread = jnp.where(
+        improved, round_idx + 1 + params.periods_to_spread, md_spread
+    )
+    fz = frozen_rows[:, None]
+    new_md = jnp.where(fz[:, :, None], md, new_md)
+    new_spread = jnp.where(fz, md_spread, new_spread)
+    return new_md, new_spread
+
+
+def owner_words(md, node_ids, n_members: int, offset=0, axis_name=None):
+    """[N, M] ground-truth table: each owner's words about itself.
+
+    The owner's own row is the authority (pushes land there; the
+    self-pin keeps it so).  Sharded: each device contributes its local
+    diagonal block and one pmax assembles the full table.
+    """
+    # Full view: column j is node j, so each row's own column index IS
+    # its global node id.
+    diag = jnp.take_along_axis(md, node_ids[:, None, None], axis=1)[:, 0, :]
+    buf = jnp.zeros((n_members, md.shape[2]), dtype=jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, diag, (offset, 0))
+    if axis_name is not None:
+        buf = jax.lax.pmax(buf, axis_name)
+    return buf
+
+
+def divergent_count(md, node_ids, alive, alive_here, n_members: int,
+                    offset=0, axis_name=None):
+    """int32 scalar: (live observer, live owner, key) cells where the
+    observer's word differs from the owner's own word — 0 iff every
+    live member agrees with every live owner's map (the convergence
+    observable; the ``metadata_divergent`` metric).  Globally reduced
+    (one psum) when ``axis_name`` is set.
+    """
+    owners = owner_words(md, node_ids, n_members, offset=offset,
+                         axis_name=axis_name)
+    owner_live = jnp.asarray(alive, jnp.bool_)              # [N]
+    cell = (md != owners[None, :, :]) \
+        & alive_here[:, None, None] & owner_live[None, :, None]
+    count = jnp.sum(cell, dtype=jnp.int32)
+    if axis_name is not None:
+        count = jax.lax.psum(count, axis_name)
+    return count
+
+
+# --------------------------------------------------------------------------
+# Host-side convergence probes (the bench poll loop)
+# --------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("params",))
+def divergence_probe(state, params, world, n_rounds):
+    """Divergent-cell count of a finished carry at cursor ``n_rounds``
+    — the probe ``bench.py --rollout`` polls between run segments
+    (the sync-plane divergence_probe pattern: dynamic cursor, no
+    recompile per poll).  Single-device full view.
+    """
+    cursor = jnp.asarray(n_rounds, jnp.int32)
+    n = params.n_members
+    node_ids = jnp.arange(n, dtype=jnp.int32)
+    alive = world.alive_at(cursor)
+    return divergent_count(state.md, node_ids, alive, alive, n)
+
+
+@partial(jax.jit, static_argnames=("params",))
+def member_converged(state, params, world, n_rounds):
+    """[N] bool: live members whose FULL metadata view agrees with
+    every live owner's own words — the per-member observable behind
+    ``metadata_convergence_p99`` (the p99 is over members' first
+    converged poll, measured by the bench's segment loop).  A dead
+    observer reports converged (it is not a member of the SLO
+    population).
+    """
+    cursor = jnp.asarray(n_rounds, jnp.int32)
+    n = params.n_members
+    node_ids = jnp.arange(n, dtype=jnp.int32)
+    alive = world.alive_at(cursor)
+    owners = owner_words(state.md, node_ids, n)
+    mismatch = (state.md != owners[None, :, :]) & alive[None, :, None]
+    return ~(jnp.any(mismatch, axis=(1, 2)) & alive)

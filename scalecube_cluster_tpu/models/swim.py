@@ -104,6 +104,7 @@ import jax.numpy as jnp
 
 from scalecube_cluster_tpu import records
 from scalecube_cluster_tpu.models import lifeguard
+from scalecube_cluster_tpu.models import metadata
 from scalecube_cluster_tpu.models import sync as sync_plane
 from scalecube_cluster_tpu.ops import delivery, prng, ring as ring_ops, \
     shift as shift_ops
@@ -381,6 +382,19 @@ class SwimParams:
     # own self_inc cannot be about the current occupant —
     # chaos/monitor.NO_RESURRECTION / JOIN_COMPLETENESS).
     epoch_guard: bool = True
+    # Metadata KV plane (models/metadata.py): M fixed-shape per-member
+    # config cells, LWW-versioned per the (slot, epoch) identity, hot
+    # rows piggybacking the gossip channels and the full table riding
+    # the anti-entropy exchange (sync_interval > 0) — the reference's
+    # MetadataStoreImpl as infection-style payload dissemination.
+    # 0 (the default) compiles the plane OUT entirely: zero-size
+    # ``md``/``md_spread`` lanes, no extra draws (the plane reuses the
+    # round's existing targets and drop masks), every layout and run
+    # shape bit-identical to the plane-less tick
+    # (tests/test_metadata_plane.py).  Requires full view (column j IS
+    # node j — the owner-row authority rule) and excludes k_block (an
+    # [N, N, M] table has no place on the >10M capacity path).
+    metadata_keys: int = 0
 
     def __post_init__(self):
         if self.delivery not in ("scatter", "shift"):
@@ -451,6 +465,27 @@ class SwimParams:
                 "wire24 and int16_wire are distinct rungs of the wire-"
                 "format ladder (24-bit vs 16-bit wire keys) — pick one"
             )
+        if self.metadata_keys < 0:
+            raise ValueError(
+                f"metadata_keys must be >= 0 (0 = metadata plane off; "
+                f"got {self.metadata_keys})"
+            )
+        if self.metadata_keys > 0:
+            if not self.full_view:
+                raise ValueError(
+                    "the metadata plane requires full view (n_subjects == "
+                    "n_members): column j is node j, which is what makes "
+                    "the owner's own row the table authority "
+                    f"(got n_subjects={self.n_subjects}, "
+                    f"n_members={self.n_members})"
+                )
+            if self.k_block:
+                raise ValueError(
+                    "metadata_keys > 0 excludes k_block: the blocked "
+                    "capacity path targets table sizes where an "
+                    "[N, N, M] metadata lane is itself infeasible "
+                    "(models/metadata.py docstring)"
+                )
         if self.compact_carry:
             if self.periods_to_spread + 1 > 127:
                 raise ValueError(
@@ -579,7 +614,7 @@ class Knobs:
     grid with ZERO recompiles (tune/search.py — knob values are traced
     operands, so the compiled program is knob-oblivious).
 
-    Static-vs-dynamic, all 31 ``SwimParams`` fields (why each side):
+    Static-vs-dynamic, all 32 ``SwimParams`` fields (why each side):
 
     ==================== === =====================================
     field                dyn one-line reason
@@ -639,6 +674,9 @@ class Knobs:
                              stay compile-time
     open_world           no  identity-epoch lane/wire layout
     epoch_guard          no  wire-key layout (epoch field width)
+    metadata_keys        no  md lane shape ([N, K, M]) and the
+                             0-vs-on plane off-switch (the
+                             sync_interval bit-identity rationale)
     ==================== === =====================================
 
     Each dynamic knob with a static ceiling is masked/clamped at its
@@ -959,6 +997,14 @@ class SwimWorld:
         INT32_MAX = never).  The slot must be scheduled dead strictly
         before the join (``with_join`` validates); one join per slot
         per run, so ``epoch_at`` is a single threshold per slot.
+      - ``md_push_at``/``md_push_node``/``md_push_key``/``md_push_value``
+        [P] int32: the metadata-plane config-push schedule
+        (``SwimParams.metadata_keys``; ``with_metadata_push`` appends):
+        at round ``md_push_at[p]`` node ``md_push_node[p]`` writes
+        ``md_push_value[p]`` into its own metadata cell
+        ``md_push_key[p]`` at the next version — the batched analog of
+        ``Cluster.updateMetadata`` (MetadataStoreImpl).  Empty (the
+        default) means no pushes; ignored when the plane is off.
     """
 
     down_from: jnp.ndarray
@@ -973,6 +1019,10 @@ class SwimWorld:
     gossip_origin: jnp.ndarray
     gossip_spread_at: jnp.ndarray
     join_at: jnp.ndarray = None
+    md_push_at: jnp.ndarray = None
+    md_push_node: jnp.ndarray = None
+    md_push_key: jnp.ndarray = None
+    md_push_value: jnp.ndarray = None
 
     @staticmethod
     def healthy(params: SwimParams,
@@ -999,6 +1049,10 @@ class SwimWorld:
             gossip_origin=jnp.arange(g, dtype=jnp.int32) % max(n, 1),
             gossip_spread_at=jnp.full((g,), INT32_MAX, dtype=jnp.int32),
             join_at=jnp.full((n,), INT32_MAX, dtype=jnp.int32),
+            md_push_at=jnp.zeros((0,), dtype=jnp.int32),
+            md_push_node=jnp.zeros((0,), dtype=jnp.int32),
+            md_push_key=jnp.zeros((0,), dtype=jnp.int32),
+            md_push_value=jnp.zeros((0,), dtype=jnp.int32),
         )
 
     def with_spread(self, gossip_idx: int, origin, at_round: int) -> "SwimWorld":
@@ -1160,6 +1214,51 @@ class SwimWorld:
         """[N] bool: slots whose JOIN fires exactly this round."""
         return self.join_at == round_idx
 
+    def with_metadata_push(self, node, key: int, value: int,
+                           at_round: int) -> "SwimWorld":
+        """Schedule a config push: ``node`` writes ``value`` into its own
+        metadata cell ``key`` at ``at_round`` (Cluster.updateMetadata;
+        ``SwimParams.metadata_keys`` must cover ``key`` for the push to
+        take effect — models/metadata.inject_pushes).  APPENDS to the
+        schedule (multiple pushes compose; the schedule length is a
+        static program shape, so vary it sparingly).  Ids are
+        range-checked like every other schedule; ``value`` must fit the
+        10-bit payload field and ``key``/``at_round`` be non-negative
+        (the packed-word layout, models/metadata.py docstring)."""
+        node_ids = self._checked_node_ids(node, "with_metadata_push")
+        if node_ids.shape[0] != 1:
+            raise ValueError(
+                "with_metadata_push schedules ONE push per call (a push "
+                "is one owner-local write; compose calls for fleets)")
+        key, value, at_round = int(key), int(value), int(at_round)
+        if key < 0:
+            raise ValueError(f"with_metadata_push: key {key} must be >= 0")
+        if not 0 <= value <= metadata.MD_VALUE_MAX:
+            raise ValueError(
+                f"with_metadata_push: value {value} outside the "
+                f"{metadata.MD_VALUE_BITS}-bit payload field "
+                f"[0, {metadata.MD_VALUE_MAX}]")
+        if at_round < 0:
+            raise ValueError(
+                f"with_metadata_push: at_round {at_round} must be >= 0")
+
+        def app(arr, v):
+            base = (jnp.zeros((0,), dtype=jnp.int32) if arr is None else arr)
+            return jnp.concatenate(
+                [base, jnp.asarray([v], dtype=jnp.int32)])
+
+        return dataclasses.replace(
+            self,
+            md_push_at=app(self.md_push_at, at_round),
+            md_push_node=jnp.concatenate([
+                (jnp.zeros((0,), dtype=jnp.int32)
+                 if self.md_push_node is None else self.md_push_node),
+                node_ids,
+            ]),
+            md_push_key=app(self.md_push_key, key),
+            md_push_value=app(self.md_push_value, value),
+        )
+
     def with_partition_schedule(self, partition_of, phase_rounds: int):
         partition_of = jnp.asarray(partition_of, dtype=jnp.int8)
         if partition_of.ndim == 1:
@@ -1218,7 +1317,8 @@ jax.tree_util.register_dataclass(
         "down_from", "down_until", "leave_at", "partition_of",
         "partition_phase_rounds", "faults", "seed_ids",
         "subject_ids", "slot_of_node", "gossip_origin", "gossip_spread_at",
-        "join_at",
+        "join_at", "md_push_at", "md_push_node", "md_push_key",
+        "md_push_value",
     ],
     meta_fields=[],
 )
@@ -1278,6 +1378,16 @@ class SwimState:
                         int16 under compact_carry (the lhm-lane dtype
                         pattern), int32 otherwise; zero-size
                         ([N, 0] int32) when the plane is compiled out.
+    ``md``              [N, K, M] int32: metadata KV lane — observer's
+                        packed (epoch, version, value) word per subject
+                        cell (params.metadata_keys; models/metadata.py).
+                        Always int32 absolute in BOTH carry layouts (the
+                        packed word IS the stored form); zero-size
+                        ([N, 0, 0]) when the plane is compiled out.
+    ``md_spread``       [N, K] int32: per-(observer, subject) metadata
+                        gossip window (the ``spread_until`` rule applied
+                        to metadata rows); int32 absolute in both
+                        layouts, zero-size ([N, 0]) when off.
     """
 
     status: jnp.ndarray
@@ -1292,13 +1402,16 @@ class SwimState:
     g_ring: jnp.ndarray
     lhm: jnp.ndarray
     epoch: jnp.ndarray
+    md: jnp.ndarray
+    md_spread: jnp.ndarray
 
 
 jax.tree_util.register_dataclass(
     SwimState,
     data_fields=["status", "inc", "spread_until", "suspect_deadline",
                  "self_inc", "inbox_ring", "flag_ring",
-                 "g_infected", "g_spread_until", "g_ring", "lhm", "epoch"],
+                 "g_infected", "g_spread_until", "g_ring", "lhm", "epoch",
+                 "md", "md_spread"],
     meta_fields=[],
 )
 
@@ -1354,6 +1467,7 @@ def initial_state(params: SwimParams, world: SwimWorld,
         g_ring=jnp.zeros((gd_slots, n, g), dtype=jnp.bool_),
         lhm=lifeguard.initial_lhm(params),
         epoch=initial_epoch(params),
+        **metadata.initial_lanes(params, n),
     )
     # The ring stores wire-format keys; the int16 wire (compact_carry or
     # int16_wire) makes its delayed slots int16 (records.merge_key16).
@@ -1722,12 +1836,22 @@ def _apply_joins(state: SwimState, round_idx, params: SwimParams,
         g_spread_until = jnp.where(jrow[:, :1], 0, state.g_spread_until)
         if state.g_ring.shape[0] > 0:
             g_ring = jnp.where(jrow[None, :, :1], False, state.g_ring)
+    md, md_spread = state.md, state.md_spread
+    if params.metadata_keys > 0:
+        # A reborn slot starts with an EMPTY metadata table (the reference
+        # seeds a fresh MetadataStore per member): its own words are re-
+        # published by the next ConfigPush under the new epoch, and stale
+        # words about it die at receivers via the epoch gate in
+        # metadata.merge.
+        md = jnp.where(jrow[:, :1, None], 0, state.md)
+        md_spread = jnp.where(jrow[:, :1], 0, state.md_spread)
     return SwimState(
         status=status, inc=inc, spread_until=spread,
         suspect_deadline=deadline, self_inc=self_inc,
         inbox_ring=inbox_ring, flag_ring=flag_ring,
         g_infected=g_infected, g_spread_until=g_spread_until,
         g_ring=g_ring, lhm=lhm, epoch=epoch,
+        md=md, md_spread=md_spread,
     )
 
 
@@ -1836,6 +1960,19 @@ def _round_context(state: SwimState, round_idx, base_key,
                 state.g_spread_until,
             ),
         )
+
+    # Scheduled config pushes (SwimWorld.with_metadata_push): owner-
+    # local writes applied in this shared preamble, so the pipelined
+    # halves re-derive the identical injection from the same carried
+    # state — the same argument as the self-record pin above
+    # (metadata.inject_pushes is pure in (md, md_spread, round_idx)).
+    if (params.metadata_keys > 0 and world.md_push_at is not None
+            and world.md_push_at.shape[0] > 0):
+        md, md_spread = metadata.inject_pushes(
+            state.md, state.md_spread, round_idx, params, world,
+            node_ids, own_epoch, alive_here,
+        )
+        state = dataclasses.replace(state, md=md, md_spread=md_spread)
 
     # ping_every/sync_every <= 0 disable the phase entirely (a plain
     # modulo sentinel like INT32_MAX would still fire at round 0).
@@ -2117,6 +2254,13 @@ def _round_metrics(new_state: SwimState, status, aux, params: SwimParams,
         metrics["user_gossip_infected"] = global_sum(
             jnp.sum(new_state.g_infected, axis=0, dtype=jnp.int32)
         )
+    if params.metadata_keys > 0:
+        # Metadata convergence observable (models/metadata.py): the
+        # count of (live observer, live owner, key) cells disagreeing
+        # with the owner's own word — computed in the tick bodies where
+        # the shard offset lives, ALREADY globally reduced (one psum
+        # inside divergent_count), so no global_sum here.
+        metrics["metadata_divergent"] = aux["metadata_divergent"]
     return metrics
 
 
@@ -2129,7 +2273,7 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
                       params, kn, world, node_ids, alive_here, is_self,
                       inbox_ring=None, flag_ring=None,
                       g_delivered=None, g_ring=None, lhm_signals=None,
-                      epoch=None, own_epoch=None):
+                      epoch=None, own_epoch=None, md_delivered=None):
     """Inbox merge, self-refutation, suspicion timers, crash/leave freeze.
 
     Shared tail of both delivery modes; all elementwise on [n_local, K].
@@ -2145,6 +2289,10 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
     (``_round_context``) — the merge gate resolves identities with
     them and the updated lane lands in the carry; None (plane off)
     leaves the zero-size lane untouched.
+    ``md_delivered`` [n_local, K*M] int32 (metadata plane on): the
+    round's max-folded metadata arrivals, LWW-merged against the
+    receiver's POST-merge identity beliefs (metadata.merge); None
+    leaves the md lanes untouched.
     Returns (new_state, refuted[n_local] bool).
     """
     # Dead-member suppression window (SwimParams.dead_suppress_rounds):
@@ -2296,6 +2444,19 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
             alive_here, knob_lhm_cap(kn, params),
         )
 
+    # Metadata LWW merge (models/metadata.py): gated on the receiver's
+    # POST-merge identity beliefs, so a round that both learns a slot's
+    # new epoch and delivers its fresh config accepts the config (and
+    # zeroes the stale words) in the same round.
+    new_md, new_md_spread = state.md, state.md_spread
+    if params.metadata_keys > 0 and md_delivered is not None:
+        new_md, new_md_spread = metadata.merge(
+            state.md, state.md_spread, md_delivered, round_idx, params,
+            is_self,
+            (new_epoch if new_epoch is not None else None),
+            ~alive_here,
+        )
+
     new_state = SwimState(
         status=new_status.astype(jnp.int8),
         inc=new_inc.astype(jnp.int32),
@@ -2310,6 +2471,7 @@ def _merge_and_timers(state, status, inc, inbox, inbox_alive, round_idx,
         lhm=new_lhm,
         epoch=(state.epoch if new_epoch is None
                else new_epoch.astype(jnp.int32)),
+        md=new_md, md_spread=new_md_spread,
     )
     return new_state, refuted
 
@@ -2725,9 +2887,21 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
     if params.lhm_max > 0:
         lg = dict(lhm_fail=probes_sent & ~direct_ok,
                   lhm_clean=probes_sent & direct_ok)
+    # Metadata plane payloads (models/metadata.py): hot rows piggyback
+    # the gossip + sync channels, the full table rides the anti-entropy
+    # exchange — same targets, same drop masks, no new draws (the
+    # structural metadata_keys=0 bit-identity argument).
+    mdp = {}
+    if params.metadata_keys > 0:
+        mdp = dict(
+            md_hot=metadata.hot_payload(state.md, state.md_spread,
+                                        round_idx),
+            md_full=metadata.full_payload(state.md),
+        )
     return dict(
         **ae,
         **lg,
+        **mdp,
         gossip_keys=gossip_keys, sync_keys=sync_keys,
         gossip_targets=gossip_targets, gossip_drop=gossip_drop,
         sync_target=sync_target, sync_drop=sync_drop,
@@ -2764,6 +2938,13 @@ def _scatter_channel_bufs(s, params, gossip_extra_drop, sync_extra_drop,
     collectives and rides the pipelined double-buffer unchanged.  Its
     delivery is same-round only (models/sync.py docstring), so the
     delay path passes ``ae_suppress=True`` for every bin after 0.
+
+    Returns ``(buf, fbuf, md_buf)``.  ``md_buf`` [N, K*M] int32 (fill
+    -1) is the metadata plane's contribution (``metadata_keys > 0``,
+    else None): hot rows through the gossip + sync channels, the full
+    table through the anti-entropy channels — the identical targets and
+    drop masks, folded with the same associative max.  Metadata is
+    same-round only like the anti-entropy plane, so only bin 0 reads it.
     """
     n = params.n_members
     g_drop = s["gossip_drop"] | gossip_extra_drop
@@ -2779,8 +2960,21 @@ def _scatter_channel_bufs(s, params, gossip_extra_drop, sync_extra_drop,
             delivery.scatter_max(s["sync_keys"], s["ae_targets"],
                                  s["ae_drop"], n),
         )
+    md_buf = None
+    if params.metadata_keys > 0:
+        md_buf = jnp.maximum(
+            delivery.scatter_max(s["md_hot"], s["gossip_targets"],
+                                 g_drop, n),
+            delivery.scatter_max(s["md_hot"], s["sync_target"], s_drop, n),
+        )
+        if params.sync_interval > 0 and not ae_suppress:
+            md_buf = jnp.maximum(
+                md_buf,
+                delivery.scatter_max(s["md_full"], s["ae_targets"],
+                                     s["ae_drop"], n),
+            )
     if params.fused_wire:
-        return buf, None
+        return buf, None, md_buf
     fbuf = (
         delivery.scatter_or(s["alive_flags"], s["gossip_targets"],
                             g_drop, n)
@@ -2791,7 +2985,7 @@ def _scatter_channel_bufs(s, params, gossip_extra_drop, sync_extra_drop,
         fbuf = fbuf | delivery.scatter_or(
             s["sync_alive_flags"], s["ae_targets"], s["ae_drop"], n
         )
-    return buf, fbuf.astype(jnp.int8)
+    return buf, fbuf.astype(jnp.int8), md_buf
 
 
 def _scatter_send_aux(s, params):
@@ -2846,16 +3040,18 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
     )
 
     def channel_bufs(gossip_extra_drop, sync_extra_drop, ae_suppress=False):
-        buf, fbuf = _scatter_channel_bufs(s, params, gossip_extra_drop,
-                                          sync_extra_drop,
-                                          ae_suppress=ae_suppress)
+        buf, fbuf, md_buf = _scatter_channel_bufs(s, params,
+                                                  gossip_extra_drop,
+                                                  sync_extra_drop,
+                                                  ae_suppress=ae_suppress)
         # Fused wire: ONE combined buffer per bin (fbuf is None — the
         # merge gate derives the ALIVE flag from the winner key).
-        return combine_max(buf), (None if fbuf is None
-                                  else combine_max(fbuf))
+        return (combine_max(buf),
+                None if fbuf is None else combine_max(fbuf),
+                None if md_buf is None else combine_max(md_buf))
 
     if params.max_delay_rounds == 0:
-        inbox, inbox_alive8 = channel_bufs(False, False)
+        inbox, inbox_alive8, md_delivered = channel_bufs(False, False)
         inbox_alive = (None if inbox_alive8 is None
                        else inbox_alive8.astype(jnp.bool_))
     else:
@@ -2870,15 +3066,20 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
                    jax.random.fold_in(s["k_sync_drop"], 7), delay_s,
                    params.round_ms, params.max_delay_rounds,
                    (n_local,)))[:, None]
-        inbox, inbox_alive8 = channel_bufs(q_g != 0, q_s != 0)
+        # Metadata is same-round only like the anti-entropy exchange:
+        # the bin-0 call below is its one delivery (a delayed message
+        # carries membership but not the md piggyback — module
+        # docstring deviation; convergence is measured in rounds).
+        inbox, inbox_alive8, md_delivered = channel_bufs(q_g != 0,
+                                                         q_s != 0)
         inbox = jnp.maximum(inbox, inbox_now)
         inbox_alive = (None if inbox_alive8 is None
                        else inbox_alive8.astype(jnp.bool_) | flags_now)
         d = params.max_delay_rounds + 1
         for j in range(1, d):
             # The anti-entropy exchange is same-round only (bin 0).
-            buf_j, fbuf_j = channel_bufs(q_g != j, q_s != j,
-                                         ae_suppress=True)
+            buf_j, fbuf_j, _ = channel_bufs(q_g != j, q_s != j,
+                                            ae_suppress=True)
             if fbuf_j is None:
                 # Fused wire: the flag ring is dead weight — future
                 # flags rederive from the ring's key slots at open time
@@ -2942,12 +3143,19 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
         g_delivered=g_delivered, g_ring=g_ring_new,
         lhm_signals=((s["lhm_fail"], s["lhm_clean"])
                      if params.lhm_max > 0 else None),
-        epoch=epoch, own_epoch=own_epoch,
+        epoch=epoch, own_epoch=own_epoch, md_delivered=md_delivered,
     )
     aux = dict(
         _scatter_send_aux(s, params),
         refutations=jnp.sum(refuted & alive_here, dtype=jnp.int32),
     )
+    if params.metadata_keys > 0:
+        # Already globally reduced (one psum inside when sharded) —
+        # _round_metrics passes it through without re-summing.
+        aux["metadata_divergent"] = metadata.divergent_count(
+            new_state.md, node_ids, alive, alive_here, n,
+            offset=offset, axis_name=axis_name,
+        )
     if params.link_counters:
         # Per-sender wire accounting (SwimParams.link_counters docstring).
         # A gossip message exists per active channel when the sender is
@@ -3061,7 +3269,7 @@ def swim_tick_send(state: SwimState, round_idx, base_key,
                             ctx["known_live"], ctx["is_seed"],
                             ctx["keys"], offset,
                             k_channel=ctx["k_shifts"], epoch=ctx["epoch"])
-    buf, fbuf = _scatter_channel_bufs(s, params, False, False)
+    buf, fbuf, md_buf = _scatter_channel_bufs(s, params, False, False)
     # FD verdicts are observer-local: fold them into the owner's row
     # block of the pending buffer (serial folds after the combine; max
     # commutes with the pmax because no other device writes fd values
@@ -3075,6 +3283,11 @@ def swim_tick_send(state: SwimState, round_idx, base_key,
     pending = dict(keys=buf)
     if fbuf is not None:
         pending["flags"] = fbuf
+    if md_buf is not None:
+        # Metadata contribution crosses the round boundary uncombined,
+        # exactly like the key buffer (max is associative; the deferred
+        # pmax runs in the recv half).
+        pending["md"] = md_buf
     if params.n_user_gossips > 0:
         pending["g_bits"] = delivery.scatter_or(
             s["hot_g"], s["gossip_targets"], s["gossip_drop"],
@@ -3129,6 +3342,9 @@ def swim_tick_recv(state: SwimState, pending, send_aux, round_idx,
     g_delivered = None
     if params.n_user_gossips > 0:
         g_delivered = combine_max(pending["g_bits"]).astype(jnp.bool_)
+    md_delivered = None
+    if params.metadata_keys > 0:
+        md_delivered = combine_max(pending["md"])
 
     new_state, refuted = _merge_and_timers(
         ctx["state"], ctx["status"], ctx["inc"], inbox, inbox_alive,
@@ -3137,11 +3353,19 @@ def swim_tick_recv(state: SwimState, pending, send_aux, round_idx,
         lhm_signals=((pending["lhm_fail"], pending["lhm_clean"])
                      if params.lhm_max > 0 else None),
         epoch=ctx["epoch"], own_epoch=ctx["own_epoch"],
+        md_delivered=md_delivered,
     )
     aux = dict(
         send_aux,
         refutations=jnp.sum(refuted & ctx["alive_here"], dtype=jnp.int32),
     )
+    if params.metadata_keys > 0:
+        # Globally reduced inside (psum) — _round_metrics passes through.
+        aux["metadata_divergent"] = metadata.divergent_count(
+            new_state.md, ctx["node_ids"], ctx["alive"],
+            ctx["alive_here"], params.n_members,
+            offset=offset, axis_name=axis_name,
+        )
     metrics = _round_metrics(new_state, ctx["status"], aux, params, world,
                              ctx["alive"], ctx["alive_here"], axis_name)
     if params.compact_carry:
@@ -3386,6 +3610,19 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         hot_any_local = hot_any_local | jnp.any(hot_g, axis=1)
     h_hot_any = eng.prep(hot_any_local)
     h_status = eng.prep(status) if gate_contacts else None
+    # Metadata plane payloads (models/metadata.py): hot rows on the
+    # gossip + sync/refute channels, the full table on the anti-entropy
+    # exchange — the same channels, shifts, and gates, no new draws.
+    # Same-round delivery only (the anti-entropy precedent): the
+    # per-channel ok_*_now masks below exclude delayed messages.
+    h_md_hot = h_md_full = None
+    md_delivered = None
+    if params.metadata_keys > 0:
+        h_md_hot = eng.prep(
+            metadata.hot_payload(state.md, state.md_spread, round_idx))
+        h_md_full = eng.prep(metadata.full_payload(state.md))
+        md_delivered = jnp.zeros(
+            (n_local, k * params.metadata_keys), dtype=jnp.int32)
 
     def deliver_channel(s, tx_bit):
         """(payload, alive-flags) of the channel at shift ``s`` whose
@@ -3477,6 +3714,11 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         inbox_alive |= delivered_flags & ok_now[:, None]
         if g_bits_c is not None:
             g_delivered = g_delivered | (g_bits_c & ok_now[:, None])
+        if h_md_hot is not None:
+            md_delivered = jnp.maximum(
+                md_delivered,
+                jnp.where(ok_now[:, None], eng.deliver(h_md_hot, s), 0),
+            )
         n_gossip_sent += jnp.sum(
             ok_c & eng.deliver(h_hot_any, s), dtype=jnp.int32,
         )
@@ -3520,14 +3762,22 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         )
         contrib = jnp.where(ok_r_now[:, None], delivered_r, no_msg)
         fcontrib = flags_r & ok_r_now[:, None]
+        md_contrib = None
+        if h_md_hot is not None:
+            # The refute push is a SYNC to the suspected member; the md
+            # hot rows ride it like any other sync payload.
+            md_contrib = jnp.where(ok_r_now[:, None],
+                                   eng.deliver(h_md_hot, fd_shift), 0)
         lost_r_mask = pushing_r & (wire_drop_r | ~part_ok_r)
         return contrib, fcontrib, ring_, fring_, \
-            eng.deliver(h_pushers, sync_shift), lost_r_mask
+            eng.deliver(h_pushers, sync_shift), lost_r_mask, md_contrib
 
     (refute_contrib, refute_flags, ring, fring, sender_refuting,
-     refute_lost_r) = refute_deliver((ring, fring))
+     refute_lost_r, refute_md) = refute_deliver((ring, fring))
     inbox = jnp.maximum(inbox, refute_contrib)
     inbox_alive |= refute_flags
+    if refute_md is not None:
+        md_delivered = jnp.maximum(md_delivered, refute_md)
     if counters_on:
         # The refute push is sender-local (the pusher mask IS per sender);
         # only its in-flight loss needs unshifting back from the receiver.
@@ -3576,6 +3826,11 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         inbox, jnp.where(ok_s_now[:, None], delivered, no_msg)
     )
     inbox_alive |= delivered_flags & ok_s_now[:, None]
+    if h_md_hot is not None:
+        md_delivered = jnp.maximum(
+            md_delivered,
+            jnp.where(ok_s_now[:, None], eng.deliver(h_md_hot, s), 0),
+        )
 
     # Anti-entropy plane: the paired full-table exchange (models/sync.py)
     # as two extra syncable-payload channels at the shared offset ±s —
@@ -3616,6 +3871,14 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
                 inbox, jnp.where(ok_ae[:, None], delivered_ae, no_msg)
             )
             inbox_alive |= flags_ae & ok_ae[:, None]
+            if h_md_full is not None:
+                # The FULL metadata table rides the exchange — the
+                # convergence-through-heal guarantee (module docstring).
+                md_delivered = jnp.maximum(
+                    md_delivered,
+                    jnp.where(ok_ae[:, None],
+                              eng.deliver(h_md_full, sft), 0),
+                )
             if counters_on:
                 attempt_ae = ae_due & sa_ae
                 if contact_ok_ae is not None:
@@ -3642,7 +3905,7 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         g_delivered=g_delivered, g_ring=g_ring_acc,
         lhm_signals=((ping_req_launches, lhm_clean)
                      if params.lhm_max > 0 else None),
-        epoch=epoch, own_epoch=own_epoch,
+        epoch=epoch, own_epoch=own_epoch, md_delivered=md_delivered,
     )
     aux = dict(
         messages_gossip=n_gossip_sent,
@@ -3651,6 +3914,12 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         messages_ping_req_sent=ping_req_n,
         refutations=jnp.sum(refuted & alive_here, dtype=jnp.int32),
     )
+    if params.metadata_keys > 0:
+        # Globally reduced inside (psum) — _round_metrics passes through.
+        aux["metadata_divergent"] = metadata.divergent_count(
+            new_state.md, node_ids, alive, alive_here, n,
+            offset=offset, axis_name=axis_name,
+        )
     if ae_sent_local is not None:
         aux["messages_anti_entropy"] = ae_sent_local
     if counters_on:
@@ -3846,6 +4115,10 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
         g_infected=jnp.zeros((n, 0), dtype=jnp.bool_),
         g_spread_until=jnp.zeros((n, 0), dtype=jnp.int32),
         g_ring=jnp.zeros((0, n, 0), dtype=jnp.bool_),
+        # metadata_keys > 0 excludes k_block (SwimParams.__post_init__),
+        # so the block view only carries the zero-size lanes.
+        md=jnp.zeros((n, 0, 0), dtype=jnp.int32),
+        md_spread=jnp.zeros((n, 0), dtype=jnp.int32),
     )
 
     def body(b, acc):
@@ -4031,6 +4304,7 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
         g_ring=state.g_ring,
         lhm=new_lhm,
         epoch=ep_acc,
+        md=state.md, md_spread=state.md_spread,
     )
     subject_alive_i = (alive[world.subject_ids].astype(jnp.int32)
                        if per_subject

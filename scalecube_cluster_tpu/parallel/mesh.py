@@ -94,6 +94,8 @@ def _shard_prelude(params: swim.SwimParams, mesh: Mesh):
         g_infected=P(axis), g_spread_until=P(axis), g_ring=P(None, axis),
         lhm=P(axis),
         epoch=P(axis),
+        # Metadata lanes are observer-row-major like the tables.
+        md=P(axis), md_spread=P(axis),
     )
     metric_names = ["alive", "suspect", "dead", "absent", "false_positives",
                     "false_suspicion_onsets", "false_suspect_rounds",
@@ -105,6 +107,8 @@ def _shard_prelude(params: swim.SwimParams, mesh: Mesh):
         metric_names.append("user_gossip_infected")
     if params.sync_interval > 0:
         metric_names.append("messages_anti_entropy")
+    if params.metadata_keys > 0:
+        metric_names.append("metadata_divergent")
     out_metric_specs = {name: P() for name in metric_names}
     return axis, n_dev, n_local, state_specs, out_metric_specs
 

@@ -10,8 +10,9 @@ open-horizon *stream* of per-segment scenario slices:
   one-line repro (the campaign purity contract, streamed).
 - Draw order follows the PR-10/PR-12 **trailing-draw contract**: the
   boundary straddler is drawn first, then the severity-tier interior
-  ops, then the trailing open-world rung — future tiers must APPEND
-  draws after the existing ones, never reshuffle them
+  ops, then the trailing rungs (the net-zero join storm, then the
+  rolling metadata config push) — future tiers must APPEND draws
+  after the existing ones, never reshuffle them
   (tests/test_soak.py pins the historical (seed, segment) → op-kind
   table exactly like the generate_scenario pin in
   tests/test_chaos_fuzz.py).
@@ -87,15 +88,29 @@ class SoakSegment:
     spans_boundary: bool
 
 
-def _fault_pool(seed: int, n: int, severity: str):
-    """The stream-global faultable-node permutation (pure in
-    (seed, n, severity); segment-independent so every segment can
-    compute its own disjoint slice).  A quarter of the cluster is a
-    quorum reserve that never takes a node-schedule fault."""
+def _stream_permutation(seed: int, n: int, severity: str):
+    """The stream-global node permutation (pure in (seed, n,
+    severity); segment-independent so every segment can compute its
+    own disjoint slice).  The first ``n - n // 4`` entries are the
+    faultable pool; the tail quarter is the quorum reserve."""
     rng = np.random.default_rng(np.random.SeedSequence(
         [seed, _STREAM_DOMAIN, cs.SEVERITIES.index(severity)]))
-    faultable = n - n // 4
-    return [int(x) for x in rng.permutation(n)[:faultable]]
+    return [int(x) for x in rng.permutation(n)]
+
+
+def _fault_pool(seed: int, n: int, severity: str):
+    """The faultable-node slice: a quarter of the cluster is a quorum
+    reserve that never takes a node-schedule fault."""
+    return _stream_permutation(seed, n, severity)[:n - n // 4]
+
+
+def _config_owner_ring(seed: int, n: int, severity: str):
+    """The quorum reserve in permutation order: the rolling ConfigPush
+    owner ring.  Disjoint from :func:`_fault_pool` by construction, so
+    a push owner is never node-down when its push lands — the
+    metadata-under-churn question the soak asks is about *propagation*
+    through the weather, not about injecting into a crashed slot."""
+    return _stream_permutation(seed, n, severity)[n - n // 4:]
 
 
 def soak_segment(seed: int, segment_index: int, n: int = 32,
@@ -259,6 +274,22 @@ def soak_segment(seed: int, segment_index: int, n: int = 32,
                                                  segment_rounds - 63)),
             wave_every=lag + int(rng.integers(2, 7)),
             join_wave_size=2, join_lag=lag, arrivals=()))
+
+    # --- Trailing config rung (the metadata KV plane): half the
+    # segments push a fresh value for key 0 from a ROLLING quorum-
+    # reserve owner — the config plane soaks under the same weather
+    # the failure detector does.  Owners rotate through the reserve
+    # ring (disjoint from the fault pool, so a pusher is never
+    # node-down at push time); the draw TRAILS every earlier rung so
+    # historical streams replay bit-identically.
+    if rng.integers(0, 2):
+        from scalecube_cluster_tpu.models import metadata
+
+        ring = _config_owner_ring(seed, n, severity)
+        add("config_push", cs.ConfigPush(
+            node=ring[segment_index % len(ring)], key=0,
+            value=int(rng.integers(1, metadata.MD_VALUE_MAX + 1)),
+            at_round=start + int(rng.integers(8, segment_rounds - 31))))
 
     return SoakSegment(
         index=segment_index, round_start=start, round_end=end,

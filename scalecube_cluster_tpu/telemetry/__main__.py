@@ -209,7 +209,8 @@ def _cmd_regress(args) -> int:
             os.path.join("artifacts", "static_analysis*.json"),
             os.path.join("artifacts", "alarm_drill*.json"),
             os.path.join("artifacts", "tune_pareto*.json"),
-            os.path.join("artifacts", "soak_report*.json")])
+            os.path.join("artifacts", "soak_report*.json"),
+            os.path.join("artifacts", "config_rollout*.json")])
     readable = [p for p in paths if os.path.exists(p)]
     if not readable:
         print("regress: no artifacts matched", file=sys.stderr)
@@ -285,7 +286,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "artifacts/static_analysis*.json "
                         "artifacts/alarm_drill*.json "
                         "artifacts/tune_pareto*.json "
-                        "artifacts/soak_report*.json)")
+                        "artifacts/soak_report*.json "
+                        "artifacts/config_rollout*.json)")
     p.add_argument("--band", type=float, default=query.DEFAULT_NOISE_BAND,
                    help="relative noise band (default 0.10)")
     p.add_argument("--json", action="store_true")

@@ -223,6 +223,14 @@ def compute_slos(report: HealthReport) -> dict:
     slos["sync_rounds_to_converge"] = report.summary.get(
         "sync_rounds_to_converge")
 
+    # Metadata KV plane: p99 of per-push convergence latency (rounds
+    # from a config push — or the end of the disruption that covered it
+    # — to every live table holding the word; bench.py --rollout writes
+    # this into the run's summary row, models/metadata.py defines the
+    # divergence observable).
+    slos["metadata_convergence_p99"] = report.summary.get(
+        "metadata_convergence_p99")
+
     slos["chaos_violations"] = c.get("chaos_violations")
     slos["suspect_entries"] = g.get("suspect_entries")
     slos["wire_saturation"] = g.get("wire_saturation")
@@ -312,6 +320,7 @@ def load_bench_payload(path: str) -> Tuple[Optional[dict], Optional[str]]:
                      or "metrics_overhead_ratio" in payload
                      or "pipelined_speedup_ratio" in payload
                      or "sync_rounds_to_converge" in payload
+                     or "metadata_convergence_p99" in payload
                      or "fp_ratio" in payload
                      or "no_resurrection_violations" in payload
                      or "vmap_speedup_ratio" in payload
@@ -357,6 +366,14 @@ def regress(paths: Sequence[str],
         diverging, when recorded) — absolute gates — and the
         convergence-time series stays <= best_prior * (1 + band) + 1
         quantization round;
+      - Config-rollout artifacts (``rollout_converged`` present,
+        bench.py --rollout): absolute gates — the staged rollout
+        converged every stage within its deadline with no rollback,
+        the gossip-only control (metadata on, SYNC off) still
+        divergent, ``metadata_convergence_p99`` within the scenario's
+        convergence bound, and zero monitor violations — plus the
+        banded non-smoke p99 series (smoke rows under the sync-heal
+        fallback rule);
       - Lifeguard A/B artifacts (``fp_ratio`` +
         ``detection_p99_delta_rounds`` present, bench.py --lifeguard):
         absolute gates — ``fp_ratio`` (plane-on FP observer rate over
@@ -543,6 +560,65 @@ def regress(paths: Sequence[str],
             check("slo/sync_rounds_to_converge", last_path,
                   last["sync_rounds_to_converge"], best, limit,
                   last["sync_rounds_to_converge"] <= limit)
+        # Config-rollout artifacts (bench.py --rollout): the staged
+        # rollout's headline claims gate ABSOLUTELY — every stage
+        # converged within its deadline with no rollback, the
+        # gossip-only control (metadata on, SYNC off) demonstrably did
+        # NOT converge through the partition, the per-push convergence
+        # p99 landed inside the scenario's promised bound
+        # (chaos/scenarios.metadata_convergence_bound, recorded in the
+        # payload), and the monitored composite ran violation-free.
+        # Smoke rollout artifacts are provenance unless the walk holds
+        # only smoke rounds (the sync-heal fallback rule: `--rollout
+        # --smoke`'s in-bench check of its own fresh artifact bites).
+        ro_all = [(p, pl) for p, pl in entries
+                  if "rollout_converged" in pl]
+        ro = [(p, pl) for p, pl in ro_all
+              if not pl.get("smoke")] or ro_all
+        if ro is not ro_all:
+            for p, pl in ro_all:
+                if pl.get("smoke"):
+                    rows.append({
+                        "check": "slo/config_rollout", "source":
+                        os.path.basename(p), "ok": None,
+                        "note": "smoke rollout round — different scale, "
+                                "not a trajectory datum",
+                    })
+        if ro:
+            last_path, last = ro[-1]
+            converged = bool(last.get("rollout_converged"))
+            check("slo/rollout_converged", last_path, converged, True,
+                  True, converged)
+            rb = last.get("rolled_back")
+            check("slo/rollout_not_rolled_back", last_path, rb, False,
+                  False, rb is False)
+            if "control_converged" in last:
+                check("slo/rollout_control_diverges", last_path,
+                      last["control_converged"], False, False,
+                      last["control_converged"] is False)
+            p99 = last.get("metadata_convergence_p99")
+            bound = last.get("convergence_deadline_rounds")
+            if isinstance(p99, (int, float)) and isinstance(
+                    bound, (int, float)):
+                check("slo/metadata_convergence_p99_within_bound",
+                      last_path, p99, bound, bound, p99 <= bound)
+            mv = last.get("monitor_violations")
+            check("slo/rollout_monitor_violations", last_path, mv, 0, 0,
+                  mv == 0)
+        ro_conv = [(p, pl) for p, pl in ro
+                   if isinstance(pl.get("metadata_convergence_p99"),
+                                 (int, float))]
+        if len(ro_conv) >= 2:
+            *prior, (last_path, last) = ro_conv
+            best = min(pl["metadata_convergence_p99"] for _, pl in prior)
+            # Same phase-luck floor as the sync series: one exchange
+            # interval.
+            floor = last.get("sync_interval") or 0
+            limit = (max(best, floor) * (1.0 + band)
+                     + DISSEMINATION_SLACK_ROUNDS)
+            check("slo/metadata_convergence_p99", last_path,
+                  last["metadata_convergence_p99"], best, limit,
+                  last["metadata_convergence_p99"] <= limit)
         # Lifeguard A/B artifacts (bench.py --lifeguard): the headline
         # adaptivity claims gate ABSOLUTELY — the plane must at least
         # halve the false-positive observer rate of its own control

@@ -43,10 +43,12 @@ def state_from_arrays(fields: dict, origin: str = "checkpoint",
     """Inverse of :func:`state_to_arrays` (keys WITHOUT the ``state/``
     prefix).  Checkpoints written before the user-gossip fields existed
     load as G=0 (zero-width arrays), ones written before the Lifeguard
-    health lane existed load with the plane-off zero-size ``lhm``, and
+    health lane existed load with the plane-off zero-size ``lhm``,
     ones written before the open-world identity lane existed load with
-    the plane-off zero-size ``epoch`` — the layouts
-    params.n_user_gossips=0 / params.lhm_max=0 / params.open_world=False
+    the plane-off zero-size ``epoch``, and ones written before the
+    metadata KV lanes existed load with the plane-off zero-size
+    ``md``/``md_spread`` — the layouts params.n_user_gossips=0 /
+    params.lhm_max=0 / params.open_world=False / params.metadata_keys=0
     produce, so resume validation stays meaningful.
 
     ``params`` (optional SwimParams): when given and the checkpoint
@@ -74,6 +76,11 @@ def state_from_arrays(fields: dict, origin: str = "checkpoint",
             "g_ring": jax.numpy.zeros((0, n, 0), dtype=bool),
             "lhm": jax.numpy.zeros((0,), dtype=jax.numpy.int32),
             "epoch": epoch_default,
+            # Pre-metadata-plane checkpoints (PR-19) load the plane-off
+            # zero-size lanes — the PR-9/10 back-compat rule.
+            "md": jax.numpy.zeros((n, 0, 0), dtype=jax.numpy.int32),
+            "md_spread": jax.numpy.zeros(
+                (n, 0), dtype=jax.numpy.int32),
         }
         unknown = missing - set(g_defaults)
         if unknown:

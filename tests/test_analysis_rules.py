@@ -31,6 +31,32 @@ def ids_of(findings):
 # plane-matrix
 # --------------------------------------------------------------------------
 
+# MINI_SWIM grown a metadata_keys knob consulted in scatter, shift and
+# the pipelined send half — but NOT in the k_block body (the planted
+# gap the triggering fixture asserts on).
+_MD_SWIM = MINI_SWIM.replace(
+    "    shadow_knob: int = 0",
+    "    shadow_knob: int = 0\n    metadata_keys: int = 0",
+).replace(
+    "def _tick_scatter(state, params):\n"
+    "    return state + params.sync_interval",
+    "def _tick_scatter(state, params):\n"
+    "    return state + params.sync_interval + params.metadata_keys",
+).replace(
+    "def _tick_shift(state, params):\n"
+    "    return state + params.sync_interval",
+    "def _tick_shift(state, params):\n"
+    "    return state + params.sync_interval + params.metadata_keys",
+).replace(
+    "def swim_tick_send(state, params):\n"
+    "    ctx = _round_context(state, params)\n"
+    "    return ctx + params.sync_interval",
+    "def swim_tick_send(state, params):\n"
+    "    ctx = _round_context(state, params)\n"
+    "    return ctx + params.sync_interval + params.metadata_keys",
+)
+
+
 class TestPlaneMatrix:
     def test_uniform_tree_is_clean(self, tmp_path):
         matrix, findings = lint.plane_matrix(graph_of(tmp_path, {}))
@@ -132,6 +158,34 @@ class TestPlaneMatrix:
                 "plane-matrix:n_members:batch",
                 "plane-matrix:lhm_max:batch"} <= got
         assert all(":batch" in fid for fid in got)
+
+    def test_uniformly_threaded_metadata_knob_is_clean(self, tmp_path):
+        # The metadata KV plane's knob rides the same matrix as every
+        # other plane: threaded through all tick bodies, it reaches
+        # every entry / compose / batch column with no new rule code.
+        src = _MD_SWIM.replace(
+            "def _tick_shift_blocked(state, params):\n"
+            "    return state + params.sync_interval",
+            "def _tick_shift_blocked(state, params):\n"
+            "    return state + params.sync_interval"
+            " + params.metadata_keys",
+        )
+        matrix, findings = lint.plane_matrix(
+            graph_of(tmp_path, {"models/swim.py": src}))
+        assert findings == []
+        assert all(matrix["entries"]["metadata_keys"][e]
+                   for e in lint.ENTRY_POINTS)
+        assert matrix["compose"]["metadata_keys"]["compose"]
+        assert matrix["batch"]["metadata_keys"]["batch"]
+
+    def test_metadata_knob_body_gap_fires(self, tmp_path):
+        # ... and un-threading it from ONE sibling body fires exactly
+        # that cell — the metadata plane cannot silently skip a tick
+        # variant.
+        _, findings = lint.plane_matrix(
+            graph_of(tmp_path, {"models/swim.py": _MD_SWIM}))
+        assert ids_of(findings) == {
+            "plane-matrix:metadata_keys:body:k_block"}
 
     def test_missing_entry_root_is_an_input_error(self, tmp_path):
         swim_src = MINI_SWIM.replace(

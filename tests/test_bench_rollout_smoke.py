@@ -1,0 +1,149 @@
+"""bench.py --rollout --smoke: the staged config-rollout JSON contract.
+
+Like tests/test_bench_sync_smoke.py for the heal plane: the bench is
+the one entry point the rollout measurement flows through, so this
+test runs the real script in a subprocess (CPU) and pins the published
+contract — one JSON line with the rollout fields (every push converged
+inside its deadline with no rollback, the monitored chaos arm green,
+the gossip-only control permanently divergent), an
+artifacts/config_rollout.json-style artifact the query layer loads as
+a real payload, the regress gate walking it with the absolute rollout
+checks, and the ``metadata_convergence_p99`` SLO surfaced from the
+JSONL manifest.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.metadata
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run_rollout_bench(tmp_path, extra_env=None, timeout=900):
+    artifact = tmp_path / "config_rollout_smoke.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_TPU_TELEMETRY_DIR=str(tmp_path),
+        SCALECUBE_ROLLOUT_ARTIFACT=str(artifact),
+        SCALECUBE_XLA_CACHE_DIR="",           # no cache writes from tests
+    )
+    env.pop("SCALECUBE_TPU_PROFILE_DIR", None)
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--rollout", "--smoke"],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, proc.stdout      # exactly ONE JSON line
+    return json.loads(lines[0]), artifact
+
+
+def test_bench_rollout_smoke_contract(tmp_path):
+    result, artifact = _run_rollout_bench(tmp_path)
+
+    assert "error" not in result, result
+    assert result["smoke"] is True
+    assert result["metric"] == "config_rollout_convergence"
+    # value stays None BY DESIGN (smaller-is-better must not enter the
+    # generic throughput walk); the payload says so.
+    assert result["value"] is None
+    assert "value_note" in result
+
+    # The headline acceptance: every push converged inside its
+    # deadline (no rollback triggered), the final table is globally
+    # agreed, the monitored chaos-campaign arm is green, and the
+    # gossip-only control demonstrably stays divergent.
+    assert result["rollout_converged"] is True
+    assert result["rolled_back"] is False
+    assert 0 <= result["metadata_convergence_p99"] <= \
+        result["convergence_deadline_rounds"]
+    assert result["final_divergent_cells"] == 0
+    assert result["monitored_green"] is True
+    assert result["monitor_violations"] == 0
+    assert result["control_converged"] is False
+    assert result["control_divergent_cells"] > 0
+
+    # Workload provenance: the staged schedule really is staged, under
+    # a real split, with the plane armed.
+    assert result["delivery"] == "shift"
+    assert result["sync_interval"] > 0
+    assert result["metadata_keys"] >= 1
+    assert result["n_stages"] >= 2
+    assert len(result["owners"]) == result["n_stages"] * \
+        result["stage_size"]
+    assert len(result["stage_rounds"]) == result["n_stages"]
+    assert len(result["stage_converge_rounds"]) == len(result["owners"])
+    assert result["split_rounds"] > 0
+    assert result["horizon_rounds"] >= max(result["stage_rounds"])
+
+    # The artifact round-trips and loads as a REAL (non-stub) payload.
+    art = json.loads(artifact.read_text())
+    assert art["metric"] == result["metric"]
+    assert art["metadata_convergence_p99"] == \
+        result["metadata_convergence_p99"]
+
+    from scalecube_cluster_tpu.telemetry import query as tquery
+
+    payload, skip_note = tquery.load_bench_payload(str(artifact))
+    assert skip_note is None
+    assert payload["rollout_converged"] is True
+
+    # The in-bench regress gate ran and the dedicated absolute checks
+    # are present and green for the fresh artifact.
+    assert result["regress"]["ok"] is True
+    assert result["regress"]["artifacts"] >= 1
+    ok, rows = tquery.regress([str(artifact)])
+    assert ok
+    names = {r["check"] for r in rows}
+    assert {"slo/rollout_converged", "slo/rollout_not_rolled_back",
+            "slo/rollout_control_diverges",
+            "slo/metadata_convergence_p99_within_bound",
+            "slo/rollout_monitor_violations"} <= names
+
+    # The SLO surface: the manifest's summary row folds into
+    # metadata_convergence_p99.
+    report = tquery.load_report(result["manifest"])
+    slos = tquery.compute_slos(report)
+    assert slos["metadata_convergence_p99"] == (
+        result["metadata_convergence_p99"])
+
+
+@pytest.mark.slow
+def test_bench_rollout_full(tmp_path):
+    """The full (non-smoke) three-stage rollout.  The design-target
+    scale is accelerator-sized; under the CPU-forced test environment
+    the env override keeps the FULL (non-smoke) path honest at a
+    feasible N."""
+    artifact = tmp_path / "config_rollout_full.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCALECUBE_TPU_TELEMETRY_DIR=str(tmp_path),
+        SCALECUBE_ROLLOUT_ARTIFACT=str(artifact),
+        SCALECUBE_XLA_CACHE_DIR="",
+        SCALECUBE_ROLLOUT_N=os.environ.get("SCALECUBE_ROLLOUT_N", "32"),
+    )
+    env.pop("SCALECUBE_TPU_PROFILE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--rollout"],
+        capture_output=True, text=True, timeout=3000, env=env,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "error" not in result, result
+    assert result["smoke"] is False
+    assert result["rollout_converged"] is True
+    assert result["rolled_back"] is False
+    assert result["monitored_green"] is True
+    assert result["control_converged"] is False
+    assert result["n_stages"] == 3

@@ -131,3 +131,54 @@ def test_campaign_attaches_cross_validation(tmp_path):
     (row,) = tsink.read_records(result.manifest_path,
                                 kind="chaos_scenario")
     assert row["cross_validation"]["agree"] is True
+
+
+def test_config_push_kv_parity_with_oracle():
+    """The metadata plane's ground truth: after a push schedule with an
+    LWW overwrite, every observer on BOTH layers holds exactly the last
+    written value per (owner, key) — the jit plane's versioned LWW and
+    the oracle's incarnation-bump demand-fetch reach the same terminal
+    table."""
+    scen = cs.Scenario(
+        name="xval-push", n_members=N, horizon=192,
+        ops=(cs.ConfigPush(node=5, key=0, value=77, at_round=4),
+             cs.ConfigPush(node=3, key=0, value=123, at_round=8),
+             # LWW overwrite: node 3's second write must win everywhere.
+             cs.ConfigPush(node=3, key=0, value=200, at_round=40)))
+    cv = cc.cross_validate_metadata(scen, seed=5)
+    assert cv is not None
+    assert cv["agree"], cv["per_push"]
+    assert cv["observers"] == N and cv["pushes"] == 3
+    assert set(cv["per_push"]) == {"5:k0", "3:k0"}
+    assert cv["per_push"]["3:k0"]["value"] == 200       # last write won
+    for digest in cv["per_push"].values():
+        assert digest["model_divergent"] == 0
+        assert digest["oracle_divergent"] == 0
+
+
+def test_staged_rollout_kv_parity_with_oracle():
+    scen = cs.Scenario(
+        name="xval-rollout", n_members=N, horizon=256,
+        ops=(cs.StagedRollout(members=(1, 9, 4, 12), n_stages=2,
+                              key=0, value=41, start_round=6,
+                              stage_every=96),))
+    cv = cc.cross_validate_metadata(scen, seed=6)
+    assert cv is not None
+    assert cv["agree"], cv["per_push"]
+    assert cv["pushes"] == 4
+    assert all(d["value"] == 41 for d in cv["per_push"].values())
+
+
+def test_metadata_inexpressible_scenarios_return_none():
+    """Mixed membership ops or background loss make terminal KV parity
+    timing-dependent — declined, not mis-compared."""
+    mixed = cs.Scenario(
+        name="nope", n_members=N, horizon=128,
+        ops=(cs.ConfigPush(node=2, key=0, value=9, at_round=4),
+             cs.Crash(7, at_round=8)))
+    assert cc.cross_validate_metadata(mixed, seed=0) is None
+    lossy = cs.Scenario(
+        name="nope", n_members=N, horizon=128,
+        ops=(cs.ConfigPush(node=2, key=0, value=9, at_round=4),),
+        loss_probability=0.05)
+    assert cc.cross_validate_metadata(lossy, seed=0) is None

@@ -123,17 +123,18 @@ SEED_STABILITY_PIN = {
     (100, 16, "mild"): "mild-100-leave",
     (100, 16, "moderate"): "moderate-100-churn+flap",
     (100, 16, "severe"): "severe-100-partition+churn+brownout",
-    (103, 16, "mild"): "mild-103-crash_revive",
-    (105, 16, "moderate"): "moderate-105-brownout+burst",
+    (103, 16, "mild"): "mild-103-crash_revive+config_push",
+    (105, 16, "moderate"): "moderate-105-brownout+burst+config_push",
     (100, 24, "mild"): "mild-100-crash",
     (101, 24, "moderate"): "moderate-101-flap+leave+churn_arrivals",
-    (105, 24, "severe"): "severe-105-partition+churn+flap+churn_arrivals",
+    (105, 24, "severe"): "severe-105-partition+churn+flap"
+                         "+churn_arrivals+config_push",
     (100, 32, "mild"): "mild-100-crash",
     (100, 32, "moderate"): "moderate-100-leave+burst+churn_arrivals",
     (100, 32, "severe"): "severe-100-partition+churn+brownout"
                          "+churn_arrivals",
     (103, 32, "moderate"): "moderate-103-leave+churn+churn_arrivals",
-    (104, 32, "severe"): "severe-104-partition+churn+flap",
+    (104, 32, "severe"): "severe-104-partition+churn+flap+config_push",
 }
 
 
@@ -159,6 +160,17 @@ def test_generate_scenario_exact_op_pin():
                       start_round=3, wave_every=48, down_rounds=0,
                       join_wave_size=3, join_lag=43, arrivals=(15, 4)),
     )
+
+    # The trailing config rung (metadata plane), fully field-pinned:
+    # a historical seed that draws it keeps the exact push forever.
+    cfg = cs.generate_scenario(seed=103, n=16, severity="mild")
+    assert cfg.name == "mild-103-crash_revive+config_push"
+    assert cfg.horizon == 256 and cfg.loss_probability == 0.0
+    assert cfg.ops == (
+        cs.Crash(node=10, at_round=4, until_round=88),
+        cs.ConfigPush(node=3, key=0, value=1, at_round=9),
+    )
+    assert cfg.has_metadata and cfg.metadata_keys_needed() == 1
 
 
 def test_generate_fuzz_campaign_is_tiled_generate_campaign():

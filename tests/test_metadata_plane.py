@@ -1,0 +1,475 @@
+"""The metadata KV plane (models/metadata.py + SwimParams.metadata_keys).
+
+Four contracts, the sync-plane test shape applied to config:
+
+  1. *off = bit-identical*: ``metadata_keys=0`` (the default) compiles
+     the plane out — zero-size lanes, no new draws, the metrics tree is
+     exactly the plane-less program's;
+  2. *the packed word is LWW by construction*: within one (slot,
+     epoch) the word is monotone in (version, value) so the merge is a
+     plain max; epoch-mismatched words are dropped and a belief change
+     zeroes stale cells (a reused slot never inherits config); a
+     member never accepts external words about its own cells;
+  3. *pushes propagate and converge*: an owner-local push reaches every
+     live observer within the convergence bound on a healthy world,
+     and through a quiesced partition heal ONLY with the anti-entropy
+     exchange on — the gossip-only control stays divergent forever
+     (the acceptance claim ``bench.py --rollout`` measures);
+  4. *every run shape carries the plane unchanged* — including the
+     sharded pipelined twin.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.models import metadata as md_plane
+from scalecube_cluster_tpu.models import swim
+
+from tests.test_swim_model import fast_config
+
+pytestmark = pytest.mark.metadata
+
+STATE_FIELDS = ("status", "inc", "spread_until", "suspect_deadline",
+                "self_inc")
+
+
+def _assert_states_equal(a, b, fields=STATE_FIELDS):
+    for f in fields:
+        assert np.array_equal(np.asarray(getattr(a, f)),
+                              np.asarray(getattr(b, f))), f
+
+
+def _md_value(state, observer, owner, key=0):
+    return int(np.asarray(
+        md_plane.word_value(state.md[observer, owner, key])))
+
+
+def _md_version(state, observer, owner, key=0):
+    return int(np.asarray(
+        md_plane.word_version(state.md[observer, owner, key])))
+
+
+# --------------------------------------------------------------------------
+# 1: disabled default == baseline
+# --------------------------------------------------------------------------
+
+
+def test_metadata_defaults_off():
+    params = swim.SwimParams.from_config(fast_config(), n_members=8)
+    assert params.metadata_keys == 0
+    explicit = dataclasses.replace(params, metadata_keys=0)
+    assert explicit == params          # same static params, same program
+    state = swim.initial_state(params, swim.SwimWorld.healthy(params))
+    assert state.md.shape == (8, 0, 0)
+    assert state.md_spread.shape == (8, 0)
+
+
+def test_param_validation():
+    params = swim.SwimParams.from_config(fast_config(), n_members=8,
+                                         delivery="shift")
+    with pytest.raises(ValueError, match="metadata_keys"):
+        dataclasses.replace(params, metadata_keys=-1)
+    with pytest.raises(ValueError, match="k_block"):
+        dataclasses.replace(params, metadata_keys=1, k_block=4)
+    focal = swim.SwimParams.from_config(fast_config(), n_members=8,
+                                        delivery="scatter")
+    with pytest.raises(ValueError, match="full view"):
+        dataclasses.replace(focal, metadata_keys=1, n_subjects=4)
+
+
+@pytest.mark.parametrize("delivery,subjects,layout", [
+    ("scatter", None, "wide"),
+    ("shift", None, "wide"),
+    ("shift", None, "openworld"),
+    ("shift", None, "compact"),
+    ("scatter", None, "wire16"),
+])
+def test_plane_on_quiet_world_is_table_noop(delivery, subjects, layout):
+    """With the plane armed but NO pushes scheduled, the carry and
+    every existing metric are bit-identical to plane-off — the plane
+    reuses the round's existing channel draws, so there is nothing to
+    perturb.  Only the ``metadata_divergent`` observable is new, and it
+    reads 0 (empty tables agree)."""
+    n = 24
+    p_off = swim.SwimParams.from_config(
+        fast_config(), n_members=n, n_subjects=subjects,
+        delivery=delivery,
+        open_world=layout == "openworld",
+        compact_carry=layout == "compact", int16_wire=layout == "wire16",
+    )
+    p_on = dataclasses.replace(p_off, metadata_keys=2)
+    world = swim.SwimWorld.healthy(p_off)
+    s_off, m_off = swim.run(jax.random.key(0), p_off, world, 20)
+    s_on, m_on = swim.run(jax.random.key(0), p_on, world, 20)
+    _assert_states_equal(s_off, s_on)
+    assert "metadata_divergent" not in m_off
+    assert set(m_on) == set(m_off) | {"metadata_divergent"}
+    for k in m_off:
+        assert np.array_equal(np.asarray(m_off[k]), np.asarray(m_on[k])), k
+    assert (np.asarray(m_on["metadata_divergent"]) == 0).all()
+    assert (np.asarray(s_on.md) == 0).all()
+
+
+# --------------------------------------------------------------------------
+# 2: the packed word and the merge gates
+# --------------------------------------------------------------------------
+
+
+def test_word_packing_roundtrip_and_lww_order():
+    ep = jnp.array([0, 3, 127, 130])        # 130 masks to 2
+    ver = jnp.array([0, 1, 16383, 7])
+    val = jnp.array([0, 1023, 512, 9])
+    w = md_plane.pack_word(ep, ver, val)
+    assert (np.asarray(w) >= 0).all()       # sign bit clear: max-safe
+    assert np.array_equal(np.asarray(md_plane.word_epoch(w)),
+                          [0, 3, 127, 2])
+    assert np.array_equal(np.asarray(md_plane.word_version(w)),
+                          np.asarray(ver))
+    assert np.array_equal(np.asarray(md_plane.word_value(w)),
+                          np.asarray(val))
+    # Within one epoch the word is monotone in (version, value): the
+    # jnp.maximum merge IS last-writer-wins.
+    low = md_plane.pack_word(1, 3, 1023)
+    high = md_plane.pack_word(1, 4, 0)
+    assert int(high) > int(low)
+
+
+def _merge_params():
+    return swim.SwimParams.from_config(
+        fast_config(), n_members=4, delivery="shift", open_world=True,
+        metadata_keys=1)
+
+
+def test_merge_is_lww_and_opens_spread_window():
+    params = _merge_params()
+    md = jnp.zeros((4, 4, 1), jnp.int32)
+    md = md.at[0, 2, 0].set(int(md_plane.pack_word(0, 2, 5)))
+    arr = jnp.zeros((4, 4, 1), jnp.int32)
+    arr = arr.at[0, 2, 0].set(int(md_plane.pack_word(0, 3, 1)))   # newer
+    arr = arr.at[1, 2, 0].set(int(md_plane.pack_word(0, 1, 9)))   # news
+    is_self = jnp.zeros((4, 4), jnp.bool_)
+    new_md, new_spread = md_plane.merge(
+        md, jnp.zeros((4, 4), jnp.int32), arr.reshape(4, 4),
+        jnp.int32(10), params, is_self,
+        jnp.zeros((4, 4), jnp.int32), jnp.zeros((4,), jnp.bool_))
+    assert _md_version(type("S", (), {"md": new_md}), 0, 2) == 3
+    assert _md_value(type("S", (), {"md": new_md}), 0, 2) == 1
+    # strictly-improved rows open the gossip window; untouched rows
+    # stay closed
+    assert int(new_spread[0, 2]) == 10 + 1 + params.periods_to_spread
+    assert int(new_spread[1, 2]) == 10 + 1 + params.periods_to_spread
+    assert int(new_spread[0, 0]) == 0
+    # an OLDER arrival loses: replaying the stale word changes nothing
+    again, _ = md_plane.merge(
+        new_md, new_spread, md.reshape(4, 4), jnp.int32(11), params,
+        is_self, jnp.zeros((4, 4), jnp.int32),
+        jnp.zeros((4,), jnp.bool_))
+    assert np.array_equal(np.asarray(again), np.asarray(new_md))
+
+
+def test_merge_epoch_gate_drops_and_zeroes_stale():
+    """Versions are per (slot, epoch): a word from the slot's PREVIOUS
+    occupant is dropped at the receiver, and a belief change zeroes the
+    receiver's own stale cell — a reused slot starts from an empty
+    map."""
+    params = _merge_params()
+    stale = int(md_plane.pack_word(0, 9, 7))         # old occupant's word
+    md = jnp.zeros((4, 4, 1), jnp.int32).at[0, 2, 0].set(stale)
+    belief = jnp.zeros((4, 4), jnp.int32).at[0, 2].set(1)  # new epoch
+    arr = jnp.zeros((4, 4, 1), jnp.int32).at[0, 2, 0].set(stale)
+    new_md, _ = md_plane.merge(
+        md, jnp.zeros((4, 4), jnp.int32), arr.reshape(4, 4),
+        jnp.int32(5), params, jnp.zeros((4, 4), jnp.bool_), belief,
+        jnp.zeros((4,), jnp.bool_))
+    assert int(new_md[0, 2, 0]) == 0                 # dropped AND zeroed
+    # a word carrying the CURRENT epoch is accepted
+    fresh = jnp.zeros((4, 4, 1), jnp.int32).at[0, 2, 0].set(
+        int(md_plane.pack_word(1, 1, 3)))
+    new_md, _ = md_plane.merge(
+        new_md, jnp.zeros((4, 4), jnp.int32), fresh.reshape(4, 4),
+        jnp.int32(6), params, jnp.zeros((4, 4), jnp.bool_), belief,
+        jnp.zeros((4,), jnp.bool_))
+    assert _md_value(type("S", (), {"md": new_md}), 0, 2) == 3
+
+
+def test_merge_self_pin_rejects_external_words_about_own_cells():
+    params = _merge_params()
+    md = jnp.zeros((4, 4, 1), jnp.int32)
+    arr = jnp.zeros((4, 4, 1), jnp.int32).at[1, 1, 0].set(
+        int(md_plane.pack_word(0, 5, 5)))
+    is_self = (jnp.arange(4)[:, None] == jnp.arange(4)[None, :])
+    new_md, _ = md_plane.merge(
+        md, jnp.zeros((4, 4), jnp.int32), arr.reshape(4, 4),
+        jnp.int32(3), params, is_self, jnp.zeros((4, 4), jnp.int32),
+        jnp.zeros((4,), jnp.bool_))
+    assert int(new_md[1, 1, 0]) == 0    # the owner is the sole authority
+
+
+# --------------------------------------------------------------------------
+# 3: pushes propagate; heal converges only with the exchange
+# --------------------------------------------------------------------------
+
+
+def _push_params(n, delivery="shift", sync_interval=4, **overrides):
+    return swim.SwimParams.from_config(
+        fast_config(), n_members=n, delivery=delivery, sync_every=0,
+        sync_interval=sync_interval, metadata_keys=1, **overrides)
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+def test_push_reaches_every_observer(delivery):
+    from scalecube_cluster_tpu.chaos import scenarios as cs
+
+    n = 16
+    params = _push_params(n, delivery=delivery)
+    rounds = cs.metadata_convergence_bound(params, n)
+    world = swim.SwimWorld.healthy(params) \
+        .with_metadata_push(3, key=0, value=641, at_round=4)
+    state, metrics = swim.run(jax.random.key(2), params, world, rounds)
+    for obs in range(n):
+        assert _md_value(state, obs, 3) == 641, obs
+        assert _md_version(state, obs, 3) == 1
+    assert int(md_plane.divergence_probe(state, params, world,
+                                         rounds)) == 0
+    # the divergence metric saw the spread and then settled to 0
+    div = np.asarray(metrics["metadata_divergent"])
+    assert div.max() > 0 and div[-1] == 0
+
+
+def test_second_push_wins_everywhere():
+    """Two pushes to the same (owner, key): version 2 and the LATER
+    value end up in every observer's table — LWW, not first-writer."""
+    from scalecube_cluster_tpu.chaos import scenarios as cs
+
+    n = 16
+    params = _push_params(n)
+    rounds = 8 + cs.metadata_convergence_bound(params, n)
+    world = swim.SwimWorld.healthy(params) \
+        .with_metadata_push(5, key=0, value=900, at_round=3) \
+        .with_metadata_push(5, key=0, value=17, at_round=8)
+    state, _ = swim.run(jax.random.key(3), params, world, rounds)
+    for obs in range(n):
+        assert _md_value(state, obs, 5) == 17, obs
+        assert _md_version(state, obs, 5) == 2
+
+
+def test_crashed_owner_cannot_push():
+    n = 16
+    params = _push_params(n)
+    world = swim.SwimWorld.healthy(params) \
+        .with_crash(6, at_round=0) \
+        .with_metadata_push(6, key=0, value=99, at_round=4)
+    state, _ = swim.run(jax.random.key(4), params, world, 40)
+    assert (np.asarray(state.md) == 0).all()
+
+
+def _heal_setup(delivery, n=24, sync_interval=8):
+    from scalecube_cluster_tpu.chaos import scenarios as cs
+
+    p_ctl = _push_params(n, delivery=delivery, sync_interval=0)
+    p_on = dataclasses.replace(p_ctl, sync_interval=sync_interval)
+    phase = -(-cs.quiesce_bound(p_on, n) // 16) * 16
+    rounds = phase + cs.metadata_convergence_bound(p_on, n)
+    world = swim.SwimWorld.healthy(p_on)
+    part = np.zeros((4, n), np.int8)
+    part[0, : n // 2] = 1
+    # the push lands INSIDE the split and goes cold (spread window
+    # expires) long before heal: gossip alone can never carry it to
+    # the far half afterwards
+    world = world.with_partition_schedule(part, phase) \
+        .with_metadata_push(0, key=0, value=321, at_round=8)
+    return p_ctl, p_on, world, rounds
+
+
+@pytest.mark.parametrize("delivery", ["scatter", "shift"])
+def test_quiesced_heal_converges_only_with_exchange(delivery):
+    p_ctl, p_on, world, rounds = _heal_setup(delivery)
+    s_ctl, _ = swim.run(jax.random.key(5), p_ctl, world, rounds)
+    s_on, _ = swim.run(jax.random.key(5), p_on, world, rounds)
+    assert int(md_plane.divergence_probe(s_ctl, p_ctl, world,
+                                         rounds)) > 0
+    assert int(md_plane.divergence_probe(s_on, p_on, world,
+                                         rounds)) == 0
+    for obs in range(p_on.n_members):
+        assert _md_value(s_on, obs, 0) == 321, obs
+    # per-member view of the same fact
+    conv = np.asarray(md_plane.member_converged(s_on, p_on, world,
+                                                rounds))
+    assert conv.all()
+    assert not np.asarray(md_plane.member_converged(
+        s_ctl, p_ctl, world, rounds)).all()
+
+
+# --------------------------------------------------------------------------
+# 4: every run shape carries the plane unchanged
+# --------------------------------------------------------------------------
+
+
+def test_run_shapes_agree_with_pushes():
+    from scalecube_cluster_tpu.chaos import monitor as cm
+
+    n = 16
+    params = _push_params(n, delivery="scatter")
+    world = swim.SwimWorld.healthy(params) \
+        .with_metadata_push(2, key=0, value=55, at_round=3)
+    rounds = 48
+    ref, m_ref = swim.run(jax.random.key(8), params, world, rounds)
+    traced, _, _ = swim.run_traced(jax.random.key(8), params, world,
+                                   rounds)
+    metered, _, m_met = swim.run_metered(jax.random.key(8), params,
+                                         world, rounds)
+    spec = cm.MonitorSpec.passive(params)
+    monitored, _, _ = cm.run_monitored(jax.random.key(8), params, world,
+                                       spec, rounds)
+    mm, _, _, _ = cm.run_monitored_metered(jax.random.key(8), params,
+                                           world, spec, rounds)
+    for other in (traced, metered, monitored, mm):
+        _assert_states_equal(ref, other)
+        assert np.array_equal(np.asarray(ref.md), np.asarray(other.md))
+    assert np.array_equal(np.asarray(m_ref["metadata_divergent"]),
+                          np.asarray(m_met["metadata_divergent"]))
+
+
+def test_checkpoint_roundtrips_metadata_lanes(tmp_path):
+    from scalecube_cluster_tpu.utils import checkpoint as ckpt
+
+    n = 16
+    params = _push_params(n)
+    world = swim.SwimWorld.healthy(params) \
+        .with_metadata_push(1, key=0, value=7, at_round=2)
+    state, _ = swim.run(jax.random.key(9), params, world, 24)
+    path = str(tmp_path / "md.npz")
+    ckpt.save(path, state, next_round=24)
+    restored, next_round, _, _ = ckpt.load(path, params=params)
+    assert next_round == 24
+    assert np.array_equal(np.asarray(state.md), np.asarray(restored.md))
+    assert np.array_equal(np.asarray(state.md_spread),
+                          np.asarray(restored.md_spread))
+
+
+@pytest.mark.multichip
+def test_sharded_pipelined_equals_serial_with_pushes_and_heals():
+    from scalecube_cluster_tpu.parallel import compat
+    from scalecube_cluster_tpu.parallel import mesh as pmesh
+
+    if not compat.HAS_SHARD_MAP:
+        pytest.skip(compat.SKIP_REASON)
+    n = 32
+    _, p_on, world, rounds = _heal_setup("scatter", n=n)
+    mesh = pmesh.make_mesh(4)
+    s_ser, m_ser = pmesh.shard_run(jax.random.key(6), p_on, world,
+                                   rounds, mesh, pipelined=False)
+    s_pip, m_pip = pmesh.shard_run(jax.random.key(6), p_on, world,
+                                   rounds, mesh, pipelined=True)
+    _assert_states_equal(s_ser, s_pip)
+    assert np.array_equal(np.asarray(s_ser.md), np.asarray(s_pip.md))
+    assert np.array_equal(np.asarray(s_ser.md_spread),
+                          np.asarray(s_pip.md_spread))
+    for k in m_ser:
+        assert np.array_equal(np.asarray(m_ser[k]),
+                              np.asarray(m_pip[k])), k
+    assert "metadata_divergent" in m_ser
+    # the sharded run converged: every shard's final table carries the
+    # pushed word for every observer
+    md = np.asarray(s_ser.md).reshape(n, n, 1)
+    assert (np.asarray(md_plane.word_value(md[:, 0, 0])) == 321).all()
+
+
+# --------------------------------------------------------------------------
+# The full churn matrix: identity epochs keep LWW sound (slow)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_config_survives_churn_storm_scenario():
+    """A ConfigPush riding a real churn scenario end to end through the
+    monitored campaign path: metadata auto-armed, the monitor green,
+    and the pushed word converged across the survivors at horizon."""
+    from scalecube_cluster_tpu.chaos import campaign as cc
+    from scalecube_cluster_tpu.chaos import scenarios as cs
+
+    n = 24
+    storm = cs.ChurnStorm(nodes=(1, 2, 3, 4), wave_size=2,
+                          start_round=8, wave_every=24, down_rounds=60)
+    push = cs.ConfigPush(node=9, key=0, value=777, at_round=12)
+    params0 = swim.SwimParams.from_config(
+        cc.campaign_config(), n_members=n, delivery="shift",
+        sync_every=0, sync_interval=8, metadata_keys=1)
+    bound = cs.metadata_convergence_bound(params0, n)
+    horizon = -(-(storm.start_round + 2 * 24 + 60 + bound) // 64) * 64
+    scen = cs.Scenario(name="churn+push", n_members=n, horizon=horizon,
+                       ops=(storm, push), loss_probability=0.0, seed=0)
+    params = cc.campaign_params(scen, delivery="shift", sync_every=0,
+                                sync_interval=8)
+    assert params.metadata_keys == 1     # armed by the scenario
+    world, spec = scen.build(params)
+    from scalecube_cluster_tpu.chaos import monitor as cm
+
+    state, mon, _ = cm.run_monitored(
+        jax.random.key(0), params, world, spec, horizon)
+    assert cm.verdict(mon)["green"]
+    assert int(md_plane.divergence_probe(state, params, world,
+                                         horizon)) == 0
+    for obs in range(n):
+        assert _md_value(state, obs, 9) == 777, obs
+
+
+def test_merge_frozen_rows_keep_their_lanes():
+    """Frozen (crashed/left) rows are a stopped JVM: arrivals that
+    would improve them are ignored and their spread lanes hold — the
+    same carry-freeze rule every other plane follows."""
+    params = _merge_params()
+    md = jnp.zeros((4, 4, 1), jnp.int32)
+    spread = jnp.full((4, 4), 7, jnp.int32)
+    arr = jnp.zeros((4, 4, 1), jnp.int32)
+    arr = arr.at[2, 0, 0].set(int(md_plane.pack_word(0, 4, 8)))
+    arr = arr.at[3, 0, 0].set(int(md_plane.pack_word(0, 4, 8)))
+    frozen = jnp.asarray([False, False, True, False])
+    new_md, new_spread = md_plane.merge(
+        md, spread, arr.reshape(4, 4), jnp.int32(10), params,
+        jnp.zeros((4, 4), jnp.bool_), jnp.zeros((4, 4), jnp.int32),
+        frozen)
+    assert int(new_md[2, 0, 0]) == 0            # frozen: word dropped
+    assert int(new_spread[2, 0]) == 7           # frozen: lane held
+    assert int(new_md[3, 0, 0]) == int(md_plane.pack_word(0, 4, 8))
+    assert int(new_spread[3, 0]) == 10 + 1 + params.periods_to_spread
+
+
+def test_dead_suppression_window_keeps_words_and_versions_resume():
+    """dead_suppress_rounds interplay: a crashed owner's words are NOT
+    tombstoned — observers keep the last LWW value straight through the
+    suppression window, the owner's frozen row preserves its version
+    counter, and the first post-revival push resumes at version 2 and
+    reconverges everywhere."""
+    from scalecube_cluster_tpu.chaos import scenarios as cs
+
+    n = 16
+    params = _push_params(n, dead_suppress_rounds=24)
+    bound = cs.metadata_convergence_bound(params, n)
+    crash_at, revive_at = 8 + bound, 8 + bound + 40   # > suppress window
+    world = (swim.SwimWorld.healthy(params)
+             .with_metadata_push(2, key=0, value=555, at_round=4)
+             .with_crash(2, at_round=crash_at, until_round=revive_at)
+             .with_metadata_push(2, key=0, value=777,
+                                 at_round=revive_at + 8))
+    rounds = revive_at + 8 + bound
+
+    # Mid-run probe: inside the dead window every live observer still
+    # holds the dead owner's last write (no tombstone zeroing).
+    mid_state, _ = swim.run(jax.random.key(3), params, world,
+                            crash_at + 12)
+    for obs in range(n):
+        if obs != 2:
+            assert _md_value(mid_state, obs, 2) == 555, obs
+            assert _md_version(mid_state, obs, 2) == 1
+
+    state, _ = swim.run(jax.random.key(3), params, world, rounds)
+    for obs in range(n):
+        assert _md_value(state, obs, 2) == 777, obs
+        assert _md_version(state, obs, 2) == 2, obs     # counter resumed
+    assert int(md_plane.divergence_probe(state, params, world,
+                                         rounds)) == 0

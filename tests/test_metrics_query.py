@@ -788,6 +788,119 @@ def test_load_bench_payload_accepts_soak_artifact(tmp_path):
     assert payload["rounds_survived"] == 2048
 
 
+def _rollout_payload(**overrides):
+    payload = {
+        "metric": "config_rollout_convergence", "value": None,
+        "metadata_convergence_p99": 12.0, "rollout_converged": True,
+        "rolled_back": False, "convergence_deadline_rounds": 58,
+        "final_divergent_cells": 0, "control_divergent_cells": 24,
+        "control_converged": False, "monitored_green": True,
+        "monitor_violations": 0, "n_members": 48, "metadata_keys": 1,
+        "n_stages": 3, "stage_size": 4, "sync_interval": 8,
+    }
+    payload.update(overrides)
+    return payload
+
+
+def test_regress_rollout_gates(tmp_path):
+    """The --rollout artifact's ABSOLUTE gates: converged inside the
+    deadline with no rollback, the gossip-only control still
+    divergent, zero monitor violations — the committed claim cannot
+    silently rot."""
+    art = tmp_path / "config_rollout.json"
+    with open(art, "w") as f:
+        json.dump(_rollout_payload(), f)
+    ok, rows = query.regress([str(art)])
+    assert ok, rows
+    checks = {r["check"] for r in rows if r.get("ok") is not None}
+    assert {"slo/rollout_converged", "slo/rollout_not_rolled_back",
+            "slo/rollout_control_diverges",
+            "slo/metadata_convergence_p99_within_bound",
+            "slo/rollout_monitor_violations"} <= checks
+
+    bad_cases = [
+        ("slo/rollout_converged",
+         dict(rollout_converged=False, final_divergent_cells=3)),
+        ("slo/rollout_not_rolled_back", dict(rolled_back=True)),
+        ("slo/rollout_control_diverges",
+         dict(control_converged=True, control_divergent_cells=0)),
+        ("slo/metadata_convergence_p99_within_bound",
+         dict(metadata_convergence_p99=200.0)),
+        ("slo/rollout_monitor_violations", dict(monitor_violations=2)),
+    ]
+    for check_name, overrides in bad_cases:
+        with open(art, "w") as f:
+            json.dump(_rollout_payload(**overrides), f)
+        ok, rows = query.regress([str(art)])
+        assert not ok, check_name
+        assert any(r["check"] == check_name
+                   for r in rows if r.get("ok") is False), check_name
+
+
+def test_regress_bands_rollout_convergence_series(tmp_path):
+    """The p99 series gates within the band, floored at one exchange
+    interval (phase luck must not make a lucky prior a knife edge)."""
+    def art(path, p99):
+        path.write_text(json.dumps(_rollout_payload(
+            metadata_convergence_p99=p99)))
+        return str(path)
+
+    a = art(tmp_path / "config_rollout_r01.json", 1.0)   # lucky phase
+    ok, _ = query.regress(
+        [a, art(tmp_path / "config_rollout_r02.json", 9.0)])
+    assert ok                                            # inside floor
+    ok, rows = query.regress(
+        [a, art(tmp_path / "config_rollout_r03.json", 120.0)])
+    assert not ok
+    assert any(r["check"] == "slo/metadata_convergence_p99"
+               and r["ok"] is False for r in rows)
+
+
+def test_regress_rollout_smoke_is_provenance_beside_full_round(
+        tmp_path):
+    """A smoke rollout next to a full round is provenance only — but a
+    walk holding ONLY smoke rounds still gates them (the sync-heal
+    fallback rule, so `--rollout --smoke`'s in-bench check bites)."""
+    smoke = tmp_path / "config_rollout_smoke.json"
+    smoke.write_text(json.dumps(_rollout_payload(
+        smoke=True, rollout_converged=False, rolled_back=True,
+        monitor_violations=9)))
+    full = tmp_path / "config_rollout.json"
+    full.write_text(json.dumps(_rollout_payload()))
+    ok, rows = query.regress([str(smoke), str(full)])
+    assert ok, rows          # the red smoke round is provenance only
+    assert any(r["check"] == "slo/config_rollout" and r["ok"] is None
+               for r in rows)
+    # smoke-only walk: the gates bite the smoke round itself
+    ok, rows = query.regress([str(smoke)])
+    assert not ok
+    assert any(r["check"] == "slo/rollout_converged"
+               and r["ok"] is False for r in rows)
+
+
+def test_load_bench_payload_accepts_rollout_artifact(tmp_path):
+    art = tmp_path / "config_rollout.json"
+    with open(art, "w") as f:
+        json.dump(_rollout_payload(), f)
+    payload, note = query.load_bench_payload(str(art))
+    assert note is None
+    assert payload["rollout_converged"] is True
+
+
+def test_cli_regress_default_globs_include_rollout(tmp_path, capsys,
+                                                   monkeypatch):
+    """Bare ``regress`` walks artifacts/config_rollout*.json — the
+    committed rollout round passes its absolute gates."""
+    monkeypatch.chdir(REPO)
+    assert cli_main(["regress", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] is True
+    ro_rows = [r for r in out["checks"]
+               if r.get("source", "").startswith("config_rollout")]
+    assert any(r["check"] == "slo/rollout_converged"
+               and r.get("ok") is True for r in ro_rows)
+
+
 def test_cli_regress_default_globs_include_soak(tmp_path, capsys,
                                                 monkeypatch):
     """Bare ``regress`` walks artifacts/soak_report*.json — the

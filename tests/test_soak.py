@@ -139,27 +139,31 @@ def test_soak_schedule_concatenates_the_stream():
 SOAK_SEED_STABILITY_PIN = {
     (0, 0, "mild"): "edge_flap+crash_revive",
     (0, 1, "mild"): "edge_loss+flap",
-    (0, 3, "mild"): "edge_flap+crash_revive",
+    (0, 3, "mild"): "edge_flap+crash_revive+config_push",
     (0, 0, "moderate"): "edge_loss+flap+loss_window",
     (0, 1, "moderate"): "edge_crash+loss_window+burst",
     (0, 3, "moderate"): "edge_crash+crash_revive+flap",
-    (0, 0, "severe"): "edge_flap+flap+brownout+crash_revive",
-    (0, 1, "severe"): "edge_loss+flap+brownout+crash_revive+join_storm",
+    (0, 0, "severe"): "edge_flap+flap+brownout+crash_revive+config_push",
+    (0, 1, "severe"):
+        "edge_loss+flap+brownout+crash_revive+join_storm+config_push",
     (0, 3, "severe"): "edge_loss+flap+loss_window+loss_window",
     (7, 0, "mild"): "edge_crash+flap",
-    (7, 1, "mild"): "edge_flap+loss_window",
+    (7, 1, "mild"): "edge_flap+loss_window+config_push",
     (7, 3, "mild"): "edge_crash+crash_revive",
     (7, 0, "moderate"): "edge_loss+crash_revive+brownout+join_storm",
     (7, 1, "moderate"): "edge_loss+crash_revive+loss_window",
-    (7, 3, "moderate"): "edge_loss+flap+brownout+join_storm",
-    (7, 0, "severe"): "edge_crash+burst+crash_revive+brownout",
-    (7, 1, "severe"): "edge_flap+churn+burst+crash_revive",
+    (7, 3, "moderate"):
+        "edge_loss+flap+brownout+join_storm+config_push",
+    (7, 0, "severe"): "edge_crash+burst+crash_revive+brownout+config_push",
+    (7, 1, "severe"): "edge_flap+churn+burst+crash_revive+config_push",
     (7, 3, "severe"): "edge_flap+loss_window+brownout+loss_window",
     (11, 0, "moderate"): "edge_crash+loss_window+burst",
     (11, 1, "moderate"): "edge_flap+crash_revive+brownout",
-    (11, 3, "moderate"): "edge_loss+brownout+crash_revive+join_storm",
+    (11, 3, "moderate"):
+        "edge_loss+brownout+crash_revive+join_storm+config_push",
     (1234, 0, "severe"): "edge_loss+brownout+churn+burst",
-    (1234, 1, "severe"): "edge_flap+crash_revive+brownout+flap+join_storm",
+    (1234, 1, "severe"):
+        "edge_flap+crash_revive+brownout+flap+join_storm+config_push",
     (1234, 3, "severe"): "edge_flap+loss_window+loss_window+flap",
 }
 
@@ -189,6 +193,38 @@ def test_soak_exact_op_pin():
         cs.LinkLoss(src=11, dst=1, loss=0.5, from_round=279,
                     until_round=304),
     )
+
+
+def test_soak_exact_config_push_pin():
+    # The trailing config rung, fully field-pinned: the owner comes
+    # from the quorum-reserve ring (segment_index % ring length), the
+    # value/round from the trailing draws — all global-round, all
+    # replayable.
+    seg = ss.soak_segment(7, 1, n=32, severity="mild")
+    assert seg.kinds[-1] == "config_push"
+    assert seg.ops[-1] == cs.ConfigPush(node=19, key=0, value=905,
+                                        at_round=348)
+    ring = ss._config_owner_ring(7, 32, "mild")
+    assert ring[1 % len(ring)] == 19
+
+
+def test_config_push_owners_roll_through_the_reserve():
+    # Push owners are quorum-reserve members (never node-faulted) and
+    # rotate with the segment index — the "rolling" in rolling config
+    # pushes.
+    pool = set(ss._fault_pool(7, 32, "severe"))
+    ring = ss._config_owner_ring(7, 32, "severe")
+    assert set(ring).isdisjoint(pool)
+    assert set(ring) | pool == set(range(32))
+    owners = []
+    for idx in range(12):
+        seg = ss.soak_segment(7, idx, n=32, severity="severe")
+        for op in seg.ops:
+            if isinstance(op, cs.ConfigPush):
+                assert op.node == ring[idx % len(ring)]
+                assert seg.round_start <= op.at_round < seg.round_end
+                owners.append(op.node)
+    assert len(set(owners)) > 1   # the ring actually rolls
 
 
 # --------------------------------------------------------------------------
