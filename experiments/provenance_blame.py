@@ -1,0 +1,92 @@
+"""Provenance blame drill: per-belief channel attribution, measured.
+
+Drives ``bench.py --blame`` (the one entry point the blame measurement
+flows through, so the experiment and the driver bench cannot drift):
+the seeded ``chaos.blame_drill_scenario`` — ONE asymmetric faulty link
+(victim→observer acks drop at loss=1.0, every other link pristine) —
+run through the composed stack with the provenance plane armed.  Four
+claims measured and regress-gated ABSOLUTELY:
+
+  - BLAME: the host-side blame engine, fed only the recorded
+    (observer, subject, transition, channel, round) attributions, must
+    name the planted link's observer as ``origin_observer`` with a
+    first-hand ``fd_direct`` sighting — even though almost every other
+    member heard the false suspicion second-hand via gossip;
+  - ATTRIBUTION: every recorded transition carries exactly one channel
+    (fractions sum to 1.0), zero provenance-buffer and trace drops;
+  - OFF-SWITCH: the same composed run with ``provenance=False`` is
+    bit-identical in protocol states AND stacked metrics;
+  - OVERHEAD: ``provenance_overhead_ratio`` (interleaved best-of,
+    armed vs bare composed stack) <= query.PROVENANCE_OVERHEAD_LIMIT.
+
+Writes ``artifacts/provenance_blame.json`` (override
+``SCALECUBE_BLAME_ARTIFACT``) plus the journal with the new
+``provenance`` record kind next to it.  Any recorded belief replays
+from the journal alone::
+
+    python -m scalecube_cluster_tpu.telemetry explain \
+        artifacts/provenance_blame_journal.jsonl \
+        --observer 11 --subject 3
+
+CPU-safe (the drill is seeded; the overhead arm is an interleaved
+best-of, resilient to host-load jitter).
+
+Usage:
+    python experiments/provenance_blame.py            # committed shape
+    python experiments/provenance_blame.py --smoke    # tier-1-safe pass
+    python experiments/provenance_blame.py --n 48 --seed 7
+"""
+
+import argparse
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--smoke", action="store_true",
+                        help="tier-1-safe fast pass (the bench smoke "
+                             "geometry: n=16, 128-round horizon)")
+    parser.add_argument("--n", type=int, default=None,
+                        help="member count (bench default: 48 full / "
+                             "16 smoke)")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="scenario + run seed (default 7)")
+    parser.add_argument("--victim", type=int, default=None,
+                        help="the falsely-suspected member (default 3)")
+    parser.add_argument("--observer", type=int, default=None,
+                        help="the member behind the faulty link "
+                             "(default 11)")
+    parser.add_argument("--reps", type=int, default=None,
+                        help="overhead-arm interleaved windows "
+                             "(default 40)")
+    parser.add_argument("--artifact", default=None,
+                        help="artifact path (default "
+                             "artifacts/provenance_blame.json; smoke "
+                             "runs default to "
+                             "provenance_blame_smoke.json)")
+    args = parser.parse_args()
+
+    env = dict(os.environ)
+    for flag, var in ((args.n, "SCALECUBE_BLAME_N"),
+                      (args.seed, "SCALECUBE_BLAME_SEED"),
+                      (args.victim, "SCALECUBE_BLAME_VICTIM"),
+                      (args.observer, "SCALECUBE_BLAME_OBSERVER"),
+                      (args.reps, "SCALECUBE_BLAME_REPS"),
+                      (args.artifact, "SCALECUBE_BLAME_ARTIFACT")):
+        if flag is not None:
+            env[var] = str(flag)
+
+    cmd = [sys.executable, str(REPO / "bench.py"), "--blame"]
+    if args.smoke:
+        cmd.append("--smoke")
+    proc = subprocess.run(cmd, cwd=str(REPO), env=env)
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,6 +169,7 @@ TICK_INTERNALS: Sequence[Tuple[str, str]] = (
     ("telemetry/trace.py", "observe_round"),
     ("telemetry/trace.py", "observe_round_codes"),
     ("telemetry/metrics.py", "observe_tick"),
+    ("models/provenance.py", "observe_round"),
     ("chaos/monitor.py", "check_round"),
 )
 

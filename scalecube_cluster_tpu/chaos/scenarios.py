@@ -939,6 +939,60 @@ def alarm_drill_scenario(seed: int, n: int = 32,
                     ops=ops, seed=seed)
 
 
+def blame_drill_scenario(seed: int, n: int = 32,
+                         victim: int = 3, observer: int = 11,
+                         onset_round: int = 32,
+                         pulse_rounds: int = 96,
+                         cool_rounds: int = 96) -> Scenario:
+    """Seeded single-fault drill for the provenance blame engine
+    (bench.py --blame): ONE asymmetric faulty link, one victim.
+
+    During ``[onset_round, onset_round + pulse_rounds)`` every message
+    from ``victim`` TO ``observer`` drops (``loss=1.0`` on that one
+    directed link) while every other link — including the reverse
+    direction — stays pristine.  The observer's direct probes of the
+    victim reach it fine but the acks never come back, so the observer
+    (and ONLY the observer, first-hand) times the victim out and
+    starts the false suspicion; everyone else learns of it second-hand
+    via piggyback gossip, and the victim — alive the whole time —
+    refutes with an incarnation bump that spreads through third
+    parties.  That is exactly the asymmetry the blame report must see
+    through: ``origin_observer`` must name the observer even though
+    most of the cluster heard the rumor from a gossip carrier.
+
+    Run it with ``ping_req_members=0`` (the bench does) so the
+    first-hand sighting is unambiguously ``fd_direct`` — a ping-req
+    proxy probing on the observer's behalf would get an ack (the
+    victim→proxy link is clean) and mask the fault.  The pulse heals
+    after ``pulse_rounds`` and the horizon leaves ``cool_rounds`` for
+    the refutation to settle.  Pure in its arguments (the fault is
+    deterministic; ``seed`` seeds the RUN key and names the repro):
+    ``chaos.blame_drill_scenario(seed=S, n=N)``.
+    """
+    if n < 16:
+        raise ValueError(
+            f"blame_drill_scenario needs n >= 16 (got {n}) — the "
+            f"rumor needs a crowd of second-hand observers")
+    if not (0 <= victim < n and 0 <= observer < n) or victim == observer:
+        raise ValueError(
+            f"blame_drill_scenario needs distinct victim/observer ids "
+            f"in [0, {n}) (got {victim}, {observer})")
+    if pulse_rounds < 1 or cool_rounds < 1:
+        raise ValueError(
+            f"blame_drill_scenario needs pulse_rounds >= 1 and "
+            f"cool_rounds >= 1 (got {pulse_rounds}, {cool_rounds}) — "
+            f"no pulse means no suspicion, no cooldown means no "
+            f"refutation window")
+    ops = (
+        LinkLoss(src=int(victim), dst=int(observer), loss=1.0,
+                 from_round=int(onset_round),
+                 until_round=int(onset_round + pulse_rounds)),
+    )
+    return Scenario(name=f"blame-drill-{seed}-n{n}", n_members=n,
+                    horizon=int(onset_round + pulse_rounds + cool_rounds),
+                    ops=ops, seed=seed)
+
+
 def churn_growth_scenario(seed: int, n: int = 32, waves: int = 3,
                           wave_size: int = 2, join_wave_size: int = 3,
                           join_lag: Optional[int] = None,

@@ -100,11 +100,11 @@ class RoundCtx:
     """
 
     __slots__ = ("params", "world", "kn", "round_idx", "prev", "new",
-                 "metrics", "offset", "axis_name", "lead", "_cache",
-                 "_plane_prev", "_plane_new")
+                 "metrics", "offset", "axis_name", "lead", "provenance",
+                 "_cache", "_plane_prev", "_plane_new")
 
     def __init__(self, params, world, kn, round_idx, prev, new, metrics,
-                 offset=0, axis_name=None, lead=None):
+                 offset=0, axis_name=None, lead=None, provenance=None):
         self.params = params
         self.world = world
         self.kn = kn
@@ -115,6 +115,11 @@ class RoundCtx:
         self.offset = offset
         self.axis_name = axis_name
         self.lead = lead
+        # The tick's per-channel folded maxima (SwimParams.provenance:
+        # dict(fd=, gossip=, sync=, ping_req=) of local-row arrays),
+        # popped out of the metrics dict by the scan drivers BEFORE the
+        # scan stacks metrics — None when the knob is off.
+        self.provenance = provenance
         self._cache = {}
         self._plane_prev = {}
         self._plane_new = {}
@@ -262,6 +267,16 @@ class FinalCtx:
 # --------------------------------------------------------------------------
 
 
+def _pop_provenance(m):
+    """Detach the tick's in-band provenance evidence from the metrics
+    dict (the ``swim._round_metrics`` passthrough) BEFORE the scan
+    stacks it: the per-channel [n_local, K] maxima ride the
+    :class:`RoundCtx` for the provenance plane's attribution and must
+    never reach a ``[rounds, N, K]`` stacked metrics trace.  Returns
+    the popped dict, or None when the knob is off."""
+    return m.pop("_provenance", None)
+
+
 def _apply_planes(planes, rc: RoundCtx, slices) -> Tuple:
     """One round's plane folds, in stack order, publishing each plane's
     before/after slice into the ctx for cross-plane reads."""
@@ -307,7 +322,9 @@ def composed_scan(base_key, params: "swim.SwimParams",
         st, pcs = carry
         new_st, m = swim.swim_tick(st, round_idx, base_key, params, world,
                                    knobs=kn, shift_key=shift_key)
-        rc = RoundCtx(params, world, kn, round_idx, st, new_st, m)
+        prov = _pop_provenance(m)
+        rc = RoundCtx(params, world, kn, round_idx, st, new_st, m,
+                      provenance=prov)
         return (new_st, _apply_planes(planes, rc, pcs)), m
 
     k = params.rounds_per_step
@@ -328,7 +345,9 @@ def composed_scan(base_key, params: "swim.SwimParams",
                 st, m = swim.swim_tick(prev, rounds_k[j], base_key,
                                        params, world, knobs=kn,
                                        shift_key=shift_key)
-                rc = RoundCtx(params, world, kn, rounds_k[j], prev, st, m)
+                prov = _pop_provenance(m)
+                rc = RoundCtx(params, world, kn, rounds_k[j], prev, st, m,
+                              provenance=prov)
                 for i, plane in enumerate(planes):
                     rc._plane_prev[plane.name] = pcs[i]
                     if i in step_outs:
@@ -408,6 +427,10 @@ def _pipelined_rounds(base_key, params: "swim.SwimParams",
         new_st, metrics = recv(st, pend, aux, round_idx - 1)
         if on_round is not None:
             extra = on_round(extra, st, round_idx - 1, new_st, metrics)
+        # on_round pops the provenance evidence into its RoundCtx; this
+        # defensive pop keeps the STACKED metrics clean when no planes
+        # ride (provenance on, plane off — still a valid config).
+        _pop_provenance(metrics)
         new_pend, new_aux = send(new_st, round_idx)
         return (new_st, new_pend, new_aux, extra), metrics
 
@@ -419,6 +442,7 @@ def _pipelined_rounds(base_key, params: "swim.SwimParams",
     final_state, last_metrics = recv(st, pend, aux, last)
     if on_round is not None:
         extra = on_round(extra, st, last, final_state, last_metrics)
+    _pop_provenance(last_metrics)
     metrics = jax.tree.map(
         lambda rows, tail: jnp.concatenate([rows, tail[None]], axis=0),
         ms, last_metrics,
@@ -449,8 +473,10 @@ def composed_shard_scan(base_key, params: "swim.SwimParams",
 
     if use_pipeline:
         def on_round(pcs, prev_st, round_idx, new_st, m):
+            prov = _pop_provenance(m)
             rc = RoundCtx(params, world, kn, round_idx, prev_st, new_st,
-                          m, offset=offset, axis_name=axis, lead=lead)
+                          m, offset=offset, axis_name=axis, lead=lead,
+                          provenance=prov)
             return _apply_planes(planes, rc, pcs)
 
         final_state, slices, metrics = _pipelined_rounds(
@@ -465,8 +491,10 @@ def composed_shard_scan(base_key, params: "swim.SwimParams",
                 st, round_idx, base_key, params, world,
                 offset=offset, axis_name=axis, n_devices=n_dev,
             )
+            prov = _pop_provenance(m)
             rc = RoundCtx(params, world, kn, round_idx, st, new_st, m,
-                          offset=offset, axis_name=axis, lead=lead)
+                          offset=offset, axis_name=axis, lead=lead,
+                          provenance=prov)
             return (new_st, _apply_planes(planes, rc, pcs)), m
 
         # _fused_scan honors params.rounds_per_step (bit-identical for
@@ -574,9 +602,9 @@ class BatchRoundCtx(RoundCtx):
         new_vals = tuple(self._plane_new[n] for n in new_names)
 
         def row(world, kn, prev, new, metrics, sl_row, cvals, pvals,
-                nvals):
+                nvals, prov):
             rc = RoundCtx(self.params, world, kn, self.round_idx, prev,
-                          new, metrics)
+                          new, metrics, provenance=prov)
             rc._cache.update(zip(cache_keys, cvals))
             rc._plane_prev.update(zip(prev_names, pvals))
             rc._plane_new.update(zip(new_names, nvals))
@@ -584,7 +612,7 @@ class BatchRoundCtx(RoundCtx):
 
         return jax.vmap(row)(self.world, self.kn, self.prev, self.new,
                              self.metrics, sl, cache_vals, prev_vals,
-                             new_vals)
+                             new_vals, self.provenance)
 
 
 def _apply_planes_batch(planes, rc: BatchRoundCtx, slices) -> Tuple:
@@ -662,8 +690,9 @@ def composed_batch_scan(base_keys, params: "swim.SwimParams", worlds,
             lambda st, key, w, k: swim.swim_tick(st, round_idx, key,
                                                  params, w, knobs=k)
         )(sts, base_keys, worlds, kn)
+        prov = _pop_provenance(ms)
         rc = BatchRoundCtx(params, worlds, kn, round_idx, sts, new_sts,
-                           ms)
+                           ms, provenance=prov)
         return (new_sts, _apply_planes_batch(planes, rc, pcs)), ms
 
     (final_states, slices), metrics = swim._fused_scan(
@@ -716,10 +745,12 @@ def batch_shard_unsupported_reason(params: "swim.SwimParams") -> str:
 def build_stack(with_trace: bool, with_metrics: bool, with_monitor: bool,
                 monitor_spec=None, trace_capacity=None, metrics_spec=None,
                 monitor_capacity=None, telemetry=None, metrics_state=None,
-                monitor=None):
+                monitor=None, with_provenance: bool = False,
+                provenance_capacity=None):
     """The observer-plane stack of :func:`run_composed`, in canonical
-    order (monitor before metrics, so the metered chaos_violations
-    counter can read the monitor's per-round count delta)."""
+    order (trace, then provenance, then monitor before metrics, so the
+    metered chaos_violations counter can read the monitor's per-round
+    count delta)."""
     planes = []
     if with_trace:
         from scalecube_cluster_tpu.telemetry import trace as ttrace
@@ -728,6 +759,13 @@ def build_stack(with_trace: bool, with_metrics: bool, with_monitor: bool,
             capacity=(ttrace.DEFAULT_CAPACITY if trace_capacity is None
                       else trace_capacity),
             telemetry=telemetry,
+        ))
+    if with_provenance:
+        from scalecube_cluster_tpu.models import provenance as mprov
+
+        planes.append(mprov.ProvenancePlane(
+            capacity=(mprov.DEFAULT_CAPACITY if provenance_capacity is None
+                      else provenance_capacity),
         ))
     if with_monitor:
         from scalecube_cluster_tpu.chaos import monitor as cmonitor
@@ -758,7 +796,8 @@ def build_stack(with_trace: bool, with_metrics: bool, with_monitor: bool,
 @partial(jax.jit,
          static_argnames=("params", "n_rounds", "with_trace",
                           "with_metrics", "with_monitor", "trace_capacity",
-                          "metrics_spec", "monitor_capacity"),
+                          "metrics_spec", "monitor_capacity",
+                          "with_provenance", "provenance_capacity"),
          donate_argnames=("state",))
 def run_composed(base_key, params: "swim.SwimParams",
                  world: "swim.SwimWorld", n_rounds: int,
@@ -769,7 +808,9 @@ def run_composed(base_key, params: "swim.SwimParams",
                  state: Optional["swim.SwimState"] = None,
                  start_round: int = 0,
                  knobs: Optional["swim.Knobs"] = None, shift_key=None,
-                 telemetry=None, metrics_state=None, monitor=None):
+                 telemetry=None, metrics_state=None, monitor=None,
+                 with_provenance: bool = False,
+                 provenance_capacity: Optional[int] = None):
     """The FULL instrumented stack in one compiled program and one scan:
     event trace ⊕ invariant monitor ⊕ health-metrics registry riding
     the protocol scan together, sharing one :class:`RoundCtx` per
@@ -795,6 +836,8 @@ def run_composed(base_key, params: "swim.SwimParams",
         monitor_spec=monitor_spec, trace_capacity=trace_capacity,
         metrics_spec=metrics_spec, monitor_capacity=monitor_capacity,
         telemetry=telemetry, metrics_state=metrics_state, monitor=monitor,
+        with_provenance=with_provenance,
+        provenance_capacity=provenance_capacity,
     )
     return composed_scan(base_key, params, world, n_rounds, planes=stack,
                          state=state, start_round=start_round, knobs=knobs,
@@ -828,6 +871,11 @@ _CORE_PLANES = (
 _OBSERVER_PLANES = (
     dict(name="trace", kind="observer", knobs=(), lanes=(),
          doc="membership event trace (telemetry/trace.TracePlane)"),
+    dict(name="provenance", kind="observer", knobs=("provenance",),
+         lanes=(),
+         doc="per-belief channel attribution "
+             "(models/provenance.ProvenancePlane); the knob arms the "
+             "tick bodies' per-channel exposure the plane reads"),
     dict(name="monitor", kind="observer", knobs=(), lanes=(),
          doc="in-jit invariant monitor (chaos/monitor.MonitorPlane)"),
     dict(name="metrics", kind="observer", knobs=(), lanes=(),

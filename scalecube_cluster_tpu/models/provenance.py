@@ -1,0 +1,433 @@
+"""The provenance plane: per-belief CHANNEL ATTRIBUTION, in-jit.
+
+The trace plane (telemetry/trace.py) records THAT a belief changed;
+the metrics plane (telemetry/metrics.py) records HOW OFTEN.  Neither
+records *via which channel* the evidence arrived — so a false-positive
+death cannot be traced back to the faulty link that planted it.  This
+plane closes that gap: for every (observer, subject) status transition
+it names the winning channel —
+
+  CH_FD_DIRECT        the observer's own failure-detector verdict: a
+                      direct-probe timeout (SUSPECT) or the suspicion
+                      timer firing (DEAD) — first-hand evidence
+  CH_PINGREQ_PROXY    the FD verdict reached THROUGH proxies: the
+                      direct probe failed and k ping-req proxies were
+                      launched before the verdict (Lifeguard's
+                      indirect-probe stage)
+  CH_GOSSIP           a piggybacked membership record on the gossip
+                      fanout — the infection-style channel
+  CH_SYNC             a SYNC family exchange: periodic anti-entropy,
+                      a refutation push, or the joiner<->seed round
+                      trip (the join path IS a SYNC exchange)
+  CH_SELF_REFUTATION  the observer is the subject and bumped its own
+                      incarnation to refute a suspicion about itself
+  CH_JOIN_REBIRTH     the subject was ADMITTED into the slot this very
+                      round (open-world JOIN); later observers that
+                      learn of the admission through the wire attribute
+                      to the carrying channel, not to the admission
+
+by comparing the round's folded winner key against the per-channel
+folded maxima the tick bodies expose when ``SwimParams.provenance`` is
+on (models/swim.py: scatter, shift, k_block, and both pipelined
+halves expose ``dict(fd=, gossip=, sync=, ping_req=)`` into the shared
+``RoundCtx``).  The exposure is strictly ADDITIVE — the combined inbox
+dataflow is textually untouched, so the off-switch is bit-identical
+and the on-switch is state-identical (tests/test_provenance.py pins
+both).
+
+The attribution cascade is TOTAL: every transitioned cell gets exactly
+one channel (the bench gate checks the fractions sum to 1.0).
+Priority, most-specific first: join-rebirth, then timer-fired removals
+(a DEAD transition whose wire winner is not DEAD came from the local
+suspicion timer — FD), then the FD key when it ties the winner (split
+direct vs ping-req-proxy by the per-row launch flag), then SYNC on a
+winner tie (SYNC beats GOSSIP: the exchange is the more specific
+evidence when both delivered the identical key), then GOSSIP, with FD
+as the residual fallback (a transition none of the wire maxima explain
+is first-hand by elimination — e.g. the merge funnel's own in-tick
+edges).
+
+Records land in a fixed-capacity overflow-counted buffer — the
+record_events_batch idiom from telemetry/trace.py: one cumsum + one
+scatter per round, nothing silently truncated — journaled host-side as
+the ``provenance`` record kind (telemetry/sink.py) and mined by the
+blame engine (telemetry/query.py: infection paths, channel-mix SLOs,
+``python -m scalecube_cluster_tpu.telemetry explain``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalecube_cluster_tpu import records
+from scalecube_cluster_tpu.ops import delivery
+from scalecube_cluster_tpu.telemetry import trace as ttrace
+from scalecube_cluster_tpu.telemetry.events import TraceEventType
+
+# Channel codes, in cascade-priority order (decode_attributions and the
+# blame engine name them through CHANNEL_NAMES; tests pin the values).
+CH_FD_DIRECT = 0
+CH_PINGREQ_PROXY = 1
+CH_GOSSIP = 2
+CH_SYNC = 3
+CH_SELF_REFUTATION = 4
+CH_JOIN_REBIRTH = 5
+
+CHANNEL_NAMES = ("fd_direct", "pingreq_proxy", "gossip", "sync",
+                 "self_refutation", "join_rebirth")
+
+# (observer, subject, epoch, transition, channel, round) per record.
+_N_LANES = 6
+
+# Same sizing logic as the event trace: a transition emits at most one
+# record per (observer, subject) cell per round, so the crash-scenario
+# envelope matches the trace plane's; 65536 x 6 lanes x 4 B = 1.5 MB.
+DEFAULT_CAPACITY = 1 << 16
+
+
+# --------------------------------------------------------------------------
+# Carried state
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ProvenanceState:
+    """Fixed-capacity attribution buffer (module docstring).
+
+    ``lanes[i] = (observer, subject, epoch, transition, channel,
+    round)`` for i < ``count``, in (round, observer-major cell) order;
+    ``dropped`` counts records lost to overflow — the decoded buffer is
+    an exact prefix of the attribution stream, never a silent sample.
+    """
+
+    lanes: jnp.ndarray      # [capacity, 6] int32
+    count: jnp.ndarray      # int32 scalar: records written (<= capacity)
+    dropped: jnp.ndarray    # int32 scalar: records lost to overflow
+
+    @property
+    def capacity(self) -> int:
+        return self.lanes.shape[0]
+
+    @staticmethod
+    def empty(capacity: int = DEFAULT_CAPACITY) -> "ProvenanceState":
+        return ProvenanceState(
+            lanes=jnp.full((capacity, _N_LANES), -1, dtype=jnp.int32),
+            count=jnp.int32(0),
+            dropped=jnp.int32(0),
+        )
+
+
+jax.tree_util.register_dataclass(
+    ProvenanceState, data_fields=["lanes", "count", "dropped"],
+    meta_fields=[],
+)
+
+
+# --------------------------------------------------------------------------
+# The attribution cascade (pure, unit-testable)
+# --------------------------------------------------------------------------
+
+
+def attribute_channels(params, prov, codes, join_now):
+    """[n_local, K] int8 channel code per cell (module-docstring cascade).
+
+    ``prov`` is the tick's exposure dict (``fd``/``gossip``/``sync``
+    [n_local, K] wire keys, ``ping_req`` [n_local] bool); ``codes`` the
+    round's transition codes (0 = no event — those cells' channel
+    values are meaningless and masked out by the recorder); ``join_now``
+    [n_local, K] bool marks cells whose subject is ADMITTED this round.
+
+    The cascade is a where-chain from least to most specific, so the
+    most specific test wins — total by construction (the FD fallback is
+    the chain's base), which is exactly the "every transition gets one
+    channel" bench gate.
+    """
+    fd = prov["fd"]
+    gossip = prov["gossip"]
+    sync = prov["sync"]
+    winner = jnp.maximum(fd, jnp.maximum(sync, gossip))
+    w_status, _ = delivery.unpack_record(
+        winner, fmt=params.wire_format, epoch_bits=params.epoch_bits)
+
+    chan = jnp.full(codes.shape, jnp.int8(CH_FD_DIRECT), dtype=jnp.int8)
+    gossip_wins = (gossip >= 0) & (gossip == winner)
+    chan = jnp.where(gossip_wins, jnp.int8(CH_GOSSIP), chan)
+    # SYNC beats GOSSIP on a key tie (both channels delivered the
+    # identical record): the exchange is the direct conversation.
+    sync_wins = (sync >= 0) & (sync == winner)
+    chan = jnp.where(sync_wins, jnp.int8(CH_SYNC), chan)
+    # The FD verdict beats both when it ties the winner: first-hand
+    # evidence outranks relays carrying the same record.
+    fd_wins = (fd >= 0) & (fd == winner)
+    if params.ping_req_members > 0:
+        # The launch flag fires on any failed direct probe; only with
+        # proxies configured does it mean the verdict went THROUGH them.
+        fd_code = jnp.where(prov["ping_req"][:, None],
+                            jnp.int8(CH_PINGREQ_PROXY),
+                            jnp.int8(CH_FD_DIRECT))
+    else:
+        fd_code = jnp.int8(CH_FD_DIRECT)
+    chan = jnp.where(fd_wins, fd_code, chan)
+    # A removal no wire key explains is the local suspicion timer
+    # firing — the FD's second-stage verdict, not a relay.
+    timer_fired = (codes == jnp.int8(TraceEventType.REMOVED + 1)) \
+        & (w_status != records.DEAD)
+    chan = jnp.where(timer_fired, jnp.int8(CH_FD_DIRECT), chan)
+    chan = jnp.where(join_now, jnp.int8(CH_JOIN_REBIRTH), chan)
+    return chan
+
+
+def round_channel_records(rc):
+    """(codes, channels, epochs) of one tick's attributed transitions.
+
+    ``codes`` [n_local, K] int8 (0 = none, else TraceEventType + 1 —
+    the trace plane's exact derivation, so both planes agree on what
+    transitioned); ``channels`` int8 channel per coded cell; ``epochs``
+    int32 identity epoch of the cell AFTER the tick (0 with the
+    open-world plane off).  Self-refutations — the observer bumping its
+    own incarnation — overlay the (pinned, code-0) self cell with an
+    ALIVE_REFUTED @ CH_SELF_REFUTATION record.
+    """
+    prev_epoch = rc.prev.epoch if rc.params.epoch_bits else None
+    codes, _ = ttrace.round_transition_codes(
+        rc.round_idx, rc.prev.status, rc.prev.inc, rc.new, rc.world,
+        observer_offset=rc.offset, prev_epoch=prev_epoch,
+    )
+    n_local = rc.prev.status.shape[0]
+    node_ids = jnp.arange(n_local, dtype=jnp.int32) + rc.offset
+    subject_ids = jnp.asarray(rc.world.subject_ids, jnp.int32)
+    join_now = (rc.world.join_at[subject_ids] == rc.round_idx)[None, :]
+    channels = attribute_channels(rc.params, rc.provenance, codes,
+                                  join_now)
+
+    # Self-refutation: the tick pins self cells, so the suspicion the
+    # observer refuted lives only in the self_inc bump — surface it as
+    # its own record on the (code-0) self cell.
+    refuted = jnp.asarray(rc.new.self_inc, jnp.int32) \
+        > jnp.asarray(rc.prev.self_inc, jnp.int32)
+    is_self = subject_ids[None, :] == node_ids[:, None]
+    self_refute = is_self & refuted[:, None] & (codes == 0)
+    codes = jnp.where(
+        self_refute, jnp.int8(TraceEventType.ALIVE_REFUTED + 1), codes)
+    channels = jnp.where(self_refute, jnp.int8(CH_SELF_REFUTATION),
+                         channels)
+
+    if rc.params.epoch_bits:
+        epochs = jnp.asarray(rc.new.epoch, jnp.int32)
+    else:
+        epochs = jnp.zeros(codes.shape, dtype=jnp.int32)
+    return codes, channels, epochs
+
+
+#: Gather-compact window of the fast record path: a round with at most
+#: this many attributed cells writes ONE contiguous [window, 6] block
+#: (searchsorted + gather + dynamic_update_slice) instead of a sparse
+#: [N*K, 6] scatter — the XLA CPU scatter is a row-wise scalar loop and
+#: was the whole measured provenance overhead (bench.py --blame).
+#: Bursts beyond the window, and rounds near the buffer's capacity,
+#: take the exact scatter path instead, so semantics never change.
+COMPACT_WINDOW = 256
+
+
+def record_attributions(pv: ProvenanceState, round_idx, codes, channels,
+                        epochs, subject_ids,
+                        observer_offset: int = 0) -> ProvenanceState:
+    """Compact one round's attributed cells into the buffer — the
+    telemetry/trace.record_events_batch idiom (cumsum slot assignment,
+    exact overflow count), under a ``lax.cond`` that skips silent
+    rounds entirely.
+
+    Two record paths, bit-identical in what they append (same rows,
+    same flat order, same count/dropped accounting):
+
+    - FAST (the common case): when the round's burst fits
+      :data:`COMPACT_WINDOW` and the buffer has a full window of
+      headroom, the changed cells are gather-compacted into one
+      ``[window, 6]`` block and written with a single contiguous
+      ``dynamic_update_slice`` at ``count`` — no sparse scatter.
+    - EXACT: bigger bursts and the buffer's last window fall back to
+      the ``mode="drop"`` scatter, which handles overflow precisely.
+    """
+    n, k = codes.shape
+    cap = pv.capacity
+    flat_code = codes.reshape(-1)
+    has = flat_code > 0
+    observer = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32)[:, None] + observer_offset, (n, k)
+    ).reshape(-1)
+    subject = jnp.broadcast_to(
+        jnp.asarray(subject_ids, jnp.int32)[None, :], (n, k)
+    ).reshape(-1)
+    flat_chan = channels.reshape(-1)
+    flat_epoch = epochs.reshape(-1)
+    flat_round = jnp.broadcast_to(
+        jnp.asarray(round_idx, jnp.int32), (n * k,))
+
+    window = min(cap, COMPACT_WINDOW, n * k)
+    c = jnp.cumsum(has.astype(jnp.int32))
+    total = c[-1]
+
+    def fast(p: ProvenanceState) -> ProvenanceState:
+        # m-th changed cell = first flat index with cumsum >= m (the
+        # cumsum increments exactly at changed cells), so a vectorized
+        # searchsorted recovers the compacted source order.
+        src = jnp.searchsorted(
+            c, jnp.arange(1, window + 1, dtype=jnp.int32))
+        src = jnp.minimum(src, n * k - 1)
+        valid = jnp.arange(window, dtype=jnp.int32) < total
+        block = jnp.stack([
+            observer[src],
+            subject[src],
+            flat_epoch[src],
+            flat_code[src].astype(jnp.int32) - 1,
+            flat_chan[src].astype(jnp.int32),
+            flat_round[src],
+        ], axis=1)
+        offs = jnp.minimum(p.count, cap - window)  # == p.count here
+        existing = jax.lax.dynamic_slice(
+            p.lanes, (offs, jnp.int32(0)), (window, _N_LANES))
+        block = jnp.where(valid[:, None], block, existing)
+        lanes = jax.lax.dynamic_update_slice(
+            p.lanes, block, (offs, jnp.int32(0)))
+        # total <= window and count + window <= cap: no overflow here.
+        return ProvenanceState(lanes=lanes, count=p.count + total,
+                               dropped=p.dropped)
+
+    def exact(p: ProvenanceState) -> ProvenanceState:
+        slot = p.count + c - 1
+        idx = jnp.where(has & (slot < cap), slot, cap)  # cap = OOB -> drop
+        rows = jnp.stack([
+            observer,
+            subject,
+            flat_epoch,
+            flat_code.astype(jnp.int32) - 1,
+            flat_chan.astype(jnp.int32),
+            flat_round,
+        ], axis=1)
+        lanes = p.lanes.at[idx].set(rows, mode="drop")
+        new_count = jnp.minimum(p.count + total, cap)
+        new_dropped = p.dropped + total - (new_count - p.count)
+        return ProvenanceState(lanes=lanes, count=new_count,
+                               dropped=new_dropped)
+
+    def record(p: ProvenanceState) -> ProvenanceState:
+        use_fast = (total <= window) & (p.count + window <= cap)
+        return jax.lax.cond(use_fast, fast, exact, p)
+
+    return jax.lax.cond(jnp.any(has), record, lambda p: p, pv)
+
+
+def observe_round(pv: ProvenanceState, rc) -> ProvenanceState:
+    """One round's provenance update: derive + attribute + record.
+
+    The WHOLE derivation rides a ``lax.cond`` on the trace plane's
+    event predicate (telemetry/trace.observe_round_codes: any status
+    change, a scheduled leave, an epoch flip) widened with the
+    self-incarnation bump — the one transition the provenance plane
+    records that moves no status bit.  Event-free rounds — most of a
+    healthy run — reduce to four cheap reductions, which is what keeps
+    the armed stack inside the overhead gate (bench.py --blame)."""
+    if rc.provenance is None:
+        raise ValueError(
+            "the provenance plane needs the tick's per-channel exposure: "
+            "set SwimParams.provenance=True (the knob arms the maxima "
+            "the attribution cascade reads)"
+        )
+    n_local = rc.prev.status.shape[0]
+    node_ids = jnp.arange(n_local, dtype=jnp.int32) + rc.offset
+    pred = rc.any_status_change | jnp.any(
+        rc.world.leave_at[node_ids] == rc.round_idx)
+    if rc.params.epoch_bits:
+        pred = pred | jnp.any(
+            jnp.asarray(rc.prev.epoch) != jnp.asarray(rc.new.epoch))
+    pred = pred | jnp.any(
+        jnp.asarray(rc.new.self_inc, jnp.int32)
+        > jnp.asarray(rc.prev.self_inc, jnp.int32))
+
+    def active(p: ProvenanceState) -> ProvenanceState:
+        codes, channels, epochs = round_channel_records(rc)
+        return record_attributions(p, rc.round_idx, codes, channels,
+                                   epochs, rc.world.subject_ids,
+                                   observer_offset=rc.offset)
+
+    return jax.lax.cond(pred, active, lambda p: p, pv)
+
+
+# --------------------------------------------------------------------------
+# The compose() plane
+# --------------------------------------------------------------------------
+
+
+class ProvenancePlane:
+    """Channel attribution as a composed-runner plane
+    (models/compose.py): carry slice = :class:`ProvenanceState`,
+    per-round hook = :func:`observe_round` reading the shared round
+    context's ``provenance`` exposure.  No fused pair — the plane folds
+    once per tick inside a fused body (the exposure is per-round by
+    construction); the batched driver reaches it through
+    ``BatchRoundCtx.per_row_fold``.
+
+    ``state`` resumes an existing buffer across chunked scans.
+    """
+
+    name = "provenance"
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, state=None):
+        self.capacity = capacity
+        self.state = state
+
+    def init(self, params, world):
+        if not params.provenance:
+            raise ValueError(
+                "ProvenancePlane requires SwimParams.provenance=True: "
+                "with the knob off the tick bodies compile the "
+                "per-channel exposure out and there is nothing to "
+                "attribute"
+            )
+        if self.state is not None:
+            return self.state
+        return ProvenanceState.empty(self.capacity)
+
+    def on_round(self, rc, pv):
+        return observe_round(pv, rc)
+
+    def finalize(self, fc, pv):
+        return pv
+
+
+# --------------------------------------------------------------------------
+# Host-side decoding
+# --------------------------------------------------------------------------
+
+
+def decode_attributions(pv: ProvenanceState) -> list:
+    """Device buffer -> plain-dict rows (host side), the exact recorded
+    prefix in (round, observer-major cell) order.  ``transition`` is
+    the TraceEventType name, ``channel`` the CHANNEL_NAMES entry —
+    the same spelling the journal record and the blame engine use."""
+    lanes = np.asarray(pv.lanes)
+    count = int(pv.count)
+    out = []
+    for i in range(count):
+        obs, subj, epoch, code, chan, rnd = (int(v) for v in lanes[i])
+        out.append(dict(
+            observer=obs, subject=subj, epoch=epoch,
+            transition=TraceEventType(code).name,
+            channel=CHANNEL_NAMES[chan], round=rnd,
+        ))
+    return out
+
+
+def attributions_payload(pv: ProvenanceState) -> dict:
+    """The journal payload of the ``provenance`` record kind
+    (telemetry/sink.py): decoded rows + exact buffer accounting."""
+    return dict(
+        rows=decode_attributions(pv),
+        recorded=int(pv.count),
+        dropped=int(pv.dropped),
+        capacity=int(pv.capacity),
+    )

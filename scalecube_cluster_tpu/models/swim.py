@@ -395,6 +395,20 @@ class SwimParams:
     # node j — the owner-row authority rule) and excludes k_block (an
     # [N, N, M] table has no place on the >10M capacity path).
     metadata_keys: int = 0
+    # Provenance plane (models/provenance.py): per-(observer, subject)
+    # CHANNEL ATTRIBUTION of every status transition — which channel's
+    # folded key won the round (FD direct ack/timeout, ping-req proxy,
+    # piggyback gossip, SYNC exchange, self-refutation, join-rebirth).
+    # True arms the tick bodies to expose per-channel folded maxima
+    # into ``aux["_provenance"]`` (picked up by the composed runner's
+    # shared RoundCtx); the attribution itself lives in the plane.
+    # False (the default) compiles the exposure OUT entirely — no
+    # extra folds, no extra metrics keys, every layout and run shape
+    # bit-identical to the plane-less tick (tests/test_provenance.py).
+    # Requires max_delay_rounds == 0: the delay ring folds all
+    # channels into shared bins before delivery, so per-channel
+    # identity is unrecoverable there.
+    provenance: bool = False
 
     def __post_init__(self):
         if self.delivery not in ("scatter", "shift"):
@@ -469,6 +483,13 @@ class SwimParams:
             raise ValueError(
                 f"metadata_keys must be >= 0 (0 = metadata plane off; "
                 f"got {self.metadata_keys})"
+            )
+        if self.provenance and self.max_delay_rounds > 0:
+            raise ValueError(
+                "provenance requires max_delay_rounds == 0: the delay "
+                "ring folds every channel into shared per-round bins "
+                "before delivery, so the winning record's channel is "
+                "unrecoverable once it has been through the ring"
             )
         if self.metadata_keys > 0:
             if not self.full_view:
@@ -614,7 +635,7 @@ class Knobs:
     grid with ZERO recompiles (tune/search.py — knob values are traced
     operands, so the compiled program is knob-oblivious).
 
-    Static-vs-dynamic, all 32 ``SwimParams`` fields (why each side):
+    Static-vs-dynamic, all 33 ``SwimParams`` fields (why each side):
 
     ==================== === =====================================
     field                dyn one-line reason
@@ -677,6 +698,9 @@ class Knobs:
     metadata_keys        no  md lane shape ([N, K, M]) and the
                              0-vs-on plane off-switch (the
                              sync_interval bit-identity rationale)
+    provenance           no  off-vs-on plane off-switch: the
+                             per-channel exposure compiles in/out
+                             (sync_interval bit-identity rationale)
     ==================== === =====================================
 
     Each dynamic knob with a static ceiling is masked/clamped at its
@@ -2261,6 +2285,14 @@ def _round_metrics(new_state: SwimState, status, aux, params: SwimParams,
         # the shard offset lives, ALREADY globally reduced (one psum
         # inside divergent_count), so no global_sum here.
         metrics["metadata_divergent"] = aux["metadata_divergent"]
+    if params.provenance:
+        # Per-channel folded maxima for the provenance plane — LOCAL
+        # per-cell evidence (already cross-device combined where a
+        # combine exists), passed through un-reduced.  The composed
+        # runner pops this key into the shared RoundCtx before the
+        # scan stacks metrics (models/compose.py) — it never reaches
+        # a stacked trace.
+        metrics["_provenance"] = aux["_provenance"]
     return metrics
 
 
@@ -2919,7 +2951,7 @@ def _scatter_send_phase(state, status, inc, round_idx, params, kn, world,
 
 
 def _scatter_channel_bufs(s, params, gossip_extra_drop, sync_extra_drop,
-                          ae_suppress=False):
+                          ae_suppress=False, channel_split=False):
     """The UNCOMBINED global-height inbox contribution of one scatter
     round: the max-folded packed-key buffer (``[N, K]``), plus — on the
     legacy two-buffer wire (``params.fused_wire`` False) — the int8
@@ -2945,21 +2977,43 @@ def _scatter_channel_bufs(s, params, gossip_extra_drop, sync_extra_drop,
     table through the anti-entropy channels — the identical targets and
     drop masks, folded with the same associative max.  Metadata is
     same-round only like the anti-entropy plane, so only bin 0 reads it.
+
+    ``channel_split=True`` (the provenance plane's exposure,
+    SwimParams.provenance) appends a fourth element: the
+    ``(gossip_buf, sync_family_buf)`` per-channel components the
+    combined ``buf`` is the max of — the SAME scatters, kept apart so
+    the attribution cascade can name the winning channel at zero extra
+    fold cost (int max is associative, so building ``buf`` from the
+    split components is value-identical to the unsplit fold).
     """
     n = params.n_members
     g_drop = s["gossip_drop"] | gossip_extra_drop
     s_drop = s["sync_drop"] | sync_extra_drop
-    buf = jnp.maximum(
-        delivery.scatter_max(s["gossip_keys"], s["gossip_targets"],
-                             g_drop, n),
-        delivery.scatter_max(s["sync_keys"], s["sync_target"], s_drop, n),
-    )
-    if params.sync_interval > 0 and not ae_suppress:
+    if channel_split:
+        g_buf = delivery.scatter_max(s["gossip_keys"], s["gossip_targets"],
+                                     g_drop, n)
+        s_fam = delivery.scatter_max(s["sync_keys"], s["sync_target"],
+                                     s_drop, n)
+        if params.sync_interval > 0 and not ae_suppress:
+            s_fam = jnp.maximum(
+                s_fam,
+                delivery.scatter_max(s["sync_keys"], s["ae_targets"],
+                                     s["ae_drop"], n),
+            )
+        buf = jnp.maximum(g_buf, s_fam)
+    else:
         buf = jnp.maximum(
-            buf,
-            delivery.scatter_max(s["sync_keys"], s["ae_targets"],
-                                 s["ae_drop"], n),
+            delivery.scatter_max(s["gossip_keys"], s["gossip_targets"],
+                                 g_drop, n),
+            delivery.scatter_max(s["sync_keys"], s["sync_target"],
+                                 s_drop, n),
         )
+        if params.sync_interval > 0 and not ae_suppress:
+            buf = jnp.maximum(
+                buf,
+                delivery.scatter_max(s["sync_keys"], s["ae_targets"],
+                                     s["ae_drop"], n),
+            )
     md_buf = None
     if params.metadata_keys > 0:
         md_buf = jnp.maximum(
@@ -2974,6 +3028,8 @@ def _scatter_channel_bufs(s, params, gossip_extra_drop, sync_extra_drop,
                                      s["ae_drop"], n),
             )
     if params.fused_wire:
+        if channel_split:
+            return buf, None, md_buf, (g_buf, s_fam)
         return buf, None, md_buf
     fbuf = (
         delivery.scatter_or(s["alive_flags"], s["gossip_targets"],
@@ -2985,6 +3041,8 @@ def _scatter_channel_bufs(s, params, gossip_extra_drop, sync_extra_drop,
         fbuf = fbuf | delivery.scatter_or(
             s["sync_alive_flags"], s["ae_targets"], s["ae_drop"], n
         )
+    if channel_split:
+        return buf, fbuf.astype(jnp.int8), md_buf, (g_buf, s_fam)
     return buf, fbuf.astype(jnp.int8), md_buf
 
 
@@ -3050,8 +3108,24 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
                 None if fbuf is None else combine_max(fbuf),
                 None if md_buf is None else combine_max(md_buf))
 
+    prov_g = prov_s = None
     if params.max_delay_rounds == 0:
-        inbox, inbox_alive8, md_delivered = channel_bufs(False, False)
+        if params.provenance:
+            # channel_split: the combined inbox is rebuilt as
+            # max(g_buf, s_fam) from per-channel components (int max is
+            # associative, so the folded values are bit-identical to the
+            # single-fold path), and the components double as the
+            # provenance plane's per-channel evidence — zero extra
+            # scatters for attribution.
+            buf, fbuf, md_buf, (g_split, s_split) = _scatter_channel_bufs(
+                s, params, False, False, channel_split=True)
+            inbox = combine_max(buf)
+            inbox_alive8 = None if fbuf is None else combine_max(fbuf)
+            md_delivered = None if md_buf is None else combine_max(md_buf)
+            prov_g = combine_max(g_split)
+            prov_s = combine_max(s_split)
+        else:
+            inbox, inbox_alive8, md_delivered = channel_bufs(False, False)
         inbox_alive = (None if inbox_alive8 is None
                        else inbox_alive8.astype(jnp.bool_))
     else:
@@ -3155,6 +3229,33 @@ def _tick_scatter(state, status, inc, round_idx, params, kn, world,
         aux["metadata_divergent"] = metadata.divergent_count(
             new_state.md, node_ids, alive, alive_here, n,
             offset=offset, axis_name=axis_name,
+        )
+    if params.provenance:
+        # Per-channel folded maxima, receiver-side (the provenance
+        # plane's evidence — SwimParams.provenance): the SAME scatter
+        # components the combined inbox above was built from
+        # (channel_split), kept apart per channel so the plane can name
+        # the winning one.  No extra scatters: attribution reuses the
+        # folds the protocol already paid for, and int-max associativity
+        # keeps the combined inbox bit-identical to the single-fold
+        # off-switch path.  max_delay_rounds == 0 is validated at
+        # construction, so the single-bin folds are the round's
+        # complete deliveries.
+        g_chan = prov_g
+        s_chan = prov_s
+        if gate_contacts:
+            # Same folded key as the real round trip above -> the same
+            # draws -> identical contributions, folded into the SYNC
+            # family (the join path IS a SYNC exchange).
+            s_chan, _, _, _ = _seed_anti_entropy(
+                status, s["sync_keys"], s_chan, None, sync_round,
+                round_idx, params, kn, world, node_ids, alive_here,
+                alive, part, jax.random.fold_in(s["k_sync_drop"], 29),
+                axis_name=axis_name,
+            )
+        aux["_provenance"] = dict(
+            fd=s["fd_inbox"], gossip=g_chan, sync=s_chan,
+            ping_req=s["ping_req_launches"],
         )
     if params.link_counters:
         # Per-sender wire accounting (SwimParams.link_counters docstring).
@@ -3269,7 +3370,14 @@ def swim_tick_send(state: SwimState, round_idx, base_key,
                             ctx["known_live"], ctx["is_seed"],
                             ctx["keys"], offset,
                             k_channel=ctx["k_shifts"], epoch=ctx["epoch"])
-    buf, fbuf, md_buf = _scatter_channel_bufs(s, params, False, False)
+    if params.provenance:
+        # channel_split: per-channel components double as the provenance
+        # evidence below — zero extra scatters, and int-max associativity
+        # keeps the combined buffer value-identical to the single fold.
+        buf, fbuf, md_buf, (prov_g_buf, prov_s_buf) = _scatter_channel_bufs(
+            s, params, False, False, channel_split=True)
+    else:
+        buf, fbuf, md_buf = _scatter_channel_bufs(s, params, False, False)
     # FD verdicts are observer-local: fold them into the owner's row
     # block of the pending buffer (serial folds after the combine; max
     # commutes with the pmax because no other device writes fd values
@@ -3299,6 +3407,18 @@ def swim_tick_send(state: SwimState, round_idx, base_key,
         # transition the serial tick would (local rows, no combine).
         pending["lhm_fail"] = s["lhm_fail"]
         pending["lhm_clean"] = s["lhm_clean"]
+    if params.provenance:
+        # Per-channel folded maxima cross the round boundary UNCOMBINED
+        # exactly like the fused key buffer (max is associative; the
+        # deferred pmax runs in the recv half).  The components come
+        # straight from the channel_split fold above — no re-scatter.
+        # The pipeline's static exclusions (no delay ring, no seed
+        # contacts) already rule out every channel the serial exposure
+        # folds beyond these.
+        pending["prov_gossip"] = prov_g_buf
+        pending["prov_sync"] = prov_s_buf
+        pending["prov_fd"] = s["fd_inbox"]
+        pending["prov_ping_req"] = s["ping_req_launches"]
     return pending, _scatter_send_aux(s, params)
 
 
@@ -3365,6 +3485,15 @@ def swim_tick_recv(state: SwimState, pending, send_aux, round_idx,
             new_state.md, ctx["node_ids"], ctx["alive"],
             ctx["alive_here"], params.n_members,
             offset=offset, axis_name=axis_name,
+        )
+    if params.provenance:
+        # Combine the per-channel pending maxima the send half exposed —
+        # the same deferred pmax the key buffer gets, per channel.
+        aux["_provenance"] = dict(
+            fd=pending["prov_fd"],
+            gossip=combine_max(pending["prov_gossip"]),
+            sync=combine_max(pending["prov_sync"]),
+            ping_req=pending["prov_ping_req"],
         )
     metrics = _round_metrics(new_state, ctx["status"], aux, params, world,
                              ctx["alive"], ctx["alive_here"], axis_name)
@@ -3656,6 +3785,14 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
     )
     inbox = fd_inbox
     inbox_alive = jnp.zeros((n_local, k), dtype=jnp.bool_)
+    # Provenance accumulators (SwimParams.provenance): the same channel
+    # contributions folded a second time, kept apart per channel family
+    # so the plane can name the winner — strictly additive next to the
+    # combined inbox (XLA CSEs the shared delivery work).
+    prov_gossip = prov_sync = None
+    if params.provenance:
+        prov_gossip = jnp.full((n_local, k), no_msg, dtype=inbox.dtype)
+        prov_sync = jnp.full((n_local, k), no_msg, dtype=inbox.dtype)
     g_delivered, g_ring_acc = None, None
     if params.n_user_gossips > 0:
         g_delivered = jnp.zeros((n_local, params.n_user_gossips),
@@ -3712,6 +3849,10 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             inbox, jnp.where(ok_now[:, None], delivered, no_msg)
         )
         inbox_alive |= delivered_flags & ok_now[:, None]
+        if prov_gossip is not None:
+            prov_gossip = jnp.maximum(
+                prov_gossip, jnp.where(ok_now[:, None], delivered, no_msg)
+            )
         if g_bits_c is not None:
             g_delivered = g_delivered | (g_bits_c & ok_now[:, None])
         if h_md_hot is not None:
@@ -3776,6 +3917,10 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
      refute_lost_r, refute_md) = refute_deliver((ring, fring))
     inbox = jnp.maximum(inbox, refute_contrib)
     inbox_alive |= refute_flags
+    if prov_sync is not None:
+        # The refute push is a SYNC payload (scatter mode's do_sync
+        # override) — it folds into the SYNC family.
+        prov_sync = jnp.maximum(prov_sync, refute_contrib)
     if refute_md is not None:
         md_delivered = jnp.maximum(md_delivered, refute_md)
     if counters_on:
@@ -3826,6 +3971,10 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         inbox, jnp.where(ok_s_now[:, None], delivered, no_msg)
     )
     inbox_alive |= delivered_flags & ok_s_now[:, None]
+    if prov_sync is not None:
+        prov_sync = jnp.maximum(
+            prov_sync, jnp.where(ok_s_now[:, None], delivered, no_msg)
+        )
     if h_md_hot is not None:
         md_delivered = jnp.maximum(
             md_delivered,
@@ -3871,6 +4020,12 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
                 inbox, jnp.where(ok_ae[:, None], delivered_ae, no_msg)
             )
             inbox_alive |= flags_ae & ok_ae[:, None]
+            if prov_sync is not None:
+                # Anti-entropy is a SYNC-family exchange.
+                prov_sync = jnp.maximum(
+                    prov_sync,
+                    jnp.where(ok_ae[:, None], delivered_ae, no_msg),
+                )
             if h_md_full is not None:
                 # The FULL metadata table rides the exchange — the
                 # convergence-through-heal guarantee (module docstring).
@@ -3898,6 +4053,16 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
             round_idx, params, kn, world, node_ids, alive_here, alive, part,
             jax.random.fold_in(k_sync_drop, 29), axis_name=axis_name,
         )
+        if prov_sync is not None:
+            # Same folded key -> same draws -> identical contributions,
+            # folded into the SYNC family (the join path IS a SYNC
+            # exchange) — mirrors the scatter tick's provenance fold.
+            prov_sync, _, _, _ = _seed_anti_entropy(
+                status, sync_keys_local, prov_sync, None, sync_round,
+                round_idx, params, kn, world, node_ids, alive_here,
+                alive, part, jax.random.fold_in(k_sync_drop, 29),
+                axis_name=axis_name,
+            )
 
     new_state, refuted = _merge_and_timers(
         state, status, inc, inbox, inbox_alive, round_idx, params, kn, world,
@@ -3922,6 +4087,11 @@ def _tick_shift(state, status, inc, round_idx, params, kn, world,
         )
     if ae_sent_local is not None:
         aux["messages_anti_entropy"] = ae_sent_local
+    if params.provenance:
+        aux["_provenance"] = dict(
+            fd=fd_inbox, gossip=prov_gossip, sync=prov_sync,
+            ping_req=ping_req_launches,
+        )
     if counters_on:
         aux["sent_by_node"] = (
             sent_acc + probes_sent.astype(jnp.int32)
@@ -4124,7 +4294,7 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
     def body(b, acc):
         (st_acc, inc_acc, ep_acc, spr_acc, dl_acc, self_inc_acc,
          refuted_acc, h_alive, h_suspect, h_dead, h_still, fsr, svr,
-         ons) = acc
+         ons, prov_g_acc, prov_s_acc) = acc
         c0 = b * kb
         cols = c0 + jnp.arange(kb, dtype=jnp.int32)          # global ids
 
@@ -4178,25 +4348,45 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
             fd_suspect_key[:, None], no_msg,
         )
         inbox_alive_b = jnp.zeros((n, kb), dtype=jnp.bool_)
+        # Per-channel block maxima for the provenance plane — the same
+        # contributions folded a second time, kept apart per channel
+        # family (SwimParams.provenance; XLA CSEs the shared delivery).
+        prov_g_b = prov_s_b = None
+        if params.provenance:
+            prov_g_b = jnp.full((n, kb), no_msg, dtype=inbox_b.dtype)
+            prov_s_b = jnp.full((n, kb), no_msg, dtype=inbox_b.dtype)
         for c in range(f):
             payload, aflags = deliver_channel_b(gossip_shifts[c], 1)
             okc = ok_gossip[c][:, None]
             inbox_b = jnp.maximum(inbox_b, jnp.where(okc, payload, no_msg))
             inbox_alive_b |= aflags & okc
+            if prov_g_b is not None:
+                prov_g_b = jnp.maximum(prov_g_b,
+                                       jnp.where(okc, payload, no_msg))
         payload, aflags = deliver_channel_b(fd_shift, 2)     # refute push
         okr = ok_refute[:, None]
         inbox_b = jnp.maximum(inbox_b, jnp.where(okr, payload, no_msg))
         inbox_alive_b |= aflags & okr
+        if prov_s_b is not None:
+            # Refute push is a SYNC payload — the SYNC family.
+            prov_s_b = jnp.maximum(prov_s_b,
+                                   jnp.where(okr, payload, no_msg))
         payload, aflags = deliver_channel_b(sync_shift, 2)   # SYNC
         oks = ok_sync[:, None]
         inbox_b = jnp.maximum(inbox_b, jnp.where(oks, payload, no_msg))
         inbox_alive_b |= aflags & oks
+        if prov_s_b is not None:
+            prov_s_b = jnp.maximum(prov_s_b,
+                                   jnp.where(oks, payload, no_msg))
         for d_i, sft in enumerate(ae_shifts):        # anti-entropy pair
             payload, aflags = deliver_channel_b(sft, 2)
             oka = ok_ae[d_i][:, None]
             inbox_b = jnp.maximum(inbox_b,
                                   jnp.where(oka, payload, no_msg))
             inbox_alive_b |= aflags & oka
+            if prov_s_b is not None:
+                prov_s_b = jnp.maximum(prov_s_b,
+                                       jnp.where(oka, payload, no_msg))
 
         new_blk, refuted_b = _merge_and_timers(
             blk, st_b, inc_b, inbox_b, inbox_alive_b, round_idx,
@@ -4257,22 +4447,35 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
             fsr += jnp.sum(fsr_b)
             svr += jnp.sum(svr_b)
             ons += jnp.sum(ons_b)
+        if params.provenance:
+            prov_g_acc = jax.lax.dynamic_update_slice_in_dim(
+                prov_g_acc, prov_g_b, c0, 1)
+            prov_s_acc = jax.lax.dynamic_update_slice_in_dim(
+                prov_s_acc, prov_s_b, c0, 1)
         return (st_acc, inc_acc, ep_acc, spr_acc, dl_acc, self_inc_acc,
                 refuted_acc, h_alive, h_suspect, h_dead, h_still, fsr,
-                svr, ons)
+                svr, ons, prov_g_acc, prov_s_acc)
 
     # Accumulators stay in the STORED layout (compact dtypes included):
     # blocks are decoded on read and re-encoded on write, so no wide
     # [N, K] int32 copy of the carry ever exists.
+    # Provenance accumulators: [N, K] wire-dtype channel maxima when the
+    # plane is armed, zero-column placeholders (never touched) when off —
+    # the acc tuple keeps one static shape either way.
+    prov_cols = k if params.provenance else 0
+    prov_init = jnp.full((n, prov_cols), no_msg,
+                         dtype=fd_suspect_key.dtype)
     acc0 = (
         state.status, state.inc, state.epoch,
         state.spread_until, state.suspect_deadline,
         state.self_inc, jnp.zeros((n,), dtype=jnp.bool_),
         hist_init(), hist_init(), hist_init(), hist_init(),
         hist_init(), hist_init(), hist_init(),
+        prov_init, prov_init,
     )
     (st_acc, inc_acc, ep_acc, spr_acc, dl_acc, self_inc_acc, refuted,
-     h_alive, h_suspect, h_dead, h_still, fsr, svr, ons) = \
+     h_alive, h_suspect, h_dead, h_still, fsr, svr, ons,
+     prov_g_acc, prov_s_acc) = \
         jax.lax.fori_loop(0, n_blocks, body, acc0)
 
     # User-gossip merge (K-independent; mirrors _merge_and_timers's tail).
@@ -4324,6 +4527,19 @@ def _tick_shift_blocked(state, status, inc, round_idx, params, kn, world,
             false_suspect_rounds=fsr, stale_view_rounds=svr, onsets=ons,
         ),
     )
+    if params.provenance:
+        # FD verdicts are one cell per row — built whole outside the
+        # block loop (an [N, K] wire-dtype temp is acceptable in an
+        # observability mode; the capacity path runs with the plane off).
+        prov_fd = jnp.where(
+            (jnp.arange(k, dtype=jnp.int32)[None, :] == slot_safe[:, None])
+            & verdict_suspect[:, None],
+            fd_suspect_key[:, None], no_msg,
+        )
+        aux["_provenance"] = dict(
+            fd=prov_fd, gossip=prov_g_acc, sync=prov_s_acc,
+            ping_req=ping_req_launches,
+        )
     return new_state, aux
 
 

@@ -1,6 +1,6 @@
 """The telemetry CLI: ``python -m scalecube_cluster_tpu.telemetry``.
 
-Four subcommands over the JSONL manifests and BENCH artifacts
+Five subcommands over the JSONL manifests and BENCH artifacts
 (telemetry/query.py, telemetry/alarms.py):
 
   report   <manifest.jsonl> [...]   fold manifests, print the health
@@ -18,6 +18,14 @@ Four subcommands over the JSONL manifests and BENCH artifacts
                                     ``--max-seconds``); ``--json``
                                     emits one line per consumed window
                                     / transition for machines
+  explain  <journal.jsonl>          answer "why did observer i believe
+           --observer i --subject j  this about subject j" from the
+           [--round r]               journal's provenance records alone
+                                    (telemetry/query.explain_belief):
+                                    the belief in force, its winning
+                                    channel + round, the subject's
+                                    blame report and this observer's
+                                    infection path
   regress  [paths/globs ...]        walk the BENCH_*.json +
                                     MULTICHIP_*.json trajectories
                                     (the default globs) and exit 1 on
@@ -117,6 +125,7 @@ def _cmd_watch(args) -> int:
                 if args.max_seconds is not None else None)
     windows = transitions_seen = journal_transitions = 0
     segments = rounds_covered = 0
+    unknown_kinds: dict = {}
     done = False
     while True:
         fresh = follower.poll()
@@ -160,6 +169,21 @@ def _cmd_watch(args) -> int:
                                          if k != "kind"}}), flush=True)
             elif kind == "summary":
                 done = True
+            elif kind not in ("manifest",):
+                # A record kind this watcher doesn't render (a journal
+                # written by a newer schema — e.g. ``provenance`` rows
+                # landing on an old reader): count it per kind so new
+                # kinds degrade LOUDLY, never silently.
+                kind = kind or "<missing>"
+                first_sight = kind not in unknown_kinds
+                unknown_kinds[kind] = unknown_kinds.get(kind, 0) + 1
+                if first_sight and args.json:
+                    print(json.dumps({
+                        "kind": "unknown_record_kind",
+                        "record_kind": kind,
+                        "note": "journal kind this watcher does not "
+                                "render — counted in watch_summary",
+                    }), flush=True)
         if fresh and not args.json:
             header = f"\n# watch {args.journal}: {windows} window(s)"
             if segments:
@@ -176,6 +200,11 @@ def _cmd_watch(args) -> int:
             if journal_transitions:
                 print(f"({journal_transitions} alarm_transition row(s) "
                       f"journaled by the run itself)")
+            if unknown_kinds:
+                print("(unrendered record kinds: "
+                      + ", ".join(f"{k}×{c}" for k, c
+                                  in sorted(unknown_kinds.items()))
+                      + ")")
             sys.stdout.flush()
         if done or (deadline is not None and time.time() >= deadline):
             break
@@ -185,6 +214,7 @@ def _cmd_watch(args) -> int:
         "windows": windows, "engine_transitions": transitions_seen,
         "journal_transitions": journal_transitions,
         "segments": segments, "rounds_covered": rounds_covered,
+        "unknown_kinds": unknown_kinds,
         "run_ended": done,
         "alarms": engine.state_rows(),
     }
@@ -193,6 +223,52 @@ def _cmd_watch(args) -> int:
     else:
         print(f"# watch done: run {'ended' if done else 'still live'}, "
               f"{windows} window(s), {transitions_seen} transition(s)")
+    return 0
+
+
+def _cmd_explain(args) -> int:
+    """Answer "why did i believe j was dead" from the journal alone."""
+    report = query.load_report(args.journal)
+    if not report.provenance:
+        print(f"error: {args.journal} holds no provenance records — "
+              f"run with SwimParams.provenance=True and journal the "
+              f"plane (sink.write_provenance)", file=sys.stderr)
+        return 2
+    result = query.explain_belief(report.provenance, args.observer,
+                                  args.subject, round_idx=args.round)
+    if args.json:
+        print(json.dumps(result))
+        return 0
+    obs, subj = args.observer, args.subject
+    when = f" at round {args.round}" if args.round is not None else ""
+    print(f"# explain: observer {obs} about subject {subj}{when} "
+          f"({args.journal})")
+    ans = result["answer"]
+    if ans is None:
+        print(f"observer {obs} recorded no transition for subject "
+              f"{subj}{when} — no belief to explain")
+    else:
+        print(f"observer {obs} believed {ans['transition']} at round "
+              f"{ans['round']} via {ans['channel']} "
+              f"(epoch {ans['epoch']})")
+    if result["events"]:
+        print("\n# full (observer, subject) attribution history")
+        print(query.format_table(
+            result["events"],
+            ["round", "transition", "channel", "epoch"]))
+    blame = result["context"]["blame"]
+    print(f"\n# blame report for subject {subj}")
+    print(query.format_table(
+        [{"field": k, "value": v} for k, v in blame.items()],
+        ["field", "value"]))
+    path = result["context"]["infection_path"]
+    if path:
+        print(f"\n# observer {obs}'s infection path for subject {subj}: "
+              f"first informed round {path['first_round']} via "
+              f"{path['first_channel']} ({path['first_transition']}); "
+              f"per-channel first rounds: "
+              + ", ".join(f"{c}@{r}" for c, r
+                          in sorted(path["channels"].items())))
     return 0
 
 
@@ -210,7 +286,8 @@ def _cmd_regress(args) -> int:
             os.path.join("artifacts", "alarm_drill*.json"),
             os.path.join("artifacts", "tune_pareto*.json"),
             os.path.join("artifacts", "soak_report*.json"),
-            os.path.join("artifacts", "config_rollout*.json")])
+            os.path.join("artifacts", "config_rollout*.json"),
+            os.path.join("artifacts", "provenance_blame*.json")])
     readable = [p for p in paths if os.path.exists(p)]
     if not readable:
         print("regress: no artifacts matched", file=sys.stderr)
@@ -273,6 +350,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.set_defaults(fn=_cmd_watch)
 
     p = sub.add_parser(
+        "explain",
+        help="why did observer i believe this about subject j — from "
+             "the journal's provenance records alone")
+    p.add_argument("journal")
+    p.add_argument("--observer", type=int, required=True,
+                   help="observer node id (who held the belief)")
+    p.add_argument("--subject", type=int, required=True,
+                   help="subject node id (whom the belief was about)")
+    p.add_argument("--round", type=int, default=None,
+                   help="explain the belief in force at this round "
+                        "(default: the latest recorded transition)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_explain)
+
+    p = sub.add_parser(
         "regress",
         help="fail on regressions along the BENCH/MULTICHIP trajectories")
     p.add_argument("paths", nargs="*",
@@ -287,7 +379,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "artifacts/alarm_drill*.json "
                         "artifacts/tune_pareto*.json "
                         "artifacts/soak_report*.json "
-                        "artifacts/config_rollout*.json)")
+                        "artifacts/config_rollout*.json "
+                        "artifacts/provenance_blame*.json)")
     p.add_argument("--band", type=float, default=query.DEFAULT_NOISE_BAND,
                    help="relative noise band (default 0.10)")
     p.add_argument("--json", action="store_true")

@@ -48,6 +48,10 @@ DEFAULT_NOISE_BAND = 0.10
 # Dissemination is integer-quantized (rounds); allow the quantization
 # step on top of the relative band before calling it a regression.
 DISSEMINATION_SLACK_ROUNDS = 1
+# The provenance plane's absolute overhead ceiling (ISSUE 20): the
+# composed stack with per-channel attribution may cost at most 10% over
+# the same stack without the plane, measured interleaved on one host.
+PROVENANCE_OVERHEAD_LIMIT = 1.10
 
 
 # --------------------------------------------------------------------------
@@ -75,6 +79,10 @@ class HealthReport:
     windows: List[dict]
     curves: Dict[str, dict]
     summary: dict
+    # Channel-attribution rows (``provenance`` records, PR 20) — empty
+    # for journals written before the plane existed (old journals stay
+    # valid; the blame engine just has nothing to mine).
+    provenance: List[dict] = dataclasses.field(default_factory=list)
 
     @property
     def rounds_covered(self) -> int:
@@ -104,6 +112,7 @@ def load_report(path: str) -> HealthReport:
     windows: List[dict] = []
     curves: Dict[str, dict] = {}
     summary: dict = {}
+    provenance: List[dict] = []
     run_id = None
     for rec in tsink.iter_records(path):
         run_id = run_id or rec.get("run_id")
@@ -123,9 +132,26 @@ def load_report(path: str) -> HealthReport:
         elif kind == "summary":
             summary.update({k: v for k, v in rec.items()
                             if k not in ("kind", "run_id")})
+        elif kind == "events_footer":
+            # The trace buffer's overflow accounting (sink.write_events'
+            # footer): fold it into a counter lane so a truncated event
+            # stream surfaces in every report/regress path instead of
+            # living only in the raw journal (drops are additive across
+            # segments — each footer closes one segment's buffer).
+            counters["trace_dropped_total"] = (
+                counters.get("trace_dropped_total", 0)
+                + int(rec.get("dropped", 0)))
+        elif kind == "provenance":
+            provenance.append(rec)
+            # Accounting totals are idempotent across chunks
+            # (sink.write_provenance) — last one wins.
+            if "dropped" in rec:
+                counters["provenance_dropped_total"] = int(rec["dropped"])
+    provenance.sort(key=lambda r: int(r.get("offset", 0)))
+    rows = [row for rec in provenance for row in rec.get("rows", [])]
     return HealthReport(path=path, run_id=run_id, counters=counters,
                         gauges=gauges, histograms=hists, windows=windows,
-                        curves=curves, summary=summary)
+                        curves=curves, summary=summary, provenance=rows)
 
 
 def merge_reports(reports: Sequence[HealthReport]) -> HealthReport:
@@ -144,6 +170,7 @@ def merge_reports(reports: Sequence[HealthReport]) -> HealthReport:
         out.windows.extend(r.windows)
         out.curves.update(r.curves)
         out.summary.update(r.summary)
+        out.provenance.extend(r.provenance)
     return out
 
 
@@ -236,7 +263,214 @@ def compute_slos(report: HealthReport) -> dict:
     slos["wire_saturation"] = g.get("wire_saturation")
     slos["gossip_piggyback_occupancy"] = g.get("gossip_piggyback_occupancy")
     slos["rounds_covered"] = report.rounds_covered or None
+
+    # Trace-buffer overflow, surfaced as a first-class lane (an
+    # events_footer journals it; a report that never shows it invites
+    # mistaking a truncated trace for a complete one).  None when the
+    # journal carries no event stream at all.
+    slos["trace_dropped_total"] = c.get("trace_dropped_total")
+
+    # Provenance plane (PR 20): channel-mix SLOs over the journaled
+    # attribution rows — absent (not None-padded) for journals without
+    # the plane, so pre-plane reports render unchanged.
+    if report.provenance:
+        slos.update(provenance_slos(report.provenance))
+        slos["provenance_dropped_total"] = c.get(
+            "provenance_dropped_total", 0)
     return slos
+
+
+# --------------------------------------------------------------------------
+# The blame engine: infection paths, channel-mix SLOs, explain
+# --------------------------------------------------------------------------
+
+# Channels that are FIRST-HAND evidence (the observer's own failure
+# detector, direct or through its ping-req proxies) — everything else
+# relays somebody else's verdict (models/provenance.CHANNEL_NAMES).
+FIRST_HAND_CHANNELS = ("fd_direct", "pingreq_proxy")
+
+# The transitions that constitute "believing the subject is failing" —
+# what infection paths and blame reports trace by default.
+SUSPICION_TRANSITIONS = ("SUSPECTED", "REMOVED")
+
+
+def _percentile(values: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile of a raw sample list (None when empty)."""
+    if not values:
+        return None
+    v = sorted(values)
+    idx = min(len(v) - 1, max(0, math.ceil(q * len(v)) - 1))
+    return float(v[idx])
+
+
+def infection_paths(rows: Sequence[dict], subject: int,
+                    transitions: Sequence[str] = SUSPICION_TRANSITIONS
+                    ) -> Dict[int, dict]:
+    """Per-observer infection path for one subject: observer ->
+    ``{"first_round", "first_channel", "first_transition",
+    "channels": {channel: first round seen via it}}``.
+
+    ``rows`` is the journaled attribution stream
+    (HealthReport.provenance); ``transitions`` restricts which belief
+    the path traces (default: the suspicion funnel — SUSPECTED and
+    REMOVED).  The first-informed round per observer per channel is
+    exactly the "who told you, and how" reconstruction the module
+    docstring promises.
+    """
+    paths: Dict[int, dict] = {}
+    for r in rows:
+        if int(r.get("subject", -1)) != subject:
+            continue
+        if r.get("transition") not in transitions:
+            continue
+        obs, ch, rnd = int(r["observer"]), r["channel"], int(r["round"])
+        entry = paths.setdefault(obs, {
+            "first_round": None, "first_channel": None,
+            "first_transition": None, "channels": {},
+        })
+        if ch not in entry["channels"] or rnd < entry["channels"][ch]:
+            entry["channels"][ch] = rnd
+        if entry["first_round"] is None or rnd < entry["first_round"]:
+            entry["first_round"] = rnd
+            entry["first_channel"] = ch
+            entry["first_transition"] = r["transition"]
+    return paths
+
+
+def channel_mix(rows: Sequence[dict]) -> Dict[str, float]:
+    """Fraction of attributed transitions per channel ({} when empty).
+    The attribution cascade is total, so the fractions sum to exactly
+    1.0 — the bench gate recomputes the sum from here."""
+    counts: Dict[str, int] = {}
+    for r in rows:
+        counts[r["channel"]] = counts.get(r["channel"], 0) + 1
+    total = sum(counts.values())
+    if not total:
+        return {}
+    return {ch: c / total for ch, c in sorted(counts.items())}
+
+
+def provenance_slos(rows: Sequence[dict]) -> dict:
+    """Channel-mix SLOs over the attribution stream:
+
+      - ``removal_via_sync_fraction``: of all REMOVED transitions, the
+        fraction whose winning channel was the SYNC family — how much
+        of the death notice's spread leaned on anti-entropy instead of
+        the infection-style gossip path;
+      - ``dissemination_hops_p99``: p99 over all (subject, transition)
+        groups of (observer's first-informed round − the group's
+        earliest first-informed round) — the relay depth of the
+        epidemic, measured in rounds behind the first carrier.
+    """
+    out: dict = {"channel_mix": channel_mix(rows)}
+    removed = [r for r in rows if r.get("transition") == "REMOVED"]
+    out["removal_via_sync_fraction"] = (
+        sum(1 for r in removed if r["channel"] == "sync") / len(removed)
+        if removed else None)
+    first: Dict[tuple, int] = {}
+    for r in rows:
+        k = (int(r["subject"]), r["transition"])
+        rnd = int(r["round"])
+        if k not in first or rnd < first[k]:
+            first[k] = rnd
+    lags = [int(r["round"]) - first[(int(r["subject"]), r["transition"])]
+            for r in rows]
+    out["dissemination_hops_p99"] = _percentile(lags, 0.99)
+    return out
+
+
+def blame_report(rows: Sequence[dict], subject: int) -> dict:
+    """Who planted the belief that ``subject`` failed, and how it spread.
+
+    Mines the attribution stream for the subject's suspicion funnel
+    (SUSPECTED/REMOVED):
+
+      - ``origin_observer``/``origin_round``/``origin_channel``: the
+        EARLIEST first-hand sighting (fd_direct / pingreq_proxy — the
+        observer whose own failure detector started the rumor; for a
+        false positive under an asymmetric faulty link this names the
+        observer on the broken side);
+      - ``first_carrier_channel``: the channel of the earliest sighting
+        at any OTHER observer — how the rumor first left the origin;
+      - ``refuted``: whether the subject's suspicion was later refuted
+        (an ALIVE_REFUTED/ADDED row for the subject, or the subject's
+        own self-refutation) — True is the false-positive signature;
+      - ``observers_informed``/``onset_round``/``last_round``: spread
+        extent.
+
+    ``verdict`` is "no_suspicion_recorded" when the stream holds no
+    suspicion rows for the subject (nothing to blame).
+    """
+    sight = sorted(
+        (r for r in rows
+         if int(r.get("subject", -1)) == subject
+         and r.get("transition") in SUSPICION_TRANSITIONS),
+        key=lambda r: int(r["round"]))
+    if not sight:
+        return {"subject": subject, "verdict": "no_suspicion_recorded"}
+    onset = sight[0]
+    first_hand = [r for r in sight
+                  if r["channel"] in FIRST_HAND_CHANNELS]
+    origin = first_hand[0] if first_hand else onset
+    carriers = [r for r in sight
+                if int(r["observer"]) != int(origin["observer"])]
+    refuted = any(
+        int(r.get("subject", -1)) == subject
+        and r.get("transition") in ("ALIVE_REFUTED", "ADDED")
+        and int(r["round"]) >= int(onset["round"])
+        for r in rows)
+    return {
+        "subject": subject,
+        "verdict": "refuted_false_positive" if refuted else "suspected",
+        "onset_round": int(onset["round"]),
+        "origin_observer": int(origin["observer"]),
+        "origin_round": int(origin["round"]),
+        "origin_channel": origin["channel"],
+        "origin_first_hand": bool(first_hand),
+        "first_carrier_channel": (carriers[0]["channel"] if carriers
+                                  else None),
+        "observers_informed": len({int(r["observer"]) for r in sight}),
+        "last_round": int(sight[-1]["round"]),
+        "refuted": refuted,
+    }
+
+
+def explain_belief(rows: Sequence[dict], observer: int, subject: int,
+                   round_idx: Optional[int] = None) -> dict:
+    """Answer "why did ``observer`` believe this about ``subject``"
+    from the attribution stream alone — the ``telemetry explain``
+    subcommand's engine.
+
+    Returns every recorded (observer, subject) attribution in round
+    order plus ``answer``: the row in force at ``round_idx`` (the last
+    transition at or before it; the latest transition when ``round_idx``
+    is None).  ``context`` carries the subject's blame report and this
+    observer's infection path, so one query shows the full chain:
+    what the observer believed, via which channel, and who started it.
+    """
+    events = sorted(
+        (r for r in rows
+         if int(r.get("observer", -1)) == observer
+         and int(r.get("subject", -1)) == subject),
+        key=lambda r: int(r["round"]))
+    answer = None
+    if round_idx is None:
+        answer = events[-1] if events else None
+    else:
+        at_or_before = [r for r in events
+                        if int(r["round"]) <= round_idx]
+        answer = at_or_before[-1] if at_or_before else None
+    return {
+        "observer": observer,
+        "subject": subject,
+        "round": round_idx,
+        "events": events,
+        "answer": answer,
+        "context": {
+            "blame": blame_report(rows, subject),
+            "infection_path": infection_paths(rows, subject).get(observer),
+        },
+    }
 
 
 # --------------------------------------------------------------------------
@@ -329,7 +563,8 @@ def load_bench_payload(path: str) -> Tuple[Optional[dict], Optional[str]]:
                      or "findings_total" in payload
                      or "alarm_detection_lag_windows" in payload
                      or "batch_speedup_ratio" in payload
-                     or "rounds_survived" in payload)):
+                     or "rounds_survived" in payload
+                     or "blame_origin_correct" in payload)):
             return None, stub_note
     return payload, None
 
@@ -416,7 +651,21 @@ def regress(paths: Sequence[str],
         byte-identical to the uninterrupted run (journal AND state
         digest), and the live alarm engine quiet.  Smoke soaks are
         provenance unless the walk holds only smoke rounds (the
-        sync-heal fallback rule).
+        sync-heal fallback rule);
+      - Blame-drill artifacts (``blame_origin_correct`` present,
+        bench.py --blame): ABSOLUTE gates — the blame report named the
+        planted faulty-link origin, every recorded transition carried
+        exactly one channel (attribution fractions sum to 1.0 with
+        zero provenance-buffer drops AND zero trace drops — the
+        committed full-provenance artifact must be complete), the
+        off-switch stayed bit-identical (states + metrics),
+        ``provenance_overhead_ratio`` <= 1.10 (absolute — the plane
+        must stay near-free next to the same composed stack without
+        it), and the ``telemetry explain`` probe resolved its seeded
+        (observer, subject) query with the correct channel and round.
+        Smoke drills are provenance unless the walk holds only smoke
+        rounds (the sync-heal fallback rule: `--blame --smoke`'s
+        in-bench check of its own fresh artifact still bites).
 
     Returns (ok, check rows); each row {"check", "latest", "reference",
     "threshold", "ok", "source"}.  Unreadable/failed artifacts — and
@@ -1048,6 +1297,57 @@ def regress(paths: Sequence[str],
                   alarms.get("transitions"), 0, 0,
                   alarms.get("quiet") is True
                   and alarms.get("transitions") == 0)
+        # Blame-drill artifacts (bench.py --blame): the provenance
+        # plane's measured attribution claims, gated ABSOLUTELY on the
+        # latest round (docstring bullet).  Smoke drills are provenance
+        # unless the walk holds only smoke rounds (the sync-heal
+        # fallback rule).
+        bl_all = [(p, pl) for p, pl in entries
+                  if "blame_origin_correct" in pl]
+        bl = [(p, pl) for p, pl in bl_all
+              if not pl.get("smoke")] or bl_all
+        if bl is not bl_all:
+            for p, pl in bl_all:
+                if pl.get("smoke"):
+                    rows.append({
+                        "check": "slo/blame_drill", "source":
+                        os.path.basename(p), "ok": None,
+                        "note": "smoke blame drill — different scale, "
+                                "not a trajectory datum",
+                    })
+        if bl:
+            last_path, last = bl[-1]
+            check("slo/blame_origin_correct", last_path,
+                  last.get("blame_origin_correct"), True, True,
+                  last.get("blame_origin_correct") is True)
+            attr = last.get("attribution") or {}
+            frac = attr.get("total_fraction")
+            check("slo/provenance_attribution_total", last_path, frac,
+                  1.0, 1.0,
+                  isinstance(frac, (int, float))
+                  and math.isfinite(frac) and abs(frac - 1.0) < 1e-9)
+            check("slo/provenance_dropped", last_path,
+                  attr.get("dropped"), 0, 0, attr.get("dropped") == 0)
+            check("slo/trace_dropped_total", last_path,
+                  last.get("trace_dropped_total"), 0, 0,
+                  last.get("trace_dropped_total") == 0)
+            check("slo/provenance_off_switch_identical", last_path,
+                  last.get("off_switch_identical"), True, True,
+                  last.get("off_switch_identical") is True)
+            ratio = last.get("provenance_overhead_ratio")
+            check("slo/provenance_overhead_ratio", last_path, ratio,
+                  1.0, PROVENANCE_OVERHEAD_LIMIT,
+                  isinstance(ratio, (int, float))
+                  and math.isfinite(ratio)
+                  and ratio <= PROVENANCE_OVERHEAD_LIMIT)
+            ex = last.get("explain_check") or {}
+            check("slo/provenance_explain_resolved", last_path,
+                  {k: ex.get(k) for k in
+                   ("resolved", "channel_correct", "round_correct")},
+                  True, True,
+                  ex.get("resolved") is True
+                  and ex.get("channel_correct") is True
+                  and ex.get("round_correct") is True)
     return ok, rows
 
 

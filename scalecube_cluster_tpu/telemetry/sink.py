@@ -44,7 +44,7 @@ import hashlib
 import json
 import os
 import time
-from typing import Dict, Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -323,6 +323,29 @@ class TelemetrySink:
                     f"cursor dedups on round_end)")
         self._write("metrics_window", dict(window))
 
+    def write_provenance(self, payload: dict, batch: int = 2000) -> None:
+        """Channel-attribution rows (models/provenance.py's
+        ``attributions_payload``) as ``provenance`` records, chunked so
+        single lines stay parseable-sized.  EVERY chunk carries the
+        buffer accounting (``recorded``/``dropped``/``capacity`` — they
+        are totals, idempotent across chunks), so a reader holding any
+        one chunk knows whether the stream is complete; a truncated
+        attribution stream is never mistaken for a whole one (the
+        write_events ``dropped`` discipline)."""
+        rows = list(payload.get("rows", []))
+        acct = {k: int(payload[k])
+                for k in ("recorded", "dropped", "capacity")
+                if k in payload}
+        if not rows:
+            self._write("provenance", {"offset": 0, "rows": [], **acct})
+            return
+        for i in range(0, len(rows), batch):
+            self._write("provenance", {
+                "offset": i,
+                "rows": rows[i:i + batch],
+                **acct,
+            })
+
     def write_record(self, kind: str, payload: dict) -> None:
         """Generic typed row for schema extensions that don't warrant a
         dedicated writer (the chaos verdict rows — module docstring).
@@ -529,6 +552,25 @@ def read_events(path: str) -> List[MembershipTraceEvent]:
             MembershipTraceEvent.from_json(e) for e in rec["events"]
         )
     return events
+
+
+def read_provenance(path: str) -> Tuple[List[dict], dict]:
+    """The journal's channel-attribution stream: (rows, accounting).
+
+    Rows concatenate across ``provenance`` chunks in offset order (the
+    writer emits them in order; the sort makes a merged journal safe);
+    accounting is the LAST chunk's recorded/dropped/capacity totals
+    (idempotent across chunks — write_provenance's contract)."""
+    chunks = read_records(path, kind="provenance")
+    chunks.sort(key=lambda r: int(r.get("offset", 0)))
+    rows: List[dict] = []
+    acct: dict = {}
+    for rec in chunks:
+        rows.extend(rec.get("rows", []))
+        for k in ("recorded", "dropped", "capacity"):
+            if k in rec:
+                acct[k] = int(rec[k])
+    return rows, acct
 
 
 def fraction_informed_curve(dead_counts, n_live_observers: int):

@@ -488,3 +488,37 @@ def test_watch_tails_live_subprocess_exactly_once(tmp_path):
     assert summary["kind"] == "watch_summary"
     assert summary["windows"] == n and summary["run_ended"] is True
     assert summary["engine_transitions"] == 2
+
+
+def test_watch_counts_unknown_record_kinds(tmp_path):
+    """A journal written by a newer schema (e.g. ``provenance`` rows
+    landing on an old reader) degrades LOUDLY: watch emits ONE
+    unknown_record_kind notice per kind on first sight and counts every
+    occurrence into the watch_summary — never a silent skip."""
+    path = tmp_path / "newer_schema.jsonl"
+    with tsink.TelemetrySink(path=str(path)) as s:
+        s.write_manifest(params={"n": 8})
+        s.write_metrics_window(row(0, 4, 0))
+        s.write_provenance({"rows": [
+            {"observer": 1, "subject": 3, "epoch": 0,
+             "transition": "SUSPECTED", "channel": "gossip",
+             "round": 2}], "recorded": 1, "dropped": 0,
+            "capacity": 64})
+        s.write_provenance({"rows": [], "recorded": 1, "dropped": 0,
+                            "capacity": 64})
+        s.write_summary(windows=1)
+    env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu")
+    watch = subprocess.run(
+        [sys.executable, "-m", "scalecube_cluster_tpu.telemetry",
+         "watch", str(path), "--json", "--interval", "0.05",
+         "--threshold", "0.5", "--max-seconds", "30"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert watch.returncode == 0, watch.stderr
+    lines = [json.loads(ln) for ln in watch.stdout.splitlines()]
+    notices = [ln for ln in lines if ln["kind"] == "unknown_record_kind"]
+    assert len(notices) == 1                 # first sight only
+    assert notices[0]["record_kind"] == "provenance"
+    summary = lines[-1]
+    assert summary["kind"] == "watch_summary"
+    assert summary["unknown_kinds"]["provenance"] == 2
+    assert summary["windows"] == 1 and summary["run_ended"] is True

@@ -81,6 +81,34 @@ def test_load_report_merges_windows(tmp_path):
     assert slos["rounds_covered"] == 64
 
 
+def test_trace_dropped_total_folds_additively(tmp_path):
+    """Every events_footer closes one segment's trace buffer: the
+    report folds the per-segment ``dropped`` counts ADDITIVELY into the
+    ``trace_dropped_total`` counter lane, and compute_slos surfaces it
+    first-class — a truncated event stream can't pass for a complete
+    one.  Journals with no event stream at all read as None, not 0."""
+    from scalecube_cluster_tpu.telemetry.events import (
+        MembershipTraceEvent, TraceEventType)
+
+    path = tmp_path / "a.jsonl"
+    ev = MembershipTraceEvent(round=1, observer=0, subject=3,
+                              event_type=TraceEventType.SUSPECTED,
+                              incarnation=0)
+    with tsink.TelemetrySink(path=str(path)) as sink:
+        sink.write_manifest(params={"n": 8})
+        sink.write_metrics_window(window(0, 32))
+        sink.write_events([ev], dropped=3)       # segment 1
+        sink.write_metrics_window(window(32, 64))
+        sink.write_events([ev], dropped=2)       # segment 2
+    r = query.load_report(str(path))
+    assert r.counters["trace_dropped_total"] == 5
+    assert query.compute_slos(r)["trace_dropped_total"] == 5
+
+    clean = write_manifest(tmp_path / "clean.jsonl", [window(0, 32)])
+    slos = query.compute_slos(query.load_report(clean))
+    assert slos["trace_dropped_total"] is None
+
+
 def test_percentile_from_histogram():
     # 10 samples in [0,4), 10 in [4,16): p50 = upper edge of bucket 0.
     assert query.percentile_from_histogram([0, 4, 16], [10, 10], 0.5) \
